@@ -1,0 +1,84 @@
+"""deepspeed_tpu — a TPU-native training framework with the capability
+surface of DeepSpeed v0.3.2 (see SURVEY.md), built on JAX/XLA/Pallas.
+
+Public entry point mirrors the reference (reference: deepspeed/__init__.py:47):
+
+    engine, optimizer, dataloader, lr_schedule = deepspeed_tpu.initialize(
+        model=my_model, config=ds_config_dict_or_path, ...)
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Any, Optional
+
+from .version import __version__
+from .config import DeepSpeedConfig, DeepSpeedConfigError
+from .runtime.engine import DeepSpeedEngine
+from .runtime.module import TrainModule, FunctionalModule, FlaxModule
+from .runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+
+
+def initialize(args=None,
+               model: Optional[TrainModule] = None,
+               optimizer=None,
+               params: Optional[Any] = None,
+               training_data=None,
+               lr_scheduler=None,
+               mesh=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               seed: int = 0):
+    """Create the engine (reference: deepspeed/__init__.py:47-136).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)`` —
+    same 4-tuple contract as the reference.  ``config`` may be a path to a
+    ds_config.json or a dict (``config_params`` alias kept for parity).
+    Dispatches to the pipeline engine when ``model`` is a PipelineModule.
+    """
+    assert model is not None, "deepspeed_tpu.initialize requires a model"
+    cfg_src = config if config is not None else config_params
+    if cfg_src is None and args is not None:
+        cfg_src = getattr(args, "deepspeed_config", None)
+    if cfg_src is None:
+        raise DeepSpeedConfigError("No DeepSpeed config provided")
+
+    from .parallel.mesh import build_mesh, mesh_axis_size, DATA_AXIS
+    from .pipe.module import PipelineModule
+
+    if isinstance(model, PipelineModule):
+        from .pipe.engine import PipelineEngine
+        if mesh is None:
+            mesh = build_mesh(pp=model.num_stages)
+        cfg = DeepSpeedConfig(cfg_src,
+                              world_size=mesh_axis_size(mesh, DATA_AXIS))
+        engine = PipelineEngine(model=model, config=cfg, mesh=mesh,
+                                optimizer=optimizer,
+                                lr_schedule=lr_scheduler,
+                                training_data=training_data,
+                                collate_fn=collate_fn, seed=seed)
+    else:
+        if mesh is None:
+            mesh = build_mesh()
+        cfg = DeepSpeedConfig(cfg_src,
+                              world_size=mesh_axis_size(mesh, DATA_AXIS))
+        engine = DeepSpeedEngine(model=model, config=cfg, mesh=mesh,
+                                 optimizer=optimizer,
+                                 lr_schedule=lr_scheduler, params=params,
+                                 training_data=training_data,
+                                 collate_fn=collate_fn, seed=seed)
+    return engine, engine.optimizer, engine.training_dataloader, lr_scheduler
+
+
+def add_config_arguments(parser: argparse.ArgumentParser):
+    """argparse plumbing (reference: deepspeed/__init__.py:139-203)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag, parity only)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the DeepSpeed json config file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse.SUPPRESS)  # deprecated alias
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help=argparse.SUPPRESS)
+    return parser

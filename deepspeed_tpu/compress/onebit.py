@@ -1,0 +1,11 @@
+"""1-bit Adam (error-feedback sign compression over the data axis).
+
+Implementation lands with the compression milestone; this placeholder keeps
+the engine's optimizer dispatch importable with a clear error.
+"""
+from __future__ import annotations
+
+
+def onebit_adam(*args, **kwargs):
+    raise NotImplementedError(
+        "onebitadam is not implemented yet in this build; use 'adam'")

@@ -1,0 +1,11 @@
+from .config import (
+    DeepSpeedConfig,
+    DeepSpeedConfigError,
+    DeepSpeedZeroConfig,
+    DeepSpeedFP16Config,
+    DeepSpeedBF16Config,
+    DeepSpeedActivationCheckpointingConfig,
+    DeepSpeedSparseAttentionConfig,
+    DeepSpeedPipelineConfig,
+)
+from . import constants

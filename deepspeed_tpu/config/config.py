@@ -1,0 +1,350 @@
+"""ds_config.json → typed config.
+
+Behavioral contract mirrors the reference parser
+(reference: deepspeed/runtime/config.py:485-694): same key surface, the
+batch-size triangle solver ``train_batch = micro_batch × grad_acc ×
+world_size`` (config.py:586-636 there), duplicate-JSON-key rejection
+(config_utils.py there), and the same sanity checks — re-expressed as
+plain dataclass-style objects with no torch anywhere.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from . import constants as C
+from ..utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+def _dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate top-level keys instead of silently last-wins."""
+    d = dict(ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counts = {}
+        for k, _ in ordered_pairs:
+            counts[k] = counts.get(k, 0) + 1
+        dupes = [k for k, n in counts.items() if n > 1]
+        raise DeepSpeedConfigError(f"Duplicate keys in DeepSpeed config: {dupes}")
+    return d
+
+
+def get_scalar_param(d: Dict, key: str, default):
+    return d.get(key, default) if d is not None else default
+
+
+class DeepSpeedZeroConfig:
+    """ZeRO block. Accepts both the dict form and the deprecated bool form
+    (reference: deepspeed/runtime/zero/config.py:34-47)."""
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        zero = param_dict.get(C.ZERO_OPTIMIZATION, None)
+        if zero is None:
+            zero = {}
+        elif isinstance(zero, bool):  # deprecated style: "zero_optimization": true
+            logger.warning(
+                "zero_optimization boolean form is deprecated; use {'stage': n}"
+            )
+            zero = {C.ZERO_STAGE: 1 if zero else 0}
+        if not isinstance(zero, dict):
+            raise DeepSpeedConfigError(
+                f"{C.ZERO_OPTIMIZATION} must be a dict or bool, got {type(zero)}"
+            )
+        self.stage = get_scalar_param(zero, C.ZERO_STAGE, C.ZERO_STAGE_DEFAULT)
+        self.allgather_partitions = get_scalar_param(
+            zero, C.ZERO_ALLGATHER_PARTITIONS, C.ZERO_ALLGATHER_PARTITIONS_DEFAULT)
+        self.reduce_scatter = get_scalar_param(
+            zero, C.ZERO_REDUCE_SCATTER, C.ZERO_REDUCE_SCATTER_DEFAULT)
+        self.overlap_comm = get_scalar_param(
+            zero, C.ZERO_OVERLAP_COMM, C.ZERO_OVERLAP_COMM_DEFAULT)
+        self.contiguous_gradients = get_scalar_param(
+            zero, C.ZERO_CONTIGUOUS_GRADIENTS, C.ZERO_CONTIGUOUS_GRADIENTS_DEFAULT)
+        self.reduce_bucket_size = get_scalar_param(
+            zero, C.ZERO_REDUCE_BUCKET_SIZE, C.ZERO_REDUCE_BUCKET_SIZE_DEFAULT)
+        self.allgather_bucket_size = get_scalar_param(
+            zero, C.ZERO_ALLGATHER_BUCKET_SIZE, C.ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT)
+        self.max_elements_per_comm = get_scalar_param(
+            zero, C.ZERO_MAX_ELEMENTS_PER_COMM, C.ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT)
+        self.cpu_offload = get_scalar_param(
+            zero, C.ZERO_CPU_OFFLOAD, C.ZERO_CPU_OFFLOAD_DEFAULT)
+        self.elastic_checkpoint = get_scalar_param(
+            zero, C.ZERO_ELASTIC_CHECKPOINT, C.ZERO_ELASTIC_CHECKPOINT_DEFAULT)
+
+        if not isinstance(self.stage, int) or not (
+                C.ZERO_OPTIMIZATION_DISABLED <= self.stage <= C.MAX_STAGE_ZERO_OPTIMIZATION):
+            raise DeepSpeedConfigError(
+                f"ZeRO stage must be an int in [0, {C.MAX_STAGE_ZERO_OPTIMIZATION}], "
+                f"got {self.stage!r}")
+
+    def repr_dict(self):
+        return {
+            C.ZERO_STAGE: self.stage,
+            C.ZERO_ALLGATHER_PARTITIONS: self.allgather_partitions,
+            C.ZERO_REDUCE_SCATTER: self.reduce_scatter,
+            C.ZERO_OVERLAP_COMM: self.overlap_comm,
+            C.ZERO_CONTIGUOUS_GRADIENTS: self.contiguous_gradients,
+            C.ZERO_REDUCE_BUCKET_SIZE: self.reduce_bucket_size,
+            C.ZERO_ALLGATHER_BUCKET_SIZE: self.allgather_bucket_size,
+            C.ZERO_CPU_OFFLOAD: self.cpu_offload,
+            C.ZERO_ELASTIC_CHECKPOINT: self.elastic_checkpoint,
+        }
+
+
+class DeepSpeedActivationCheckpointingConfig:
+    """Activation-checkpointing block → remat policy knobs
+    (reference: deepspeed/runtime/activation_checkpointing/config.py)."""
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        act = param_dict.get(C.ACTIVATION_CHECKPOINTING) or {}
+        self.partition_activations = get_scalar_param(
+            act, C.ACT_CKPT_PARTITION_ACTIVATIONS,
+            C.ACT_CKPT_PARTITION_ACTIVATIONS_DEFAULT)
+        self.contiguous_memory_optimization = get_scalar_param(
+            act, C.ACT_CKPT_CONTIGUOUS_MEMORY_OPTIMIZATION,
+            C.ACT_CKPT_CONTIGUOUS_MEMORY_OPTIMIZATION_DEFAULT)
+        self.cpu_checkpointing = get_scalar_param(
+            act, C.ACT_CKPT_CPU_CHECKPOINTING, C.ACT_CKPT_CPU_CHECKPOINTING_DEFAULT)
+        self.number_checkpoints = get_scalar_param(
+            act, C.ACT_CKPT_NUMBER_CHECKPOINTS, C.ACT_CKPT_NUMBER_CHECKPOINTS_DEFAULT)
+        self.synchronize_checkpoint_boundary = get_scalar_param(
+            act, C.ACT_CKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY,
+            C.ACT_CKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT)
+        self.profile = get_scalar_param(
+            act, C.ACT_CKPT_PROFILE, C.ACT_CKPT_PROFILE_DEFAULT)
+
+
+class DeepSpeedFP16Config:
+    def __init__(self, param_dict: Dict[str, Any]):
+        fp16 = param_dict.get(C.FP16) or {}
+        self.enabled = get_scalar_param(fp16, C.FP16_ENABLED, C.FP16_ENABLED_DEFAULT)
+        self.loss_scale = get_scalar_param(
+            fp16, C.FP16_LOSS_SCALE, C.FP16_LOSS_SCALE_DEFAULT)
+        self.initial_scale_power = get_scalar_param(
+            fp16, C.FP16_INITIAL_SCALE_POWER, C.FP16_INITIAL_SCALE_POWER_DEFAULT)
+        self.loss_scale_window = get_scalar_param(
+            fp16, C.FP16_LOSS_SCALE_WINDOW, C.FP16_LOSS_SCALE_WINDOW_DEFAULT)
+        self.hysteresis = get_scalar_param(
+            fp16, C.FP16_HYSTERESIS, C.FP16_HYSTERESIS_DEFAULT)
+        self.min_loss_scale = get_scalar_param(
+            fp16, C.FP16_MIN_LOSS_SCALE, C.FP16_MIN_LOSS_SCALE_DEFAULT)
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0
+
+    @property
+    def initial_dynamic_scale(self) -> float:
+        return 2 ** self.initial_scale_power
+
+
+class DeepSpeedBF16Config:
+    """TPU-native precision block (extension; bf16 needs no loss scale)."""
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        bf16 = param_dict.get(C.BF16) or {}
+        self.enabled = get_scalar_param(bf16, C.BF16_ENABLED, C.BF16_ENABLED_DEFAULT)
+
+
+class DeepSpeedSparseAttentionConfig:
+    def __init__(self, param_dict: Dict[str, Any]):
+        sa = param_dict.get(C.SPARSE_ATTENTION)
+        self.enabled = sa is not None
+        self.params: Optional[Dict[str, Any]] = dict(sa) if sa else None
+        if sa is not None:
+            mode = sa.get(C.SPARSE_MODE, C.SPARSE_MODE_DEFAULT)
+            valid = {C.SPARSE_DENSE_MODE, C.SPARSE_FIXED_MODE, C.SPARSE_VARIABLE_MODE,
+                     C.SPARSE_BIGBIRD_MODE, C.SPARSE_BSLONGFORMER_MODE}
+            if mode not in valid:
+                raise DeepSpeedConfigError(f"Invalid sparse attention mode {mode!r}")
+            self.mode = mode
+        else:
+            self.mode = None
+
+
+class DeepSpeedPLDConfig:
+    def __init__(self, param_dict: Dict[str, Any]):
+        pld = param_dict.get(C.PROGRESSIVE_LAYER_DROP) or {}
+        self.enabled = get_scalar_param(pld, C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT)
+        self.theta = get_scalar_param(pld, C.PLD_THETA, C.PLD_THETA_DEFAULT)
+        self.gamma = get_scalar_param(pld, C.PLD_GAMMA, C.PLD_GAMMA_DEFAULT)
+
+
+class DeepSpeedTensorboardConfig:
+    def __init__(self, param_dict: Dict[str, Any]):
+        tb = param_dict.get(C.TENSORBOARD) or {}
+        self.enabled = get_scalar_param(
+            tb, C.TENSORBOARD_ENABLED, C.TENSORBOARD_ENABLED_DEFAULT)
+        self.output_path = get_scalar_param(
+            tb, C.TENSORBOARD_OUTPUT_PATH, C.TENSORBOARD_OUTPUT_PATH_DEFAULT)
+        self.job_name = get_scalar_param(
+            tb, C.TENSORBOARD_JOB_NAME, C.TENSORBOARD_JOB_NAME_DEFAULT)
+
+
+class DeepSpeedPipelineConfig:
+    def __init__(self, param_dict: Dict[str, Any]):
+        pipe = param_dict.get(C.PIPELINE) or {}
+        self.stages = get_scalar_param(
+            pipe, C.PIPELINE_STAGES, C.PIPELINE_STAGES_DEFAULT)
+        self.partition = get_scalar_param(
+            pipe, C.PIPELINE_PARTITION, C.PIPELINE_PARTITION_DEFAULT)
+        self.seed_layers = get_scalar_param(
+            pipe, C.PIPELINE_SEED_LAYERS, C.PIPELINE_SEED_LAYERS_DEFAULT)
+        self.activation_checkpoint_interval = get_scalar_param(
+            pipe, C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL,
+            C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT)
+
+
+class DeepSpeedConfig:
+    """Parse a ds_config path or dict; solve + validate the batch triangle.
+
+    ``world_size`` is the number of data-parallel replicas (mesh ``data``-axis
+    size on TPU — the analogue of the reference's DP world size).
+    """
+
+    def __init__(self, config: Any, world_size: int = 1):
+        if isinstance(config, (str,)):
+            with open(config, "r") as f:
+                self._param_dict = json.load(
+                    f, object_pairs_hook=_dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = config
+        else:
+            raise DeepSpeedConfigError(
+                f"Expected a config path or dict, got {type(config)}")
+
+        self.world_size = world_size
+        pd = self._param_dict
+
+        self.train_batch_size = pd.get(C.TRAIN_BATCH_SIZE, C.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = pd.get(
+            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = pd.get(
+            C.GRADIENT_ACCUMULATION_STEPS, C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+        self.steps_per_print = pd.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = pd.get(C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.wall_clock_breakdown = pd.get(
+            C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = pd.get(C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
+
+        self.gradient_clipping = pd.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = pd.get(
+            C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = pd.get(
+            C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = pd.get(
+            C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        self.allreduce_always_fp32 = pd.get(
+            C.ALLREDUCE_ALWAYS_FP32, C.ALLREDUCE_ALWAYS_FP32_DEFAULT)
+        self.disable_allgather = pd.get(
+            C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT)
+
+        opt = pd.get(C.OPTIMIZER)
+        self.optimizer_name = opt.get(C.TYPE) if opt else None
+        if self.optimizer_name is not None:
+            self.optimizer_name = self.optimizer_name.lower()
+        self.optimizer_params = (opt.get(C.OPTIMIZER_PARAMS) if opt else None) or {}
+        self.optimizer_legacy_fusion = (
+            opt.get(C.LEGACY_FUSION, C.LEGACY_FUSION_DEFAULT) if opt else False)
+
+        sched = pd.get(C.SCHEDULER)
+        self.scheduler_name = sched.get(C.TYPE) if sched else None
+        self.scheduler_params = (sched.get(C.SCHEDULER_PARAMS) if sched else None) or {}
+
+        self.fp16 = DeepSpeedFP16Config(pd)
+        self.bf16 = DeepSpeedBF16Config(pd)
+        self.zero_config = DeepSpeedZeroConfig(pd)
+        self.activation_checkpointing_config = (
+            DeepSpeedActivationCheckpointingConfig(pd))
+        self.sparse_attention_config = DeepSpeedSparseAttentionConfig(pd)
+        self.pld_config = DeepSpeedPLDConfig(pd)
+        self.tensorboard_config = DeepSpeedTensorboardConfig(pd)
+        self.pipeline_config = DeepSpeedPipelineConfig(pd)
+
+        self._solve_batch_triangle()
+        self._do_sanity_check()
+
+    # ---- compat properties matching reference attribute names ----
+    @property
+    def fp16_enabled(self):
+        return self.fp16.enabled
+
+    @property
+    def bf16_enabled(self):
+        return self.bf16.enabled
+
+    @property
+    def loss_scale(self):
+        return self.fp16.loss_scale
+
+    @property
+    def zero_enabled(self):
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self):
+        return self.zero_config.stage
+
+    def _solve_batch_triangle(self):
+        """Solve train_batch = micro_batch * grad_acc * world_size given any
+        subset (reference: runtime/config.py:586-636)."""
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        accum = self.gradient_accumulation_steps
+        ws = self.world_size
+
+        if train is not None and micro is not None and accum is not None:
+            pass  # fully specified; checked below
+        elif train is not None and micro is not None:
+            accum = train // (micro * ws)
+        elif train is not None and accum is not None:
+            micro = train // (ws * accum)
+        elif micro is not None and accum is not None:
+            train = micro * accum * ws
+        elif train is not None:
+            accum = 1
+            micro = train // ws
+        elif micro is not None:
+            train = micro * ws
+            accum = 1
+        else:
+            raise DeepSpeedConfigError(
+                "At least one of train_batch_size or "
+                "train_micro_batch_size_per_gpu must be set")
+
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = accum
+
+        if train != micro * accum * ws:
+            raise DeepSpeedConfigError(
+                f"Batch triangle check failed: train_batch_size={train} != "
+                f"micro_batch={micro} * grad_acc={accum} * world_size={ws}")
+        for name, v in [("train_batch_size", train),
+                        ("train_micro_batch_size_per_gpu", micro),
+                        ("gradient_accumulation_steps", accum)]:
+            if not isinstance(v, int) or v <= 0:
+                raise DeepSpeedConfigError(f"{name} must be a positive int, got {v!r}")
+
+    def _do_sanity_check(self):
+        if self.zero_enabled and not (self.fp16_enabled or self.bf16_enabled):
+            # The reference requires fp16 for ZeRO (config.py:664 there); on
+            # TPU we additionally accept bf16 (the native dtype).
+            raise DeepSpeedConfigError(
+                "ZeRO optimization requires fp16 or bf16 to be enabled")
+        if self.zero_config.cpu_offload and self.zero_config.stage < 2:
+            raise DeepSpeedConfigError(
+                "cpu_offload requires ZeRO stage >= 2")
+        if self.optimizer_name is not None and self.optimizer_name in (
+                C.ONEBIT_ADAM_OPTIMIZER,) and not (self.fp16_enabled or self.bf16_enabled):
+            raise DeepSpeedConfigError("onebitadam requires fp16 or bf16")
+
+    def print_config(self):
+        logger.info("DeepSpeedConfig:")
+        for k in ("train_batch_size", "train_micro_batch_size_per_gpu",
+                  "gradient_accumulation_steps", "world_size", "optimizer_name",
+                  "scheduler_name", "gradient_clipping"):
+            logger.info("  %s: %s", k, getattr(self, k))
+        logger.info("  zero: %s", self.zero_config.repr_dict())

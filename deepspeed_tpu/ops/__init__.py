@@ -1,0 +1,2 @@
+from .adam import fused_adam, FusedAdamState
+from .lamb import fused_lamb, FusedLambState

@@ -1,0 +1,93 @@
+"""Fused Adam/AdamW — optax-compatible, single-kernel-per-step on TPU.
+
+Replaces the reference's multi-tensor-apply CUDA Adam
+(reference: csrc/adam/multi_tensor_adam.cu:163, ops/adam/fused_adam.py:15).
+On TPU the "fusion" is XLA's: the whole tree-mapped update compiles into a
+few fused loops over HBM, so no hand-written kernel is needed — the value
+preserved here is the exact update rule and the knob surface (adam_w_mode,
+bias_correction, per-group lr) rather than kernel plumbing.
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+ScalarOrSchedule = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
+
+
+class FusedAdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+def _lr_at(lr: ScalarOrSchedule, count):
+    return lr(count) if callable(lr) else jnp.asarray(lr, jnp.float32)
+
+
+def fused_adam(lr: ScalarOrSchedule = 1e-3,
+               betas: Tuple[float, float] = (0.9, 0.999),
+               eps: float = 1e-8,
+               weight_decay: float = 0.0,
+               adam_w_mode: bool = True,
+               bias_correction: bool = True,
+               weight_decay_mask: Optional[Callable] = None
+               ) -> optax.GradientTransformation:
+    """AdamW (``adam_w_mode=True``, decoupled decay) or classic Adam with L2
+    folded into the gradient (``adam_w_mode=False``) — the same truth table
+    as the reference wrapper (ops/adam/fused_adam.py:15-60 there).
+
+    ``weight_decay_mask(params) -> bool pytree`` optionally exempts leaves
+    (e.g. biases / LayerNorm scales) from decay.
+    """
+    b1, b2 = betas
+
+    def init_fn(params):
+        mu = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        nu = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        return FusedAdamState(count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adam requires params for weight decay")
+        count = state.count + 1
+        step_lr = _lr_at(lr, count)
+
+        if weight_decay != 0.0 and not adam_w_mode:
+            decay_mask = (weight_decay_mask(params) if weight_decay_mask
+                          else jax.tree.map(lambda _: True, params))
+            grads = jax.tree.map(
+                lambda g, p, m: g + weight_decay * p if m else g,
+                grads, params, decay_mask)
+
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * (g * g),
+                          state.nu, grads)
+
+        if bias_correction:
+            c1 = 1 - b1 ** count.astype(jnp.float32)
+            c2 = 1 - b2 ** count.astype(jnp.float32)
+        else:
+            c1 = c2 = jnp.asarray(1.0, jnp.float32)
+
+        def adam_update(m, v):
+            m_hat = m / c1
+            v_hat = v / c2
+            return m_hat / (jnp.sqrt(v_hat) + eps)
+
+        updates = jax.tree.map(adam_update, mu, nu)
+
+        if weight_decay != 0.0 and adam_w_mode:
+            decay_mask = (weight_decay_mask(params) if weight_decay_mask
+                          else jax.tree.map(lambda _: True, params))
+            updates = jax.tree.map(
+                lambda u, p, m: u + weight_decay * p.astype(u.dtype) if m else u,
+                updates, params, decay_mask)
+
+        updates = jax.tree.map(lambda u: -step_lr * u, updates)
+        return updates, FusedAdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,19 @@
+from .topology import (
+    ProcessTopology,
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    ParallelGrid,
+)
+from .partition import partition_uniform, partition_balanced
+from .mesh import (
+    build_mesh,
+    single_device_mesh,
+    mesh_axis_size,
+    replicated,
+    data_sharded,
+    PIPE_AXIS,
+    DATA_AXIS,
+    MODEL_AXIS,
+    DEFAULT_AXES,
+)
+from . import collectives

@@ -1,0 +1,76 @@
+"""Named-axis collective wrappers for use inside ``shard_map``-ed code.
+
+The TPU-native communication backend (reference inventory: SURVEY.md §2.5;
+reference backend = torch.distributed/NCCL at deepspeed/runtime/engine.py:130
+plus pair-group broadcast p2p at runtime/pipe/p2p.py:31-55).  Mapping:
+
+  dist.all_reduce      → psum / pmean        (XLA all-reduce over ICI)
+  dist.reduce_scatter  → reduce_scatter      (lax.psum_scatter)
+  dist.all_gather      → all_gather
+  pipe p2p send/recv   → ppermute_shift      (neighbor exchange on the ring)
+  dist.broadcast       → pbroadcast_from
+
+Under jit+GSPMD most of these are implicit in sharding annotations; these
+explicit forms exist for shard_map regions (pipeline schedules, 1-bit Adam)
+where manual placement is the point.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x, axis: str):
+    return lax.psum(x, axis_name=axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis_name=axis)
+
+
+def pmax(x, axis: str):
+    return lax.pmax(x, axis_name=axis)
+
+
+def reduce_scatter(x, axis: str, scatter_dimension: int = 0, tiled: bool = True):
+    """Sum-reduce over ``axis`` and leave each participant with its shard —
+    the ZeRO gradient-partition primitive (reference: stage1.py:583,
+    stage2.py:675-738 reimplemented as one XLA op)."""
+    return lax.psum_scatter(x, axis_name=axis,
+                            scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def all_gather(x, axis: str, gather_dimension: int = 0, tiled: bool = True):
+    """Reassemble shards along ``axis`` — the ZeRO param all-gather
+    (reference: stage2.py:1438-1471)."""
+    return lax.all_gather(x, axis_name=axis, axis=gather_dimension, tiled=tiled)
+
+
+def ppermute_shift(x, axis: str, shift: int = 1, wrap: bool = True):
+    """Send to the ``+shift`` neighbor along ``axis`` (pipeline p2p; replaces
+    the pair-group broadcast trick at reference runtime/pipe/p2p.py:31-55).
+    With ``wrap=False`` the first ``shift`` participants receive zeros."""
+    n = lax.axis_size(axis)
+    if wrap:
+        perm = [(i, (i + shift) % n) for i in range(n)]
+    else:
+        perm = [(i, i + shift) for i in range(n) if 0 <= i + shift < n]
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def pbroadcast_from(x, axis: str, root: int = 0):
+    """Broadcast the root participant's value to all along ``axis``."""
+    idx = lax.axis_index(axis)
+    zero = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(zero, axis_name=axis)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return lax.axis_size(axis)

@@ -1,0 +1,67 @@
+"""Device-mesh construction — the TPU replacement for the reference's
+process-group zoo (reference: deepspeed/runtime/pipe/topology.py:252-455 and
+the NCCL init at runtime/engine.py:125-145).
+
+One ``jax.sharding.Mesh`` with named axes replaces all NCCL communicators:
+  - ``data``  axis ↔ DP groups (gradient psum / ZeRO reduce-scatter)
+  - ``model`` axis ↔ Megatron slice groups (TP collectives)
+  - ``pipe``  axis ↔ stage p2p pair groups (ppermute)
+Axis order places ``pipe`` outermost (slow links OK — p2p is latency-bound,
+low volume) and ``model`` innermost (fastest ICI — TP collectives are in the
+critical path of every matmul), matching the scaling-book recipe and the
+reference's own axis-ordering rationale (topology.py:235-243).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+DEFAULT_AXES: Tuple[str, str, str] = (PIPE_AXIS, DATA_AXIS, MODEL_AXIS)
+
+
+def build_mesh(pp: int = 1,
+               dp: Optional[int] = None,
+               tp: int = 1,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Build a (pipe, data, model) mesh over the available devices.
+
+    ``dp=None`` absorbs whatever device count remains after pp×tp.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if dp is None:
+        if n % (pp * tp) != 0:
+            raise ValueError(
+                f"device count {n} not divisible by pp*tp={pp * tp}")
+        dp = n // (pp * tp)
+    if pp * dp * tp != n:
+        raise ValueError(
+            f"pp*dp*tp = {pp}*{dp}*{tp} != device count {n}")
+    dev_array = np.asarray(devices).reshape(pp, dp, tp)
+    return Mesh(dev_array, DEFAULT_AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return build_mesh(pp=1, dp=1, tp=1, devices=jax.devices()[:1])
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh, rank: int = 1, batch_dim: int = 0) -> NamedSharding:
+    """Batch sharding over the data axis for an array of given rank."""
+    spec = [None] * rank
+    spec[batch_dim] = DATA_AXIS
+    return NamedSharding(mesh, P(*spec))

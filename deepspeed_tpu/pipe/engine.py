@@ -1,0 +1,14 @@
+"""Pipeline-parallel engine (1F1B over the ``pipe`` mesh axis).
+
+Implementation lands with the pipeline milestone; this placeholder keeps
+``deepspeed_tpu.initialize`` dispatch importable with a clear error instead
+of a ModuleNotFoundError.
+"""
+from __future__ import annotations
+
+
+class PipelineEngine:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "PipelineEngine is not implemented yet in this build; "
+            "use a non-pipeline model or ZeRO data parallelism meanwhile")

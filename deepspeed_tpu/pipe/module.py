@@ -1,0 +1,210 @@
+"""Declarative pipeline-parallel model description.
+
+(reference: deepspeed/runtime/pipe/module.py:23-575 — LayerSpec lazy build,
+TiedLayerSpec, partitioning by parameters/uniform/type:regex.)
+
+A PipelineModule is a *declaration*: an ordered list of layer specs plus a
+partitioning policy.  Stage assignment is pure math (parallel/partition.py);
+execution lives in pipe/engine.py, which runs the stages under shard_map
+over the ``pipe`` mesh axis with ppermute for activations.
+
+Layer contract (functional, TPU-style): each built layer is an object with
+``init(rng) -> params`` and ``apply(params, x, rng, train) -> x``.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+
+from ..parallel.partition import partition_balanced, partition_uniform
+from ..utils.logging import logger
+
+
+class LayerSpec:
+    """Lazily-built layer (reference: pipe/module.py:23-68): stores the
+    constructor + args so each stage materializes only its own layers."""
+
+    def __init__(self, typename: Callable, *args, **kwargs):
+        self.typename = typename
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.args, **self.kwargs)
+
+    @property
+    def name(self) -> str:
+        return getattr(self.typename, "__name__", str(self.typename))
+
+    def __repr__(self):
+        return f"LayerSpec({self.name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer whose params are shared with every other TiedLayerSpec of the
+    same key (reference: pipe/module.py:71-82).  On TPU the tied params live
+    once in the param tree under ``tied/<key>`` and every tied layer reads
+    them; the gradient psum over stages replaces the tied-group allreduce
+    (reference: pipe/module.py:405-418)."""
+
+    def __init__(self, key: str, typename: Callable, *args,
+                 forward_fn: Optional[Callable] = None, **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+class PipelineModule:
+    """Ordered layer list + stage partitioning.
+
+    partition_method (reference: pipe/module.py:348-403):
+      - 'uniform'          — equal layer counts
+      - 'parameters'       — balance by parameter count
+      - 'type:<regex>'     — balance count of layers whose class name matches
+    """
+
+    def __init__(self,
+                 layers: Sequence[LayerSpec],
+                 num_stages: int,
+                 loss_fn: Optional[Callable] = None,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0,
+                 seed_layers: bool = False,
+                 base_seed: int = 1234):
+        self.specs: List[LayerSpec] = list(layers)
+        for s in self.specs:
+            if not isinstance(s, LayerSpec):
+                raise TypeError(f"layers must be LayerSpec, got {type(s)}")
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.parts = self._partition_layers()
+        self._built_layers: Optional[List[Any]] = None
+
+    # ----- partitioning (pure math, testable without devices) -----
+    def _count_layer_params(self, spec: LayerSpec) -> int:
+        layer = spec.build()
+        if hasattr(layer, "param_count"):
+            return max(int(layer.param_count()), 1)
+        if hasattr(layer, "init"):
+            try:
+                params = jax.eval_shape(
+                    lambda: layer.init(jax.random.PRNGKey(0)))
+                return max(sum(int(np_prod(l.shape))
+                               for l in jax.tree.leaves(params)), 1)
+            except Exception:
+                return 1
+        return 1
+
+    def _partition_layers(self) -> List[int]:
+        n = len(self.specs)
+        method = self.partition_method.lower()
+        if method == "uniform":
+            parts = partition_uniform(n, self.num_stages)
+        elif method == "parameters":
+            weights = [self._count_layer_params(s) for s in self.specs]
+            parts = partition_balanced(weights, self.num_stages)
+        elif method.startswith("type:"):
+            pat = method[len("type:"):]
+            weights = [1 if re.search(pat, s.name, re.IGNORECASE) else 0
+                       for s in self.specs]
+            # avoid empty-weight degenerate case
+            if sum(weights) == 0:
+                weights = [1] * n
+            parts = partition_balanced(weights, self.num_stages)
+        else:
+            raise ValueError(
+                f"Unknown partition_method {self.partition_method!r}")
+        logger.info("PipelineModule partitions: %s", parts)
+        return parts
+
+    def stage_layer_range(self, stage_id: int):
+        return self.parts[stage_id], self.parts[stage_id + 1]
+
+    # ----- build + functional forward -----
+    def build_layers(self) -> List[Any]:
+        if self._built_layers is None:
+            self._built_layers = [s.build() for s in self.specs]
+        return self._built_layers
+
+    def tied_keys(self) -> List[str]:
+        seen = []
+        for s in self.specs:
+            if isinstance(s, TiedLayerSpec) and s.key not in seen:
+                seen.append(s.key)
+        return seen
+
+    def init(self, rng):
+        """Init ALL layers' params as {'layer_<i>': ..., 'tied': {key: ...}}.
+        Tied specs initialize once (first occurrence owns the params)."""
+        layers = self.build_layers()
+        params = {}
+        tied = {}
+        for i, (spec, layer) in enumerate(zip(self.specs, layers)):
+            lrng = (jax.random.fold_in(jax.random.PRNGKey(self.base_seed), i)
+                    if self.seed_layers else jax.random.fold_in(rng, i))
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in tied:
+                    tied[spec.key] = layer.init(lrng)
+            elif hasattr(layer, "init"):
+                p = layer.init(lrng)
+                if p is not None:
+                    params[f"layer_{i}"] = p
+        if tied:
+            params["tied"] = tied
+        return params
+
+    def apply_layer(self, i: int, params, x, rng, train: bool = True):
+        spec = self.specs[i]
+        layer = self.build_layers()[i]
+        lrng = jax.random.fold_in(rng, i)
+        if isinstance(spec, TiedLayerSpec):
+            p = params["tied"][spec.key]
+            fn = spec.forward_fn
+            if fn is not None:
+                return fn(layer, p, x, lrng, train)
+            return layer.apply(p, x, lrng, train)
+        p = params.get(f"layer_{i}")
+        if p is None:
+            # stateless layer (e.g. reshape/activation)
+            if hasattr(layer, "apply"):
+                return layer.apply(None, x, lrng, train)
+            return layer(x)
+        return layer.apply(p, x, lrng, train)
+
+    def forward_range(self, params, x, rng, start: int, stop: int,
+                      train: bool = True):
+        """Run layers [start, stop), with optional remat every
+        activation_checkpoint_interval layers (reference:
+        pipe/module.py:292-346)."""
+        interval = self.activation_checkpoint_interval
+        if interval and interval > 0:
+            i = start
+            while i < stop:
+                j = min(i + interval, stop)
+
+                def chunk(p, y, i=i, j=j):
+                    for k in range(i, j):
+                        y = self.apply_layer(k, p, y, rng, train)
+                    return y
+                x = jax.checkpoint(chunk)(params, x)
+                i = j
+        else:
+            for i in range(start, stop):
+                x = self.apply_layer(i, params, x, rng, train)
+        return x
+
+    def forward(self, params, x, rng, train: bool = True):
+        return self.forward_range(params, x, rng, 0, len(self.specs), train)
+
+
+def np_prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out

@@ -1,0 +1,5 @@
+from .engine import DeepSpeedEngine, TrainState, StepMetrics
+from .module import TrainModule, FunctionalModule, FlaxModule
+from .dataloader import DeepSpeedDataLoader, RepeatingLoader
+from .zero import ZeroShardingPlan
+from . import precision, lr_schedules

@@ -1,0 +1,74 @@
+"""Data loading for SPMD training.
+
+The reference auto-wraps datasets in a DistributedSampler keyed on DP rank
+(reference: deepspeed/runtime/dataloader.py:48-58).  Under single-controller
+SPMD there are no per-rank samplers: the loader yields *global* batches and
+the engine shards them over the ``data`` mesh axis with one device_put.
+``RepeatingLoader`` (reference: dataloader.py:10-30) ports unchanged.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterable so it restarts instead of raising StopIteration."""
+
+    def __init__(self, loader: Iterable):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    """Batch iterator over an indexable dataset of pytrees (dicts/tuples of
+    arrays, or (x, y) pairs), yielding stacked global batches."""
+
+    def __init__(self, dataset, batch_size: int,
+                 collate_fn: Optional[Callable] = None,
+                 mesh=None, shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.mesh = mesh
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+        self.len = len(dataset) // batch_size
+        if not self.drop_last and len(dataset) % batch_size:
+            self.len += 1
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self):
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            self._rng.shuffle(order)
+        for i in range(self.len):
+            idx = order[i * self.batch_size:(i + 1) * self.batch_size]
+            yield self.collate_fn([self.dataset[int(j)] for j in idx])
+
+
+def _default_collate(samples):
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples])
+                for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(
+            np.stack([np.asarray(s[i]) for s in samples])
+            for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])

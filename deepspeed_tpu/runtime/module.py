@@ -1,0 +1,75 @@
+"""Model protocol consumed by the engine.
+
+The reference wraps an ``nn.Module`` whose forward returns the loss
+(reference: tests/unit/simple_model.py:9-25 and engine.py:779).  The JAX
+equivalent is a pair (init, loss_fn) over an immutable param pytree:
+
+    class MyModel(TrainModule):
+        def init(self, rng) -> params
+        def loss_fn(self, params, batch, rng, train=True) -> scalar loss
+
+Adapters are provided for Flax linen modules and bare (init_fn, loss_fn)
+pairs.  ``param_partition_specs`` optionally returns a pytree of
+PartitionSpecs carrying the model's own tensor-parallel placement (the
+analogue of the user-supplied Megatron ``mpu`` object, reference
+deepspeed/__init__.py:76-77) which ZeRO composes with the data axis.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+
+class TrainModule:
+    """Duck-typed protocol; subclass or just match the surface."""
+
+    def init(self, rng) -> Any:
+        raise NotImplementedError
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        raise NotImplementedError
+
+    def param_partition_specs(self, params) -> Optional[Any]:
+        return None
+
+
+class FunctionalModule(TrainModule):
+    """Wrap bare (init_fn, loss_fn) callables."""
+
+    def __init__(self, init_fn: Callable, loss_fn: Callable,
+                 partition_spec_fn: Optional[Callable] = None):
+        self._init = init_fn
+        self._loss = loss_fn
+        self._specs = partition_spec_fn
+
+    def init(self, rng):
+        return self._init(rng)
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        return self._loss(params, batch, rng, train)
+
+    def param_partition_specs(self, params):
+        return self._specs(params) if self._specs else None
+
+
+class FlaxModule(TrainModule):
+    """Adapter for a Flax linen module + a loss callable.
+
+    ``loss_fn(apply_fn, variables, batch, rng, train) -> loss``.
+    ``example_batch`` supplies shapes for lazy init.
+    """
+
+    def __init__(self, module, loss_fn: Callable, example_batch,
+                 partition_spec_fn: Optional[Callable] = None):
+        self.module = module
+        self._loss = loss_fn
+        self._example_batch = example_batch
+        self._specs = partition_spec_fn
+
+    def init(self, rng):
+        return self.module.init(rng, self._example_batch)
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        return self._loss(self.module.apply, params, batch, rng, train)
+
+    def param_partition_specs(self, params):
+        return self._specs(params) if self._specs else None

@@ -1,0 +1,146 @@
+"""Mixed precision + loss scaling as traced, jit-safe state.
+
+Behavioral port of the reference loss scalers
+(reference: deepspeed/runtime/fp16/loss_scaler.py:56-166): static scale, and
+dynamic scaling with growth window + hysteresis ("delayed shift").  The
+reference mutates Python attributes on overflow (stage2.py:1341-1362); here
+the overflow→skip→rescale decision is data in the train-step pytree under
+``lax.cond`` inside one compiled step (SURVEY.md §7 "hard parts" #1).
+
+State/config split: ``LossScaleState`` holds only traced arrays (it rides in
+the donated TrainState pytree); ``LossScaleConfig`` is static Python the
+step closes over — keeping jit caches stable.
+
+On TPU the native compute dtype is bfloat16, which needs no loss scaling —
+``make_loss_scaler(enabled=False)`` yields a unit scale and ``update_scale``
+becomes the identity.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    """Traced pytree state."""
+    loss_scale: jnp.ndarray      # f32 scalar
+    good_steps: jnp.ndarray      # i32 — consecutive overflow-free steps
+    hysteresis: jnp.ndarray      # i32 — overflows left before scale halves
+
+
+@dataclasses.dataclass(frozen=True)
+class LossScaleConfig:
+    """Static knobs (hashable; closed over by the compiled step)."""
+    dynamic: bool = True
+    scale_window: int = 1000
+    min_scale: float = 1.0
+    init_hysteresis: int = 2
+    enabled: bool = True
+
+
+def make_loss_scaler(enabled: bool = True,
+                     static_scale: float = 0,
+                     initial_scale_power: int = 32,
+                     scale_window: int = 1000,
+                     hysteresis: int = 2,
+                     min_scale: float = 1.0
+                     ) -> Tuple[LossScaleState, LossScaleConfig]:
+    """``static_scale == 0`` selects dynamic scaling (reference semantics:
+    fp16.loss_scale == 0 ⇒ dynamic, runtime/config.py)."""
+    dynamic = static_scale == 0
+    init = float(2 ** initial_scale_power) if dynamic else float(static_scale)
+    if not enabled:
+        init = 1.0
+    state = LossScaleState(
+        loss_scale=jnp.asarray(init, jnp.float32),
+        good_steps=jnp.asarray(0, jnp.int32),
+        hysteresis=jnp.asarray(hysteresis, jnp.int32),
+    )
+    config = LossScaleConfig(
+        dynamic=dynamic and enabled,
+        scale_window=scale_window,
+        min_scale=min_scale,
+        init_hysteresis=hysteresis,
+        enabled=enabled,
+    )
+    return state, config
+
+
+def from_fp16_config(fp16_cfg) -> Tuple[LossScaleState, LossScaleConfig]:
+    """Build from a DeepSpeedFP16Config block."""
+    return make_loss_scaler(
+        enabled=fp16_cfg.enabled,
+        static_scale=fp16_cfg.loss_scale,
+        initial_scale_power=fp16_cfg.initial_scale_power,
+        scale_window=fp16_cfg.loss_scale_window,
+        hysteresis=fp16_cfg.hysteresis,
+        min_scale=fp16_cfg.min_loss_scale,
+    )
+
+
+def scale_loss(loss: jnp.ndarray, state: LossScaleState) -> jnp.ndarray:
+    return loss * state.loss_scale.astype(loss.dtype)
+
+
+def unscale_grads(grads, state: LossScaleState):
+    inv = (1.0 / state.loss_scale).astype(jnp.float32)
+    return jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    """Fused isfinite-reduction overflow check (replaces the reference's
+    serial NaN/Inf scan + allreduce, runtime/utils.py:41-137; under SPMD the
+    cross-replica reduction is implicit because grads are already reduced)."""
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.all(jnp.isfinite(g)) for g in leaves]
+    return jnp.stack(finite).all()
+
+
+def update_scale(state: LossScaleState, finite: jnp.ndarray,
+                 config: LossScaleConfig) -> LossScaleState:
+    """One dynamic-loss-scale transition (reference: loss_scaler.py:151-166)."""
+    if not config.dynamic:
+        return state
+
+    def on_good(s: LossScaleState):
+        good = s.good_steps + 1
+        grow = good >= config.scale_window
+        new_scale = jnp.where(grow, s.loss_scale * 2.0, s.loss_scale)
+        new_good = jnp.where(grow, 0, good).astype(jnp.int32)
+        return s._replace(loss_scale=new_scale, good_steps=new_good)
+
+    def on_overflow(s: LossScaleState):
+        hys = s.hysteresis - 1
+        drop = hys <= 0
+        new_scale = jnp.where(
+            drop, jnp.maximum(s.loss_scale / 2.0, config.min_scale),
+            s.loss_scale)
+        new_hys = jnp.where(drop, config.init_hysteresis, hys).astype(jnp.int32)
+        return s._replace(loss_scale=new_scale,
+                          good_steps=jnp.asarray(0, jnp.int32),
+                          hysteresis=new_hys)
+
+    return jax.lax.cond(finite, on_good, on_overflow, state)
+
+
+def select_compute_dtype(fp16_enabled: bool, bf16_enabled: bool):
+    if bf16_enabled:
+        return jnp.bfloat16
+    if fp16_enabled:
+        return jnp.float16
+    return jnp.float32
+
+
+def cast_to_compute(params, dtype):
+    """fp32 master → compute-dtype params (the reference's model.half() at
+    engine.py:508 becomes a per-step cast; float leaves only)."""
+    def cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree.map(cast, params)

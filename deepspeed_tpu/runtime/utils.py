@@ -1,0 +1,32 @@
+"""Runtime numeric utilities (norms, clipping).
+
+(reference: deepspeed/runtime/utils.py:154-275 — grad/weight norms with
+model-parallel dedup.  Under SPMD-by-sharding there is nothing to dedup:
+gradients are unique per logical tensor, so the norms are plain reductions
+which XLA fuses into the step.)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    return jnp.sqrt(sq)
+
+
+def clip_by_global_norm(tree, max_norm: float, norm=None):
+    """Scale the tree so its global L2 norm is <= max_norm
+    (reference: runtime/utils.py clip_grad_norm_ semantics)."""
+    if norm is None:
+        norm = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda x: x * scale.astype(x.dtype), tree), norm
+
+
+def weight_norm(tree) -> jnp.ndarray:
+    return global_norm(tree)

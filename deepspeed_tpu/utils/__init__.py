@@ -1,0 +1,1 @@
+from .logging import logger, log_dist

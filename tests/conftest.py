@@ -1,0 +1,26 @@
+"""Test harness configuration.
+
+Mirrors the reference's multi-process-without-a-cluster strategy
+(reference: tests/unit/common.py:14-100 forks NCCL workers on localhost):
+on TPU-less CI we instead expose an 8-device virtual CPU mesh via
+``--xla_force_host_platform_device_count`` so every sharding/collective
+path (ZeRO, pipeline ppermute, tensor-parallel psum) executes for real,
+single-process SPMD, no cluster needed.
+
+Note: this image's sitecustomize force-registers the ``axon`` TPU platform
+before conftest runs, so the env var JAX_PLATFORMS alone is not enough —
+we must also override jax.config before any backend initializes.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}")

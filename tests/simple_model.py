@@ -1,0 +1,66 @@
+"""Test fixtures — the JAX analogue of the reference's
+tests/unit/simple_model.py (SimpleModel :9-25, random_dataloader :115).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.module import TrainModule
+
+
+class SimpleModel(TrainModule):
+    """Stack of linear layers + MSE loss (loss-returning model, like the
+    reference fixture)."""
+
+    def __init__(self, hidden_dim: int = 16, nlayers: int = 2):
+        self.hidden_dim = hidden_dim
+        self.nlayers = nlayers
+
+    def init(self, rng):
+        params = {}
+        for i in range(self.nlayers):
+            rng, k = jax.random.split(rng)
+            params[f"w{i}"] = jax.random.normal(
+                k, (self.hidden_dim, self.hidden_dim), jnp.float32) * 0.1
+            params[f"b{i}"] = jnp.zeros((self.hidden_dim,), jnp.float32)
+        return params
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        x, y = batch
+        h = x
+        for i in range(self.nlayers):
+            h = h @ params[f"w{i}"].astype(h.dtype) + \
+                params[f"b{i}"].astype(h.dtype)
+            if i < self.nlayers - 1:
+                h = jax.nn.relu(h)
+        return jnp.mean((h.astype(jnp.float32) - y.astype(jnp.float32)) ** 2)
+
+
+def random_batches(batch_size: int, hidden_dim: int, num_batches: int = 8,
+                   seed: int = 0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    for _ in range(num_batches):
+        x = rng.standard_normal((batch_size, hidden_dim)).astype(dtype)
+        # a learnable linear target keeps the loss reducible
+        y = (0.5 * x).astype(dtype)
+        yield (x, y)
+
+
+def base_config(micro_bs=4, grad_acc=1, stage=0, precision="bf16", **over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": grad_acc,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+    }
+    if precision == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    elif precision == "fp16":
+        cfg["fp16"] = {"enabled": True}
+    elif precision == "fp32":
+        pass
+    cfg.update(over)
+    return cfg

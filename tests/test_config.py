@@ -1,0 +1,162 @@
+"""Config parsing + batch triangle (mirrors reference tests/unit/test_config.py
+and test_ds_config.py coverage)."""
+import json
+
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_triangle_all_given_ok():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+    }, world_size=4)
+    assert cfg.train_batch_size == 32
+
+
+def test_triangle_all_given_mismatch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({
+            "train_batch_size": 33,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2,
+        }, world_size=4)
+
+
+def test_triangle_solve_grad_acc():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+    }, world_size=4)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_triangle_solve_micro():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 32,
+        "gradient_accumulation_steps": 2,
+    }, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_triangle_solve_train():
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+    }, world_size=4)
+    assert cfg.train_batch_size == 32
+
+
+def test_triangle_only_train_batch():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, world_size=2)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_triangle_nothing_given_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=1)
+
+
+def test_zero_requires_mixed_precision():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({
+            "train_batch_size": 8,
+            "zero_optimization": {"stage": 2},
+        }, world_size=1)
+
+
+def test_zero_with_bf16_ok():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+    }, world_size=1)
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 2
+
+
+def test_zero_stage3_supported():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3},
+    }, world_size=1)
+    assert cfg.zero_optimization_stage == 3
+
+
+def test_zero_bool_deprecated_form():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "fp16": {"enabled": True},
+        "zero_optimization": True,
+    }, world_size=1)
+    assert cfg.zero_optimization_stage == 1
+
+
+def test_cpu_offload_requires_stage2():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({
+            "train_batch_size": 8,
+            "fp16": {"enabled": True},
+            "zero_optimization": {"stage": 1, "cpu_offload": True},
+        }, world_size=1)
+
+
+def test_fp16_dynamic_loss_scale_default():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "fp16": {"enabled": True},
+    }, world_size=1)
+    assert cfg.fp16.dynamic_loss_scale
+    assert cfg.fp16.initial_dynamic_scale == 2 ** 32
+
+
+def test_fp16_static_loss_scale():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "fp16": {"enabled": True, "loss_scale": 128},
+    }, world_size=1)
+    assert not cfg.fp16.dynamic_loss_scale
+    assert cfg.loss_scale == 128
+
+
+def test_duplicate_json_keys_rejected(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(str(p), world_size=1)
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({
+        "train_batch_size": 16,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.001}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 10}},
+    }))
+    cfg = DeepSpeedConfig(str(p), world_size=2)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params["lr"] == 0.001
+    assert cfg.scheduler_name == "WarmupLR"
+
+
+def test_sparse_attention_mode_validation():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({
+            "train_batch_size": 8,
+            "sparse_attention": {"mode": "bogus"},
+        }, world_size=1)
+
+
+def test_unknown_optimizer_params_passthrough():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Lamb",
+                      "params": {"lr": 0.1, "max_coeff": 5.0}},
+    }, world_size=1)
+    assert cfg.optimizer_name == "lamb"
+    assert cfg.optimizer_params["max_coeff"] == 5.0

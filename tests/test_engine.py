@@ -1,0 +1,147 @@
+"""End-to-end engine training on the 8-device virtual mesh — the analogue of
+the reference's tests/unit/test_fp16.py training loops over
+@distributed_test(world_size=[1,2]) (common.py:14-100)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config import DeepSpeedConfig
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+from simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 16
+
+
+def _train(stage, precision="bf16", grad_acc=1, micro=2, steps=10,
+           mesh=None, **over):
+    mesh = mesh or build_mesh()
+    dp = mesh.shape["data"]
+    cfg = DeepSpeedConfig(
+        base_config(micro_bs=micro, grad_acc=grad_acc, stage=stage,
+                    precision=precision, **over),
+        world_size=dp)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=HIDDEN), cfg, mesh=mesh)
+    batch_size = cfg.train_batch_size
+    losses = []
+    for batch in random_batches(batch_size, HIDDEN, num_batches=steps):
+        losses.append(float(eng.train_batch(batch)))
+    return losses, eng
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages_loss_decreases(stage):
+    losses, eng = _train(stage=stage)
+    assert losses[-1] < losses[0] * 0.7, losses
+    assert eng.global_steps == 10
+    assert eng.get_skipped_steps() == 0
+
+
+@pytest.mark.parametrize("stage", [0, 2])
+def test_grad_accumulation(stage):
+    losses, eng = _train(stage=stage, grad_acc=4, micro=1, steps=8)
+    assert losses[-1] < losses[0] * 0.8
+    assert eng.micro_steps == 8 * 4
+
+
+def test_fp16_training():
+    losses, eng = _train(stage=0, precision="fp16", steps=10,
+                         **{"fp16": {"enabled": True,
+                                     "initial_scale_power": 8}})
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_fp32_training():
+    losses, _ = _train(stage=0, precision="fp32")
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_zero_stages_agree():
+    """Stages 0/1/2/3 must produce (nearly) identical training curves —
+    ZeRO is a memory layout, not an algorithm change (the TPU analogue of
+    the reference's pg_correctness_test, stage2.py:23-25)."""
+    ref, _ = _train(stage=0, steps=5)
+    for stage in (1, 2, 3):
+        got, _ = _train(stage=stage, steps=5)
+        np.testing.assert_allclose(got, ref, rtol=2e-2)
+
+
+def test_zero_sharding_actually_shards():
+    mesh = build_mesh()
+    cfg = DeepSpeedConfig(base_config(micro_bs=2, stage=2), world_size=8)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=HIDDEN), cfg, mesh=mesh)
+    w0 = eng.state.master_params["w0"]
+    # hidden=16 divisible by dp=8 → dim 0 sharded over data axis
+    shard_shape = w0.sharding.shard_shape(w0.shape)
+    assert shard_shape[0] == HIDDEN // 8
+    # optimizer moments shard identically
+    mu0 = eng.state.opt_state.mu["w0"]
+    assert mu0.sharding.shard_shape(mu0.shape)[0] == HIDDEN // 8
+
+
+def test_stage0_replicated():
+    mesh = build_mesh()
+    cfg = DeepSpeedConfig(base_config(micro_bs=2, stage=0), world_size=8)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=HIDDEN), cfg, mesh=mesh)
+    w0 = eng.state.master_params["w0"]
+    assert w0.sharding.shard_shape(w0.shape) == w0.shape
+
+
+def test_initialize_api():
+    mesh = build_mesh()
+    engine, optimizer, dataloader, sched = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN),
+        config=base_config(micro_bs=2, stage=1),
+        mesh=mesh)
+    batch = next(random_batches(engine.train_batch_size, HIDDEN))
+    loss0 = float(engine.train_batch(batch))
+    loss1 = float(engine.train_batch(batch))
+    assert loss1 < loss0
+
+
+def test_forward_backward_step_facade():
+    mesh = build_mesh()
+    cfg = DeepSpeedConfig(base_config(micro_bs=2, grad_acc=2),
+                          world_size=8)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=HIDDEN), cfg, mesh=mesh)
+    micro_global = 2 * 8
+    batches = list(random_batches(micro_global, HIDDEN, num_batches=4))
+    for i, b in enumerate(batches):
+        loss = eng.forward(b)
+        eng.backward(loss)
+        eng.step()
+    assert eng.global_steps == 2  # 4 micros / grad_acc 2
+
+
+def test_wrong_batch_size_raises():
+    mesh = build_mesh()
+    cfg = DeepSpeedConfig(base_config(micro_bs=2), world_size=8)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=HIDDEN), cfg, mesh=mesh)
+    with pytest.raises(ValueError):
+        eng.train_batch(next(random_batches(7, HIDDEN)))
+
+
+def test_gradient_clipping_runs():
+    losses, eng = _train(stage=1, gradient_clipping=0.1)
+    assert losses[-1] < losses[0]
+
+
+def test_lamb_optimizer():
+    losses, _ = _train(
+        stage=0,
+        optimizer={"type": "Lamb", "params": {"lr": 1e-2}})
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_scheduler_from_config():
+    losses, eng = _train(
+        stage=0,
+        scheduler={"type": "WarmupLR",
+                   "params": {"warmup_min_lr": 0.0,
+                              "warmup_max_lr": 1e-2,
+                              "warmup_num_steps": 5}})
+    assert losses[-1] < losses[0]
+    assert eng.get_lr() > 0

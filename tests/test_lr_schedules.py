@@ -1,0 +1,75 @@
+"""LR schedule semantics (mirrors reference tests/unit/test_lr_schedulers.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    get_lr_schedule, lr_range_test, one_cycle, warmup_lr, warmup_decay_lr,
+    VALID_LR_SCHEDULES,
+)
+
+
+def _at(sched, step):
+    return float(sched(jnp.asarray(step)))
+
+
+def test_warmup_linear():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=10,
+                  warmup_type="linear")
+    assert _at(s, 0) == 0.0
+    assert abs(_at(s, 5) - 0.5) < 1e-6
+    assert _at(s, 10) == 1.0
+    assert _at(s, 100) == 1.0
+
+
+def test_warmup_log_above_linear():
+    s_log = warmup_lr(0.0, 1.0, 10, "log")
+    s_lin = warmup_lr(0.0, 1.0, 10, "linear")
+    assert _at(s_log, 5) > _at(s_lin, 5)
+    assert abs(_at(s_log, 10) - 1.0) < 1e-6
+
+
+def test_warmup_decay_hits_zero():
+    s = warmup_decay_lr(total_num_steps=20, warmup_max_lr=1.0,
+                        warmup_num_steps=10, warmup_type="linear")
+    assert abs(_at(s, 10) - 1.0) < 1e-6
+    assert abs(_at(s, 15) - 0.5) < 1e-6
+    assert _at(s, 20) == 0.0
+    assert _at(s, 30) == 0.0
+
+
+def test_lr_range_test_continuous():
+    s = lr_range_test(lr_range_test_min_lr=0.1,
+                      lr_range_test_step_size=10,
+                      lr_range_test_step_rate=1.0)
+    assert abs(_at(s, 0) - 0.1) < 1e-7
+    assert _at(s, 10) > _at(s, 5) > _at(s, 0)
+
+
+def test_lr_range_test_staircase():
+    s = lr_range_test(lr_range_test_min_lr=0.1,
+                      lr_range_test_step_size=10,
+                      lr_range_test_step_rate=1.0,
+                      lr_range_test_staircase=True)
+    assert _at(s, 3) == _at(s, 9)
+    assert _at(s, 10) > _at(s, 9)
+
+
+def test_one_cycle_shape():
+    s = one_cycle(cycle_min_lr=0.0, cycle_max_lr=1.0,
+                  cycle_first_step_size=10, cycle_second_step_size=10)
+    assert _at(s, 0) == 0.0
+    assert abs(_at(s, 10) - 1.0) < 1e-6   # peak
+    assert _at(s, 15) < _at(s, 10)
+    assert abs(_at(s, 20)) < 1e-6          # back to min
+
+
+def test_registry():
+    for name in VALID_LR_SCHEDULES:
+        params = {}
+        if name == "WarmupDecayLR":
+            params = {"total_num_steps": 100}
+        sched = get_lr_schedule(name, params)
+        assert np.isfinite(_at(sched, 5))
+    with pytest.raises(ValueError):
+        get_lr_schedule("Nope", {})

@@ -1,0 +1,63 @@
+"""Balanced-partition algorithms (mirrors reference tests/unit/test_partition.py
+coverage of partition_uniform / partition_balanced)."""
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel import partition_uniform, partition_balanced
+
+
+def _max_part(weights, parts):
+    return max(sum(weights[parts[p]:parts[p + 1]])
+               for p in range(len(parts) - 1))
+
+
+def test_uniform_exact():
+    assert partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+
+
+def test_uniform_remainder_front_loaded():
+    parts = partition_uniform(10, 4)
+    sizes = [parts[i + 1] - parts[i] for i in range(4)]
+    assert sum(sizes) == 10
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_balanced_uniform_weights():
+    parts = partition_balanced([1.0] * 8, 4)
+    sizes = [parts[i + 1] - parts[i] for i in range(4)]
+    assert sizes == [2, 2, 2, 2]
+
+
+def test_balanced_skewed():
+    w = [10.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0]
+    parts = partition_balanced(w, 2)
+    # optimal: [10] | rest (max=10) — anything placing 10 with others is worse
+    assert _max_part(w, parts) == 10.0
+
+
+def test_balanced_monotone_boundaries():
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        n = int(rng.integers(1, 40))
+        k = int(rng.integers(1, 10))
+        w = rng.random(n).tolist()
+        parts = partition_balanced(w, k)
+        assert len(parts) == k + 1
+        assert parts[0] == 0 and parts[-1] == n
+        assert all(parts[i] <= parts[i + 1] for i in range(k))
+
+
+def test_balanced_near_optimal():
+    rng = np.random.default_rng(1)
+    w = rng.random(32).tolist()
+    parts = partition_balanced(w, 4)
+    # bottleneck within 1.05x of the trivial lower bound would be too strict;
+    # require within max(weight) + mean (greedy bound)
+    lower = max(max(w), sum(w) / 4)
+    assert _max_part(w, parts) <= lower + max(w)
+
+
+def test_more_parts_than_items():
+    parts = partition_balanced([1.0, 1.0], 4)
+    assert parts[0] == 0 and parts[-1] == 2
+    assert len(parts) == 5

@@ -1,0 +1,82 @@
+"""Topology / grid math (mirrors reference tests/unit/test_topology.py)."""
+import pytest
+
+from deepspeed_tpu.parallel import (
+    ProcessTopology, PipeDataParallelTopology, PipeModelDataParallelTopology,
+    ParallelGrid,
+)
+
+
+def test_topology_2d_ranks():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+
+
+def test_topology_coords_roundtrip():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    for r in range(topo.world_size()):
+        c = topo.get_coord(r)
+        assert topo.get_rank(**c._asdict()) == r
+
+
+def test_topology_missing_axis_raises():
+    topo = ProcessTopology(axes=["a", "b"], dims=[2, 2])
+    with pytest.raises(ValueError):
+        topo.get_rank(a=0)
+
+
+def test_axis_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    data_lists = topo.get_axis_comm_lists("data")
+    assert data_lists == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert pipe_lists == [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+
+def test_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    ranks = topo.filter_match(pipe=0)
+    assert ranks == [0, 1, 2, 3]
+    ranks = topo.filter_match(pipe=1, model=1)
+    assert len(ranks) == 2
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    # data axis omitted by default → checkpoint naming stable across DP
+    r = topo.get_rank(pipe=1, data=0, model=1)
+    assert "pipe_01" in topo.get_rank_repr(r)
+    assert "model_01" in topo.get_rank_repr(r)
+    assert "data" not in topo.get_rank_repr(r)
+
+
+def test_grid_queries():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = ParallelGrid(topo, rank=topo.get_rank(pipe=1, data=1, model=0))
+    assert grid.get_pipe_parallel_rank() == 1
+    assert grid.get_data_parallel_rank() == 1
+    assert grid.get_model_parallel_rank() == 0
+    assert grid.get_pipe_parallel_world_size() == 2
+    assert grid.get_data_parallel_world_size() == 2
+    assert grid.get_slice_parallel_world_size() == 2
+    assert grid.is_last_stage()
+    assert not grid.is_first_stage()
+
+
+def test_grid_stage_to_global():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    grid = ParallelGrid(topo, rank=topo.get_rank(pipe=1, data=1))
+    nxt = grid.stage_to_global(2)
+    assert topo.get_coord(nxt).pipe == 2
+    assert topo.get_coord(nxt).data == 1
+
+
+def test_grid_missing_axis_defaults():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    grid = ParallelGrid(topo, rank=0)
+    assert grid.get_model_parallel_world_size() == 1
+    assert grid.get_model_parallel_rank() == 0

@@ -67,7 +67,8 @@ def initialize(args=None,
                                  lr_schedule=lr_scheduler, params=params,
                                  training_data=training_data,
                                  collate_fn=collate_fn, seed=seed)
-    return engine, engine.optimizer, engine.training_dataloader, lr_scheduler
+    return (engine, engine.optimizer, engine.training_dataloader,
+            engine.lr_scheduler)
 
 
 def add_config_arguments(parser: argparse.ArgumentParser):

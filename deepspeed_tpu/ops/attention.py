@@ -1,0 +1,43 @@
+"""Attention ops — the XLA-path implementation.
+
+This module is the reference ("dense") path; the Pallas flash-attention
+kernel (ops/pallas/flash_attention.py) replaces it on TPU for long
+sequences, and the block-sparse path (ops/sparse_attention/) covers the
+reference's sparse-attention feature slot (reference:
+deepspeed/ops/sparse_attention/sparse_self_attention.py:83-142).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     dropout_rate: float = 0.0,
+                     dropout_rng: Optional[jax.Array] = None,
+                     mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Multi-head causal attention.
+
+    q, k, v: [B, H, T, Dh].  Softmax accumulates in fp32 (matching the
+    reference kernel's fp32 softmax accumulation for fp16 inputs,
+    csrc/transformer/softmax_kernels.cu) and returns q.dtype.
+    """
+    B, H, T, Dh = q.shape
+    scale = 1.0 / jnp.sqrt(Dh).astype(jnp.float32)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+    scores = jnp.where(causal[None, None], scores, neg)
+    if mask is not None:
+        scores = jnp.where(mask, scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)

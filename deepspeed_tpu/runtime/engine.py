@@ -21,6 +21,7 @@ API surface preserved from the reference:
 """
 from __future__ import annotations
 
+import collections
 import time
 from typing import Any, Callable, Dict, NamedTuple, Optional
 
@@ -135,7 +136,8 @@ class DeepSpeedEngine:
         self.skipped_steps = 0
         self._pending_micros = []
         self._last_metrics: Optional[StepMetrics] = None
-        self._step_times: list = []
+        self._step_times = collections.deque(
+            maxlen=max(min(config.steps_per_print, 1000), 10))
 
         self.training_dataloader = (
             self.deepspeed_io(training_data, collate_fn=collate_fn)
@@ -351,9 +353,11 @@ class DeepSpeedEngine:
         t0 = time.time()
         sharded = self._shard_batch(batch)
         self.state, metrics = self._train_step(self.state, sharded)
-        # block before stopping the clock — JAX dispatch is async and the
-        # enqueue time alone would wildly inflate samples/sec
-        metrics = jax.block_until_ready(metrics)
+        # Materialize metrics on host before stopping the clock: JAX dispatch
+        # is async and on some platforms (axon tunnel) block_until_ready
+        # returns before completion — np.asarray is the reliable sync, and
+        # the reference returns a concrete loss per step anyway.
+        metrics = StepMetrics(*[np.asarray(m) for m in metrics])
         self._last_metrics = metrics
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
@@ -416,6 +420,11 @@ class DeepSpeedEngine:
     def last_metrics(self) -> Optional[StepMetrics]:
         return self._last_metrics
 
+    @property
+    def lr_scheduler(self):
+        """The resolved step→lr callable (config- or client-provided)."""
+        return self._lr_schedule
+
     def get_lr(self):
         if self._lr_schedule is not None:
             applied = self.global_steps - self.get_skipped_steps()
@@ -429,7 +438,7 @@ class DeepSpeedEngine:
         return int(self.state.skipped_steps)
 
     def _report(self, metrics: StepMetrics):
-        times = self._step_times[-self.config.steps_per_print:]
+        times = list(self._step_times)
         avg = sum(times) / max(len(times), 1)
         tput = self.train_batch_size / avg if avg > 0 else 0.0
         log_dist(

@@ -112,7 +112,12 @@ def update_scale(state: LossScaleState, finite: jnp.ndarray,
         grow = good >= config.scale_window
         new_scale = jnp.where(grow, s.loss_scale * 2.0, s.loss_scale)
         new_good = jnp.where(grow, 0, good).astype(jnp.int32)
-        return s._replace(loss_scale=new_scale, good_steps=new_good)
+        # replenish hysteresis at every growth window (reference:
+        # loss_scaler.py:161-166 resets cur_hysteresis on raise)
+        new_hys = jnp.where(grow, config.init_hysteresis,
+                            s.hysteresis).astype(jnp.int32)
+        return s._replace(loss_scale=new_scale, good_steps=new_good,
+                          hysteresis=new_hys)
 
     def on_overflow(s: LossScaleState):
         hys = s.hysteresis - 1

@@ -118,7 +118,6 @@ class ZeroShardingPlan:
     def opt_state_specs(self, opt_state, params):
         """Optimizer moments mirror the master-param placement; scalar
         counters stay replicated."""
-        param_leaves = {id(l) for l in jax.tree.leaves(params)}
         master = self.master_param_specs(params)
         master_leaves = jax.tree.leaves(master)
         # Build spec tree by structural matching: any sub-tree of opt_state

@@ -1,0 +1,105 @@
+"""GPT-2 flagship model: shapes, loss, TP sharding, end-to-end ZeRO train."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config import DeepSpeedConfig
+from deepspeed_tpu.models import GPT2Config, GPT2Model
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+from simple_model import base_config
+
+TINY = GPT2Config(vocab_size=128, n_positions=64, d_model=32, n_layer=2,
+                  n_head=4, remat=None)
+
+
+def _tokens(batch, seqlen, vocab, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, (batch, seqlen), dtype=np.int32)
+
+
+def test_forward_shapes():
+    model = GPT2Model(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    toks = _tokens(2, 16, TINY.vocab_size)
+    logits = model.apply(params, jnp.asarray(toks), jax.random.PRNGKey(1),
+                         train=False)
+    assert logits.shape == (2, 16, TINY.vocab_size)
+
+
+def test_loss_near_uniform_at_init():
+    model = GPT2Model(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    toks = _tokens(4, 32, TINY.vocab_size)
+    loss = model.loss_fn(params, jnp.asarray(toks), jax.random.PRNGKey(1),
+                         train=False)
+    # random init → loss ≈ ln(vocab)
+    assert abs(float(loss) - np.log(TINY.vocab_size)) < 1.0
+
+
+def test_remat_matches_no_remat():
+    cfg_r = GPT2Config(**{**TINY.__dict__, "remat": "block"})
+    m1, m2 = GPT2Model(TINY), GPT2Model(cfg_r)
+    params = m1.init(jax.random.PRNGKey(0))
+    toks = jnp.asarray(_tokens(2, 16, TINY.vocab_size))
+    l1 = m1.loss_fn(params, toks, jax.random.PRNGKey(1), train=False)
+    l2 = m2.loss_fn(params, toks, jax.random.PRNGKey(1), train=False)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+
+def test_param_count_formula():
+    model = GPT2Model(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    actual = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    assert actual == TINY.num_params
+
+
+def test_gpt2_trains_with_zero2():
+    mesh = build_mesh()
+    cfg = DeepSpeedConfig(
+        base_config(micro_bs=1, stage=2,
+                    optimizer={"type": "Adam", "params": {"lr": 1e-3}}),
+        world_size=8)
+    eng = DeepSpeedEngine(GPT2Model(TINY), cfg, mesh=mesh)
+    toks = _tokens(8, 33, TINY.vocab_size)
+    losses = [float(eng.train_batch(toks)) for _ in range(8)]
+    assert losses[-1] < losses[0]  # memorizes the repeated batch
+
+
+def test_gpt2_tensor_parallel_mesh():
+    """dp=4 × tp=2 mesh: TP specs shard qkv over 'model' axis and training
+    still runs (the Megatron-slice integration slot, reference
+    topology.py:344-364)."""
+    mesh = build_mesh(pp=1, dp=4, tp=2)
+    cfg = DeepSpeedConfig(
+        base_config(micro_bs=2, stage=1,
+                    optimizer={"type": "Adam", "params": {"lr": 1e-3}}),
+        world_size=4)
+    eng = DeepSpeedEngine(GPT2Model(TINY), cfg, mesh=mesh)
+    qkv = eng.state.master_params["blocks"]["qkv_w"]
+    # [L, d, 3d]: data axis (4) shards some dim, model axis (2) shards last
+    shard = qkv.sharding.shard_shape(qkv.shape)
+    assert shard[-1] == qkv.shape[-1] // 2  # model-axis split
+    toks = _tokens(8, 33, TINY.vocab_size)
+    losses = [float(eng.train_batch(toks)) for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+def test_gpt2_dp_tp_matches_pure_dp():
+    """Same seed, same data: (dp=8) and (dp=4,tp=2) must match numerics."""
+    toks = _tokens(8, 33, TINY.vocab_size)
+
+    def run(mesh, ws):
+        cfg = DeepSpeedConfig(
+            base_config(micro_bs=8 // ws, stage=1,
+                        optimizer={"type": "Adam", "params": {"lr": 1e-3}}),
+            world_size=ws)
+        eng = DeepSpeedEngine(GPT2Model(TINY), cfg, mesh=mesh, seed=7)
+        return [float(eng.train_batch(toks)) for _ in range(3)]
+
+    a = run(build_mesh(), 8)
+    b = run(build_mesh(pp=1, dp=4, tp=2), 4)
+    np.testing.assert_allclose(a, b, rtol=5e-3)

@@ -54,7 +54,7 @@ def initialize(args=None,
                               world_size=mesh_axis_size(mesh, DATA_AXIS))
         engine = PipelineEngine(model=model, config=cfg, mesh=mesh,
                                 optimizer=optimizer,
-                                lr_schedule=lr_scheduler,
+                                lr_schedule=lr_scheduler, params=params,
                                 training_data=training_data,
                                 collate_fn=collate_fn, seed=seed)
     else:

@@ -130,32 +130,7 @@ class GPT2Model(TrainModule):
     # ---------------- forward ----------------
     def _block(self, bp, x, rng, train: bool):
         """One transformer block; bp leaves have the layer axis removed."""
-        cfg = self.config
-        B, T, D = x.shape
-        H, Dh = cfg.n_head, cfg.d_head
-        r1, r2, r3 = jax.random.split(rng, 3)
-
-        h = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
-        qkv = h @ bp["qkv_w"].astype(h.dtype) + bp["qkv_b"].astype(h.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            return t.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
-
-        drop = cfg.dropout if train else 0.0
-        attn = causal_attention(heads(q), heads(k), heads(v),
-                                dropout_rate=drop, dropout_rng=r1)
-        attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
-        attn = attn @ bp["out_w"].astype(h.dtype) + bp["out_b"].astype(h.dtype)
-        attn = _dropout(attn, drop, r2)
-        x = x + attn
-
-        h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
-        h = h @ bp["fc_w"].astype(h.dtype) + bp["fc_b"].astype(h.dtype)
-        h = jax.nn.gelu(h, approximate=True)
-        h = h @ bp["proj_w"].astype(h.dtype) + bp["proj_b"].astype(h.dtype)
-        h = _dropout(h, drop, r3)
-        return x + h
+        return gpt2_block_forward(self.config, bp, x, rng, train)
 
     def apply(self, params, tokens: jnp.ndarray, rng,
               train: bool = True) -> jnp.ndarray:
@@ -196,6 +171,35 @@ class GPT2Model(TrainModule):
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
         return jnp.mean(nll)
+
+
+def gpt2_block_forward(cfg: GPT2Config, bp, x, rng, train: bool):
+    """One pre-LN transformer block over unstacked per-layer params — the
+    single source of the block math, shared by the scan-over-layers model
+    and the pipeline flavor (models/gpt2_pipe.py)."""
+    B, T, D = x.shape
+    H, Dh = cfg.n_head, cfg.d_head
+    r1, r2, r3 = jax.random.split(rng, 3)
+    drop = cfg.dropout if train else 0.0
+
+    h = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+    qkv = h @ bp["qkv_w"].astype(h.dtype) + bp["qkv_b"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+
+    attn = causal_attention(heads(q), heads(k), heads(v),
+                            dropout_rate=drop, dropout_rng=r1)
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
+    attn = attn @ bp["out_w"].astype(h.dtype) + bp["out_b"].astype(h.dtype)
+    x = x + _dropout(attn, drop, r2)
+
+    h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    h = h @ bp["fc_w"].astype(h.dtype) + bp["fc_b"].astype(h.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = h @ bp["proj_w"].astype(h.dtype) + bp["proj_b"].astype(h.dtype)
+    return x + _dropout(h, drop, r3)
 
 
 def _layer_norm(x, scale, bias, eps: float = 1e-5):

@@ -1,0 +1,117 @@
+"""GPT-2 as a pipeline-parallel module.
+
+The pipeline flavor of the flagship model: per-layer LayerSpecs instead of
+the scan-over-layers stack, so stages can own layer ranges (the analogue of
+the reference's GPT2 PipelineModule usage; reference pattern:
+deepspeed/runtime/pipe/module.py:85 + DeepSpeedExamples Megatron pipe
+models).  The embedding is a TiedLayerSpec and the LM head reads the same
+``wte`` through the 3-ary loss head — gradient tying falls out of AD
+(replacing the tied-weight allreduce, reference pipe/module.py:405-474).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..pipe.module import LayerSpec, TiedLayerSpec, PipelineModule
+from .gpt2 import GPT2Config, _dropout, _layer_norm, gpt2_block_forward
+
+
+class GPT2EmbeddingPipe:
+    def __init__(self, cfg: GPT2Config):
+        self.cfg = cfg
+
+    def init(self, rng):
+        cfg = self.cfg
+        k1, k2 = jax.random.split(rng)
+        return {
+            "wte": jax.random.normal(
+                k1, (cfg.vocab_size, cfg.d_model), jnp.float32) * 0.02,
+            "wpe": jax.random.normal(
+                k2, (cfg.n_positions, cfg.d_model), jnp.float32) * 0.02,
+        }
+
+    def apply(self, params, tokens, rng, train: bool = True):
+        cfg = self.cfg
+        T = tokens.shape[1]
+        if T > cfg.n_positions:
+            raise ValueError(
+                f"sequence length {T} exceeds n_positions={cfg.n_positions}")
+        x = params["wte"][tokens] + params["wpe"][:T][None]
+        return _dropout(x, cfg.embd_dropout if train else 0.0, rng)
+
+
+class GPT2BlockPipe:
+    """One transformer block (same math as GPT2Model._block, unstacked)."""
+
+    def __init__(self, cfg: GPT2Config, layer_idx: int):
+        self.cfg = cfg
+        self.layer_idx = layer_idx
+
+    def init(self, rng):
+        cfg = self.cfg
+        d = cfg.d_model
+        ks = jax.random.split(rng, 4)
+        std = 0.02
+        resid_std = std / float(jnp.sqrt(2.0 * cfg.n_layer))
+        return {
+            "ln1_scale": jnp.ones((d,), jnp.float32),
+            "ln1_bias": jnp.zeros((d,), jnp.float32),
+            "qkv_w": jax.random.normal(ks[0], (d, 3 * d), jnp.float32) * std,
+            "qkv_b": jnp.zeros((3 * d,), jnp.float32),
+            "out_w": jax.random.normal(ks[1], (d, d), jnp.float32) * resid_std,
+            "out_b": jnp.zeros((d,), jnp.float32),
+            "ln2_scale": jnp.ones((d,), jnp.float32),
+            "ln2_bias": jnp.zeros((d,), jnp.float32),
+            "fc_w": jax.random.normal(ks[2], (d, 4 * d), jnp.float32) * std,
+            "fc_b": jnp.zeros((4 * d,), jnp.float32),
+            "proj_w": jax.random.normal(
+                ks[3], (4 * d, d), jnp.float32) * resid_std,
+            "proj_b": jnp.zeros((d,), jnp.float32),
+        }
+
+    def apply(self, bp, x, rng, train: bool = True):
+        return gpt2_block_forward(self.cfg, bp, x, rng, train)
+
+
+class GPT2FinalNormPipe:
+    def __init__(self, cfg: GPT2Config):
+        self.cfg = cfg
+
+    def init(self, rng):
+        d = self.cfg.d_model
+        return {"scale": jnp.ones((d,), jnp.float32),
+                "bias": jnp.zeros((d,), jnp.float32)}
+
+    def apply(self, params, x, rng, train: bool = True):
+        return _layer_norm(x, params["scale"], params["bias"])
+
+
+def gpt2_loss_head(params, hidden, labels):
+    """Tied LM head + next-token CE; 3-ary so it can read the tied wte
+    (labels are the raw token ids; hidden covers positions [0, T-1))."""
+    wte = params["tied"]["embed"]["wte"]
+    logits = hidden @ wte.astype(hidden.dtype).T
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def build_gpt2_pipe(cfg: GPT2Config, num_stages: int,
+                    partition_method: str = "type:GPT2BlockPipe",
+                    activation_checkpoint_interval: int = 0
+                    ) -> PipelineModule:
+    layers = [TiedLayerSpec("embed", GPT2EmbeddingPipe, cfg)]
+    layers += [LayerSpec(GPT2BlockPipe, cfg, i) for i in range(cfg.n_layer)]
+    layers += [LayerSpec(GPT2FinalNormPipe, cfg)]
+    return PipelineModule(
+        layers, num_stages=num_stages, loss_fn=gpt2_loss_head,
+        partition_method=partition_method,
+        activation_checkpoint_interval=activation_checkpoint_interval)
+
+
+def split_gpt2_batch(tokens):
+    """tokens [B, T+1] → (inputs [B, T], labels [B, T]) for the pipeline
+    (inputs enter stage 0; labels are consumed by the last-stage loss)."""
+    return tokens[:, :-1], tokens[:, 1:]

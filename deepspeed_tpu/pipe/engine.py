@@ -1,14 +1,302 @@
-"""Pipeline-parallel engine (1F1B over the ``pipe`` mesh axis).
+"""Pipeline-parallel engine — the whole schedule in one compiled program.
 
-Implementation lands with the pipeline milestone; this placeholder keeps
-``deepspeed_tpu.initialize`` dispatch importable with a clear error instead
-of a ModuleNotFoundError.
+The reference interprets schedules imperatively: a dispatch table maps
+instructions to Python methods that issue NCCL ops and autograd calls
+(reference: deepspeed/runtime/pipe/engine.py:1131-1157, p2p pair-group
+broadcasts at runtime/pipe/p2p.py:31-55, shape-metadata handshake at
+pipe/engine.py:653-764).  On TPU the entire pipelined training step is ONE
+jit program (SURVEY.md §7 "hard parts" #3, option (b)):
+
+  - ``shard_map`` over the ``pipe`` mesh axis, manual only on that axis
+    (data/model stay under GSPMD, so ZeRO + tensor parallelism compose);
+  - a ``lax.scan`` over M + S - 1 ticks; at each tick every stage runs its
+    layer range (``lax.switch`` on ``axis_index('pipe')`` — heterogeneous
+    stages supported, only stage-BOUNDARY activations must share a shape);
+  - activation handoff is one ``ppermute`` per tick (static shapes: the
+    reference's meta handshake has no equivalent here);
+  - the backward schedule is not written at all: differentiating the scan
+    transposes every ppermute and replays ticks in reverse — the fill/drain
+    structure the reference hand-codes in TrainSchedule falls out of AD;
+  - loss is computed on the last stage under ``lax.cond`` and shared via
+    ``psum`` (reference _aggregate_total_loss, pipe/engine.py:373-403).
+
+Gradient accumulation IS pipeline micro-batching here (as in the
+reference's train_batch contract, pipe/engine.py:229-303): the engine
+consumes ``gradient_accumulation_steps`` micro-batches per step, all live
+in the pipeline at once.
+
+Tied layers (e.g. embedding/LM-head): tied params live once in the param
+tree; every stage's branch reads them, so AD sums their gradient
+contributions across stages — replacing the tied-weight comm groups and
+explicit allreduce (reference: runtime/pipe/module.py:405-474).
+
+Current placement note: all stages hold the full param tree replicated over
+``pipe`` (ZeRO still shards over ``data``).  Stage-local placement of a
+pipe-sharded stacked param tree is a planned optimization for homogeneous
+stacks.
 """
 from __future__ import annotations
 
+from functools import partial
+from typing import Any, Optional
 
-class PipelineEngine:
-    def __init__(self, *args, **kwargs):
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, PIPE_AXIS, mesh_axis_size
+from ..runtime.engine import DeepSpeedEngine
+from ..runtime.module import TrainModule
+from ..utils.logging import log_dist
+from .module import PipelineModule
+
+
+class _PipelinedTrainModule(TrainModule):
+    """Adapts a PipelineModule to the engine's TrainModule protocol; its
+    loss_fn runs the full GPipe-style pipelined forward."""
+
+    def __init__(self, pipe_module: PipelineModule, mesh, num_micro: int):
+        self.pm = pipe_module
+        self.mesh = mesh
+        self.num_micro = num_micro
+        self.num_stages = pipe_module.num_stages
+        if pipe_module.loss_fn is None:
+            raise ValueError("PipelineModule needs loss_fn for training")
+        # loss_fn arity: (outputs, labels) or (params, outputs, labels) —
+        # the 3-ary form lets the loss head read params (e.g. a tied
+        # embedding projection, the reference's TiedLayerSpec LM head).
+        # Count only required positional params so `def mse(o, l, eps=1e-8)`
+        # stays 2-ary.
+        import inspect
+        try:
+            sig = inspect.signature(pipe_module.loss_fn)
+            nargs = sum(
+                1 for p in sig.parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                and p.default is p.empty)
+        except (TypeError, ValueError):
+            nargs = 2
+        self._loss_takes_params = nargs >= 3
+
+    def init(self, rng):
+        return self.pm.init(rng)
+
+    def param_partition_specs(self, params):
+        return None  # replicated over pipe; ZeRO composes the data axis
+
+    # -----------------------------------------------------------------
+    def _boundary_struct(self, params, inputs_micro, rng):
+        """Shape/dtype of activations at each stage boundary (must agree)."""
+        pm = self.pm
+        structs = []
+        x = inputs_micro
+        for s in range(self.num_stages):
+            start, stop = pm.stage_layer_range(s)
+            try:
+                x = jax.eval_shape(
+                    lambda p, xx: pm.forward_range(p, xx, rng, start, stop,
+                                                   train=True),
+                    params, x)
+            except Exception as e:
+                raise ValueError(
+                    f"pipeline stage {s} (layers [{start},{stop})) cannot "
+                    f"consume the previous stage's boundary activation — "
+                    f"stage boundaries must share one shape: {e}") from e
+            structs.append(x)
+        # Every stage output must share one shape: boundaries feed the next
+        # stage AND all stage bodies are branches of one lax.switch.
+        first = structs[0]
+        for i, st in enumerate(structs):
+            if (st.shape, st.dtype) != (first.shape, first.dtype):
+                raise ValueError(
+                    "pipeline stage boundaries must share one activation "
+                    f"shape; stage {i} output is {st.shape}/{st.dtype} vs "
+                    f"{first.shape}/{first.dtype} — adjust the partition")
+        return structs[0]
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        if not (isinstance(batch, (tuple, list)) and len(batch) == 2):
+            raise ValueError(
+                "pipeline batch must be a (inputs, labels) pair")
+        inputs, labels = batch
+        pm, S, M = self.pm, self.num_stages, self.num_micro
+        mesh = self.mesh
+
+        def split_micro(tree):
+            def r(x):
+                if x.shape[0] % M != 0:
+                    raise ValueError(
+                        f"batch dim {x.shape[0]} not divisible by "
+                        f"micro count {M}")
+                x = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, P(None, DATA_AXIS)))
+            return jax.tree.map(r, tree)
+
+        micros_in = split_micro(inputs)
+        micros_lb = split_micro(labels)
+
+        sample_in = jax.tree.map(lambda x: jax.ShapeDtypeStruct(
+            x.shape[1:], x.dtype), micros_in)
+        boundary = self._boundary_struct(params, sample_in, rng)
+        parts = [pm.stage_layer_range(s) for s in range(S)]
+
+        # Params cross the shard_map boundary in fp32: a replicated input's
+        # transpose is a psum over ``pipe``, and grads are fp32 by design
+        # anyway (a bf16 psum also trips an XLA-CPU AllReducePromotion
+        # crash on the test mesh).  Stage bodies cast back to compute dtype.
+        float_leaves = [jnp.issubdtype(l.dtype, jnp.floating)
+                        for l in jax.tree.leaves(params)]
+        compute_dtypes = [l.dtype for l in jax.tree.leaves(params)]
+
+        def upcast(tree):
+            leaves, tdef = jax.tree.flatten(tree)
+            out = []
+            for l, f in zip(leaves, float_leaves):
+                l = l.astype(jnp.float32) if f else l
+                # ZeRO-1/2 semantics: COMPUTE params are replicated (only
+                # master/optimizer state shard over data).  Constraining here
+                # keeps every collective at the shard_map boundary — a
+                # data-axis all-gather inside the last-stage-only lax.cond
+                # loss head deadlocks the pipe ppermute rendezvous otherwise.
+                l = jax.lax.with_sharding_constraint(
+                    l, NamedSharding(mesh, P()))
+                out.append(l)
+            return jax.tree.unflatten(tdef, out)
+
+        def downcast(tree):
+            leaves, tdef = jax.tree.flatten(tree)
+            return jax.tree.unflatten(tdef, [
+                l.astype(d) for l, d in zip(leaves, compute_dtypes)])
+
+        def spmd(params32, micros_in, micros_lb, rng):
+            stage = jax.lax.axis_index(PIPE_AXIS)
+            params = downcast(params32)
+
+            def branch(s):
+                start, stop = parts[s]
+
+                def run(buf, m_idx):
+                    mrng = jax.random.fold_in(rng, m_idx)
+                    if s == 0:
+                        x = jax.tree.map(lambda a: a[m_idx], micros_in)
+                    else:
+                        x = buf
+                    return pm.forward_range(params, x, mrng, start, stop,
+                                            train=train)
+                return run
+
+            branches = [branch(s) for s in range(S)]
+
+            def tick(carry, t):
+                buf, loss_sum = carry
+                m = t - stage
+                m_idx = jnp.clip(m, 0, M - 1)
+                active = (m >= 0) & (m < M)
+                y = jax.lax.switch(stage, branches, buf, m_idx)
+
+                def loss_branch(_):
+                    lb = jax.tree.map(lambda a: a[m_idx], micros_lb)
+                    if self._loss_takes_params:
+                        return pm.loss_fn(params, y, lb).astype(jnp.float32)
+                    return pm.loss_fn(y, lb).astype(jnp.float32)
+
+                lm = jax.lax.cond(active & (stage == S - 1), loss_branch,
+                                  lambda _: jnp.asarray(0.0, jnp.float32),
+                                  None)
+                # forward handoff ring: stage s -> s+1 (no wraparound; the
+                # last stage's output is consumed by the loss above)
+                buf_next = jax.lax.ppermute(
+                    y, PIPE_AXIS, perm=[(i, i + 1) for i in range(S - 1)])
+                return (buf_next, loss_sum + lm), None
+
+            buf0 = jnp.zeros(boundary.shape, boundary.dtype)
+            (_, loss_sum), _ = jax.lax.scan(
+                tick, (buf0, jnp.asarray(0.0, jnp.float32)),
+                jnp.arange(M + S - 1))
+            # only the last stage accumulated loss; share it
+            return jax.lax.psum(loss_sum, PIPE_AXIS) / M
+
+        sm = jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P(), P(), P(), P()),
+            out_specs=P(),
+            axis_names={PIPE_AXIS},
+            check_vma=False)
+        return sm(upcast(params), micros_in, micros_lb, rng)
+
+
+class PipelineEngine(DeepSpeedEngine):
+    """DeepSpeedEngine whose step runs the compiled pipeline.
+
+    (reference: deepspeed/runtime/pipe/engine.py:45 — also a subclass of the
+    core engine, inheriting optimizer/precision/checkpoint machinery.)
+    """
+
+    def __init__(self, model: PipelineModule, config, mesh,
+                 optimizer=None, lr_schedule=None, training_data=None,
+                 collate_fn=None, seed: int = 0, params=None):
+        if not isinstance(model, PipelineModule):
+            raise TypeError("PipelineEngine requires a PipelineModule")
+        pp = mesh_axis_size(mesh, PIPE_AXIS)
+        if pp != model.num_stages:
+            raise ValueError(
+                f"mesh pipe axis ({pp}) != PipelineModule.num_stages "
+                f"({model.num_stages})")
+        if config.zero_optimization_stage >= 3:
+            raise ValueError(
+                "ZeRO-3 (parameter sharding) with pipeline parallelism is "
+                "not supported yet — use ZeRO stage <= 2 with pp, or "
+                "ZeRO-3 with dp/tp")
+        self.pipeline_module = model
+        num_micro = config.gradient_accumulation_steps
+        adapter = _PipelinedTrainModule(model, mesh, num_micro)
+        super().__init__(adapter, config, mesh=mesh, optimizer=optimizer,
+                         lr_schedule=lr_schedule, params=params,
+                         training_data=training_data, collate_fn=collate_fn,
+                         seed=seed)
+        self.num_stages = model.num_stages
+        self.micro_batches = num_micro
+        log_dist(
+            f"PipelineEngine: stages={self.num_stages} "
+            f"micro_batches={self.micro_batches} parts={model.parts}",
+            ranks=[0])
+
+    def _shard_batch(self, batch):
+        """The pipeline consumes all micro-batches in one program — no outer
+        grad-accum scan.  Present the batch as [1, total, ...] (the engine's
+        scan dim) sharded over ``data`` on the sample dim."""
+        def reshape(x):
+            x = np.asarray(x)
+            expect = self.train_batch_size
+            if x.shape[0] != expect:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} != train_batch_size {expect}")
+            return x.reshape((1,) + x.shape)
+
+        batch = jax.tree.map(reshape, batch)
+
+        def shard(x):
+            spec = [None] * x.ndim
+            spec[1] = DATA_AXIS
+            return jax.device_put(x, NamedSharding(self.mesh, P(*spec)))
+
+        return jax.tree.map(shard, batch)
+
+    @property
+    def _scan_grad_acc(self) -> int:
+        return 1  # all micro-batches live inside the pipelined program
+
+    def eval_batch(self, batch):
         raise NotImplementedError(
-            "PipelineEngine is not implemented yet in this build; "
-            "use a non-pipeline model or ZeRO data parallelism meanwhile")
+            "pipeline eval_batch lands with the inference schedule")
+
+    def forward(self, batch):
+        raise NotImplementedError(
+            "the forward/backward/step facade is not supported on the "
+            "pipeline engine — use train_batch (reference parity: "
+            "PipelineEngine.train_batch is the only training entry there "
+            "too, pipe/engine.py:229)")
+
+    __call__ = forward

@@ -197,12 +197,19 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # compiled step construction
     # ------------------------------------------------------------------
+    @property
+    def _scan_grad_acc(self) -> int:
+        """Micro-batches handled by the engine's outer accumulation scan.
+        The pipeline engine overrides this to 1: there, all micro-batches
+        live inside the pipelined program itself."""
+        return self.gradient_accumulation_steps
+
     def _build_train_step(self):
         module = self.module
         optimizer = self.optimizer
         plan = self.zero_plan
         compute_dtype = self.compute_dtype
-        grad_acc = self.gradient_accumulation_steps
+        grad_acc = self._scan_grad_acc
         clip = self.gradient_clipping
         scale_config = self.loss_scale_config
         lr_schedule = self._lr_schedule

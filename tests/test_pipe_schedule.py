@@ -1,0 +1,117 @@
+"""Schedule generators as pure data — no devices needed
+(mirrors reference tests/unit/test_pipe_schedule.py)."""
+import pytest
+
+from deepspeed_tpu.pipe.schedule import (
+    TrainSchedule, InferenceSchedule, DataParallelSchedule,
+    ForwardPass, BackwardPass, SendActivation, RecvActivation,
+    SendGrad, RecvGrad, LoadMicroBatch, OptimizerStep, ReduceGrads,
+    ReduceTiedGrads,
+)
+
+
+def _flat(sched):
+    return [c for step in sched for c in step]
+
+
+def _count(sched, cls):
+    return sum(1 for c in _flat(sched) if isinstance(c, cls))
+
+
+@pytest.mark.parametrize("micros,stages", [(1, 1), (4, 2), (8, 4), (3, 4)])
+def test_train_schedule_full_coverage(micros, stages):
+    """Every stage forwards and backwards every micro-batch exactly once."""
+    for stage in range(stages):
+        s = TrainSchedule(micro_batches=micros, stages=stages, stage_id=stage)
+        cmds = _flat(s)
+        assert sum(isinstance(c, ForwardPass) for c in cmds) == micros
+        assert sum(isinstance(c, BackwardPass) for c in cmds) == micros
+        assert sum(isinstance(c, OptimizerStep) for c in cmds) == 1
+        assert sum(isinstance(c, ReduceGrads) for c in cmds) == 1
+        assert sum(isinstance(c, ReduceTiedGrads) for c in cmds) == 1
+
+
+def test_train_schedule_step_count():
+    s = TrainSchedule(micro_batches=4, stages=2, stage_id=0)
+    assert len(list(s.steps())) == 2 * (4 + 2 - 1)
+
+
+def test_send_recv_pairing():
+    """Stage s sends exactly as many activations as stage s+1 receives —
+    AND every Send lands on the same tick as the matching neighbor Recv
+    (rendezvous-p2p pairing, reference schedule.py:200-232)."""
+    micros, stages = 4, 3
+    steps = [list(TrainSchedule(micros, stages, s).steps())
+             for s in range(stages)]
+    for s in range(stages - 1):
+        sends = sum(isinstance(c, SendActivation)
+                    for step in steps[s] for c in step)
+        recvs = sum(isinstance(c, RecvActivation)
+                    for step in steps[s + 1] for c in step)
+        assert sends == recvs == micros
+        gsends = sum(isinstance(c, SendGrad)
+                     for step in steps[s + 1] for c in step)
+        grecvs = sum(isinstance(c, RecvGrad)
+                     for step in steps[s] for c in step)
+        assert gsends == grecvs == micros
+        # same-tick pairing
+        for t in range(len(steps[s])):
+            n_send = sum(isinstance(c, SendActivation) for c in steps[s][t])
+            n_recv = sum(isinstance(c, RecvActivation)
+                         for c in steps[s + 1][t])
+            assert n_send == n_recv, (s, t)
+            n_gsend = sum(isinstance(c, SendGrad) for c in steps[s + 1][t])
+            n_grecv = sum(isinstance(c, RecvGrad) for c in steps[s][t])
+            assert n_gsend == n_grecv, (s, t)
+
+
+def test_first_last_stage_no_external_comm():
+    micros, stages = 4, 3
+    first = TrainSchedule(micros, stages, 0)
+    last = TrainSchedule(micros, stages, stages - 1)
+    assert _count(first, RecvActivation) == 0
+    assert _count(first, SendGrad) == 0
+    assert _count(last, SendActivation) == 0
+    assert _count(last, RecvGrad) == 0
+    # only first/last load data (reference pipe/engine.py:612-651)
+    assert _count(first, LoadMicroBatch) == micros
+    assert _count(last, LoadMicroBatch) == micros
+    mid = TrainSchedule(micros, stages, 1)
+    assert _count(mid, LoadMicroBatch) == 0
+
+
+def test_forward_before_backward_per_micro():
+    s = TrainSchedule(micro_batches=4, stages=2, stage_id=1)
+    seen_fwd = set()
+    for c in _flat(s):
+        if isinstance(c, ForwardPass):
+            seen_fwd.add(c.buffer_id)
+        if isinstance(c, BackwardPass):
+            assert c.buffer_id in seen_fwd
+
+
+def test_buffer_count():
+    assert TrainSchedule(8, 4, 0).num_pipe_buffers() == 5
+    assert TrainSchedule(8, 4, 3).num_pipe_buffers() == 2
+    assert TrainSchedule(1, 4, 0).num_pipe_buffers() == 2
+
+
+def test_inference_schedule():
+    micros, stages = 4, 2
+    for stage in range(stages):
+        s = InferenceSchedule(micros, stages, stage)
+        cmds = _flat(s)
+        assert sum(isinstance(c, ForwardPass) for c in cmds) == micros
+        assert sum(isinstance(c, BackwardPass) for c in cmds) == 0
+
+
+def test_data_parallel_schedule():
+    s = DataParallelSchedule(micro_batches=3, stages=1, stage_id=0)
+    cmds = _flat(s)
+    assert sum(isinstance(c, ForwardPass) for c in cmds) == 3
+    assert sum(isinstance(c, OptimizerStep) for c in cmds) == 1
+
+
+def test_invalid_stage_raises():
+    with pytest.raises(ValueError):
+        TrainSchedule(4, 2, 5)

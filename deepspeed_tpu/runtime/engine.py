@@ -421,6 +421,27 @@ class DeepSpeedEngine:
         return self.train_batch(batch)
 
     # ------------------------------------------------------------------
+    # checkpointing (reference engine.py:1211-1478)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        from .checkpointing import save_checkpoint
+        return save_checkpoint(self, save_dir, tag=tag,
+                               client_state=client_state,
+                               save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir, tag=None,
+                        load_optimizer_states=True,
+                        load_lr_scheduler_states=True,
+                        load_module_only=False):
+        from .checkpointing import load_checkpoint
+        return load_checkpoint(
+            self, load_dir, tag=tag,
+            load_optimizer_states=load_optimizer_states,
+            load_lr_scheduler_states=load_lr_scheduler_states,
+            load_module_only=load_module_only)
+
+    # ------------------------------------------------------------------
     # introspection / logging
     # ------------------------------------------------------------------
     @property

@@ -195,6 +195,14 @@ class _PipelinedTrainModule(TrainModule):
                 m_idx = jnp.clip(m, 0, M - 1)
                 active = (m >= 0) & (m < M)
                 y = jax.lax.switch(stage, branches, buf, m_idx)
+                # Fill/drain ticks run the stage on recycled activations.
+                # Zero their outputs: otherwise an inf/NaN produced from
+                # garbage input survives into the scan's backward pass
+                # (0 * inf = NaN) and poisons the real gradients.  With
+                # outputs zeroed, inactive inputs are always zeros (buf0 is
+                # zeros and the ring only carries masked values).
+                y = jax.tree.map(
+                    lambda a: jnp.where(active, a, jnp.zeros_like(a)), y)
 
                 def loss_branch(_):
                     lb = jax.tree.map(lambda a: a[m_idx], micros_lb)
@@ -263,26 +271,15 @@ class PipelineEngine(DeepSpeedEngine):
             f"micro_batches={self.micro_batches} parts={model.parts}",
             ranks=[0])
 
-    def _shard_batch(self, batch):
+    def _batch_leading_reshape(self, x):
         """The pipeline consumes all micro-batches in one program — no outer
         grad-accum scan.  Present the batch as [1, total, ...] (the engine's
         scan dim) sharded over ``data`` on the sample dim."""
-        def reshape(x):
-            x = np.asarray(x)
-            expect = self.train_batch_size
-            if x.shape[0] != expect:
-                raise ValueError(
-                    f"batch dim {x.shape[0]} != train_batch_size {expect}")
-            return x.reshape((1,) + x.shape)
-
-        batch = jax.tree.map(reshape, batch)
-
-        def shard(x):
-            spec = [None] * x.ndim
-            spec[1] = DATA_AXIS
-            return jax.device_put(x, NamedSharding(self.mesh, P(*spec)))
-
-        return jax.tree.map(shard, batch)
+        expect = self.train_batch_size
+        if x.shape[0] != expect:
+            raise ValueError(
+                f"batch dim {x.shape[0]} != train_batch_size {expect}")
+        return x.reshape((1,) + x.shape)
 
     @property
     def _scan_grad_acc(self) -> int:

@@ -322,22 +322,24 @@ class DeepSpeedEngine:
             collate_fn=collate_fn,
             mesh=self.mesh)
 
-    def _shard_batch(self, batch):
-        """Place a global batch as [grad_acc, micro_global, ...] sharded over
-        the data axis on dim 1."""
+    def _batch_leading_reshape(self, x: np.ndarray) -> np.ndarray:
+        """[train_batch, ...] → [grad_acc, micro_global, ...] (the engine's
+        accumulation-scan layout).  The pipeline engine overrides this —
+        it's the only part of batch placement that differs there."""
         ga, mb = self.gradient_accumulation_steps, self.micro_batch_size
         micro_global = mb * self.dp_world_size
+        expect = ga * micro_global
+        if x.shape[0] != expect:
+            raise ValueError(
+                f"batch dim {x.shape[0]} != train_batch_size {expect} "
+                f"(grad_acc {ga} × micro {mb} × dp {self.dp_world_size})")
+        return x.reshape((ga, micro_global) + x.shape[1:])
 
-        def reshape(x):
-            x = np.asarray(x)
-            expect = ga * micro_global
-            if x.shape[0] != expect:
-                raise ValueError(
-                    f"batch dim {x.shape[0]} != train_batch_size {expect} "
-                    f"(grad_acc {ga} × micro {mb} × dp {self.dp_world_size})")
-            return x.reshape((ga, micro_global) + x.shape[1:])
-
-        batch = jax.tree.map(reshape, batch)
+    def _shard_batch(self, batch):
+        """Place a global batch as [leading, samples, ...] sharded over the
+        data axis on dim 1."""
+        batch = jax.tree.map(
+            lambda x: self._batch_leading_reshape(np.asarray(x)), batch)
 
         def shard(x):
             spec = [None] * x.ndim

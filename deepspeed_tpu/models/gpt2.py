@@ -41,6 +41,7 @@ class GPT2Config:
     dropout: float = 0.0
     embd_dropout: float = 0.0
     remat: Optional[str] = "block"   # None | 'block'
+    attn_impl: str = "flash"         # 'flash' (Pallas kernel) | 'dense'
 
     @property
     def d_head(self) -> int:
@@ -189,8 +190,17 @@ def gpt2_block_forward(cfg: GPT2Config, bp, x, rng, train: bool):
     def heads(t):
         return t.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
 
-    attn = causal_attention(heads(q), heads(k), heads(v),
-                            dropout_rate=drop, dropout_rng=r1)
+    if cfg.attn_impl == "flash":
+        # Pallas flash kernel (falls back to dense when prob-dropout on).
+        from ..ops.pallas.flash_attention import mha
+        attn = mha(heads(q), heads(k), heads(v),
+                   dropout_rate=drop, dropout_rng=r1, causal=True)
+    elif cfg.attn_impl == "dense":
+        attn = causal_attention(heads(q), heads(k), heads(v),
+                                dropout_rate=drop, dropout_rng=r1)
+    else:
+        raise ValueError(
+            f"attn_impl={cfg.attn_impl!r}: expected 'flash' or 'dense'")
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
     attn = attn @ bp["out_w"].astype(h.dtype) + bp["out_b"].astype(h.dtype)
     x = x + _dropout(attn, drop, r2)

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      dropout_rate: float = 0.0,
                      dropout_rng: Optional[jax.Array] = None,
-                     mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                     mask: Optional[jnp.ndarray] = None,
+                     sm_scale: Optional[float] = None) -> jnp.ndarray:
     """Multi-head causal attention.
 
     q, k, v: [B, H, T, Dh].  Softmax accumulates in fp32 (matching the
@@ -26,7 +27,8 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     csrc/transformer/softmax_kernels.cu) and returns q.dtype.
     """
     B, H, T, Dh = q.shape
-    scale = 1.0 / jnp.sqrt(Dh).astype(jnp.float32)
+    scale = (jnp.asarray(sm_scale, jnp.float32) if sm_scale is not None
+             else 1.0 / jnp.sqrt(Dh).astype(jnp.float32))
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     causal = jnp.tril(jnp.ones((T, T), bool))

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels — the framework's replacement for the reference's
+hand-written CUDA kernel layer (reference: csrc/).
+
+Each kernel ships with a pure-jnp reference implementation and a
+differential test, mirroring the reference's kernel-vs-HuggingFace test
+strategy (reference: tests/unit/test_cuda_forward.py).
+"""
+from .flash_attention import flash_attention  # noqa: F401

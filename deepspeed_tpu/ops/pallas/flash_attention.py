@@ -1,0 +1,387 @@
+"""Flash attention as a Pallas TPU kernel (forward + backward).
+
+This is the TPU-native replacement for the reference's fused attention
+path inside the CUDA transformer layer (reference:
+csrc/transformer/softmax_kernels.cu + strided-batch GEMMs composed in
+csrc/transformer/ds_transformer_cuda.cpp:99-121, whose fused softmax is
+capped at seq 1024 — ds_transformer_cuda.cpp:124).  The Pallas kernel has
+no sequence cap: scores are never materialised in HBM; an online-softmax
+accumulator streams over key blocks in VMEM, so memory is O(T·D) instead
+of O(T²), and both matmuls per block hit the MXU.
+
+Layout: grid = (batch·heads, q_blocks, k_blocks) with the k axis
+innermost; VMEM scratch (running max `m`, normaliser `l`, output
+accumulator) persists across the k iterations of one q block.  The
+backward pass recomputes probabilities per block from the saved
+log-sum-exp (classic flash-attention-2 style) in two kernels: one
+accumulating dQ over k blocks, one accumulating dK/dV over q blocks.
+
+Numerics: softmax statistics and all accumulators are fp32 regardless of
+input dtype (matching the reference kernel's fp32 softmax accumulation
+for fp16 inputs).
+
+Dropout inside the kernel is not supported; the module-level `mha`
+wrapper falls back to the dense XLA path (ops/attention.py) when
+attention-probability dropout is active (training with
+attn_dropout > 0), which the reference also treats as the
+memory-hungry path (attn_dropout_checkpoint knob,
+reference: deepspeed/ops/transformer/transformer.py:108-117).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    from .runtime import use_interpret
+    return use_interpret()
+
+
+def _pad_seq(x, block, axis):
+    t = x.shape[axis]
+    pad = (-t) % block
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+
+def _masked_scores(q, k, iq, ik, *, sm_scale, causal, block_q, block_k,
+                   seq_len):
+    """Scaled q·kᵀ for one (q-block, k-block) tile with padding + causal
+    masking — the single source of the mask math shared by the forward
+    and both backward kernels (they must stay bit-identical or forward
+    and backward silently disagree)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale      # [bq, bk]
+    k_ids = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    k_global = k_ids + ik * block_k
+    valid = k_global < seq_len
+    if causal:
+        q_ids = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        valid = jnp.logical_and(valid, k_global <= q_ids + iq * block_q)
+    return jnp.where(valid, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, sm_scale: float, causal: bool, block_q: int,
+                block_k: int, seq_len: int):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Whole k block strictly above the causal diagonal → nothing to do.
+    run = True
+    if causal:
+        run = (ik * block_k) <= (iq * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]                                   # [bq, d]
+        k = k_ref[0]                                   # [bk, d]
+        v = v_ref[0]                                   # [bk, d]
+        s = _masked_scores(q, k, iq, ik, sm_scale=sm_scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           seq_len=seq_len)
+
+        m_prev = m_scr[:, 0:1]                          # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)       # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                          # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)                 # [bq, 1]
+        l_new = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = m_scr[:, 0:1] + jnp.log(l_safe)           # [bq, 1]
+        # lse_ref holds the full padded row (TPU tiling forbids
+        # (1, block_q) blocks); store this q block's slice.
+        lse_ref[0, 0, pl.ds(iq * block_q, block_q)] = jnp.transpose(lse)[0]
+
+
+def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, max(t, 8))
+    block_k = min(block_k, max(tk, 8))
+    qp = _pad_seq(q, block_q, 1)
+    kp = _pad_seq(k, block_k, 1)
+    vp = _pad_seq(v, block_k, 1)
+    tq_p, tk_p = qp.shape[1], kp.shape[1]
+    nq, nk = tq_p // block_q, tk_p // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=tk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, tq_p), lambda b, i, j: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, tq_p), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :t], lse[:, 0, :t]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, sm_scale, causal, block_q, block_k, seq_len):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = True
+    if causal:
+        run = (ik * block_k) <= (iq * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        qs = pl.ds(iq * block_q, block_q)
+        lse = jnp.transpose(lse_ref[0, 0:1, qs])        # [bq, 1]
+        delta = jnp.transpose(delta_ref[0, 0:1, qs])    # [bq, 1]
+
+        s = _masked_scores(q, k, iq, ik, sm_scale=sm_scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           seq_len=seq_len)
+        p = jnp.exp(s - lse)                            # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [bq, bk]
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, sm_scale, causal, block_q, block_k, seq_len):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = True
+    if causal:
+        run = (ik * block_k) <= (iq * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        qs = pl.ds(iq * block_q, block_q)
+        lse = jnp.transpose(lse_ref[0, 0:1, qs])        # [bq, 1]
+        delta = jnp.transpose(delta_ref[0, 0:1, qs])    # [bq, 1]
+
+        s = _masked_scores(q, k, iq, ik, sm_scale=sm_scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           seq_len=seq_len)
+        p = jnp.exp(s - lse)                            # [bq, bk]
+        # dV += Pᵀ · dO
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale                # [bq, bk]
+        # dK += dSᵀ · Q
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, out, lse, do, *, sm_scale, causal, block_q, block_k,
+         interpret):
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, max(t, 8))
+    block_k = min(block_k, max(tk, 8))
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                            # [bh, t]
+
+    qp = _pad_seq(q, block_q, 1)
+    dop = _pad_seq(do, block_q, 1)
+    lsep = _pad_seq(lse, block_q, 1)[:, None, :]
+    deltap = _pad_seq(delta, block_q, 1)[:, None, :]
+    kp = _pad_seq(k, block_k, 1)
+    vp = _pad_seq(v, block_k, 1)
+    tq_p, tk_p = qp.shape[1], kp.shape[1]
+    nq, nk = tq_p // block_q, tk_p // block_k
+
+    q_spec_i = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec_j = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    # full padded row per program (TPU tiling forbids (1, block_q) blocks);
+    # kernels slice their q block out with pl.ds.
+    row_spec = pl.BlockSpec((1, 1, tq_p), lambda b, i, j: (b, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=tk),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec,
+                  row_spec],
+        out_specs=q_spec_i,
+        out_shape=jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    # dK/dV: k blocks outer, q blocks inner.
+    q_spec_j = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    kv_spec_i = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=tk),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec_j, kv_spec_i, kv_spec_i, q_spec_j, row_spec,
+                  row_spec],
+        out_specs=[kv_spec_i, kv_spec_i],
+        out_shape=[jax.ShapeDtypeStruct((bh, tk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, tk_p, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+    return dq[:, :t], dk[:, :tk], dv[:, :tk]
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, sm_scale=sm_scale, causal=causal,
+                  block_q=block_q, block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, sm_scale=sm_scale, causal=causal,
+                    block_q=block_q, block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, out, lse, do, sm_scale=sm_scale,
+                      causal=causal, block_q=block_q, block_k=block_k,
+                      interpret=interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention over [B, H, T, Dh] inputs (differentiable).
+
+    Drop-in for ops.attention.causal_attention with dropout_rate=0; use
+    `mha` for the dropout-aware dispatcher.
+    """
+    assert q.ndim == 4, f"expected [B, H, T, D], got {q.shape}"
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = float(d) ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    out = _flash(qf, kf, vf, sm_scale, causal, block_q, block_k, interpret)
+    return out.reshape(b, h, t, d)
+
+
+def mha(q, k, v, dropout_rate: float = 0.0, dropout_rng=None,
+        causal: bool = True, **kwargs):
+    """Attention dispatcher: Pallas flash kernel unless probability
+    dropout is active (then the dense XLA path, which supports it)."""
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        from ..attention import causal_attention
+        assert causal, "dense fallback is causal-only"
+        unsupported = set(kwargs) - {"sm_scale", "block_q", "block_k"}
+        if unsupported:
+            raise TypeError(f"mha dense fallback: unsupported {unsupported}")
+        return causal_attention(q, k, v, dropout_rate=dropout_rate,
+                                dropout_rng=dropout_rng,
+                                sm_scale=kwargs.get("sm_scale"))
+    return flash_attention(q, k, v, causal=causal, **kwargs)

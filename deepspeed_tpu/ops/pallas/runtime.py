@@ -1,0 +1,43 @@
+"""Pallas execution-mode plumbing.
+
+Pallas kernels must run in interpret mode off-TPU (CPU test meshes, the
+driver's virtual-device dryrun).  ``jax.default_backend()`` is not a
+reliable signal on this image — the TPU platform stays registered as
+default even when the computation is placed on CPU devices — so each
+engine declares the execution platform of *its* mesh around the calls
+that trace its compiled steps (runtime/engine.py), and kernels consult
+it at trace time.  A scoped setting (not a set-once global) keeps
+several engines with different meshes in one process honest.
+"""
+from __future__ import annotations
+
+import contextlib
+from contextvars import ContextVar
+from typing import Optional
+
+import jax
+
+_interpret_override: ContextVar[Optional[bool]] = ContextVar(
+    "pallas_interpret", default=None)
+
+
+@contextlib.contextmanager
+def interpret_scope(value: Optional[bool]):
+    """Force interpret mode (or None = auto) within the scope."""
+    token = _interpret_override.set(value)
+    try:
+        yield
+    finally:
+        _interpret_override.reset(token)
+
+
+def mesh_wants_interpret(mesh) -> bool:
+    """True when the mesh's devices are not real TPU chips."""
+    return mesh.devices.flat[0].platform != "tpu"
+
+
+def use_interpret() -> bool:
+    override = _interpret_override.get()
+    if override is not None:
+        return override
+    return jax.default_backend() != "tpu"

@@ -82,6 +82,14 @@ class DeepSpeedEngine:
         self.mesh = mesh if mesh is not None else build_mesh()
         self.dp_world_size = mesh_axis_size(self.mesh, DATA_AXIS)
 
+        # Pallas kernels need interpret mode off-TPU; the mesh knows where
+        # the computation actually runs (see ops/pallas/runtime.py).  The
+        # scope is entered around compiled-step calls (_pallas_scope) so
+        # engines on different meshes don't fight over a global.
+        from ..ops.pallas.runtime import interpret_scope, mesh_wants_interpret
+        self._pallas_interpret = mesh_wants_interpret(self.mesh)
+        self._pallas_scope = lambda: interpret_scope(self._pallas_interpret)
+
         self.compute_dtype = precision.select_compute_dtype(
             config.fp16_enabled, config.bf16_enabled)
         self.micro_batch_size = config.train_micro_batch_size_per_gpu
@@ -361,7 +369,8 @@ class DeepSpeedEngine:
             batch = next(it)
         t0 = time.time()
         sharded = self._shard_batch(batch)
-        self.state, metrics = self._train_step(self.state, sharded)
+        with self._pallas_scope():
+            self.state, metrics = self._train_step(self.state, sharded)
         # Materialize metrics on host before stopping the clock: JAX dispatch
         # is async and on some platforms (axon tunnel) block_until_ready
         # returns before completion — np.asarray is the reliable sync, and
@@ -389,14 +398,17 @@ class DeepSpeedEngine:
     def eval_batch(self, batch):
         micro = jax.tree.map(np.asarray, batch)
         rng = jax.random.fold_in(self._data_rng, self.micro_steps)
-        return self._eval_step(self.state, micro, rng)
+        with self._pallas_scope():
+            return self._eval_step(self.state, micro, rng)
 
     # --- reference-style imperative facade -----------------------------
     def forward(self, batch):
         """Compat shim for the reference trio (engine.py:779): computes the
         micro-batch loss and queues the batch for the fused step."""
         rng = jax.random.fold_in(self._data_rng, self.micro_steps)
-        loss = self._eval_step(self.state, jax.tree.map(np.asarray, batch), rng)
+        with self._pallas_scope():
+            loss = self._eval_step(self.state,
+                                   jax.tree.map(np.asarray, batch), rng)
         self._pending_micros.append(batch)
         return loss
 

@@ -1,0 +1,12 @@
+"""Compressed data-parallel communication (reference feature slot:
+deepspeed/runtime/fp16/onebit_adam.py + custom_collectives.py)."""
+from .onebit import (OnebitAdamState, compressed_allreduce,
+                     init_onebit_state, onebit_adam, pack_signs,
+                     padded_size, simulated_compressed_allreduce,
+                     unpack_signs)
+
+__all__ = [
+    "OnebitAdamState", "compressed_allreduce", "init_onebit_state",
+    "onebit_adam", "pack_signs", "padded_size",
+    "simulated_compressed_allreduce", "unpack_signs",
+]

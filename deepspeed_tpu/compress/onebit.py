@@ -1,11 +1,272 @@
-"""1-bit Adam (error-feedback sign compression over the data axis).
+"""1-bit Adam — error-feedback sign compression, TPU-native.
 
-Implementation lands with the compression milestone; this placeholder keeps
-the engine's optimizer dispatch importable with a clear error.
+The reference implements 1-bit Adam (APMSqueeze) as a torch optimizer with a
+two-phase MPI+cupy compressed allreduce (reference:
+deepspeed/runtime/fp16/onebit_adam.py:104-228, custom_collectives.py:23-154):
+
+  worker:  buf = momentum + worker_error
+           scale = ||buf||_2 / sqrt(n); sign-compress; update worker_error
+           chunk into world_size pieces; igather chunk r to server r
+  server:  mean of workers' scaled signs for its chunk (+ server_error)
+           re-compress with server_error feedback; allgather result
+
+Here the same algorithm is expressed with XLA collectives over a named mesh
+axis: the igather-to-servers becomes ``lax.all_to_all`` of bit-packed uint8
+sign buffers (so the wire volume really is 1/32 of fp32, matching the
+reference's cupy.packbits scheme), and the result allgather becomes
+``lax.all_gather``.  One backend covers ICI and DCN — no MPI/NCCL split
+(custom_collectives.py's cuda_aware fork disappears).
+
+Two execution modes, chosen automatically at trace time:
+  - inside ``shard_map`` with the data axis bound: the real multi-worker
+    collective (each shard compresses its *local* momentum).
+  - under plain ``jit`` with pre-averaged gradients (the standard engine
+    path, where XLA already reduced the grads): the single-worker
+    simulation, which is bit-identical to the real collective when all
+    workers hold the same buffer (the worker mean equals each worker's own
+    compressed value).
+
+The optimizer state machine mirrors the reference step
+(onebit_adam.py:230-374): steps 1..freeze_step run plain Adam updating both
+moments; afterwards the variance is frozen and only the sign-compressed
+momentum is exchanged.  Unlike the reference — which allocates error buffers
+lazily and drops them on the bootstrap step (onebit_adam.py:356-359, a known
+wart) — the error-feedback state lives in the optimizer pytree from step 0
+and therefore survives checkpointing (SURVEY.md §7 "hard parts").
+
+Note the reference computes a ``bias_correction`` flag but never applies it
+in the update (onebit_adam.py:267,321-350); we reproduce the *actual*
+behavior (no bias correction) rather than the dead flag.
 """
 from __future__ import annotations
 
+from typing import Callable, NamedTuple, Optional, Tuple, Union
 
-def onebit_adam(*args, **kwargs):
-    raise NotImplementedError(
-        "onebitadam is not implemented yet in this build; use 'adam'")
+import jax
+import jax.numpy as jnp
+import optax
+
+ScalarOrSchedule = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
+
+# packbits-compatible big-endian bit weights (cupy.packbits default order)
+_BIT_WEIGHTS = (128, 64, 32, 16, 8, 4, 2, 1)
+
+
+def pack_signs(bits: jnp.ndarray) -> jnp.ndarray:
+    """bool [..., 8k] → uint8 [..., k], big-endian like cupy.packbits."""
+    w = jnp.asarray(_BIT_WEIGHTS, jnp.uint8)
+    b = bits.reshape(bits.shape[:-1] + (-1, 8)).astype(jnp.uint8)
+    return jnp.sum(b * w, axis=-1, dtype=jnp.uint8)
+
+
+def unpack_signs(packed: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [..., k] → ±1 float32 [..., 8k] (0-bit → −1, 1-bit → +1)."""
+    w = jnp.asarray(_BIT_WEIGHTS, jnp.uint8)
+    bits = (packed[..., None] & w) > 0
+    pm = bits.astype(jnp.float32) * 2.0 - 1.0
+    return pm.reshape(packed.shape[:-1] + (-1,))
+
+
+def _sign_compress(buf: jnp.ndarray, error: jnp.ndarray
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Error-feedback sign compression of a flat buffer.
+
+    Returns (sign ±1, scale, new_error).  Matches the reference's
+    ``scale = ||buf||_2 / sqrt(n)`` and sign(0) → +1 convention
+    (onebit_adam.py:122-128: sign().add_(1).bool() maps 0 to True).
+    """
+    buf = buf + error
+    scale = jnp.linalg.norm(buf) / jnp.sqrt(jnp.asarray(buf.size, jnp.float32))
+    sign = jnp.where(buf >= 0, 1.0, -1.0).astype(jnp.float32)
+    new_error = buf - scale * sign
+    return sign, scale, new_error
+
+
+def padded_size(n: int, world: int) -> int:
+    """Pad length so every per-server chunk is a whole number of bytes
+    (the reference's ``corrected_tensor_size``, onebit_adam.py:294-300)."""
+    q = world * 8
+    return ((n + q - 1) // q) * q
+
+
+def compressed_allreduce(x: jnp.ndarray,
+                         worker_error: jnp.ndarray,
+                         server_error: jnp.ndarray,
+                         axis_name: str
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Two-phase error-compensated 1-bit allreduce over a mesh axis.
+
+    Must run inside ``shard_map`` with ``axis_name`` bound.  ``x`` is this
+    worker's flat fp32 buffer; ``worker_error`` has length
+    ``padded_size(x.size, world)`` and ``server_error`` one world-th of
+    that.  Returns (averaged buffer [x.size], new worker_error, new
+    server_error).
+    """
+    world = jax.lax.axis_size(axis_name)
+    n = x.size
+    P = worker_error.size
+    chunk = P // world
+    assert P == padded_size(n, world) and server_error.size == chunk, (
+        f"error-buffer sizes ({P}, {server_error.size}) do not match "
+        f"padded_size({n}, {world})={padded_size(n, world)}")
+
+    buf = jnp.pad(x.astype(jnp.float32), (0, P - n))
+    sign, scale, new_we = _sign_compress(buf, worker_error)
+
+    # Phase 1: igather-to-servers ≡ all_to_all of packed sign chunks.
+    packed = pack_signs(sign.reshape(world, chunk) > 0)        # [world, chunk/8]
+    recv = jax.lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0)
+    scales = jax.lax.all_gather(scale, axis_name)              # [world]
+
+    # Server: average workers' scaled signs for my chunk, re-compress.
+    comp = jnp.mean(unpack_signs(recv) * scales[:, None], axis=0)
+    ssign, sscale, new_se = _sign_compress(comp, server_error)
+
+    # Phase 2: allgather of the servers' compressed chunks.
+    spacked = pack_signs(ssign > 0)                            # [chunk/8]
+    all_signs = jax.lax.all_gather(spacked, axis_name)         # [world, chunk/8]
+    all_scales = jax.lax.all_gather(sscale, axis_name)         # [world]
+    out = (unpack_signs(all_signs) * all_scales[:, None]).reshape(P)[:n]
+    return out, new_we, new_se
+
+
+def simulated_compressed_allreduce(x: jnp.ndarray,
+                                   worker_error: jnp.ndarray,
+                                   server_error: jnp.ndarray
+                                   ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                              jnp.ndarray]:
+    """The collective's fixed point when every worker holds the same buffer
+    (the engine's pre-averaged-gradient path): worker compress → server
+    compress, no communication.  ``server_error`` here spans the full
+    padded buffer (world=1 chunking)."""
+    n = x.size
+    P = worker_error.size
+    buf = jnp.pad(x.astype(jnp.float32), (0, P - n))
+    sign, scale, new_we = _sign_compress(buf, worker_error)
+    comp = scale * sign
+    ssign, sscale, new_se = _sign_compress(comp, server_error)
+    return (sscale * ssign)[:n], new_we, new_se
+
+
+class OnebitAdamState(NamedTuple):
+    count: jnp.ndarray           # applied steps (i32)
+    mu: optax.Updates            # momentum (fp32)
+    nu: optax.Updates            # variance (fp32, frozen after freeze_step)
+    worker_error: optax.Updates  # flat padded, per leaf
+    server_error: optax.Updates  # flat padded/world, per leaf
+
+
+def _axis_bound(axis_name: Optional[str]) -> bool:
+    """True iff we are tracing inside a context (shard_map/pmap) where
+    ``axis_name`` is a bound mesh axis — decided at trace time."""
+    if axis_name is None:
+        return False
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except Exception:
+        return False
+
+
+def onebit_adam(lr: ScalarOrSchedule = 1e-3,
+                betas: Tuple[float, float] = (0.9, 0.999),
+                eps: float = 1e-8,
+                weight_decay: float = 0.0,
+                freeze_step: int = 100000,
+                data_axis: Optional[str] = None
+                ) -> optax.GradientTransformation:
+    """1-bit Adam as an optax transformation.
+
+    ``data_axis``: mesh axis for the compressed collective.  When the
+    transform is traced inside ``shard_map`` with that axis bound, momentum
+    is exchanged with the real 1-bit collective (error buffers must then be
+    sized for that world via ``init_onebit_state``); otherwise (plain ``jit``
+    with already-reduced grads) the equivalent single-worker compression is
+    applied.  Warmup steps (1..freeze_step) are plain Adam, matching the
+    reference's freeze transition (onebit_adam.py:366-369: compression
+    starts on the step *after* ``step >= freeze_step``).
+    """
+    b1, b2 = betas
+
+    def init_fn(params):
+        return init_onebit_state(params, 1)
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("onebit_adam requires params (weight decay)")
+        count = state.count + 1
+        use_collective = _axis_bound(data_axis)
+
+        def leaf_update(g, p, mu, nu, we, se):
+            g = g.astype(jnp.float32)
+
+            def warm(_):
+                # Collective mode receives *local* grads; during warmup the
+                # reference relies on the engine's uncompressed allreduce
+                # (it sets enable_backward_allreduce=False only at freeze,
+                # onebit_adam.py:366-372), so the reduction happens here.
+                ga = jax.lax.pmean(g, data_axis) if use_collective else g
+                mu2 = b1 * mu + (1 - b1) * ga
+                nu2 = b2 * nu + (1 - b2) * ga * ga
+                return mu2, nu2, we, se
+
+            def frozen(_):
+                # local grad feeds the momentum; the compressed collective
+                # is what crosses workers (onebit_adam.py:336-348)
+                mu2 = b1 * mu + (1 - b1) * g
+                flat = mu2.reshape(-1)
+                if use_collective:
+                    out, we2, se2 = compressed_allreduce(
+                        flat, we, se, data_axis)
+                else:
+                    out, we2, se2 = simulated_compressed_allreduce(
+                        flat, we, se)
+                return out.reshape(mu2.shape), nu, we2, se2
+
+            mu2, nu2, we2, se2 = jax.lax.cond(
+                count <= freeze_step, warm, frozen, operand=None)
+            upd = mu2 / (jnp.sqrt(nu2) + eps)
+            if weight_decay > 0.0:
+                upd = upd + weight_decay * p.astype(jnp.float32)
+            step_lr = lr(count) if callable(lr) else jnp.asarray(
+                lr, jnp.float32)
+            return (-step_lr * upd).astype(p.dtype), mu2, nu2, we2, se2
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        outs = [leaf_update(g, p, mu, nu, we, se) for g, p, mu, nu, we, se
+                in zip(flat_g,
+                       jax.tree.leaves(params),
+                       jax.tree.leaves(state.mu),
+                       jax.tree.leaves(state.nu),
+                       jax.tree.leaves(state.worker_error),
+                       jax.tree.leaves(state.server_error))]
+        unflatten = lambda i: jax.tree.unflatten(
+            treedef, [o[i] for o in outs])
+        new_state = OnebitAdamState(
+            count=count, mu=unflatten(1), nu=unflatten(2),
+            worker_error=unflatten(3), server_error=unflatten(4))
+        return unflatten(0), new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def init_onebit_state(params, world: int) -> OnebitAdamState:
+    """Error-buffer initialization for the real collective path: buffers
+    sized for a data axis of ``world`` shards (shard_map users call this
+    instead of ``tx.init``, whose world=1 sizing fits only the simulated
+    path)."""
+    zeros = lambda p: jnp.zeros(jnp.shape(p), jnp.float32)
+    we = jax.tree.map(
+        lambda p: jnp.zeros((padded_size(int(jnp.size(p)), world),),
+                            jnp.float32), params)
+    se = jax.tree.map(
+        lambda p: jnp.zeros(
+            (padded_size(int(jnp.size(p)), world) // world,),
+            jnp.float32), params)
+    return OnebitAdamState(
+        count=jnp.zeros([], jnp.int32),
+        mu=jax.tree.map(zeros, params),
+        nu=jax.tree.map(zeros, params),
+        worker_error=we,
+        server_error=se,
+    )

@@ -360,6 +360,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     assert q.ndim == 4, f"expected [B, H, T, D], got {q.shape}"
     b, h, t, d = q.shape
     tk = k.shape[2]
+    # The causal mask is top-left-anchored (k_pos <= q_pos); with t != tk
+    # that silently mis-masks (e.g. a KV-cache decode step would attend to
+    # key 0 only).  Cross-length callers must use causal=False.
+    assert not causal or t == tk, (
+        f"causal flash attention requires equal q/k lengths, got {t} vs "
+        f"{tk}; pass causal=False for cross-attention")
     if sm_scale is None:
         sm_scale = float(d) ** -0.5
     if interpret is None:

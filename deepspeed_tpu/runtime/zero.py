@@ -124,13 +124,23 @@ class ZeroShardingPlan:
         # with the same structure as params gets master specs; scalars get P().
         params_def = jax.tree.structure(params)
 
+        param_shapes = [_leaf_shape(l) for l in jax.tree.leaves(params)]
+
         def match(subtree):
+            """Same structure AND same leaf shapes as params.  The shape
+            check matters: optimizer states may carry param-structured trees
+            whose leaves are NOT param-shaped (e.g. 1-bit Adam's flat error
+            buffers), and assigning them master specs would be wrong."""
             try:
-                if jax.tree.structure(subtree) == params_def:
+                if (jax.tree.structure(subtree) == params_def
+                        and [_leaf_shape(l) for l in
+                             jax.tree.leaves(subtree)] == param_shapes):
                     return jax.tree.unflatten(params_def, master_leaves)
             except Exception:
                 pass
             return None
+
+        sharded = self.stage >= 1
 
         def recurse(node):
             m = match(node)
@@ -141,7 +151,14 @@ class ZeroShardingPlan:
                 return type(node)(out) if not hasattr(node, "_fields") else type(node)(*out)
             if isinstance(node, dict):
                 return {k: recurse(v) for k, v in node.items()}
-            return P()  # scalar counters etc.
+            # non-param-shaped state (e.g. 1-bit Adam's flat error buffers):
+            # shard over data when divisible — replicating a full-param-size
+            # fp32 buffer per device would undo the ZeRO memory win.  Scalar
+            # counters have no divisible dim and stay replicated.
+            shape = _leaf_shape(node)
+            if sharded and shape:
+                return shard_spec_for_leaf(shape, self.dp, DATA_AXIS)
+            return P()
 
         return recurse(opt_state)
 

@@ -164,7 +164,10 @@ def _axis_bound(axis_name: Optional[str]) -> bool:
     try:
         jax.lax.axis_index(axis_name)
         return True
-    except Exception:
+    except NameError:
+        # the one expected failure: axis not bound here (plain jit).  Any
+        # other exception (typo'd axis colliding with a bound one, API
+        # breakage) must surface, not silently select the simulated path.
         return False
 
 

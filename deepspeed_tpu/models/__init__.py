@@ -2,3 +2,4 @@ from .gpt2 import (
     GPT2Config, GPT2Model,
     GPT2_SMALL, GPT2_MEDIUM, GPT2_LARGE, GPT2_XL,
 )
+from .bert import BertConfig, BertModel, BERT_BASE, BERT_LARGE

@@ -1,0 +1,18 @@
+"""Block-sparse attention — the reference's long-sequence feature slot
+(reference: deepspeed/ops/sparse_attention/)."""
+from .sparsity_config import (BigBirdSparsityConfig,
+                              BSLongformerSparsityConfig,
+                              DenseSparsityConfig, FixedSparsityConfig,
+                              SparsityConfig, VariableSparsityConfig)
+from .sparse_self_attention import SparseSelfAttention, build_lut
+from .bert_sparse_self_attention import (BertSelfAttentionConfig,
+                                         BertSparseSelfAttention)
+from .sparse_attention_utils import SparseAttentionUtils
+
+__all__ = [
+    "BigBirdSparsityConfig", "BSLongformerSparsityConfig",
+    "DenseSparsityConfig", "FixedSparsityConfig", "SparsityConfig",
+    "VariableSparsityConfig", "SparseSelfAttention", "build_lut",
+    "BertSelfAttentionConfig", "BertSparseSelfAttention",
+    "SparseAttentionUtils",
+]

@@ -1,0 +1,70 @@
+"""BERT-style sparse self-attention block (reference:
+deepspeed/ops/sparse_attention/bert_sparse_self_attention.py:1-78).
+
+Functional JAX flavor of the reference's drop-in BERT layer: Q/K/V linear
+projections + block-sparse attention with the incoming attention mask used
+as a key-padding mask ('add' mode, matching the reference default where the
+HF mask is already additive -10000.0 style).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .sparse_self_attention import SparseSelfAttention
+from .sparsity_config import FixedSparsityConfig, SparsityConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class BertSelfAttentionConfig:
+    hidden_size: int
+    num_attention_heads: int
+
+    @property
+    def attention_head_size(self) -> int:
+        if self.hidden_size % self.num_attention_heads != 0:
+            raise ValueError(
+                f"hidden size {self.hidden_size} is not a multiple of "
+                f"attention heads {self.num_attention_heads}")
+        return self.hidden_size // self.num_attention_heads
+
+
+class BertSparseSelfAttention:
+    """``__call__(params, hidden_states, attention_mask)`` →
+    context [B, T, hidden]."""
+
+    def __init__(self, config: BertSelfAttentionConfig,
+                 sparsity_config: Optional[SparsityConfig] = None):
+        self.config = config
+        self.sparse_attn = SparseSelfAttention(
+            sparsity_config or FixedSparsityConfig(
+                num_heads=config.num_attention_heads),
+            key_padding_mask_mode="add")
+
+    def init(self, rng):
+        d = self.config.hidden_size
+        keys = jax.random.split(rng, 3)
+        std = 0.02
+        mk = lambda k: {"w": jax.random.normal(k, (d, d), jnp.float32) * std,
+                        "b": jnp.zeros((d,), jnp.float32)}
+        return {"query": mk(keys[0]), "key": mk(keys[1]),
+                "value": mk(keys[2])}
+
+    def _split_heads(self, x):
+        B, T, _ = x.shape
+        H = self.config.num_attention_heads
+        Dh = self.config.attention_head_size
+        return x.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+
+    def __call__(self, params, hidden_states, attention_mask=None):
+        proj = lambda p: hidden_states @ p["w"].astype(hidden_states.dtype) \
+            + p["b"].astype(hidden_states.dtype)
+        q = self._split_heads(proj(params["query"]))
+        k = self._split_heads(proj(params["key"]))
+        v = self._split_heads(proj(params["value"]))
+        ctx = self.sparse_attn(q, k, v, key_padding_mask=attention_mask)
+        B, H, T, Dh = ctx.shape
+        return ctx.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)

@@ -1,0 +1,80 @@
+"""Model-surgery helpers for sparse attention (reference:
+deepspeed/ops/sparse_attention/sparse_attention_utils.py:13-225).
+
+The reference mutates HuggingFace torch models in place; here the helpers
+are functional — they return new arrays/param trees — which is the JAX way
+and keeps them usable inside jit-free setup code.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseAttentionUtils:
+    @staticmethod
+    def extend_position_embedding(pos_emb: jnp.ndarray,
+                                  max_position: int) -> jnp.ndarray:
+        """Extend a [T0, D] position-embedding table to ``max_position``
+        rows by tiling the original table (the reference's scheme of
+        repeating the base embeddings, sparse_attention_utils.py:53-88)."""
+        T0, D = pos_emb.shape
+        if max_position <= T0:
+            return pos_emb[:max_position]
+        reps = -(-max_position // T0)  # ceil
+        return jnp.tile(pos_emb, (reps, 1))[:max_position]
+
+    @staticmethod
+    def pad_to_block_size(block_size: int,
+                          input_ids: jnp.ndarray,
+                          attention_mask: Optional[jnp.ndarray] = None,
+                          token_type_ids: Optional[jnp.ndarray] = None,
+                          position_ids: Optional[jnp.ndarray] = None,
+                          inputs_embeds: Optional[jnp.ndarray] = None,
+                          pad_token_id: int = 0,
+                          ) -> Tuple[int, tuple]:
+        """Right-pad sequence tensors so seq_len % block_size == 0
+        (reference sparse_attention_utils.py:173-210).  Padded positions
+        get mask 0 so they are ignored by the attention.
+
+        Returns (pad_len, (input_ids, attention_mask, token_type_ids,
+        position_ids, inputs_embeds)) with None entries passed through.
+        """
+        seq_len = input_ids.shape[-1] if input_ids is not None \
+            else inputs_embeds.shape[-2]
+        pad_len = (block_size - seq_len % block_size) % block_size
+        if pad_len == 0:
+            return 0, (input_ids, attention_mask, token_type_ids,
+                       position_ids, inputs_embeds)
+
+        def pad_tok(x, value=0):
+            if x is None:
+                return None
+            cfg = [(0, 0)] * (x.ndim - 1) + [(0, pad_len)]
+            return jnp.pad(x, cfg, constant_values=value)
+
+        input_ids = pad_tok(input_ids, pad_token_id)
+        attention_mask = pad_tok(attention_mask, 0)
+        token_type_ids = pad_tok(token_type_ids, 0)
+        if position_ids is not None:
+            # continue the position sequence into the padding
+            last = position_ids[..., -1:]
+            extra = last + jnp.arange(1, pad_len + 1)
+            position_ids = jnp.concatenate([position_ids, extra], axis=-1)
+        if inputs_embeds is not None:
+            cfg = [(0, 0)] * (inputs_embeds.ndim - 2) + [(0, pad_len), (0, 0)]
+            inputs_embeds = jnp.pad(inputs_embeds, cfg)
+        return pad_len, (input_ids, attention_mask, token_type_ids,
+                         position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len: int,
+                              sequence_output: jnp.ndarray) -> jnp.ndarray:
+        """Drop the padding added by pad_to_block_size (reference
+        sparse_attention_utils.py:212-225)."""
+        if pad_len == 0:
+            return sequence_output
+        return sequence_output[..., :-pad_len, :] \
+            if sequence_output.ndim >= 2 else sequence_output[:-pad_len]

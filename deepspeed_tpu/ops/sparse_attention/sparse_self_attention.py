@@ -1,0 +1,167 @@
+"""Block-sparse self-attention — TPU-native.
+
+The reference builds this from Triton sdd/dsd matmuls + a block-sparse
+softmax kernel with natively-built lookup tables (reference:
+deepspeed/ops/sparse_attention/sparse_self_attention.py:83-142, matmul.py,
+softmax.py, csrc/sparse_attention/utils.cpp).  Here the layout is compiled
+into a *gathered block* computation: for every query block row we gather
+its active key/value blocks (a static LUT padded to the row-max count) and
+run a dense blockwise attention over just those.  Compute and memory are
+O(T · max_active_blocks · block) — the same asymptotics as the Triton
+kernels — and everything lowers onto the MXU as batched [block × block]
+matmuls.  The LUT is static metadata: XLA sees constant gather indices and
+a fixed loop structure, nothing data-dependent.
+
+Semantics preserved from the reference forward
+(sparse_self_attention.py:83-142 → softmax.py):
+  scores = (Q·Kᵀ) * scale  (only active blocks)
+  scores += rpe                      (if given)
+  key_padding_mask: 'add' → scores += mask;  'mul' → -inf where mask == 0
+  attn_mask:        same two modes
+  softmax over the active blocks of each row, then context = probs · V.
+Inactive blocks are exactly zero probability — tokens whose *entire* row
+is masked out produce zeros, matching the sparse kernel's behavior.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sparsity_config import FixedSparsityConfig, SparsityConfig
+
+_NEG_INF = float(np.finfo(np.float32).min)
+
+
+def build_lut(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Layout [H, nb, nb] → (cols [H, nb, width], valid [H, nb, width]).
+
+    ``cols[h, r]`` lists the active key-block indices of query-block row r
+    (padded with 0), ``valid`` flags real entries.  ``width`` is the max
+    active count over all heads/rows — the TPU analogue of the reference's
+    ``segment_blocks`` lookup-table build (csrc/sparse_attention/
+    utils.cpp:14), done in numpy because it is trace-time metadata.
+    """
+    H, nb, _ = layout.shape
+    width = max(int(layout.sum(-1).max()), 1)
+    cols = np.zeros((H, nb, width), dtype=np.int32)
+    valid = np.zeros((H, nb, width), dtype=bool)
+    for h in range(H):
+        for r in range(nb):
+            (active,) = np.nonzero(layout[h, r])
+            cols[h, r, :len(active)] = active
+            valid[h, r, :len(active)] = True
+    return cols, valid
+
+
+@partial(jax.jit, static_argnames=("block", "kp_mode", "am_mode"))
+def _sparse_attn(q, k, v, cols, valid, rpe, key_padding_mask, attn_mask,
+                 scale, block: int, kp_mode: str, am_mode: str):
+    """q,k,v: [B,H,T,D]; cols/valid: [H, nb, W]; returns [B,H,T,D]."""
+    B, H, T, D = q.shape
+    nb = T // block
+    W = cols.shape[-1]
+
+    qb = q.reshape(B, H, nb, block, D)
+    kb = k.reshape(B, H, nb, block, D)
+    vb = v.reshape(B, H, nb, block, D)
+
+    def per_head(qh, kh, vh, cols_h, valid_h, am_h):
+        # qh: [B, nb, blk, D]; gather active key/value blocks per row
+        kg = kh[:, cols_h]            # [B, nb, W, blk, D]
+        vg = vh[:, cols_h]
+        scores = jnp.einsum("brqd,brwkd->brqwk", qh, kg,
+                            preferred_element_type=jnp.float32) * scale
+        if rpe is not None:
+            # rpe [T, T] → per (row, w) block: rpe[row*blk:, col*blk:]
+            rpe_b = rpe.reshape(nb, block, nb, block)
+            rpe_g = rpe_b[np.arange(nb)[:, None], :, cols_h, :]  # [nb,W,blk,blk]
+            scores = scores + jnp.transpose(
+                rpe_g, (0, 2, 1, 3))[None].astype(jnp.float32)
+        if am_h is not None:
+            am_b = am_h.reshape(nb, block, nb, block)
+            am_g = am_b[np.arange(nb)[:, None], :, cols_h, :]
+            am_g = jnp.transpose(am_g, (0, 2, 1, 3))[None]  # [1,nb,blk,W,blk]
+            if am_mode == "add":
+                scores = scores + am_g.astype(jnp.float32)
+            else:  # mul
+                scores = jnp.where(am_g != 0, scores, _NEG_INF)
+        if key_padding_mask is not None:
+            # [B, T] → gathered [B, nb, W, blk] → [B,nb,1,W,blk]
+            kp_b = key_padding_mask.reshape(B, nb, block)
+            kp_g = kp_b[:, cols_h]                      # [B, nb, W, blk]
+            kp_g = kp_g[:, :, None, :, :]
+            if kp_mode == "add":
+                scores = scores + kp_g.astype(jnp.float32)
+            else:
+                scores = jnp.where(kp_g != 0, scores, _NEG_INF)
+        # mask LUT padding
+        scores = jnp.where(valid_h[None, :, None, :, None], scores,
+                           _NEG_INF)
+        flat = scores.reshape(B, nb, block, W * block)
+        # guard fully-masked rows (all -inf → zeros, not NaN)
+        m = jnp.max(flat, axis=-1, keepdims=True)
+        e = jnp.exp(flat - jax.lax.stop_gradient(m))
+        e = jnp.where(flat <= _NEG_INF / 2, 0.0, e)
+        s = jnp.sum(e, axis=-1, keepdims=True)
+        probs = jnp.where(s > 0, e / jnp.maximum(s, 1e-30), 0.0)
+        probs = probs.reshape(B, nb, block, W, block).astype(q.dtype)
+        return jnp.einsum("brqwk,brwkd->brqd", probs, vg)
+
+    # vmap over heads: one compiled per-head subgraph regardless of H
+    out = jax.vmap(per_head, in_axes=(1, 1, 1, 0, 0, None),
+                   out_axes=1)(qb, kb, vb, cols, valid, attn_mask)
+    return out.reshape(B, H, T, D)  # [B, H, nb, blk, D] → [B, H, T, D]
+
+
+class SparseSelfAttention:
+    """Drop-in for the reference module (reference
+    sparse_self_attention.py:13): ``forward(q, k, v, rpe=None,
+    key_padding_mask=None, attn_mask=None)`` over [B, H, T, Dh] tensors.
+    LUTs are cached per sequence length.
+    """
+
+    def __init__(self, sparsity_config: Optional[SparsityConfig] = None,
+                 key_padding_mask_mode: str = "add",
+                 attn_mask_mode: str = "mul",
+                 max_seq_length: int = 2048):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(
+            num_heads=4)
+        if key_padding_mask_mode not in ("add", "mul"):
+            raise ValueError("key_padding_mask_mode must be 'add' or 'mul'")
+        if attn_mask_mode not in ("add", "mul"):
+            raise ValueError("attn_mask_mode must be 'add' or 'mul'")
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self.max_seq_length = max_seq_length
+        self._lut_cache = {}
+
+    def get_lut(self, seq_len: int):
+        if seq_len not in self._lut_cache:
+            layout = self.sparsity_config.make_layout(seq_len)
+            self._lut_cache[seq_len] = build_lut(layout)
+        return self._lut_cache[seq_len]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None):
+        B, H, T, D = query.shape
+        if query.shape != key.shape or key.shape != value.shape:
+            raise NotImplementedError(
+                "only self-attention is supported (q/k/v same shape)")
+        if H != self.sparsity_config.num_heads:
+            raise ValueError(
+                f"input has {H} heads but sparsity config was built for "
+                f"{self.sparsity_config.num_heads}")
+        cols, valid = self.get_lut(T)
+        scale = float(D) ** -0.5
+        return _sparse_attn(query, key, value, jnp.asarray(cols),
+                            jnp.asarray(valid), rpe, key_padding_mask,
+                            attn_mask, scale,
+                            block=self.sparsity_config.block,
+                            kp_mode=self.key_padding_mask_mode,
+                            am_mode=self.attn_mask_mode)
+
+    forward = __call__

@@ -1,0 +1,6 @@
+"""Fused transformer layer (reference feature slot:
+deepspeed/ops/transformer/ + csrc/transformer/)."""
+from .transformer import (DeepSpeedTransformerConfig,
+                          DeepSpeedTransformerLayer)
+
+__all__ = ["DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer"]

@@ -237,10 +237,12 @@ def test_onebit_adam_collective_in_shard_map():
             server_error=squeeze(state.server_error))
         updates, state2 = tx.update(g, local, params)
         new_params = optax.apply_updates(params, updates)
+        # expose every shard's momentum so the test can assert they agree
+        mu_all = jax.lax.all_gather(state2.mu["x"], "data")
         state2 = state2._replace(
             worker_error=unsq(state2.worker_error),
             server_error=unsq(state2.server_error))
-        return new_params, state2
+        return new_params, state2, mu_all
 
     from deepspeed_tpu.compress import OnebitAdamState
     state_spec = OnebitAdamState(
@@ -249,18 +251,24 @@ def test_onebit_adam_collective_in_shard_map():
     fn = shard_map(
         one_step, mesh=mesh,
         in_specs=(P(), state_spec, P("data")),
-        out_specs=(P(), state_spec))
+        out_specs=(P(), state_spec, P()))
     fn = jax.jit(fn)
 
     we = jnp.tile(state.worker_error["x"], (WORLD, 1))
     se = jnp.tile(state.server_error["x"], (WORLD, 1))
     st = state._replace(worker_error={"x": we}, server_error={"x": se})
-    for _ in range(6):
-        params, st = fn(params, st, local_targets)
-    # mean target is the optimum of the summed local losses
+    for step in range(6):
+        params, st, mu_all = fn(params, st, local_targets)
+        # momentum must be identical on every shard: during warmup because
+        # grads are pmean'd, after freeze because the compressed collective
+        # returns one all-gathered buffer
+        mu_all = np.asarray(mu_all)
+        for w in range(1, WORLD):
+            np.testing.assert_allclose(mu_all[w], mu_all[0], rtol=1e-6,
+                                       atol=1e-7,
+                                       err_msg=f"step {step} shard {w}")
     assert params["x"].shape == (n,)
     assert np.isfinite(np.asarray(params["x"])).all()
-    # momentum identical across the mesh ⇒ params stayed replicated
     assert int(st.count) == 6
 
 

@@ -1,0 +1,257 @@
+"""Sparse attention tests.
+
+Differential pattern from the reference (reference:
+tests/unit/test_sparse_attention.py — sparse ops vs dense masked
+references): every layout family is checked against a dense attention with
+the block mask expanded to token granularity.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BertSelfAttentionConfig, BertSparseSelfAttention, BigBirdSparsityConfig,
+    BSLongformerSparsityConfig, DenseSparsityConfig, FixedSparsityConfig,
+    SparseAttentionUtils, SparseSelfAttention, VariableSparsityConfig,
+    build_lut)
+
+BLOCK = 16
+
+
+def dense_reference(q, k, v, token_mask, rpe=None, key_padding_mask=None,
+                    kp_mode="add", attn_mask=None, am_mode="mul"):
+    """Dense attention with explicit token-level mask [H, T, T]."""
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
+    if rpe is not None:
+        scores = scores + rpe[None, None]
+    if attn_mask is not None:
+        if am_mode == "add":
+            scores = scores + attn_mask[None, None]
+        else:
+            scores = jnp.where(attn_mask[None, None] != 0, scores, -1e38)
+    if key_padding_mask is not None:
+        kp = key_padding_mask[:, None, None, :]
+        scores = scores + kp if kp_mode == "add" else jnp.where(
+            kp != 0, scores, -1e38)
+    scores = jnp.where(token_mask[None] != 0, scores, -1e38)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # rows with no active keys → zero output (sparse kernel convention)
+    any_active = (token_mask[None] != 0).any(-1, keepdims=True)
+    if key_padding_mask is not None and kp_mode == "mul":
+        any_active = any_active & (key_padding_mask[:, None, None, :] != 0
+                                   ).any(-1, keepdims=True)
+    probs = jnp.where(any_active, probs, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v32)
+
+
+def expand_layout(layout):
+    """[H, nb, nb] block layout → [H, T, T] token mask."""
+    return np.kron(layout, np.ones((BLOCK, BLOCK), dtype=np.int64))
+
+
+def make_qkv(B, H, T, D, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((B, H, T, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+CONFIGS = [
+    ("dense", lambda H: DenseSparsityConfig(H, block=BLOCK)),
+    ("fixed_bi", lambda H: FixedSparsityConfig(
+        H, block=BLOCK, num_local_blocks=2, num_global_blocks=1)),
+    ("fixed_uni", lambda H: FixedSparsityConfig(
+        H, block=BLOCK, num_local_blocks=2, attention="unidirectional")),
+    ("fixed_horizontal", lambda H: FixedSparsityConfig(
+        H, block=BLOCK, num_local_blocks=2,
+        horizontal_global_attention=True)),
+    ("variable", lambda H: VariableSparsityConfig(
+        H, block=BLOCK, num_random_blocks=1, local_window_blocks=[1, 2],
+        global_block_indices=[0, 3], seed=11)),
+    ("variable_ranges", lambda H: VariableSparsityConfig(
+        H, block=BLOCK, global_block_indices=[0],
+        global_block_end_indices=[2])),
+    ("bigbird", lambda H: BigBirdSparsityConfig(
+        H, block=BLOCK, num_random_blocks=1, num_sliding_window_blocks=3,
+        num_global_blocks=1, seed=5)),
+    ("longformer", lambda H: BSLongformerSparsityConfig(
+        H, block=BLOCK, num_sliding_window_blocks=3,
+        global_block_indices=[0])),
+]
+
+
+@pytest.mark.parametrize("name,make_cfg", CONFIGS, ids=[c[0] for c in CONFIGS])
+def test_sparse_matches_dense_masked(name, make_cfg):
+    B, H, T, D = 2, 4, 6 * BLOCK, 32
+    cfg = make_cfg(H)
+    attn = SparseSelfAttention(cfg)
+    q, k, v = make_qkv(B, H, T, D, seed=1)
+    out = attn(q, k, v)
+    layout = cfg.make_layout(T)
+    ref = dense_reference(q, k, v, jnp.asarray(expand_layout(layout)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_sparse_with_key_padding_mask_add():
+    B, H, T, D = 2, 4, 4 * BLOCK, 16
+    cfg = FixedSparsityConfig(H, block=BLOCK, num_local_blocks=2)
+    attn = SparseSelfAttention(cfg, key_padding_mask_mode="add")
+    q, k, v = make_qkv(B, H, T, D, seed=2)
+    # additive HF-style mask: 0 keep, -10000 drop last quarter
+    kp = np.zeros((B, T), np.float32)
+    kp[:, -T // 4:] = -1e9
+    out = attn(q, k, v, key_padding_mask=jnp.asarray(kp))
+    layout = cfg.make_layout(T)
+    ref = dense_reference(q, k, v, jnp.asarray(expand_layout(layout)),
+                          key_padding_mask=jnp.asarray(kp), kp_mode="add")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_sparse_with_attn_mask_mul():
+    B, H, T, D = 1, 2, 4 * BLOCK, 16
+    cfg = BSLongformerSparsityConfig(H, block=BLOCK)
+    attn = SparseSelfAttention(cfg, attn_mask_mode="mul")
+    q, k, v = make_qkv(B, H, T, D, seed=3)
+    causal = np.tril(np.ones((T, T), np.float32))
+    out = attn(q, k, v, attn_mask=jnp.asarray(causal))
+    layout = cfg.make_layout(T)
+    ref = dense_reference(q, k, v, jnp.asarray(expand_layout(layout)),
+                          attn_mask=jnp.asarray(causal), am_mode="mul")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_sparse_with_rpe():
+    B, H, T, D = 1, 2, 3 * BLOCK, 16
+    cfg = FixedSparsityConfig(H, block=BLOCK, num_local_blocks=3)
+    attn = SparseSelfAttention(cfg)
+    q, k, v = make_qkv(B, H, T, D, seed=4)
+    rpe = jnp.asarray(
+        np.random.default_rng(5).standard_normal((T, T)), jnp.float32)
+    out = attn(q, k, v, rpe=rpe)
+    layout = cfg.make_layout(T)
+    ref = dense_reference(q, k, v, jnp.asarray(expand_layout(layout)),
+                          rpe=rpe)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_sparse_attention_differentiable():
+    B, H, T, D = 1, 2, 4 * BLOCK, 16
+    cfg = BigBirdSparsityConfig(H, block=BLOCK, seed=1)
+    attn = SparseSelfAttention(cfg)
+    q, k, v = make_qkv(B, H, T, D, seed=6)
+
+    def loss(qkv):
+        return jnp.sum(attn(*qkv) ** 2)
+
+    grads = jax.grad(loss)((q, k, v))
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.max(jnp.abs(g))) > 0
+
+
+# ---------------------------------------------------------------------------
+# layout-shape properties (mirror test_sparse_attention.py's layout checks)
+# ---------------------------------------------------------------------------
+def test_fixed_unidirectional_is_block_lower_triangular():
+    cfg = FixedSparsityConfig(2, block=BLOCK, num_local_blocks=4,
+                              attention="unidirectional")
+    layout = cfg.make_layout(8 * BLOCK)
+    assert (np.triu(layout[0], 1) == 0).all()
+    # diagonal always attended
+    assert (np.diagonal(layout[0]) == 1).all()
+
+
+def test_fixed_global_patterns_differ_per_head():
+    cfg = FixedSparsityConfig(4, block=BLOCK, num_local_blocks=4,
+                              different_layout_per_head=True,
+                              num_different_global_patterns=4)
+    layout = cfg.make_layout(8 * BLOCK)
+    # each head uses a different global column within each window
+    firsts = [np.nonzero(layout[h, 0])[0] for h in range(4)]
+    assert len({tuple(f.tolist()) for f in firsts}) == 4
+
+
+def test_bigbird_global_rows_and_cols():
+    cfg = BigBirdSparsityConfig(1, block=BLOCK, num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=2)
+    layout = cfg.make_layout(8 * BLOCK)
+    assert (layout[0, :2, :] == 1).all() and (layout[0, :, :2] == 1).all()
+
+
+def test_longformer_window_width():
+    cfg = BSLongformerSparsityConfig(1, block=BLOCK,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0])
+    layout = cfg.make_layout(8 * BLOCK)
+    # row 4 attends blocks {0 (global), 3, 4, 5}
+    np.testing.assert_array_equal(np.nonzero(layout[0, 4])[0], [0, 3, 4, 5])
+
+
+def test_layout_head_propagation():
+    cfg = BigBirdSparsityConfig(4, block=BLOCK, seed=3)
+    layout = cfg.make_layout(4 * BLOCK)
+    for h in range(1, 4):
+        np.testing.assert_array_equal(layout[h], layout[0])
+
+
+def test_seq_len_not_divisible_raises():
+    cfg = FixedSparsityConfig(2, block=BLOCK)
+    with pytest.raises(ValueError, match="divisible"):
+        cfg.make_layout(BLOCK + 1)
+
+
+def test_build_lut_padding():
+    layout = np.zeros((1, 4, 4), dtype=np.int64)
+    layout[0, 0, [0, 2]] = 1
+    layout[0, 1, 1] = 1
+    layout[0, 2] = 1
+    layout[0, 3, 3] = 1
+    cols, valid = build_lut(layout)
+    assert cols.shape == (1, 4, 4)  # width = max row count = 4
+    np.testing.assert_array_equal(cols[0, 0], [0, 2, 0, 0])
+    np.testing.assert_array_equal(valid[0, 0], [True, True, False, False])
+
+
+# ---------------------------------------------------------------------------
+# utils + BERT layer
+# ---------------------------------------------------------------------------
+def test_pad_to_block_size_and_unpad():
+    ids = jnp.ones((2, 20), jnp.int32)
+    mask = jnp.ones((2, 20), jnp.float32)
+    pad_len, (ids2, mask2, _, _, _) = SparseAttentionUtils.pad_to_block_size(
+        BLOCK, ids, attention_mask=mask, pad_token_id=7)
+    assert pad_len == 12 and ids2.shape == (2, 32)
+    assert (np.asarray(ids2[:, 20:]) == 7).all()
+    assert (np.asarray(mask2[:, 20:]) == 0).all()
+    seq_out = jnp.ones((2, 32, 8))
+    unp = SparseAttentionUtils.unpad_sequence_output(pad_len, seq_out)
+    assert unp.shape == (2, 20, 8)
+
+
+def test_extend_position_embedding():
+    pe = jnp.asarray(np.arange(8 * 4, dtype=np.float32).reshape(8, 4))
+    ext = SparseAttentionUtils.extend_position_embedding(pe, 20)
+    assert ext.shape == (20, 4)
+    np.testing.assert_array_equal(np.asarray(ext[8:16]), np.asarray(pe))
+
+
+def test_bert_sparse_self_attention_shapes_and_grad():
+    cfg = BertSelfAttentionConfig(hidden_size=64, num_attention_heads=4)
+    layer = BertSparseSelfAttention(
+        cfg, FixedSparsityConfig(4, block=BLOCK, num_local_blocks=2))
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(8).standard_normal(
+        (2, 4 * BLOCK, 64)), jnp.float32)
+    mask = jnp.zeros((2, 4 * BLOCK), jnp.float32)
+    out = layer(params, x, attention_mask=mask)
+    assert out.shape == (2, 4 * BLOCK, 64)
+    g = jax.grad(lambda p: jnp.sum(layer(p, x, mask) ** 2))(params)
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))

@@ -1,2 +1,3 @@
 from .adam import fused_adam, FusedAdamState
 from .lamb import fused_lamb, FusedLambState
+from .cpu_adam import DeepSpeedCPUAdam

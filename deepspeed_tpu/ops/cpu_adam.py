@@ -1,0 +1,171 @@
+"""DeepSpeedCPUAdam — host-resident fused Adam for ZeRO-Offload.
+
+Python binding over the native kernel (csrc/cpu_adam.cpp; reference:
+deepspeed/ops/adam/cpu_adam.py:12-134 + csrc/adam/cpu_adam.cpp).  Operates
+in place on numpy fp32 buffers (host RAM — the whole point of offload) and
+optionally emits a bf16/fp16 copy of the updated params in the same pass,
+the analogue of the reference's fused fp16 copy-back
+(``step(fp16_param_groups=...)``, cpu_adam.py:116-125).
+
+A pure-numpy fallback keeps the feature usable when no C++ toolchain is
+present; selection is explicit and reported (ds_report-style).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, Dict, Optional, Tuple, Union
+
+import numpy as np
+
+from .op_builder import OpBuilderError, load_cpu_ops
+
+ScalarOrSchedule = Union[float, Callable]
+
+_LOWP_NONE, _LOWP_BF16, _LOWP_FP16 = 0, 1, 2
+
+
+def lowp_np_dtype(out_dtype: Optional[str]):
+    """None | 'bfloat16' | 'float16' → numpy dtype (single source for the
+    mapping used by the kernel binding and the offload tier)."""
+    if out_dtype is None:
+        return None
+    if out_dtype == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    if out_dtype == "float16":
+        return np.dtype(np.float16)
+    raise ValueError(f"unsupported low-precision dtype {out_dtype!r}")
+
+
+def _np_ptr(a: np.ndarray, typ):
+    return a.ctypes.data_as(typ)
+
+
+class DeepSpeedCPUAdam:
+    """Fused host Adam over a pytree of numpy fp32 leaves.
+
+    ``step(params, grads, out_dtype=None)`` updates params/moments in place
+    and returns the low-precision upload copies (or None).
+    """
+
+    def __init__(self, lr: ScalarOrSchedule = 1e-3,
+                 betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8,
+                 weight_decay: float = 0.0,
+                 adamw_mode: bool = True,
+                 bias_correction: bool = True,
+                 use_native: Optional[bool] = None):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.bias_correction = bias_correction
+        self.step_count = 0
+        if use_native is None:
+            try:
+                self._lib = load_cpu_ops()
+            except OpBuilderError:
+                self._lib = None
+        elif use_native:
+            self._lib = load_cpu_ops()  # raises if unavailable
+        else:
+            self._lib = None
+        self._state: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+
+    @property
+    def is_native(self) -> bool:
+        return self._lib is not None
+
+    # ------------------------------------------------------------------
+    def _moments(self, idx: int, leaf: np.ndarray):
+        if idx not in self._state:
+            self._state[idx] = (np.zeros_like(leaf), np.zeros_like(leaf))
+        return self._state[idx]
+
+    def _lr_now(self) -> float:
+        if callable(self.lr):
+            return float(self.lr(self.step_count))
+        return float(self.lr)
+
+    def step(self, params, grads, out_dtype=None):
+        """params/grads: pytrees with matching numpy fp32 leaves (params
+        updated IN PLACE).  out_dtype: None | 'bfloat16' | 'float16' —
+        fused low-precision copies returned as a matching pytree of uint16
+        views reinterpreted via numpy dtype."""
+        import jax
+        self.step_count += 1
+        lr = self._lr_now()
+        p_leaves, treedef = jax.tree.flatten(params)
+        g_leaves = jax.tree.leaves(grads)
+        assert len(p_leaves) == len(g_leaves)
+        lowp_kind = {None: _LOWP_NONE, "bfloat16": _LOWP_BF16,
+                     "float16": _LOWP_FP16}[out_dtype]
+        outs = []
+        for i, (p, g) in enumerate(zip(p_leaves, g_leaves)):
+            if p.dtype != np.float32:
+                # non-floating state (step counters, int buffers): no Adam
+                outs.append(p if lowp_kind else None)
+                continue
+            assert p.flags.c_contiguous, (
+                f"leaf {i} is not C-contiguous; reshape(-1) would update a "
+                "copy and silently drop the result — pass a contiguous "
+                "master buffer")
+            m, v = self._moments(i, p)
+            flat_p = p.reshape(-1)
+            flat_g = np.ascontiguousarray(
+                np.asarray(g, dtype=np.float32).reshape(-1))
+            out = (np.empty(flat_p.shape, np.uint16)
+                   if lowp_kind else np.empty(0, np.uint16))
+            if self._lib is not None:
+                fp = ctypes.POINTER(ctypes.c_float)
+                u16 = ctypes.POINTER(ctypes.c_uint16)
+                self._lib.ds_cpu_adam_step(
+                    flat_p.size, _np_ptr(flat_p, fp), _np_ptr(flat_g, fp),
+                    _np_ptr(m.reshape(-1), fp), _np_ptr(v.reshape(-1), fp),
+                    lr, self.betas[0], self.betas[1], self.eps,
+                    self.weight_decay, int(self.adamw_mode),
+                    int(self.bias_correction), self.step_count,
+                    _np_ptr(out, u16), lowp_kind)
+            else:
+                self._numpy_step(flat_p, flat_g, m.reshape(-1),
+                                 v.reshape(-1), lr, out, lowp_kind)
+            if lowp_kind:
+                outs.append(out.view(lowp_np_dtype(out_dtype))
+                            .reshape(p.shape))
+            else:
+                outs.append(None)
+        return jax.tree.unflatten(treedef, outs) if lowp_kind else None
+
+    # ------------------------------------------------------------------
+    def _numpy_step(self, p, g, m, v, lr, out, lowp_kind):
+        b1, b2 = self.betas
+        if not self.adamw_mode and self.weight_decay > 0:
+            g = g + self.weight_decay * p
+        m *= b1
+        m += (1 - b1) * g
+        v *= b2
+        v += (1 - b2) * g * g
+        c1 = c2 = 1.0
+        if self.bias_correction:
+            c1 = 1 - b1 ** self.step_count
+            c2 = 1 - b2 ** self.step_count
+        update = (m / c1) / (np.sqrt(v) / np.sqrt(c2) + self.eps)
+        if self.adamw_mode and self.weight_decay > 0:
+            update = update + self.weight_decay * p
+        p -= lr * update
+        if lowp_kind == _LOWP_BF16:
+            out[:] = p.astype(lowp_np_dtype("bfloat16")).view(np.uint16)
+        elif lowp_kind == _LOWP_FP16:
+            out[:] = p.astype(np.float16).view(np.uint16)
+
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        return {"step": self.step_count,
+                "moments": {str(k): (m.copy(), v.copy())
+                            for k, (m, v) in self._state.items()}}
+
+    def load_state_dict(self, sd):
+        self.step_count = int(sd["step"])
+        self._state = {int(k): (np.array(m), np.array(v))
+                       for k, (m, v) in sd["moments"].items()}

@@ -1,0 +1,108 @@
+"""Native-op build system (the L1 layer).
+
+The reference JIT-builds CUDA extensions with ninja + torch.utils.cpp_ext
+and version-match asserts (reference: op_builder/builder.py:146-216).  The
+TPU build has exactly one native surface — host-side C++ ops (CPU Adam for
+ZeRO-Offload) — compiled here with the system g++ into a shared library
+bound via ctypes (no pybind11 in this image).  Pallas kernels need no
+build step; they ship as Python.
+
+Build artifacts are cached under ``deepspeed_tpu/ops/_build/`` keyed by a
+source hash, so the compile happens once per source change (the analogue of
+the reference's ninja dependency tracking).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_CSRC = _REPO_ROOT / "csrc"
+_BUILD_DIR = Path(__file__).resolve().parent / "_build"
+
+_compile_error: Optional[str] = None
+_lib: Optional[ctypes.CDLL] = None
+
+
+class OpBuilderError(RuntimeError):
+    pass
+
+
+def _source_hash(sources) -> str:
+    h = hashlib.sha256()
+    for s in sources:
+        h.update(Path(s).read_bytes())
+    return h.hexdigest()[:16]
+
+
+def build_cpu_ops(verbose: bool = False) -> Path:
+    """Compile csrc/cpu_adam.cpp → _build/libds_cpu_ops_<hash>.so."""
+    sources = [_CSRC / "cpu_adam.cpp"]
+    tag = _source_hash(sources)
+    out = _BUILD_DIR / f"libds_cpu_ops_{tag}.so"
+    if out.exists():
+        return out
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+           "-o", str(out)] + [str(s) for s in sources]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:  # no g++ etc.
+        raise OpBuilderError(f"native build failed to launch: {e}") from e
+    if proc.returncode != 0:
+        raise OpBuilderError(
+            f"native build failed:\n{' '.join(cmd)}\n{proc.stderr}")
+    if verbose:
+        print(f"[deepspeed_tpu] built {out.name}")
+    return out
+
+
+def load_cpu_ops() -> ctypes.CDLL:
+    """Build (if needed) and dlopen the host-ops library.  Raises
+    OpBuilderError when the toolchain is unavailable — callers choose the
+    numpy fallback explicitly (mirrors the reference's op-compatibility
+    gating, op_builder/builder.py + env_report)."""
+    global _lib, _compile_error
+    if _lib is not None:
+        return _lib
+    if _compile_error is not None:
+        raise OpBuilderError(_compile_error)
+    try:
+        path = build_cpu_ops()
+        lib = ctypes.CDLL(str(path))
+    except (OpBuilderError, OSError) as e:
+        _compile_error = str(e)
+        raise OpBuilderError(_compile_error) from None
+
+    i64, f32 = ctypes.c_int64, ctypes.c_float
+    fp = ctypes.POINTER(ctypes.c_float)
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    lib.ds_cpu_adam_step.argtypes = [
+        i64, fp, fp, fp, fp, f32, f32, f32, f32, f32,
+        ctypes.c_int, ctypes.c_int, i64, u16p, ctypes.c_int]
+    lib.ds_cpu_adam_step.restype = None
+    lib.ds_f32_to_bf16.argtypes = [i64, fp, u16p]
+    lib.ds_f32_to_bf16.restype = None
+    lib.ds_cpu_ops_version.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def cpu_ops_available() -> bool:
+    try:
+        load_cpu_ops()
+        return True
+    except OpBuilderError:
+        return False
+
+
+def cpu_ops_status() -> str:
+    """ds_report-style one-liner for env_report."""
+    if cpu_ops_available():
+        return f"cpu_ops ... compatible (v{load_cpu_ops().ds_cpu_ops_version()})"
+    return f"cpu_ops ... NOT compatible ({_compile_error})"

@@ -256,12 +256,24 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             state.master_params, engine.compute_dtype)
         loaded = load_tree(os.path.join(ckpt_dir, "model"),
                            {"module": module_tmpl})
-        master = jax.tree.map(
-            lambda cur, new: jax.device_put(
-                np.asarray(jax.device_get(new)).astype(cur.dtype),
-                cur.sharding),
-            state.master_params, loaded["module"])
-        opt_state = engine.optimizer.init(master)
+        def _promote(cur, new):
+            arr = np.asarray(jax.device_get(new)).astype(cur.dtype)
+            sharding = getattr(cur, "sharding", None)  # numpy (offload): none
+            from jax.sharding import NamedSharding
+            if isinstance(sharding, NamedSharding):
+                return jax.device_put(arr, sharding)
+            return arr
+
+        master = jax.tree.map(_promote, state.master_params,
+                              loaded["module"])
+        if getattr(engine, "_offload", False):
+            # host tier rebuilds its own fresh moments in
+            # _sync_offload_from_state; materializing device fp32 moments
+            # here would transiently cost 2× model size in HBM — the exact
+            # memory offload exists to avoid
+            opt_state = None
+        else:
+            opt_state = engine.optimizer.init(master)
         scaler = state.scaler
 
     engine.state = TrainState(

@@ -118,13 +118,47 @@ class DeepSpeedEngine:
             base_param_specs=base_specs,
             offload=config.zero_config.cpu_offload)
 
-        master_shardings = self.zero_plan.master_shardings(master)
-        master = _device_put_tree(master, master_shardings)
-        opt_state = self.optimizer.init(master)
-        opt_shardings = self.zero_plan.opt_state_shardings(opt_state, master)
-        opt_state = _device_put_tree(opt_state, opt_shardings)
-
         scaler, self.loss_scale_config = precision.from_fp16_config(config.fp16)
+        self._offload = bool(config.zero_config.cpu_offload)
+        if self._offload:
+            # ZeRO-Offload: fp32 master + moments live in HOST memory and
+            # are updated by the native CPU Adam (runtime/offload.py); the
+            # device keeps only compute-dtype params.
+            from .offload import HostOffloadOptimizer
+            name = config.optimizer_name or C.ADAM_OPTIMIZER
+            if name != C.ADAM_OPTIMIZER or optimizer is not None:
+                raise ValueError(
+                    "cpu_offload requires the built-in Adam optimizer "
+                    "(the reference's offload whitelist likewise admits "
+                    "only Adam-family, zero/utils.py:26-40)")
+            oparams = dict(config.optimizer_params)
+            lr = self._lr_schedule or float(oparams.get("lr", 1e-3))
+            self._host_opt = HostOffloadOptimizer(
+                master,
+                lr=lr,
+                betas=tuple(oparams.get("betas", (0.9, 0.999))),
+                eps=oparams.get("eps", 1e-8),
+                weight_decay=oparams.get("weight_decay", 0.0),
+                adamw_mode=oparams.get("adam_w_mode", True),
+                bias_correction=oparams.get("bias_correction", True),
+                compute_dtype=self.compute_dtype)
+            specs = base_specs if base_specs is not None else jax.tree.map(
+                lambda _: P(), master)
+            self._compute_shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P))
+            self._compute_params = _device_put_tree(
+                self._host_opt.compute_params(), self._compute_shardings)
+            master = self._host_opt.master       # host numpy identity
+            opt_state = self._host_opt.state_tree()
+        else:
+            master_shardings = self.zero_plan.master_shardings(master)
+            master = _device_put_tree(master, master_shardings)
+            opt_state = self.optimizer.init(master)
+            opt_shardings = self.zero_plan.opt_state_shardings(
+                opt_state, master)
+            opt_state = _device_put_tree(opt_state, opt_shardings)
+
         self.state = TrainState(
             master_params=master,
             opt_state=opt_state,
@@ -135,8 +169,12 @@ class DeepSpeedEngine:
         )
 
         # ---- compiled steps ----
-        self._train_step = self._build_train_step()
-        self._eval_step = self._build_eval_step()
+        if self._offload:
+            self._grad_step = self._build_offload_grad_step()
+            self._offload_eval_step = self._build_offload_eval_step()
+        else:
+            self._train_step = self._build_train_step()
+            self._eval_step = self._build_eval_step()
 
         # ---- python-side bookkeeping (untraced) ----
         self.global_steps = 0
@@ -320,6 +358,129 @@ class DeepSpeedEngine:
         return jax.jit(eval_step)
 
     # ------------------------------------------------------------------
+    # ZeRO-Offload steps (device grads → host Adam → device params)
+    # ------------------------------------------------------------------
+    def _build_offload_grad_step(self):
+        module = self.module
+        plan = self.zero_plan
+        grad_acc = self._scan_grad_acc
+        clip = self.gradient_clipping
+
+        def grad_step(compute_params, batch, loss_scale, step_rng):
+            def micro_loss(params, mb, rng):
+                loss = module.loss_fn(params, mb, rng, train=True)
+                return loss.astype(jnp.float32) * loss_scale
+
+            grad_fn = jax.value_and_grad(micro_loss)
+
+            def acc_body(carry, mb):
+                gsum, i = carry
+                rng = jax.random.fold_in(step_rng, i)
+                scaled_loss, g = grad_fn(compute_params, mb, rng)
+                gsum = jax.tree.map(
+                    lambda a, b: a + b.astype(jnp.float32), gsum, g)
+                return (gsum, i + 1), scaled_loss
+
+            gsum0 = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), compute_params)
+            (gsum, _), scaled_losses = jax.lax.scan(
+                acc_body, (gsum0, jnp.asarray(0, jnp.int32)), batch)
+            inv = (1.0 / (loss_scale * grad_acc)).astype(jnp.float32)
+            grads = jax.tree.map(lambda g: g * inv, gsum)
+            # ZeRO-2 placement: the host pulls reduce-scattered shards
+            grads = constrain_grads(grads, plan)
+            finite = precision.grads_finite(grads)
+            grad_norm = global_norm(grads)
+            if clip > 0:
+                grads, _ = clip_by_global_norm(grads, clip, norm=grad_norm)
+            mean_loss = jnp.mean(scaled_losses) / loss_scale
+            return grads, mean_loss, finite, grad_norm
+
+        return jax.jit(grad_step, donate_argnums=(1,))
+
+    def _build_offload_eval_step(self):
+        module = self.module
+
+        def eval_step(compute_params, batch, rng):
+            return module.loss_fn(compute_params, batch, rng, train=False)
+
+        return jax.jit(eval_step)
+
+    def _train_batch_offload(self, batch):
+        scaler = self.state.scaler
+        step_rng = jax.random.fold_in(self.state.rng,
+                                      int(self.state.global_steps))
+        with self._pallas_scope():
+            grads, loss, finite, grad_norm = self._grad_step(
+                self._compute_params, batch, scaler.loss_scale, step_rng)
+        finite_b = bool(finite)
+        if finite_b:
+            # Device → host staging, then the native host Adam with fused
+            # bf16 copy-back, then upload.  Single-controller: device_get
+            # assembles the FULL gradient on this host and the host Adam
+            # updates the full master (host RAM is the resource offload
+            # spends; HBM is what it frees).  A multi-host offload would
+            # pull only the local reduce-scattered shard per process —
+            # not implemented yet.
+            host_grads = jax.tree.map(
+                lambda g: np.asarray(jax.device_get(g)), grads)
+            lowp = self._host_opt.step(host_grads)
+            self._compute_params = _device_put_tree(
+                lowp, self._compute_shardings)
+        new_scaler = precision.update_scale(
+            scaler, jnp.asarray(finite_b), self.loss_scale_config)
+        self.state = TrainState(
+            master_params=self._host_opt.master,
+            opt_state=self._host_opt.state_tree(),
+            scaler=new_scaler,
+            global_steps=self.state.global_steps + 1,
+            skipped_steps=self.state.skipped_steps
+            + (0 if finite_b else 1),
+            rng=self.state.rng,
+        )
+        applied = self._host_opt.opt.step_count
+        lr = (self._lr_schedule(jnp.asarray(applied))
+              if self._lr_schedule is not None
+              else self.config.optimizer_params.get("lr", 1e-3))
+        return StepMetrics(
+            loss=np.asarray(loss), grad_norm=np.asarray(grad_norm),
+            loss_scale=np.asarray(scaler.loss_scale),
+            overflow=np.asarray(not finite_b),
+            lr=np.asarray(lr, np.float32))
+
+    def _sync_offload_from_state(self):
+        """After a checkpoint load replaced engine.state with device/loaded
+        arrays: copy them back into the host buffers (identity-preserving)
+        and refresh the device compute params."""
+        opt_tree = self.state.opt_state
+        if not (isinstance(opt_tree, dict) and "mu" in opt_tree):
+            # module-only restore path: fresh moments (the loader built a
+            # device optimizer state that doesn't apply to the host tier)
+            opt_tree = None
+        if opt_tree is None:
+            def copy_into(dst, src):
+                arr = np.asarray(jax.device_get(src))
+                dst[...] = arr.astype(dst.dtype) if arr.dtype != dst.dtype \
+                    else arr
+            jax.tree.map(copy_into, self._host_opt.master,
+                         self.state.master_params)
+            for m, v in self._host_opt.opt._state.values():
+                m[...] = 0.0
+                v[...] = 0.0
+            # Adam restarts at t=1: stale step_count with zeroed moments
+            # would mis-apply bias correction (c1≈1 against m≈0) and resume
+            # lr schedules mid-curve
+            self._host_opt.opt.step_count = 0
+        else:
+            self._host_opt.load_state_tree(self.state.master_params,
+                                           opt_tree)
+        self._compute_params = _device_put_tree(
+            self._host_opt.compute_params(), self._compute_shardings)
+        self.state = self.state._replace(
+            master_params=self._host_opt.master,
+            opt_state=self._host_opt.state_tree())
+
+    # ------------------------------------------------------------------
     # data plumbing
     # ------------------------------------------------------------------
     def deepspeed_io(self, dataset, batch_size=None, collate_fn=None):
@@ -369,13 +530,17 @@ class DeepSpeedEngine:
             batch = next(it)
         t0 = time.time()
         sharded = self._shard_batch(batch)
-        with self._pallas_scope():
-            self.state, metrics = self._train_step(self.state, sharded)
-        # Materialize metrics on host before stopping the clock: JAX dispatch
-        # is async and on some platforms (axon tunnel) block_until_ready
-        # returns before completion — np.asarray is the reliable sync, and
-        # the reference returns a concrete loss per step anyway.
-        metrics = StepMetrics(*[np.asarray(m) for m in metrics])
+        if self._offload:
+            metrics = self._train_batch_offload(sharded)
+        else:
+            with self._pallas_scope():
+                self.state, metrics = self._train_step(self.state, sharded)
+            # Materialize metrics on host before stopping the clock: JAX
+            # dispatch is async and on some platforms (axon tunnel)
+            # block_until_ready returns before completion — np.asarray is
+            # the reliable sync, and the reference returns a concrete loss
+            # per step anyway.
+            metrics = StepMetrics(*[np.asarray(m) for m in metrics])
         self._last_metrics = metrics
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
@@ -399,6 +564,9 @@ class DeepSpeedEngine:
         micro = jax.tree.map(np.asarray, batch)
         rng = jax.random.fold_in(self._data_rng, self.micro_steps)
         with self._pallas_scope():
+            if self._offload:
+                return self._offload_eval_step(self._compute_params,
+                                               micro, rng)
             return self._eval_step(self.state, micro, rng)
 
     # --- reference-style imperative facade -----------------------------
@@ -406,9 +574,13 @@ class DeepSpeedEngine:
         """Compat shim for the reference trio (engine.py:779): computes the
         micro-batch loss and queues the batch for the fused step."""
         rng = jax.random.fold_in(self._data_rng, self.micro_steps)
+        micro = jax.tree.map(np.asarray, batch)
         with self._pallas_scope():
-            loss = self._eval_step(self.state,
-                                   jax.tree.map(np.asarray, batch), rng)
+            if self._offload:
+                loss = self._offload_eval_step(self._compute_params,
+                                               micro, rng)
+            else:
+                loss = self._eval_step(self.state, micro, rng)
         self._pending_micros.append(batch)
         return loss
 
@@ -449,11 +621,14 @@ class DeepSpeedEngine:
                         load_lr_scheduler_states=True,
                         load_module_only=False):
         from .checkpointing import load_checkpoint
-        return load_checkpoint(
+        result = load_checkpoint(
             self, load_dir, tag=tag,
             load_optimizer_states=load_optimizer_states,
             load_lr_scheduler_states=load_lr_scheduler_states,
             load_module_only=load_module_only)
+        if self._offload and result[0] is not None:
+            self._sync_offload_from_state()
+        return result
 
     # ------------------------------------------------------------------
     # introspection / logging

@@ -1,0 +1,117 @@
+"""ZeRO-Offload — host-resident optimizer tier.
+
+The reference's offload path (reference: deepspeed/runtime/zero/
+stage2.py:743-900 + csrc/adam/cpu_adam.cpp) stages gradients into pinned
+host buffers during backward, runs the AVX CPU Adam over host fp32
+partitions, and copies fp16 params back to the GPU with a fused kernel.
+
+The TPU shape of the same idea, given XLA's execution model:
+
+  device (one jitted function): forward + backward + grad unscale/clip +
+      overflow check — everything that wants the MXU.
+  host: fp32 master + both moments live in numpy (host RAM — the HBM
+      those buffers would occupy is what ZeRO-Offload frees); the native
+      CPU Adam (ops/cpu_adam.py) updates them and emits bf16 upload copies
+      in the same pass, which are device_put back as the next step's
+      compute params.
+
+Scope note: this is the single-controller tier — the host stages the FULL
+gradient and owns the full master.  Multi-host offload (each process
+pulling only its reduce-scattered shard, the reference's per-DP-rank
+partitions) is future work and is called out where it matters.
+
+Loss-scale skip/update bookkeeping runs on host (it is per-step control
+flow, exactly what the reference does in Python, stage2.py:1341-1362).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.cpu_adam import DeepSpeedCPUAdam
+
+
+class HostOffloadOptimizer:
+    """Owns the host-side master params + moments and the upload cast."""
+
+    def __init__(self, master_params, lr, betas, eps, weight_decay,
+                 adamw_mode: bool = True, bias_correction: bool = True,
+                 compute_dtype=jnp.bfloat16,
+                 use_native: Optional[bool] = None):
+        # pull master to host numpy once; it never goes back whole.
+        # fp32-promote only floating leaves — integer/bool buffers keep
+        # their dtype and are never touched by Adam (same rule the engine
+        # applies building the master, engine.py master cast).
+        def to_host(x):
+            arr = np.asarray(jax.device_get(x))
+            if np.issubdtype(arr.dtype, np.floating) or \
+                    arr.dtype.name == "bfloat16":
+                return np.array(arr, dtype=np.float32)
+            return np.array(arr)
+
+        self.master = jax.tree.map(to_host, master_params)
+        self.opt = DeepSpeedCPUAdam(
+            lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+            adamw_mode=adamw_mode, bias_correction=bias_correction,
+            use_native=use_native)
+        self.compute_dtype = compute_dtype
+        self._out_dtype = ("bfloat16" if compute_dtype == jnp.bfloat16
+                           else "float16" if compute_dtype == jnp.float16
+                           else None)
+
+    @property
+    def is_native(self) -> bool:
+        return self.opt.is_native
+
+    def compute_params(self):
+        """Initial low-precision copies for the device (non-floating
+        leaves pass through unchanged)."""
+        from ..ops.cpu_adam import lowp_np_dtype
+        dt = lowp_np_dtype(self._out_dtype)
+
+        def cast(x):
+            if dt is None or x.dtype != np.float32:
+                return x.copy()
+            return x.astype(dt)
+
+        return jax.tree.map(cast, self.master)
+
+    def step(self, host_grads):
+        """Update master/moments in place; return upload copies."""
+        out = self.opt.step(self.master, host_grads,
+                            out_dtype=self._out_dtype or "bfloat16")
+        return out
+
+    # -- checkpoint plumbing -------------------------------------------
+    def state_tree(self):
+        """Optimizer state as a pytree aligned with the master params
+        (what the engine stores in TrainState.opt_state and the
+        checkpointer serializes)."""
+        leaves, treedef = jax.tree.flatten(self.master)
+        mu, nu = [], []
+        for i, leaf in enumerate(leaves):
+            m, v = self.opt._moments(i, leaf)
+            mu.append(m)
+            nu.append(v)
+        return {"step": np.asarray(self.opt.step_count, np.int64),
+                "mu": jax.tree.unflatten(treedef, mu),
+                "nu": jax.tree.unflatten(treedef, nu)}
+
+    def load_state_tree(self, master_tree, opt_tree):
+        """In-place restore (buffer identity preserved so the numpy views
+        the native kernel updates stay the engine's state)."""
+        def copy_into(dst, src):
+            dst[...] = np.asarray(jax.device_get(src), dtype=np.float32)
+        jax.tree.map(copy_into, self.master, master_tree)
+        self.opt.step_count = int(np.asarray(
+            jax.device_get(opt_tree["step"])))
+        leaves = jax.tree.leaves(self.master)
+        mu = jax.tree.leaves(opt_tree["mu"])
+        nu = jax.tree.leaves(opt_tree["nu"])
+        for i, leaf in enumerate(leaves):
+            m, v = self.opt._moments(i, leaf)
+            m[...] = np.asarray(jax.device_get(mu[i]), np.float32)
+            v[...] = np.asarray(jax.device_get(nu[i]), np.float32)

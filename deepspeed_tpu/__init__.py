@@ -46,12 +46,17 @@ def initialize(args=None,
     from .parallel.mesh import build_mesh, mesh_axis_size, DATA_AXIS
     from .pipe.module import PipelineModule
 
+    def resolve_cfg(mesh):
+        if isinstance(cfg_src, DeepSpeedConfig):
+            return cfg_src  # pre-built config passes through unchanged
+        return DeepSpeedConfig(cfg_src,
+                               world_size=mesh_axis_size(mesh, DATA_AXIS))
+
     if isinstance(model, PipelineModule):
         from .pipe.engine import PipelineEngine
         if mesh is None:
             mesh = build_mesh(pp=model.num_stages)
-        cfg = DeepSpeedConfig(cfg_src,
-                              world_size=mesh_axis_size(mesh, DATA_AXIS))
+        cfg = resolve_cfg(mesh)
         engine = PipelineEngine(model=model, config=cfg, mesh=mesh,
                                 optimizer=optimizer,
                                 lr_schedule=lr_scheduler, params=params,
@@ -60,8 +65,7 @@ def initialize(args=None,
     else:
         if mesh is None:
             mesh = build_mesh()
-        cfg = DeepSpeedConfig(cfg_src,
-                              world_size=mesh_axis_size(mesh, DATA_AXIS))
+        cfg = resolve_cfg(mesh)
         engine = DeepSpeedEngine(model=model, config=cfg, mesh=mesh,
                                  optimizer=optimizer,
                                  lr_schedule=lr_scheduler, params=params,

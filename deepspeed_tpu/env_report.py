@@ -1,0 +1,47 @@
+"""``ds_report`` — environment / op-compatibility report (reference:
+deepspeed/env_report.py + bin/ds_report): versions, devices, native-op
+build status."""
+from __future__ import annotations
+
+import sys
+
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_FAIL = "\033[91m[FAIL]\033[0m"
+
+
+def collect_report() -> list:
+    lines = []
+    lines.append(("python", sys.version.split()[0]))
+    for mod in ("jax", "jaxlib", "numpy", "optax", "flax"):
+        try:
+            m = __import__(mod)
+            lines.append((mod, getattr(m, "__version__", "?")))
+        except ImportError:
+            lines.append((mod, "NOT INSTALLED"))
+    try:
+        import jax
+        devs = jax.devices()
+        lines.append(("platform", devs[0].platform))
+        lines.append(("devices", f"{len(devs)} × "
+                      f"{getattr(devs[0], 'device_kind', '?')}"))
+    except Exception as e:  # backend init can fail off-TPU
+        lines.append(("devices", f"unavailable ({e})"))
+    from .ops.op_builder import cpu_ops_status
+    lines.append(("native host ops", cpu_ops_status()))
+    from . import __version__
+    lines.append(("deepspeed_tpu", __version__))
+    return lines
+
+
+def main():
+    print("-" * 60)
+    print("deepspeed_tpu environment report")
+    print("-" * 60)
+    for key, val in collect_report():
+        print(f"{key:.<24} {val}")
+    print("-" * 60)
+
+
+if __name__ == "__main__":
+    main()

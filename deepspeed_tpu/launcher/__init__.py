@@ -1,0 +1,9 @@
+"""Launcher — hostfile-driven multi-host TPU job dispatch (reference
+feature slot: deepspeed/launcher/ + bin/ds)."""
+from .runner import (encode_world_info, fetch_hostfile,
+                     parse_inclusion_exclusion, parse_resource_filter)
+from .launch import build_env, decode_world_info
+
+__all__ = ["encode_world_info", "fetch_hostfile",
+           "parse_inclusion_exclusion", "parse_resource_filter",
+           "build_env", "decode_world_info"]

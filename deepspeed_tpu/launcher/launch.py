@@ -1,0 +1,79 @@
+"""Per-node launcher — decodes world info and starts the training process.
+
+The reference spawns one subprocess per local GPU with RANK /
+CUDA_VISIBLE_DEVICES (reference: deepspeed/launcher/launch.py:65-132).  On
+TPU one process per host drives every local chip, so this sets up the
+``jax.distributed`` env contract instead and execs the user script once:
+
+  JAX_COORDINATOR_ADDRESS  = master_addr:master_port
+  JAX_NUM_PROCESSES        = number of hosts
+  JAX_PROCESS_ID           = this host's node_rank
+plus the reference-compatible RANK / WORLD_SIZE / MASTER_ADDR / MASTER_PORT
+aliases some user scripts read.
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import sys
+
+from ..utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", type=str, required=True,
+                        help="base64 json of {host: [slots]}")
+    parser.add_argument("--node_rank", type=int, required=True)
+    parser.add_argument("--master_addr", type=str, required=True)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def decode_world_info(encoded: str) -> dict:
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+def build_env(world_info: dict, node_rank: int, master_addr: str,
+              master_port: int, base_env=None) -> dict:
+    env = dict(base_env if base_env is not None else os.environ)
+    hosts = list(world_info.keys())
+    if not 0 <= node_rank < len(hosts):
+        raise ValueError(
+            f"node_rank {node_rank} out of range for {len(hosts)} hosts")
+    slots = world_info[hosts[node_rank]]
+    env.update({
+        "JAX_COORDINATOR_ADDRESS": f"{master_addr}:{master_port}",
+        "JAX_NUM_PROCESSES": str(len(hosts)),
+        "JAX_PROCESS_ID": str(node_rank),
+        # reference-compatible aliases (launch.py:101-110 there)
+        "RANK": str(node_rank),
+        "WORLD_SIZE": str(len(hosts)),
+        "LOCAL_RANK": "0",
+        "MASTER_ADDR": master_addr,
+        "MASTER_PORT": str(master_port),
+        # libtpu honors TPU_VISIBLE_CHIPS (TPU_VISIBLE_DEVICES on older
+        # runtimes) — both set so slot filters actually partition the host
+        "TPU_VISIBLE_CHIPS": ",".join(str(s) for s in slots),
+        "TPU_VISIBLE_DEVICES": ",".join(str(s) for s in slots),
+    })
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    env = build_env(world_info, args.node_rank, args.master_addr,
+                    args.master_port)
+    cmd = [sys.executable, args.user_script] + args.user_args
+    logger.info("node %d/%d exec: %s", args.node_rank, len(world_info),
+                " ".join(cmd))
+    os.execvpe(cmd[0], cmd, env)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,249 @@
+"""``ds`` CLI front-end — multi-host TPU launcher.
+
+Mirrors the reference launcher's resource model (reference:
+deepspeed/launcher/runner.py:115-232: hostfile ``host slots=N`` lines,
+``--include``/``--exclude`` NODE_SPEC[@NODE_SPEC...] filters, base64 world
+info) with TPU launch semantics: one *process per host* (a TPU-VM process
+drives all local chips through jax, unlike the reference's
+process-per-GPU fork, launch.py:112-125 there), wired together via
+``jax.distributed`` coordinator env vars instead of NCCL's MASTER_ADDR
+rendezvous.  Multi-node dispatch shells out over ssh (pdsh if present),
+matching the reference's PDSH runner (multinode_runner.py:35-75).
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import collections
+import json
+import os
+import shlex
+import shutil
+import subprocess
+import sys
+from copy import deepcopy
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ("JAX_", "XLA_", "TPU_", "LIBTPU", "PYTHON", "PATH",
+               "LD_LIBRARY_PATH", "DEEPSPEED_TPU_")
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher: run a training script across "
+        "TPU hosts")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile of 'hostname slots=N' lines "
+                        "(slots = TPU chips on that host)")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="NODE_SPEC[@NODE_SPEC ...]; "
+                        "NODE_SPEC=NAME[:SLOT[,SLOT...]]")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="same syntax as --include; mutually exclusive")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="limit to the first N nodes of the hostfile")
+    parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1,
+                        dest="num_gpus",
+                        help="chips per node to use (reference flag name "
+                        "kept for CLI compatibility)")
+    parser.add_argument("--master_addr", type=str, default="",
+                        help="jax.distributed coordinator address "
+                        "(default: first host)")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--launcher", type=str, default="pdsh",
+                        choices=("pdsh", "ssh", "local"),
+                        help="multi-node transport")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="treat a single node as a multi-node launch")
+    parser.add_argument("user_script", type=str,
+                        help="training script to launch")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Optional[Dict[str, int]]:
+    """Parse ``hostname slots=N`` lines → OrderedDict (reference
+    runner.py:115-140: same format, duplicate-host error)."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning("Unable to find hostfile %s; proceeding with local "
+                       "resources only", hostfile_path)
+        return None
+    resource_pool: Dict[str, int] = collections.OrderedDict()
+    with open(hostfile_path) as fd:
+        for line in fd:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                key, slot_count = slots.split("=")
+                if key != "slots":
+                    raise ValueError(key)
+                slot_count = int(slot_count)
+            except ValueError:
+                raise ValueError(
+                    f"Hostfile line not formatted as 'host slots=N': "
+                    f"{line!r}")
+            if hostname in resource_pool:
+                raise ValueError(f"host {hostname} is already defined")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def parse_resource_filter(host_info: Dict[str, List[int]],
+                          include_str: str = "",
+                          exclude_str: str = "") -> Dict[str, List[int]]:
+    """Filter {host: [slots]} by include/exclude NODE_SPEC strings —
+    the reference's exact semantics (runner.py:143-232): include builds
+    from scratch, exclude removes, the two are mutually exclusive, empty
+    hosts are dropped, hostfile ordering is preserved."""
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually "
+                         "exclusive")
+    if not include_str and not exclude_str:
+        return host_info
+
+    filtered: Dict[str, List[int]] = {}
+    parse_str = include_str
+    if exclude_str:
+        filtered = deepcopy(host_info)
+        parse_str = exclude_str
+
+    for node_config in parse_str.split("@"):
+        if ":" in node_config:
+            hostname, slot_str = node_config.split(":")
+            slots = [int(x) for x in slot_str.split(",")]
+            if hostname not in host_info:
+                raise ValueError(
+                    f"Hostname '{hostname}' not found in hostfile")
+            for s in slots:
+                if s not in host_info[hostname]:
+                    raise ValueError(
+                        f"No slot '{s}' specified on host '{hostname}'")
+            if include_str:
+                filtered[hostname] = slots
+            else:
+                for s in slots:
+                    filtered[hostname].remove(s)
+        else:
+            hostname = node_config
+            if hostname not in host_info:
+                raise ValueError(
+                    f"Hostname '{hostname}' not found in hostfile")
+            filtered[hostname] = host_info[hostname] if include_str else []
+
+    for hostname in list(filtered):
+        filtered[hostname] = sorted(set(filtered[hostname]))
+        if not filtered[hostname]:
+            del filtered[hostname]
+
+    return collections.OrderedDict(
+        (h, filtered[h]) for h in host_info if h in filtered)
+
+
+def parse_inclusion_exclusion(resource_pool: Dict[str, int],
+                              inclusion: str,
+                              exclusion: str) -> Dict[str, List[int]]:
+    active = collections.OrderedDict(
+        (host, list(range(slots))) for host, slots in resource_pool.items())
+    return parse_resource_filter(active, include_str=inclusion,
+                                 exclude_str=exclusion)
+
+
+def encode_world_info(world_info: Dict[str, List[int]]) -> str:
+    """base64(json) world info passed to every node (reference
+    runner.py:245-248)."""
+    return base64.urlsafe_b64encode(
+        json.dumps(world_info).encode()).decode()
+
+
+def _export_env_lines(extra_env_file: str = DEEPSPEED_ENVIRONMENT_NAME
+                      ) -> Dict[str, str]:
+    """Env vars propagated to remote nodes: JAX/XLA/TPU families plus any
+    KEY=VALUE lines from a .deepspeed_env file (reference
+    runner.py:27-29,340-351)."""
+    exports = {}
+    for key, val in os.environ.items():
+        if any(key.startswith(p) for p in EXPORT_ENVS):
+            exports[key] = val
+    for candidate in (os.path.join(os.path.expanduser("~"),
+                                   extra_env_file), extra_env_file):
+        if os.path.isfile(candidate):
+            with open(candidate) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and "=" in line and not line.startswith("#"):
+                        k, v = line.split("=", 1)
+                        exports[k] = v
+            break
+    return exports
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if resource_pool is None:
+        # single-host launch: exec in place with chip visibility
+        env = os.environ.copy()
+        if args.num_gpus > 0:
+            chips = ",".join(str(i) for i in range(args.num_gpus))
+            env["TPU_VISIBLE_CHIPS"] = chips
+            env["TPU_VISIBLE_DEVICES"] = chips
+        cmd = [sys.executable, args.user_script] + args.user_args
+        logger.info("single-host launch: %s", " ".join(cmd))
+        os.execvpe(cmd[0], cmd, env)
+        return  # unreachable
+
+    active = parse_inclusion_exclusion(resource_pool, args.include,
+                                       args.exclude)
+    if args.num_nodes > 0:
+        active = collections.OrderedDict(
+            list(active.items())[:args.num_nodes])
+    if args.num_gpus > 0:
+        active = collections.OrderedDict(
+            (h, s[:args.num_gpus]) for h, s in active.items())
+    if not active:
+        raise ValueError("no resources left after include/exclude filters")
+
+    master_addr = args.master_addr or next(iter(active))
+    world_info = encode_world_info(active)
+    exports = _export_env_lines()
+
+    num_processes = len(active)  # one process per TPU host
+    launch_cmds = []
+    for proc_id, (host, slots) in enumerate(active.items()):
+        env_str = " ".join(f"{k}={shlex.quote(v)}"
+                           for k, v in sorted(exports.items()))
+        parts = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+                 f"--world_info={world_info}",
+                 f"--node_rank={proc_id}",
+                 f"--master_addr={master_addr}",
+                 f"--master_port={args.master_port}",
+                 args.user_script] + list(args.user_args)
+        remote = (env_str + " " +
+                  " ".join(shlex.quote(p) for p in parts)).strip()
+        launch_cmds.append((host, remote))
+
+    if args.launcher == "local" or (len(active) == 1
+                                    and not args.force_multi):
+        host, remote = launch_cmds[0]
+        logger.info("local launch on %s", host)
+        return subprocess.call(remote, shell=True)
+
+    # per-host fan-out: each node gets a distinct node_rank, so commands
+    # differ per host and pdsh's single-command broadcast doesn't apply —
+    # both transports dispatch one remote command per host
+    transport = (["pdsh", "-w"] if args.launcher == "pdsh"
+                 and shutil.which("pdsh") else ["ssh"])
+    procs = [subprocess.Popen(transport + [host, remote])
+             for host, remote in launch_cmds]
+    return max(p.wait() for p in procs)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

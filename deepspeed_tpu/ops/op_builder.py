@@ -47,8 +47,11 @@ def build_cpu_ops(verbose: bool = False) -> Path:
     if out.exists():
         return out
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    # compile to a process-unique temp path and rename into place: a
+    # concurrent builder must never dlopen a half-written .so
+    tmp = out.with_suffix(f".tmp{os.getpid()}")
     cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
-           "-o", str(out)] + [str(s) for s in sources]
+           "-o", str(tmp)] + [str(s) for s in sources]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
@@ -57,6 +60,7 @@ def build_cpu_ops(verbose: bool = False) -> Path:
     if proc.returncode != 0:
         raise OpBuilderError(
             f"native build failed:\n{' '.join(cmd)}\n{proc.stderr}")
+    os.replace(tmp, out)
     if verbose:
         print(f"[deepspeed_tpu] built {out.name}")
     return out

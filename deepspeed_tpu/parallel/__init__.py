@@ -13,7 +13,9 @@ from .mesh import (
     data_sharded,
     PIPE_AXIS,
     DATA_AXIS,
+    SEQ_AXIS,
     MODEL_AXIS,
     DEFAULT_AXES,
 )
+from .sequence import ring_attention, ulysses_attention
 from . import collectives

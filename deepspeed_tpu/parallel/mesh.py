@@ -21,30 +21,38 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
-DEFAULT_AXES: Tuple[str, str, str] = (PIPE_AXIS, DATA_AXIS, MODEL_AXIS)
+DEFAULT_AXES: Tuple[str, str, str, str] = (
+    PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 
 def build_mesh(pp: int = 1,
                dp: Optional[int] = None,
                tp: int = 1,
+               sp: int = 1,
                devices: Optional[Sequence] = None) -> Mesh:
-    """Build a (pipe, data, model) mesh over the available devices.
+    """Build a (pipe, data, seq, model) mesh over the available devices.
 
-    ``dp=None`` absorbs whatever device count remains after pp×tp.
+    ``dp=None`` absorbs whatever device count remains after pp×sp×tp.
+    ``sp`` is the sequence/context-parallel axis consumed by
+    parallel/sequence.py (ring / Ulysses attention); it sits between data
+    (slow OK) and model (fastest ICI) because ring rotations are
+    bandwidth-hungry but latency-tolerant.
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     if dp is None:
-        if n % (pp * tp) != 0:
+        if n % (pp * tp * sp) != 0:
             raise ValueError(
-                f"device count {n} not divisible by pp*tp={pp * tp}")
-        dp = n // (pp * tp)
-    if pp * dp * tp != n:
+                f"device count {n} not divisible by pp*sp*tp="
+                f"{pp * sp * tp}")
+        dp = n // (pp * tp * sp)
+    if pp * dp * sp * tp != n:
         raise ValueError(
-            f"pp*dp*tp = {pp}*{dp}*{tp} != device count {n}")
-    dev_array = np.asarray(devices).reshape(pp, dp, tp)
+            f"pp*dp*sp*tp = {pp}*{dp}*{sp}*{tp} != device count {n}")
+    dev_array = np.asarray(devices).reshape(pp, dp, sp, tp)
     return Mesh(dev_array, DEFAULT_AXES)
 
 

@@ -1,0 +1,145 @@
+"""Sequence / context parallelism — ring attention and Ulysses all-to-all.
+
+The reference (v0.3.2) has no sequence parallelism; its long-sequence
+feature is block-sparse attention (SURVEY.md §2.4: this is the modern
+equivalent occupying that feature slot, built mesh-native from day one).
+
+Two schemes over a named mesh axis (run inside ``shard_map`` with the
+sequence dimension sharded):
+
+  ring_attention(q, k, v, axis_name, causal=True)
+      Blockwise-softmax attention where K/V shards rotate around the ring
+      via ``ppermute`` while each device keeps its query shard (Ring
+      Attention; the online-softmax accumulation is the flash-attention
+      recurrence).  Peak memory O(T_local² + T_local·D) per device;
+      communication N-1 rotations of the local K/V shard over ICI.
+
+  ulysses_attention(q, k, v, axis_name, causal=True)
+      DeepSpeed-Ulysses-style: ``all_to_all`` re-shards [seq → heads], each
+      device computes full-sequence attention for H/N heads (any local
+      kernel — here the fp32-accumulating dense path), then ``all_to_all``
+      back.  Requires num_heads % ring_size == 0; communication 2
+      all-to-alls of the activations.
+
+Both are differentiable (ppermute/all_to_all transpose to themselves under
+AD) and validated against dense full-sequence attention in
+tests/test_sequence_parallel.py.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+SEQ_AXIS = "seq"
+
+_NEG = -1e30
+
+
+def _block_scores(q, k, sm_scale):
+    """[B,H,Tq,D] x [B,H,Tk,D] → fp32 scores [B,H,Tq,Tk]."""
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * sm_scale
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str = SEQ_AXIS,
+                   causal: bool = True,
+                   sm_scale: Optional[float] = None) -> jnp.ndarray:
+    """Ring attention over a sharded sequence.
+
+    q, k, v: this shard's slice [B, H, T_local, D] (sequence dim sharded
+    over ``axis_name``).  Returns the local output shard [B, H, T_local, D].
+    """
+    B, H, T, D = q.shape
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    scale = float(D) ** -0.5 if sm_scale is None else sm_scale
+
+    q32 = q.astype(jnp.float32)
+    pos_local = jnp.arange(T)
+    q_pos = idx * T + pos_local                      # global query positions
+
+    perm = [(i, (i + 1) % n) for i in range(n)]      # rotate shards forward
+
+    def accumulate(o, m, l, kc, vc, step):
+        """Online-softmax (flash recurrence) over the chunk that
+        originated on rank (idx - step) mod n."""
+        src = jnp.mod(idx - step, n)
+        k_pos = src * T + pos_local
+        s = _block_scores(q32, kc.astype(jnp.float32), scale)
+        if causal:
+            mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+            s = jnp.where(mask, s, _NEG)
+        m_new = jnp.maximum(m, s.max(-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + p.sum(-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        return o_new, m_new, l_new
+
+    def body(carry, step):
+        o, m, l, kc, vc = carry
+        o, m, l = accumulate(o, m, l, kc, vc, step)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (o, m, l, kc, vc), None
+
+    o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    m0 = jnp.full((B, H, T), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    carry = (o0, m0, l0, k, v)
+    if n > 1:
+        # scan covers the n-1 steps that need a rotation afterwards...
+        carry, _ = jax.lax.scan(body, carry, jnp.arange(n - 1))
+    # ...and the last chunk is consumed without the wasted final rotation
+    o, m, l, kc, vc = carry
+    o, m, l = accumulate(o, m, l, kc, vc, n - 1)
+    # causal first-token rows always see at least their own position → l>0
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str = SEQ_AXIS,
+                      causal: bool = True,
+                      sm_scale: Optional[float] = None) -> jnp.ndarray:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme).
+
+    q, k, v: [B, H, T_local, D] with the sequence sharded over
+    ``axis_name``; H must be divisible by the axis size.  Internally each
+    device attends the FULL sequence for H/n heads.
+    """
+    B, H, T, D = q.shape
+    n = jax.lax.axis_size(axis_name)
+    assert H % n == 0, (
+        f"ulysses needs heads ({H}) divisible by sequence shards ({n})")
+
+    def seq2head(x):
+        # [B, H, T_local, D] → [B, H/n, T_global, D]
+        x = x.reshape(B, n, H // n, T, D)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0,
+                               tiled=False)
+        # all_to_all with split axis 1 (the n groups) and concat on a new
+        # leading axis: [n, B, 1·(H/n), T, D] → transpose seq chunks in order
+        return x.transpose(1, 2, 0, 3, 4).reshape(B, H // n, n * T, D)
+
+    def head2seq(x):
+        # [B, H/n, T_global, D] → [B, H, T_local, D]
+        x = x.reshape(B, H // n, n, T, D).transpose(2, 0, 1, 3, 4)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1)
+        return x.reshape(B, H, T, D)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    scale = float(D) ** -0.5 if sm_scale is None else sm_scale
+    s = _block_scores(qg.astype(jnp.float32), kg.astype(jnp.float32), scale)
+    if causal:
+        Tg = s.shape[-1]
+        mask = jnp.tril(jnp.ones((Tg, Tg), bool))
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    og = jnp.einsum("bhqk,bhkd->bhqd", p,
+                    vg.astype(jnp.float32)).astype(q.dtype)
+    return head2seq(og)

@@ -1,0 +1,2 @@
+from . import checkpointing
+from ...config.config import DeepSpeedActivationCheckpointingConfig

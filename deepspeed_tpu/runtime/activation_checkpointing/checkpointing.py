@@ -1,0 +1,161 @@
+"""Activation checkpointing — rematerialization policies + RNG tracking.
+
+The reference implements a Megatron-derived autograd Function that saves
+RNG state, optionally partitions/offloads saved activations, and recomputes
+in backward (reference: deepspeed/runtime/activation_checkpointing/
+checkpointing.py:314-576).  On TPU every piece maps to a first-class JAX
+facility:
+
+  checkpoint(fn, *args)      → ``jax.checkpoint`` (recompute-in-backward is
+                               the transform's definition; RNG replay is
+                               automatic because keys are explicit values)
+  partition_activations      → saved residuals inherit the model's sharding
+                               constraints (GSPMD shards them; nothing to
+                               hand-partition).  Flag accepted + recorded.
+  cpu_checkpointing          → remat policy that offloads saved dot
+                               operands to host memory when the jax version
+                               provides the offload policy; else full remat
+                               (strictly less memory than saving).
+  CudaRNGStatesTracker       → named-key tracker (checkpointing.py:147-220
+                               there): explicit ``jax.random`` keys instead
+                               of mutable CUDA RNG state — fork() returns a
+                               fresh key and advances the named stream.
+
+``configure()`` / ``is_configured()`` mirror the reference module surface
+(checkpointing.py:654-746).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+
+from ...config.config import DeepSpeedActivationCheckpointingConfig
+from ...utils.logging import log_dist
+
+_config: Optional[DeepSpeedActivationCheckpointingConfig] = None
+_policy = None
+
+
+# ---------------------------------------------------------------------------
+# RNG tracker (reference CudaRNGStatesTracker, checkpointing.py:147-220)
+# ---------------------------------------------------------------------------
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+
+
+class RNGStatesTracker:
+    """Named streams of jax PRNG keys.  ``add(name, seed)`` registers a
+    stream; ``fork(name)`` returns a fresh key and advances the stream —
+    the functional analogue of the reference's get/set of device RNG
+    state around each checkpointed region."""
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self) -> Dict[str, Any]:
+        return dict(self.states_)
+
+    def set_states(self, states: Dict[str, Any]):
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self.states_:
+            raise Exception(f"rng state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name: str = _MODEL_PARALLEL_RNG) -> jax.Array:
+        if name not in self.states_:
+            raise Exception(f"rng state {name} is not added")
+        self.states_[name], out = tuple(
+            jax.random.split(self.states_[name]))
+        return out
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_cuda_rng_tracker() -> RNGStatesTracker:  # reference-compatible name
+    return _RNG_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed: int, tp_rank: int = 0,
+                                    pp_rank: int = 0, tp_size: int = 1):
+    """Seed scheme from the reference (checkpointing.py:223-262): the
+    model-parallel stream offsets by 2718 + tp_rank (+ pipeline offset) so
+    different TP ranks draw different dropout masks while the default
+    stream stays rank-invariant."""
+    offset = seed + 2718 + pp_rank * tp_size
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add(_MODEL_PARALLEL_RNG, offset + tp_rank)
+    return offset + tp_rank
+
+
+# ---------------------------------------------------------------------------
+# checkpoint()
+# ---------------------------------------------------------------------------
+def _select_policy(cfg: DeepSpeedActivationCheckpointingConfig):
+    if cfg.cpu_checkpointing:
+        pols = getattr(jax, "checkpoint_policies", None)
+        offload = getattr(pols, "offload_dot_with_no_batch_dims", None)
+        if offload is not None:
+            try:
+                return offload("device", "pinned_host")
+            except TypeError:
+                pass
+        log_dist("cpu_checkpointing: offload remat policy unavailable in "
+                 "this jax; using full rematerialization", ranks=[0])
+    return None  # jax.checkpoint default: save nothing, recompute all
+
+
+def configure(mpu_=None, deepspeed_config=None,
+              partition_activations: Optional[bool] = None,
+              contiguous_checkpointing: Optional[bool] = None,
+              num_checkpoints: Optional[int] = None,
+              checkpoint_in_cpu: Optional[bool] = None,
+              synchronize: Optional[bool] = None,
+              profile: Optional[bool] = None):
+    """Reference-compatible configure (checkpointing.py:654-733): explicit
+    args override the config block."""
+    global _config, _policy
+    if deepspeed_config is not None and hasattr(
+            deepspeed_config, "activation_checkpointing_config"):
+        _config = deepspeed_config.activation_checkpointing_config
+    elif isinstance(deepspeed_config, dict):
+        _config = DeepSpeedActivationCheckpointingConfig(deepspeed_config)
+    elif _config is None:
+        _config = DeepSpeedActivationCheckpointingConfig({})
+    assert _config is not None
+    for name, val in (("partition_activations", partition_activations),
+                      ("contiguous_memory_optimization",
+                       contiguous_checkpointing),
+                      ("number_checkpoints", num_checkpoints),
+                      ("cpu_checkpointing", checkpoint_in_cpu),
+                      ("synchronize_checkpoint_boundary", synchronize),
+                      ("profile", profile)):
+        if val is not None:
+            setattr(_config, name, val)
+    _policy = _select_policy(_config)
+
+
+def is_configured() -> bool:
+    return _config is not None
+
+
+def reset():
+    """Reference reset() (checkpointing.py:598): clears configure state."""
+    global _config, _policy
+    _config = None
+    _policy = None
+
+
+def checkpoint(function, *args):
+    """Checkpoint a forward segment: memory-saving recompute-in-backward
+    (reference CheckpointFunction.apply, checkpointing.py:579-596).
+    Differentiable; RNG keys passed through ``args`` replay identically in
+    the recompute (keys are values, the property the reference's RNG
+    save/restore machinery exists to emulate)."""
+    policy = _policy if is_configured() else None
+    return jax.checkpoint(function, policy=policy)(*args)

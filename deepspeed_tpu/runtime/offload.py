@@ -80,9 +80,13 @@ class HostOffloadOptimizer:
         return jax.tree.map(cast, self.master)
 
     def step(self, host_grads):
-        """Update master/moments in place; return upload copies."""
+        """Update master/moments in place; return upload copies in the
+        configured compute dtype (fp32 configs get fp32 copies — no silent
+        bf16 downgrade)."""
         out = self.opt.step(self.master, host_grads,
-                            out_dtype=self._out_dtype or "bfloat16")
+                            out_dtype=self._out_dtype)
+        if self._out_dtype is None:
+            return jax.tree.map(lambda x: x.copy(), self.master)
         return out
 
     # -- checkpoint plumbing -------------------------------------------

@@ -1,0 +1,36 @@
+"""Progressive Layer Drop (reference:
+deepspeed/runtime/progressive_layer_drop.py:1-33).
+
+Keep-probability schedule θ(t) = (1−θ̄)·exp(−γ·t) + θ̄; the engine advances
+it each step and models consume ``get_state()`` (the reference injects
+``progressive_layer_drop`` kwargs into the forward, engine.py:787-788).
+On TPU the drop decision itself belongs inside the model (a
+``lax.cond``/mask over the scanned layer stack keyed on the theta value),
+so this class stays pure bookkeeping, exactly like the reference.
+"""
+from __future__ import annotations
+
+import math
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        from ..utils.logging import log_dist
+        log_dist(f"Enabled progressive layer dropping (theta = "
+                 f"{self.theta})", ranks=[0])
+
+    def get_state(self) -> dict:
+        return {"progressive_layer_drop": True,
+                "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> None:
+        def _prob(x, gamma, p):
+            return (1.0 - p) * math.exp(-gamma * x) + p
+
+        self.current_theta = _prob(global_step, self.gamma, self.theta)

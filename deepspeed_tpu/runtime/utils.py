@@ -30,3 +30,41 @@ def clip_by_global_norm(tree, max_norm: float, norm=None):
 
 def weight_norm(tree) -> jnp.ndarray:
     return global_norm(tree)
+
+
+def see_memory_usage(message: str = "", force: bool = False) -> str:
+    """Device + host memory snapshot (reference: runtime/utils.py:489-553
+    see_memory_usage/memory_status — CUDA allocator stats there, per-device
+    ``memory_stats()`` + RSS here)."""
+    return memory_status(message)
+
+
+def memory_status(message: str = "") -> str:
+    import jax
+
+    parts = []
+    for d in jax.devices()[:8]:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            pass
+        if stats:
+            used = stats.get("bytes_in_use", 0) / 2 ** 30
+            peak = stats.get("peak_bytes_in_use", 0) / 2 ** 30
+            lim = stats.get("bytes_limit", 0) / 2 ** 30
+            parts.append(f"{d.id}: {used:.2f}/{lim:.2f}GB peak {peak:.2f}")
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    rss_gb = int(line.split()[1]) / 2 ** 20
+                    parts.append(f"host RSS {rss_gb:.2f}GB")
+                    break
+    except OSError:
+        pass
+    report = (f"MEMORY {message}: " if message else "MEMORY: ") + \
+        ("; ".join(parts) if parts else "no stats available")
+    from ..utils.logging import log_dist
+    log_dist(report, ranks=[0])
+    return report

@@ -1,0 +1,274 @@
+"""Aux subsystem tests: launcher parsing (reference: tests/unit/test_run.py),
+timers, CSR tensors (test_csr.py), progressive layer drop (test_pld.py),
+activation checkpointing (test_activation_checkpointing.py), env report."""
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.launcher import (build_env, decode_world_info,
+                                    encode_world_info, fetch_hostfile,
+                                    parse_inclusion_exclusion,
+                                    parse_resource_filter)
+from deepspeed_tpu.runtime.csr_tensor import (CSRTensor, csr_allgather,
+                                              sparse_embedding_grad)
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ac
+from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer,
+                                       ThroughputTimer)
+
+shard_map = partial(jax.shard_map, check_vma=False)
+
+
+# ---------------------------------------------------------------------------
+# launcher (mirrors tests/unit/test_run.py)
+# ---------------------------------------------------------------------------
+def _pool():
+    return {"worker-0": [0, 1, 2, 3], "worker-1": [0, 1, 2, 3]}
+
+
+def test_hostfile_parse(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("# chips per host\nworker-0 slots=4\nworker-1 slots=4\n")
+    pool = fetch_hostfile(str(hf))
+    assert pool == {"worker-0": 4, "worker-1": 4}
+
+
+def test_hostfile_duplicate_raises(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 slots=4\nw0 slots=2\n")
+    with pytest.raises(ValueError, match="already defined"):
+        fetch_hostfile(str(hf))
+
+
+def test_hostfile_bad_format(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 gpus=4\n")
+    with pytest.raises(ValueError, match="slots=N"):
+        fetch_hostfile(str(hf))
+
+
+def test_hostfile_missing_returns_none(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_include_filter():
+    out = parse_resource_filter(_pool(), include_str="worker-0@worker-1:0,2")
+    assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [0, 2]}
+
+
+def test_exclude_filter():
+    out = parse_resource_filter(_pool(), exclude_str="worker-1:0")
+    assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [1, 2, 3]}
+
+
+def test_exclude_whole_node():
+    out = parse_resource_filter(_pool(), exclude_str="worker-0")
+    assert out == {"worker-1": [0, 1, 2, 3]}
+
+
+def test_include_exclude_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        parse_resource_filter(_pool(), "worker-0", "worker-1")
+
+
+def test_filter_unknown_host():
+    with pytest.raises(ValueError, match="not found"):
+        parse_resource_filter(_pool(), include_str="worker-9")
+
+
+def test_filter_unknown_slot():
+    with pytest.raises(ValueError, match="No slot"):
+        parse_resource_filter(_pool(), include_str="worker-0:7")
+
+
+def test_filter_preserves_hostfile_order():
+    out = parse_resource_filter(_pool(), include_str="worker-1@worker-0")
+    assert list(out.keys()) == ["worker-0", "worker-1"]
+
+
+def test_world_info_roundtrip_and_env():
+    active = parse_inclusion_exclusion({"a": 4, "b": 4}, "", "b:1,3")
+    enc = encode_world_info(active)
+    dec = decode_world_info(enc)
+    assert dec == {"a": [0, 1, 2, 3], "b": [0, 2]}
+    env = build_env(dec, node_rank=1, master_addr="a", master_port=1234,
+                    base_env={})
+    assert env["JAX_COORDINATOR_ADDRESS"] == "a:1234"
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert env["JAX_PROCESS_ID"] == "1"
+    assert env["TPU_VISIBLE_CHIPS"] == "0,2"
+    assert env["TPU_VISIBLE_DEVICES"] == "0,2"
+    assert env["RANK"] == "1" and env["WORLD_SIZE"] == "2"
+
+
+def test_build_env_bad_rank():
+    with pytest.raises(ValueError, match="out of range"):
+        build_env({"a": [0]}, node_rank=3, master_addr="a",
+                  master_port=1, base_env={})
+
+
+# ---------------------------------------------------------------------------
+# timers
+# ---------------------------------------------------------------------------
+def test_wallclock_timer_accumulates():
+    timers = SynchronizedWallClockTimer()
+    t = timers("phase")
+    t.start()
+    time.sleep(0.02)
+    t.stop()
+    t.start()
+    time.sleep(0.02)
+    t.stop()
+    elapsed = t.elapsed(reset=True)
+    assert 0.03 < elapsed < 0.5
+    assert t.elapsed(reset=False) == 0.0  # reset cleared it
+    timers.log(["phase"])  # must not raise
+
+
+def test_throughput_timer_warmup_skip():
+    tt = ThroughputTimer(batch_size=32, start_step=2, steps_per_output=1000)
+    for _ in range(5):
+        tt.start()
+        time.sleep(0.005)
+        tt.stop()
+    # first start_step-1 steps excluded from the average
+    assert tt.total_step_count == 5
+    sps = tt.avg_samples_per_sec()
+    assert 0 < sps < 32 / 0.004
+
+
+# ---------------------------------------------------------------------------
+# CSR tensors (mirrors tests/unit/test_csr.py)
+# ---------------------------------------------------------------------------
+def test_csr_roundtrip():
+    dense = np.zeros((10, 4), np.float32)
+    dense[2] = 1.5
+    dense[7] = -2.0
+    csr = CSRTensor.from_dense(jnp.asarray(dense), max_nnz=4)
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), dense)
+    assert csr.sparse_size() < dense.size + 10
+
+
+def test_csr_duplicate_indices_sum():
+    csr = CSRTensor(jnp.asarray([1, 1, 3]),
+                    jnp.asarray([[1.0], [2.0], [4.0]]), (5, 1))
+    dense = np.asarray(csr.to_dense())
+    assert dense[1, 0] == 3.0 and dense[3, 0] == 4.0
+
+
+def test_sparse_embedding_grad_matches_dense():
+    V, D = 50, 8
+    tokens = jnp.asarray([[1, 4, 4], [9, 1, 30]], jnp.int32)
+    emb = jnp.asarray(np.random.default_rng(0).standard_normal((V, D)),
+                      jnp.float32)
+
+    def loss(table):
+        return jnp.sum(table[tokens] ** 2)
+
+    dense_grad = jax.grad(loss)(emb)
+    csr = sparse_embedding_grad(dense_grad, tokens)
+    assert csr.nnz == 6  # one entry per token
+    # duplicates (two 4s, two 1s) overcount on densify — scale check on
+    # unique rows only
+    got = np.asarray(csr.to_dense())
+    for row in (9, 30):
+        np.testing.assert_allclose(got[row], np.asarray(dense_grad[row]))
+
+
+def test_csr_allgather_over_mesh():
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    V, D = 16, 4
+    rng = np.random.default_rng(1)
+    idx = rng.integers(0, V, (8, 2)).astype(np.int32)
+    vals = rng.standard_normal((8, 2, D)).astype(np.float32)
+
+    def combine(i, v):
+        local = CSRTensor(i[0], v[0], (V, D))
+        return csr_allgather(local, "data").to_dense()
+
+    fn = shard_map(combine, mesh=mesh, in_specs=(P("data"), P("data")),
+                   out_specs=P())
+    out = np.asarray(jax.jit(fn)(idx, vals))
+    ref = np.zeros((V, D), np.float32)
+    for s in range(8):
+        for j in range(2):
+            ref[idx[s, j]] += vals[s, j]
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# progressive layer drop (mirrors tests/unit/test_pld.py)
+# ---------------------------------------------------------------------------
+def test_pld_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.001)
+    assert pld.get_theta() == 1.0
+    expected = []
+    for step in [0, 100, 1000, 10000]:
+        pld.update_state(step)
+        theta = pld.get_theta()
+        expected.append(theta)
+        assert 0.5 <= theta <= 1.0
+        np.testing.assert_allclose(
+            theta, 0.5 * np.exp(-0.001 * step) + 0.5, rtol=1e-9)
+    assert expected == sorted(expected, reverse=True)  # monotone decay
+    assert pld.get_state()["progressive_layer_drop"] is True
+
+
+# ---------------------------------------------------------------------------
+# activation checkpointing
+# ---------------------------------------------------------------------------
+def test_checkpoint_preserves_values_and_grads():
+    ac.reset()
+    ac.configure(deepspeed_config={"activation_checkpointing": {
+        "partition_activations": True}})
+    assert ac.is_configured()
+
+    def block(x, w):
+        return jnp.tanh(x @ w)
+
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((4, 8)),
+                    jnp.float32)
+    w = jnp.asarray(np.random.default_rng(3).standard_normal((8, 8)),
+                    jnp.float32)
+    out_ck = ac.checkpoint(block, x, w)
+    np.testing.assert_allclose(np.asarray(out_ck),
+                               np.asarray(block(x, w)), rtol=1e-6)
+    g_ck = jax.grad(lambda w: jnp.sum(ac.checkpoint(block, x, w) ** 2))(w)
+    g = jax.grad(lambda w: jnp.sum(block(x, w) ** 2))(w)
+    np.testing.assert_allclose(np.asarray(g_ck), np.asarray(g), rtol=1e-6)
+    ac.reset()
+    assert not ac.is_configured()
+
+
+def test_rng_tracker_fork_advances():
+    tracker = ac.RNGStatesTracker()
+    tracker.add("mp", 17)
+    k1 = tracker.fork("mp")
+    k2 = tracker.fork("mp")
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    with pytest.raises(Exception, match="already exists"):
+        tracker.add("mp", 1)
+    with pytest.raises(Exception, match="not added"):
+        tracker.fork("nope")
+
+
+def test_model_parallel_seed_ranks_differ():
+    s0 = ac.model_parallel_cuda_manual_seed(1234, tp_rank=0)
+    s1 = ac.model_parallel_cuda_manual_seed(1234, tp_rank=1)
+    assert s0 != s1
+
+
+# ---------------------------------------------------------------------------
+# env report
+# ---------------------------------------------------------------------------
+def test_env_report_collects():
+    from deepspeed_tpu.env_report import collect_report
+    lines = dict(collect_report())
+    assert lines["jax"] != "NOT INSTALLED"
+    assert "cpu_ops" in lines["native host ops"]
+    assert "deepspeed_tpu" in lines

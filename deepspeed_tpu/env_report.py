@@ -6,10 +6,6 @@ from __future__ import annotations
 import sys
 
 
-GREEN_OK = "\033[92m[OKAY]\033[0m"
-RED_FAIL = "\033[91m[FAIL]\033[0m"
-
-
 def collect_report() -> list:
     lines = []
     lines.append(("python", sys.version.split()[0]))

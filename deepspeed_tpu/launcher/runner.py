@@ -214,7 +214,6 @@ def main(args=None):
     world_info = encode_world_info(active)
     exports = _export_env_lines()
 
-    num_processes = len(active)  # one process per TPU host
     launch_cmds = []
     for proc_id, (host, slots) in enumerate(active.items()):
         env_str = " ".join(f"{k}={shlex.quote(v)}"

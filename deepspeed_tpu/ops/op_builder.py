@@ -42,6 +42,12 @@ def _source_hash(sources) -> str:
 def build_cpu_ops(verbose: bool = False) -> Path:
     """Compile csrc/cpu_adam.cpp → _build/libds_cpu_ops_<hash>.so."""
     sources = [_CSRC / "cpu_adam.cpp"]
+    missing = [str(s) for s in sources if not s.exists()]
+    if missing:
+        raise OpBuilderError(
+            f"native sources not found: {missing} — wheel installs ship "
+            "without csrc/; use a source checkout (or the sdist) for the "
+            "native host ops")
     tag = _source_hash(sources)
     out = _BUILD_DIR / f"libds_cpu_ops_{tag}.so"
     if out.exists():

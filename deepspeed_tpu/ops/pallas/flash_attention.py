@@ -123,10 +123,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_scr[:, 0:1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse = m_scr[:, 0:1] + jnp.log(l_safe)           # [bq, 1]
-        # lse_ref holds the full padded row (TPU tiling forbids
-        # (1, block_q) blocks); store this q block's slice.
-        lse_ref[0, 0, pl.ds(iq * block_q, block_q)] = jnp.transpose(lse)[0]
+        # lse output is q-blocked with a sublane-padded layout
+        # [bh, nq, 8, block_q]: every store is a whole (8, block_q) tile at
+        # lane offset 0.  Mosaic rejects dynamic lane offsets that are not
+        # provably 128-aligned (iq*block_q is not, for block_q < 128), and
+        # TPU block shapes need their last two dims (sublane, lane) to be
+        # (8k, 128k) or the full array dims — the 8-row broadcast buys both.
+        lse = m_scr[:, 0] + jnp.log(l_safe[:, 0])       # [bq]
+        lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, block_q))
 
 
 def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
@@ -143,21 +147,33 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_len=tk)
+    if causal:
+        # clamp the K/V block index at the causal diagonal: skipped
+        # (fully-masked) grid steps revisit the previous block, and Pallas
+        # elides the HBM→VMEM copy for revisited blocks — without this the
+        # pipeline streams every K/V block even though pl.when skips the
+        # compute (≈2× attention HBM traffic at long T)
+        def kv_im(b, i, j):
+            return (b, jnp.minimum(j, (i * block_q + block_q - 1)
+                                   // block_k), 0)
+    else:
+        def kv_im(b, i, j):
+            return (b, j, 0)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_im),
+            pl.BlockSpec((1, block_k, d), kv_im),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, tq_p), lambda b, i, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, i, j: (b, i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, tq_p), jnp.float32),
+            jax.ShapeDtypeStruct((bh, nq, 8, block_q), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -166,7 +182,7 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :t], lse[:, 0, :t]
+    return out[:, :t], lse[:, :, 0, :].reshape(bh, tq_p)[:, :t]
 
 
 # ---------------------------------------------------------------------------
@@ -193,9 +209,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        qs = pl.ds(iq * block_q, block_q)
-        lse = jnp.transpose(lse_ref[0, 0:1, qs])        # [bq, 1]
-        delta = jnp.transpose(delta_ref[0, 0:1, qs])    # [bq, 1]
+        lse = jnp.transpose(lse_ref[0, 0, 0:1, :])      # [bq, 1]
+        delta = jnp.transpose(delta_ref[0, 0, 0:1, :])  # [bq, 1]
 
         s = _masked_scores(q, k, iq, ik, sm_scale=sm_scale, causal=causal,
                            block_q=block_q, block_k=block_k,
@@ -235,9 +250,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        qs = pl.ds(iq * block_q, block_q)
-        lse = jnp.transpose(lse_ref[0, 0:1, qs])        # [bq, 1]
-        delta = jnp.transpose(delta_ref[0, 0:1, qs])    # [bq, 1]
+        lse = jnp.transpose(lse_ref[0, 0, 0:1, :])      # [bq, 1]
+        delta = jnp.transpose(delta_ref[0, 0, 0:1, :])  # [bq, 1]
 
         s = _masked_scores(q, k, iq, ik, sm_scale=sm_scale, causal=causal,
                            block_q=block_q, block_k=block_k,
@@ -273,18 +287,31 @@ def _bwd(q, k, v, out, lse, do, *, sm_scale, causal, block_q, block_k,
 
     qp = _pad_seq(q, block_q, 1)
     dop = _pad_seq(do, block_q, 1)
-    lsep = _pad_seq(lse, block_q, 1)[:, None, :]
-    deltap = _pad_seq(delta, block_q, 1)[:, None, :]
     kp = _pad_seq(k, block_k, 1)
     vp = _pad_seq(v, block_k, 1)
     tq_p, tk_p = qp.shape[1], kp.shape[1]
     nq, nk = tq_p // block_q, tk_p // block_k
+    # q-blocked, sublane-padded row statistics ([bh, nq, 8, block_q]):
+    # all kernel accesses are whole tiles at lane offset 0 (no dynamic
+    # lane slicing, valid TPU block shape — see _fwd_kernel._finalize)
+    def _rows(x):
+        r = _pad_seq(x, block_q, 1).reshape(bh, nq, 1, block_q)
+        return jnp.broadcast_to(r, (bh, nq, 8, block_q))
+
+    lsep = _rows(lse)
+    deltap = _rows(delta)
 
     q_spec_i = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    kv_spec_j = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
-    # full padded row per program (TPU tiling forbids (1, block_q) blocks);
-    # kernels slice their q block out with pl.ds.
-    row_spec = pl.BlockSpec((1, 1, tq_p), lambda b, i, j: (b, 0, 0))
+    if causal:  # same revisit trick as the forward (see _fwd)
+        def kv_im_j(b, i, j):
+            return (b, jnp.minimum(j, (i * block_q + block_q - 1)
+                                   // block_k), 0)
+    else:
+        def kv_im_j(b, i, j):
+            return (b, j, 0)
+    kv_spec_j = pl.BlockSpec((1, block_k, d), kv_im_j)
+    row_spec = pl.BlockSpec((1, 1, 8, block_q),
+                            lambda b, i, j: (b, i, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
@@ -299,14 +326,29 @@ def _bwd(q, k, v, out, lse, do, *, sm_scale, causal, block_q, block_k,
     )(qp, kp, vp, dop, lsep, deltap)
 
     # dK/dV: k blocks outer, q blocks inner.
-    q_spec_j = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    if causal:
+        # the first useful q block for k block i starts at the diagonal:
+        # clamp below it so masked steps revisit (no fetch)
+        def q_im_j(b, i, j):
+            return (b, jnp.maximum(j, (i * block_k) // block_q), 0)
+
+        def row_im_j(b, i, j):
+            return (b, jnp.maximum(j, (i * block_k) // block_q), 0, 0)
+    else:
+        def q_im_j(b, i, j):
+            return (b, j, 0)
+
+        def row_im_j(b, i, j):
+            return (b, j, 0, 0)
+    q_spec_j = pl.BlockSpec((1, block_q, d), q_im_j)
     kv_spec_i = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    row_spec_j = pl.BlockSpec((1, 1, 8, block_q), row_im_j)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_len=tk),
         grid=(bh, nk, nq),
-        in_specs=[q_spec_j, kv_spec_i, kv_spec_i, q_spec_j, row_spec,
-                  row_spec],
+        in_specs=[q_spec_j, kv_spec_i, kv_spec_i, q_spec_j, row_spec_j,
+                  row_spec_j],
         out_specs=[kv_spec_i, kv_spec_i],
         out_shape=[jax.ShapeDtypeStruct((bh, tk_p, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, tk_p, d), v.dtype)],
@@ -349,8 +391,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 128,
-                    block_k: int = 128,
+                    block_q: int = 512,
+                    block_k: int = 512,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over [B, H, T, Dh] inputs (differentiable).
 

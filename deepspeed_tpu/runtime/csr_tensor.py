@@ -91,6 +91,14 @@ def sparse_embedding_grad(dense_grad: jnp.ndarray,
                           token_ids: jnp.ndarray) -> CSRTensor:
     """Build the CSR gradient of an embedding table from the dense grad
     and the batch's token ids (the rows that can be nonzero).  nnz is the
-    number of tokens — static, so this works under jit."""
+    number of tokens — static, so this works under jit.
+
+    Repeated tokens: ``dense_grad[row]`` already sums every occurrence, so
+    each of the k duplicate entries carries row/k — ``to_dense`` then
+    reconstructs exactly ``dense_grad`` instead of k× it."""
     ids = token_ids.reshape(-1).astype(jnp.int32)
-    return CSRTensor(ids, dense_grad[ids], dense_grad.shape)
+    counts = jnp.zeros((dense_grad.shape[0],), jnp.float32).at[ids].add(1.0)
+    scale = (1.0 / counts[ids]).astype(dense_grad.dtype)
+    values = dense_grad[ids] * scale.reshape(
+        (-1,) + (1,) * (dense_grad.ndim - 1))
+    return CSRTensor(ids, values, dense_grad.shape)

@@ -189,6 +189,30 @@ class DeepSpeedEngine:
             self.deepspeed_io(training_data, collate_fn=collate_fn)
             if training_data is not None else None)
 
+        # ---- aux subsystems driven by config ----
+        # progressive layer drop (reference engine.py:189-190,787-788)
+        self.progressive_layer_drop = None
+        if config.pld_config.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=config.pld_config.theta,
+                gamma=config.pld_config.gamma)
+        # tensorboard scalars from rank 0 (reference engine.py:253-285)
+        self.summary_writer = None
+        if config.tensorboard_config.enabled and jax.process_index() == 0:
+            from ..utils.monitor import SummaryWriter
+            self.summary_writer = SummaryWriter(
+                output_path=config.tensorboard_config.output_path,
+                job_name=config.tensorboard_config.job_name)
+        # per-phase timers; enabling them syncs the device every step
+        # (reference wall_clock_breakdown likewise cuda-synchronizes,
+        # engine.py:790-800) — the async dispatch overlap is traded for
+        # measurement
+        self.timers = None
+        if config.wall_clock_breakdown:
+            from ..utils.timer import SynchronizedWallClockTimer
+            self.timers = SynchronizedWallClockTimer()
+
         log_dist(
             f"DeepSpeedEngine: dp={self.dp_world_size} "
             f"zero_stage={config.zero_optimization_stage} "
@@ -335,14 +359,17 @@ class DeepSpeedEngine:
             # the optimizer's schedule actually used (skipped steps don't
             # advance the schedule).
             applied = new_global - new_skipped
-            metrics = StepMetrics(
-                loss=mean_loss,
-                grad_norm=grad_norm,
-                loss_scale=scaler.loss_scale,
-                overflow=~finite,
-                lr=lr_at(applied),
-            )
-            return new_state, metrics
+            # metrics leave the device as ONE packed f32 vector: each
+            # np.asarray is a full host round-trip (expensive through the
+            # axon tunnel), so five separate fields cost 5× the latency
+            packed = jnp.stack([
+                mean_loss.astype(jnp.float32),
+                grad_norm.astype(jnp.float32),
+                scaler.loss_scale.astype(jnp.float32),
+                (~finite).astype(jnp.float32),
+                lr_at(applied),
+            ])
+            return new_state, packed
 
         return jax.jit(train_step, donate_argnums=(0,))
 
@@ -529,25 +556,58 @@ class DeepSpeedEngine:
                 raise ValueError("train_batch needs a batch or a data_iter")
             batch = next(it)
         t0 = time.time()
+        if self.progressive_layer_drop is not None and isinstance(batch, dict):
+            # inject PLD state as batch leaves (the reference injects model
+            # kwargs, engine.py:787-788); the theta array updates per step
+            # without retracing
+            self.progressive_layer_drop.update_state(self.global_steps)
+            batch = dict(batch)
+            batch["pld_theta"] = np.full(
+                (np.asarray(next(iter(batch.values()))).shape[0],),
+                self.progressive_layer_drop.get_theta(), np.float32)
+        if self.timers is not None:
+            self.timers("train_batch_data").start()
         sharded = self._shard_batch(batch)
+        if self.timers is not None:
+            self.timers("train_batch_data").stop()
+            self.timers("train_batch_step").start()
         if self._offload:
             metrics = self._train_batch_offload(sharded)
+            self._last_metrics = metrics
+            loss_out = metrics.loss
         else:
             with self._pallas_scope():
-                self.state, metrics = self._train_step(self.state, sharded)
-            # Materialize metrics on host before stopping the clock: JAX
-            # dispatch is async and on some platforms (axon tunnel)
-            # block_until_ready returns before completion — np.asarray is
-            # the reliable sync, and the reference returns a concrete loss
-            # per step anyway.
-            metrics = StepMetrics(*[np.asarray(m) for m in metrics])
-        self._last_metrics = metrics
+                self.state, packed = self._train_step(self.state, sharded)
+            # NO host sync here: every np.asarray is a full round-trip
+            # (expensive through the axon tunnel) and a serialization
+            # point.  The packed metrics vector stays on device; steps
+            # queue back-to-back and the transfer latency overlaps with
+            # compute.  ``last_metrics`` materializes on demand, and the
+            # steps_per_print report is the periodic sync (the reference
+            # likewise returns the live loss tensor, engine.py:818).
+            self._last_packed = packed
+            self._last_metrics = None
+            loss_out = packed[0]
+        if self.timers is not None:
+            # materializing the metrics is the device sync
+            _ = self.last_metrics
+            self.timers("train_batch_step").stop()
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
         self._step_times.append(time.time() - t0)
         if self.global_steps % self.config.steps_per_print == 0:
-            self._report(metrics)
-        return metrics.loss
+            if self.timers is not None:
+                self.timers.log(["train_batch_data", "train_batch_step"])
+            self._report(self.last_metrics)
+        if self.summary_writer is not None:
+            m = self.last_metrics
+            self.summary_writer.add_scalar(
+                "Train/loss", float(m.loss), self.global_steps)
+            self.summary_writer.add_scalar(
+                "Train/lr", float(m.lr), self.global_steps)
+            self.summary_writer.add_scalar(
+                "Train/loss_scale", float(m.loss_scale), self.global_steps)
+        return loss_out
 
     def _training_iter(self):
         """Persistent iterator over the training dataloader (a fresh
@@ -635,6 +695,12 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     @property
     def last_metrics(self) -> Optional[StepMetrics]:
+        if self._last_metrics is None and \
+                getattr(self, "_last_packed", None) is not None:
+            vec = np.asarray(self._last_packed)
+            self._last_metrics = StepMetrics(
+                loss=vec[0], grad_norm=vec[1], loss_scale=vec[2],
+                overflow=bool(vec[3] > 0.5), lr=vec[4])
         return self._last_metrics
 
     @property
@@ -655,8 +721,20 @@ class DeepSpeedEngine:
         return int(self.state.skipped_steps)
 
     def _report(self, metrics: StepMetrics):
-        times = list(self._step_times)
-        avg = sum(times) / max(len(times), 1)
+        # throughput from report-interval wall time, measured AFTER the
+        # metrics materialization above drained the device: with async
+        # dispatch, per-call _step_times record only enqueue latency and
+        # would inflate samples/sec by orders of magnitude
+        now = time.time()
+        last = getattr(self, "_last_report", None)
+        steps = self.global_steps - getattr(self, "_last_report_step", 0)
+        self._last_report = now
+        self._last_report_step = self.global_steps
+        if last is not None and steps > 0:
+            avg = (now - last) / steps
+        else:
+            times = list(self._step_times)  # first report: dispatch-biased
+            avg = sum(times) / max(len(times), 1)
         tput = self.train_batch_size / avg if avg > 0 else 0.0
         log_dist(
             f"step={self.global_steps} loss={float(metrics.loss):.4f} "

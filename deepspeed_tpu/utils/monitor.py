@@ -1,0 +1,48 @@
+"""Training-scalar monitor (the reference's TensorBoard integration,
+reference: deepspeed/runtime/engine.py:253-285,832-843,977-1030).
+
+Writes to TensorBoard when the ``tensorboard`` package is importable
+(torch ships the writer), else falls back to a JSONL event file with the
+same (tag, value, step) triples — the data survives either way and the
+engine code has one interface.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+
+class SummaryWriter:
+    def __init__(self, output_path: str = "", job_name: str = "DeepSpeedJobName"):
+        base = output_path or os.path.join(os.getcwd(), "runs")
+        self.log_dir = os.path.join(base, job_name)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._tb = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter as TBWriter
+            self._tb = TBWriter(log_dir=self.log_dir)
+        except Exception:
+            self._jsonl = open(
+                os.path.join(self.log_dir, "events.jsonl"), "a")
+
+    def add_scalar(self, tag: str, value: float, global_step: int):
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, global_step)
+        else:
+            self._jsonl.write(json.dumps(
+                {"tag": tag, "value": float(value),
+                 "step": int(global_step), "ts": time.time()}) + "\n")
+
+    def flush(self):
+        if self._tb is not None:
+            self._tb.flush()
+        else:
+            self._jsonl.flush()
+
+    def close(self):
+        if self._tb is not None:
+            self._tb.close()
+        else:
+            self._jsonl.close()

@@ -18,11 +18,20 @@ from .logging import log_dist
 
 
 def _synchronize():
-    """Drain outstanding device work (≈ torch.cuda.synchronize)."""
+    """Drain outstanding device work (≈ torch.cuda.synchronize).
+
+    ``effects_barrier`` alone only waits for *effectful* computations; the
+    per-device ``synchronize_all_activity`` is what actually drains pure
+    jitted work from the execution stream."""
     try:
         jax.effects_barrier()
     except Exception:
         pass
+    for d in jax.local_devices():
+        try:
+            d.synchronize_all_activity()
+        except Exception:  # backend without the PJRT sync hook
+            break
 
 
 class _Timer:

@@ -206,9 +206,33 @@ def gpt2_block_forward(cfg: GPT2Config, bp, x, rng, train: bool):
     elif cfg.attn_impl == "dense":
         attn = causal_attention(heads(q), heads(k), heads(v),
                                 dropout_rate=drop, dropout_rng=r1)
+    elif cfg.attn_impl in ("ring", "ulysses"):
+        # sequence-parallel attention over the mesh's 'seq' axis: manual
+        # shard_map on 'seq' only, data/model stay under GSPMD.  Requires
+        # the engine to run under jax.set_mesh (it does) so the abstract
+        # mesh is visible here.
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.sequence import (SEQ_AXIS, ring_attention,
+                                         ulysses_attention)
+        assert drop == 0.0, (
+            "sequence-parallel attention has no probability-dropout path")
+        am = jax.sharding.get_abstract_mesh()
+        sp = dict(getattr(am, "shape", {})).get(SEQ_AXIS, 1)
+        if sp > 1:
+            impl = (ring_attention if cfg.attn_impl == "ring"
+                    else ulysses_attention)
+            spec = P(None, None, SEQ_AXIS, None)
+            fn = jax.shard_map(
+                lambda q, k, v: impl(q, k, v, SEQ_AXIS, causal=True),
+                in_specs=(spec, spec, spec), out_specs=spec,
+                axis_names={SEQ_AXIS}, check_vma=False)
+            attn = fn(heads(q), heads(k), heads(v))
+        else:  # mesh has no seq shards: plain dense attention
+            attn = causal_attention(heads(q), heads(k), heads(v))
     else:
         raise ValueError(
-            f"attn_impl={cfg.attn_impl!r}: expected 'flash' or 'dense'")
+            f"attn_impl={cfg.attn_impl!r}: expected 'flash', 'dense', "
+            "'ring', or 'ulysses'")
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
     attn = attn @ bp["out_w"].astype(h.dtype) + bp["out_b"].astype(h.dtype)
     x = x + _dropout(attn, drop, r2)

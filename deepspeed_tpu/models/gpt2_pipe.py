@@ -13,6 +13,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import MODEL_AXIS
 from ..pipe.module import LayerSpec, TiedLayerSpec, PipelineModule
 from .gpt2 import GPT2Config, _dropout, _layer_norm, gpt2_block_forward
 
@@ -30,6 +33,9 @@ class GPT2EmbeddingPipe:
             "wpe": jax.random.normal(
                 k2, (cfg.n_positions, cfg.d_model), jnp.float32) * 0.02,
         }
+
+    def param_partition_specs(self):
+        return {"wte": P(MODEL_AXIS, None), "wpe": P()}
 
     def apply(self, params, tokens, rng, train: bool = True):
         cfg = self.cfg
@@ -68,6 +74,19 @@ class GPT2BlockPipe:
             "proj_w": jax.random.normal(
                 ks[3], (4 * d, d), jnp.float32) * resid_std,
             "proj_b": jnp.zeros((d,), jnp.float32),
+        }
+
+    def param_partition_specs(self):
+        """Megatron column/row layout (same as GPT2Model's stacked specs,
+        minus the layer axis)."""
+        m = MODEL_AXIS
+        return {
+            "ln1_scale": P(), "ln1_bias": P(),
+            "qkv_w": P(None, m), "qkv_b": P(m),
+            "out_w": P(m, None), "out_b": P(),
+            "ln2_scale": P(), "ln2_bias": P(),
+            "fc_w": P(None, m), "fc_b": P(m),
+            "proj_w": P(m, None), "proj_b": P(),
         }
 
     def apply(self, bp, x, rng, train: bool = True):

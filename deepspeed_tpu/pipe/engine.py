@@ -83,7 +83,9 @@ class _PipelinedTrainModule(TrainModule):
         return self.pm.init(rng)
 
     def param_partition_specs(self, params):
-        return None  # replicated over pipe; ZeRO composes the data axis
+        # replicated over pipe; tensor-parallel ('model') placement comes
+        # from the layers; ZeRO composes the data axis on top
+        return self.pm.param_partition_specs(params)
 
     # -----------------------------------------------------------------
     def _boundary_struct(self, params, inputs_micro, rng):

@@ -159,6 +159,34 @@ class PipelineModule:
             params["tied"] = tied
         return params
 
+    def param_partition_specs(self, params):
+        """Tensor-parallel placement assembled from the layers: a layer
+        class may define ``param_partition_specs()`` returning a spec tree
+        for its own params (Megatron column/row splits); everything else
+        replicates.  This is what makes pp×dp×tp (3D) work — the pipeline
+        axis is manual (shard_map), the ``model`` axis placement declared
+        here stays under GSPMD (reference analogue: the Megatron slice
+        groups inside the pipeline grid, topology.py:344-364)."""
+        from jax.sharding import PartitionSpec as P
+        layers = self.build_layers()
+        specs = {}
+        tied_specs = {}
+        for i, (spec, layer) in enumerate(zip(self.specs, layers)):
+            get = getattr(layer, "param_partition_specs", None)
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in tied_specs and spec.key in params.get(
+                        "tied", {}):
+                    tied_specs[spec.key] = (
+                        get() if get is not None else jax.tree.map(
+                            lambda _: P(), params["tied"][spec.key]))
+            elif f"layer_{i}" in params:
+                specs[f"layer_{i}"] = (
+                    get() if get is not None else jax.tree.map(
+                        lambda _: P(), params[f"layer_{i}"]))
+        if tied_specs:
+            specs["tied"] = tied_specs
+        return specs
+
     def apply_layer(self, i: int, params, x, rng, train: bool = True):
         spec = self.specs[i]
         layer = self.build_layers()[i]

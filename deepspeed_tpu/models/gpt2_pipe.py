@@ -55,11 +55,12 @@ class GPT2BlockPipe:
         self.layer_idx = layer_idx
 
     def init(self, rng):
+        import math
         cfg = self.cfg
         d = cfg.d_model
         ks = jax.random.split(rng, 4)
         std = 0.02
-        resid_std = std / float(jnp.sqrt(2.0 * cfg.n_layer))
+        resid_std = std / math.sqrt(2.0 * cfg.n_layer)
         return {
             "ln1_scale": jnp.ones((d,), jnp.float32),
             "ln1_bias": jnp.zeros((d,), jnp.float32),

@@ -30,10 +30,23 @@ tree; every stage's branch reads them, so AD sums their gradient
 contributions across stages — replacing the tied-weight comm groups and
 explicit allreduce (reference: runtime/pipe/module.py:405-474).
 
-Current placement note: all stages hold the full param tree replicated over
-``pipe`` (ZeRO still shards over ``data``).  Stage-local placement of a
-pipe-sharded stacked param tree is a planned optimization for homogeneous
-stacks.
+Parameter placement is STAGE-LOCAL (reference materializes only each
+stage's own layers: runtime/pipe/module.py:197-249): homogeneous layers
+are stacked into [num_stages, k, ...] leaves sharded over ``pipe``
+(see PipelineModule.stack_plan), enter the shard_map with in_spec
+``P('pipe')`` — so their gradient transpose is local (no psum over pipe,
+no fp32 all-stage replica) and each chip stores ≈ total/num_stages param
+bytes.  Only the pipe-replicated remainder (embedding/norm/tied, a small
+fraction) crosses the boundary replicated in fp32.  Activation liveness is
+bounded by whole-stage rematerialization per tick: the scan stores only
+stage-BOUNDARY activations, the remat analogue of the reference's 1F1B
+buffer bound min(stages - stage_id + 1, micro_batches)
+(reference: runtime/pipe/schedule.py:243-247).
+
+ZeRO composes on top: stages 1/2 shard master/opt-state and grads over
+``data`` on the non-pipe dims; stage 3 additionally stores compute params
+data-sharded — the boundary constraint is then the per-step param
+all-gather.
 """
 from __future__ import annotations
 
@@ -50,6 +63,22 @@ from ..runtime.engine import DeepSpeedEngine
 from ..runtime.module import TrainModule
 from ..utils.logging import log_dist
 from .module import PipelineModule
+
+
+class _ReplicatedParamsView(dict):
+    """Params visible to a 3-ary pipeline loss head.  The loss head is
+    traced on every stage (lax.cond), so it may only read pipe-replicated
+    params; reading a stage-local (stacked) layer fails here with a real
+    explanation instead of a bare KeyError from deep inside jit."""
+
+    def __missing__(self, key):
+        raise KeyError(
+            f"pipeline loss head tried to read param {key!r}, which is "
+            "stage-local (stacked over the pipe axis). A 3-ary loss head "
+            "runs on every stage and may only read pipe-replicated params: "
+            f"tied layers or non-stacked resident layers ({list(self)}). "
+            "Make the layer a TiedLayerSpec or compute the loss inside the "
+            "last stage's layers instead.")
 
 
 class _PipelinedTrainModule(TrainModule):
@@ -124,6 +153,7 @@ class _PipelinedTrainModule(TrainModule):
         inputs, labels = batch
         pm, S, M = self.pm, self.num_stages, self.num_micro
         mesh = self.mesh
+        plan = pm.stack_plan()
 
         def split_micro(tree):
             def r(x):
@@ -144,40 +174,66 @@ class _PipelinedTrainModule(TrainModule):
         boundary = self._boundary_struct(params, sample_in, rng)
         parts = [pm.stage_layer_range(s) for s in range(S)]
 
-        # Params cross the shard_map boundary in fp32: a replicated input's
-        # transpose is a psum over ``pipe``, and grads are fp32 by design
-        # anyway (a bf16 psum also trips an XLA-CPU AllReducePromotion
-        # crash on the test mesh).  Stage bodies cast back to compute dtype.
-        float_leaves = [jnp.issubdtype(l.dtype, jnp.floating)
-                        for l in jax.tree.leaves(params)]
-        compute_dtypes = [l.dtype for l in jax.tree.leaves(params)]
+        # ALL params cross the shard_map boundary in fp32 so gradient
+        # accumulation across the scan's ticks happens in fp32 (the per-tick
+        # bf16 cotangent is cast up by the astype transpose before the scan
+        # sums it — with M micro-batches a bf16 sum would lose ~2^-8
+        # relative precision and overflow earlier under fp16 loss scaling).
+        # Placement differs per top-level key:
+        #  - STACKED params enter sharded over ``pipe`` (in_spec P('pipe')):
+        #    their transpose is LOCAL (no psum over pipe) and the fp32 copy
+        #    is stage-local and transient — each chip holds total/S, not a
+        #    full replica.  Dims past the stage dim are constrained
+        #    replicated — under ZeRO-3 this boundary constraint IS the
+        #    per-step param all-gather over ``data``.
+        #  - pipe-REPLICATED params (tied/resident — small) cross fully
+        #    replicated: a replicated input's transpose is a psum over
+        #    ``pipe`` (a bf16 psum also trips an XLA-CPU AllReducePromotion
+        #    crash on the test mesh).  The constraint keeps every collective
+        #    at the shard_map boundary — a data-axis all-gather inside the
+        #    last-stage-only lax.cond loss head deadlocks the pipe ppermute
+        #    rendezvous otherwise.
+        param_dtypes = {k: jax.tree.map(lambda l: l.dtype, v)
+                        for k, v in params.items()}
 
-        def upcast(tree):
-            leaves, tdef = jax.tree.flatten(tree)
-            out = []
-            for l, f in zip(leaves, float_leaves):
-                l = l.astype(jnp.float32) if f else l
-                # ZeRO-1/2 semantics: COMPUTE params are replicated (only
-                # master/optimizer state shard over data).  Constraining here
-                # keeps every collective at the shard_map boundary — a
-                # data-axis all-gather inside the last-stage-only lax.cond
-                # loss head deadlocks the pipe ppermute rendezvous otherwise.
-                l = jax.lax.with_sharding_constraint(
-                    l, NamedSharding(mesh, P()))
-                out.append(l)
-            return jax.tree.unflatten(tdef, out)
+        def place(tree):
+            out = {}
+            for k, v in tree.items():
+                spec = P(PIPE_AXIS) if k in plan else P()
+                out[k] = jax.tree.map(
+                    lambda l, spec=spec: jax.lax.with_sharding_constraint(
+                        l.astype(jnp.float32)
+                        if jnp.issubdtype(l.dtype, jnp.floating) else l,
+                        NamedSharding(mesh, spec)), v)
+            return out
 
-        def downcast(tree):
-            leaves, tdef = jax.tree.flatten(tree)
-            return jax.tree.unflatten(tdef, [
-                l.astype(d) for l, d in zip(leaves, compute_dtypes)])
+        param_in_specs = {
+            k: jax.tree.map(lambda _: P(PIPE_AXIS) if k in plan else P(),
+                            v)
+            for k, v in params.items()}
 
-        def spmd(params32, micros_in, micros_lb, rng):
+        def spmd(params_in, micros_in, micros_lb, rng):
             stage = jax.lax.axis_index(PIPE_AXIS)
-            params = downcast(params32)
+            local = {}
+            for k, v in params_in.items():
+                # restore compute dtype; stacked slices arrive as [1, k, ...]
+                v = jax.tree.map(lambda l, d: l.astype(d), v,
+                                 param_dtypes[k])
+                local[k] = (jax.tree.map(lambda a: jnp.squeeze(a, 0), v)
+                            if k in plan else v)
+            loss_params = _ReplicatedParamsView(pm.replicated_view(local))
 
             def branch(s):
                 start, stop = parts[s]
+
+                def stage_fwd(view, x, mrng):
+                    return pm.forward_range(view, x, mrng, start, stop,
+                                            train=train)
+                if pm.stage_remat:
+                    # store only stage-boundary activations per tick; the
+                    # stage body recomputes in backward (1F1B's memory
+                    # bound, remat-style)
+                    stage_fwd = jax.checkpoint(stage_fwd)
 
                 def run(buf, m_idx):
                     mrng = jax.random.fold_in(rng, m_idx)
@@ -185,8 +241,8 @@ class _PipelinedTrainModule(TrainModule):
                         x = jax.tree.map(lambda a: a[m_idx], micros_in)
                     else:
                         x = buf
-                    return pm.forward_range(params, x, mrng, start, stop,
-                                            train=train)
+                    view = pm.stage_view(local, s, local=True)
+                    return stage_fwd(view, x, mrng)
                 return run
 
             branches = [branch(s) for s in range(S)]
@@ -209,7 +265,10 @@ class _PipelinedTrainModule(TrainModule):
                 def loss_branch(_):
                     lb = jax.tree.map(lambda a: a[m_idx], micros_lb)
                     if self._loss_takes_params:
-                        return pm.loss_fn(params, y, lb).astype(jnp.float32)
+                        # the loss head is traced on EVERY stage (lax.cond)
+                        # — it may only read pipe-replicated params
+                        return pm.loss_fn(loss_params, y,
+                                          lb).astype(jnp.float32)
                     return pm.loss_fn(y, lb).astype(jnp.float32)
 
                 lm = jax.lax.cond(active & (stage == S - 1), loss_branch,
@@ -230,11 +289,11 @@ class _PipelinedTrainModule(TrainModule):
 
         sm = jax.shard_map(
             spmd, mesh=mesh,
-            in_specs=(P(), P(), P(), P()),
+            in_specs=(param_in_specs, P(), P(), P()),
             out_specs=P(),
             axis_names={PIPE_AXIS},
             check_vma=False)
-        return sm(upcast(params), micros_in, micros_lb, rng)
+        return sm(place(params), micros_in, micros_lb, rng)
 
 
 class PipelineEngine(DeepSpeedEngine):
@@ -254,11 +313,6 @@ class PipelineEngine(DeepSpeedEngine):
             raise ValueError(
                 f"mesh pipe axis ({pp}) != PipelineModule.num_stages "
                 f"({model.num_stages})")
-        if config.zero_optimization_stage >= 3:
-            raise ValueError(
-                "ZeRO-3 (parameter sharding) with pipeline parallelism is "
-                "not supported yet — use ZeRO stage <= 2 with pp, or "
-                "ZeRO-3 with dp/tp")
         self.pipeline_module = model
         num_micro = config.gradient_accumulation_steps
         adapter = _PipelinedTrainModule(model, mesh, num_micro)

@@ -14,7 +14,8 @@ Layer contract (functional, TPU-style): each built layer is an object with
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, List, Optional, Sequence
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
@@ -72,7 +73,8 @@ class PipelineModule:
                  partition_method: str = "parameters",
                  activation_checkpoint_interval: int = 0,
                  seed_layers: bool = False,
-                 base_seed: int = 1234):
+                 base_seed: int = 1234,
+                 stage_remat: Optional[bool] = None):
         self.specs: List[LayerSpec] = list(layers)
         for s in self.specs:
             if not isinstance(s, LayerSpec):
@@ -81,10 +83,21 @@ class PipelineModule:
         self.loss_fn = loss_fn
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
+        # Whole-stage rematerialization per pipeline tick (engine-consumed):
+        # bounds stored activations to the stage-BOUNDARY tensors — the
+        # remat analogue of the reference's 1F1B buffer bound
+        # min(stages - stage_id + 1, micro_batches)
+        # (reference: runtime/pipe/schedule.py:243-247).  None → on unless
+        # the user asked for finer-grained checkpointing via
+        # activation_checkpoint_interval.
+        self.stage_remat = (stage_remat if stage_remat is not None
+                            else activation_checkpoint_interval == 0)
         self.seed_layers = seed_layers
         self.base_seed = base_seed
         self.parts = self._partition_layers()
         self._built_layers: Optional[List[Any]] = None
+        self._stack_plan: Optional[Dict[str, List[List[int]]]] = None
+        self._stack_index: Optional[Dict[int, Tuple[str, int, int]]] = None
 
     # ----- partitioning (pure math, testable without devices) -----
     def _count_layer_params(self, spec: LayerSpec) -> int:
@@ -139,9 +152,110 @@ class PipelineModule:
                 seen.append(s.key)
         return seen
 
+    # ----- stage-local parameter placement ---------------------------
+    # The reference materializes only each stage's own layers per rank
+    # (reference: runtime/pipe/module.py:197-249, partitioning :348-403) —
+    # that is the memory point of pipeline parallelism.  The TPU-native
+    # equivalent: layers whose param trees are structurally identical
+    # across ALL stages (the homogeneous transformer blocks that dominate
+    # param bytes) are STACKED into [num_stages, k, ...] leaves and
+    # sharded over the ``pipe`` mesh axis, so each chip stores only its
+    # own stage's slice.  Non-uniform layers (embedding, final norm, tied
+    # heads) stay replicated over ``pipe`` — they are a small fraction of
+    # the model and keep the design fully general.
+    def _layer_param_struct(self, i: int):
+        layer = self.build_layers()[i]
+        if isinstance(self.specs[i], TiedLayerSpec):
+            return None
+        if not hasattr(layer, "init"):
+            return None
+        try:
+            return jax.eval_shape(lambda: layer.init(jax.random.PRNGKey(0)))
+        except Exception:
+            return None
+
+    def stack_plan(self) -> Dict[str, List[List[int]]]:
+        """{stack_name: per-stage lists of layer indices}; a stack exists
+        when every stage holds the same count >= 1 of layers with an
+        identical param-tree fingerprint (structure + shapes + dtypes)."""
+        if self._stack_plan is not None:
+            return self._stack_plan
+        plan: Dict[str, List[List[int]]] = {}
+        if self.num_stages > 1:
+            fps: Dict[int, tuple] = {}
+            for i, spec in enumerate(self.specs):
+                st = self._layer_param_struct(i)
+                if st is None:
+                    continue
+                leaves, tdef = jax.tree.flatten(st)
+                fps[i] = (spec.name, str(tdef),
+                          tuple((tuple(l.shape), str(l.dtype))
+                                for l in leaves))
+            per_stage = []
+            for s in range(self.num_stages):
+                start, stop = self.stage_layer_range(s)
+                d = defaultdict(list)
+                for i in range(start, stop):
+                    if i in fps:
+                        d[fps[i]].append(i)
+                per_stage.append(d)
+            seen = set()
+            for i in sorted(fps):
+                key = fps[i]
+                if key in seen:
+                    continue
+                seen.add(key)
+                counts = [len(ps.get(key, [])) for ps in per_stage]
+                if counts[0] >= 1 and all(c == counts[0] for c in counts):
+                    plan[f"stack_{len(plan)}"] = [ps[key] for ps in per_stage]
+        self._stack_plan = plan
+        self._stack_index = {}
+        for name, stages in plan.items():
+            for s, idxs in enumerate(stages):
+                for j, i in enumerate(idxs):
+                    self._stack_index[i] = (name, s, j)
+        return plan
+
+    def stack_index(self) -> Dict[int, Tuple[str, int, int]]:
+        """layer index -> (stack_name, stage, slot-within-stage)."""
+        self.stack_plan()
+        return self._stack_index
+
+    def stage_view(self, params, stage: int, local: bool = False):
+        """Per-stage flat view {'layer_<i>': ..., 'tied': ...} of a packed
+        param tree.  ``local=False`` indexes global [S, k, ...] stacked
+        leaves; ``local=True`` expects the stage's own [k, ...] slice (the
+        shard_map-local view)."""
+        plan = self.stack_plan()
+        view = {}
+        if "tied" in params:
+            view["tied"] = params["tied"]
+        start, stop = self.stage_layer_range(stage)
+        for i in range(start, stop):
+            key = f"layer_{i}"
+            if key in params:
+                view[key] = params[key]
+        for name, stages in plan.items():
+            src = params[name]
+            for j, i in enumerate(stages[stage]):
+                view[f"layer_{i}"] = jax.tree.map(
+                    (lambda a, j=j: a[j]) if local
+                    else (lambda a, j=j: a[stage, j]), src)
+        return view
+
+    def replicated_view(self, params):
+        """The pipe-replicated subset (tied + resident layers) — the only
+        params a 3-ary pipeline loss head may read (it is traced on every
+        stage)."""
+        plan = self.stack_plan()
+        return {k: v for k, v in params.items() if k not in plan}
+
     def init(self, rng):
-        """Init ALL layers' params as {'layer_<i>': ..., 'tied': {key: ...}}.
-        Tied specs initialize once (first occurrence owns the params)."""
+        """Init ALL layers' params, packed for stage-local placement:
+        {'stack_<n>': stacked [S, k, ...] leaves, 'layer_<i>': resident,
+        'tied': {key: ...}}.  Tied specs initialize once (first occurrence
+        owns the params)."""
+        import jax.numpy as jnp
         layers = self.build_layers()
         params = {}
         tied = {}
@@ -155,20 +269,31 @@ class PipelineModule:
                 p = layer.init(lrng)
                 if p is not None:
                     params[f"layer_{i}"] = p
+        for name, stages in self.stack_plan().items():
+            rows = [jax.tree.map(lambda *xs: jnp.stack(xs),
+                                 *[params.pop(f"layer_{i}") for i in idxs])
+                    for idxs in stages]
+            params[name] = jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
         if tied:
             params["tied"] = tied
         return params
 
     def param_partition_specs(self, params):
-        """Tensor-parallel placement assembled from the layers: a layer
-        class may define ``param_partition_specs()`` returning a spec tree
-        for its own params (Megatron column/row splits); everything else
-        replicates.  This is what makes pp×dp×tp (3D) work — the pipeline
-        axis is manual (shard_map), the ``model`` axis placement declared
-        here stays under GSPMD (reference analogue: the Megatron slice
-        groups inside the pipeline grid, topology.py:344-364)."""
+        """Placement assembled from the layers: a layer class may define
+        ``param_partition_specs()`` returning a spec tree for its own
+        params (Megatron column/row splits); everything else replicates.
+        Stacked leaves get ``P('pipe', None, *layer_spec)`` — the stage dim
+        shards over the pipe axis (stage-local storage), tensor-parallel
+        dims keep the layer's ``model``-axis placement, and ZeRO composes
+        ``data`` on a remaining dim.  This is what makes pp×dp×tp (3D)
+        work — the pipeline axis is manual (shard_map), the ``model`` axis
+        placement declared here stays under GSPMD (reference analogue: the
+        Megatron slice groups inside the pipeline grid,
+        topology.py:344-364)."""
         from jax.sharding import PartitionSpec as P
+        from ..parallel.mesh import PIPE_AXIS
         layers = self.build_layers()
+        plan = self.stack_plan()
         specs = {}
         tied_specs = {}
         for i, (spec, layer) in enumerate(zip(self.specs, layers)):
@@ -183,6 +308,16 @@ class PipelineModule:
                 specs[f"layer_{i}"] = (
                     get() if get is not None else jax.tree.map(
                         lambda _: P(), params[f"layer_{i}"]))
+        for name, stages in plan.items():
+            i0 = stages[0][0]
+            layer = layers[i0]
+            get = getattr(layer, "param_partition_specs", None)
+            struct = self._layer_param_struct(i0)
+            base = (get() if get is not None
+                    else jax.tree.map(lambda _: P(), struct))
+            specs[name] = jax.tree.map(
+                lambda p: P(PIPE_AXIS, None, *p), base,
+                is_leaf=lambda x: isinstance(x, P))
         if tied_specs:
             specs["tied"] = tied_specs
         return specs
@@ -198,6 +333,11 @@ class PipelineModule:
                 return fn(layer, p, x, lrng, train)
             return layer.apply(p, x, lrng, train)
         p = params.get(f"layer_{i}")
+        if p is None and i in self.stack_index():
+            # packed global tree (outside shard_map): index the stacked leaf
+            name, s, j = self.stack_index()[i]
+            if name in params:
+                p = jax.tree.map(lambda a: a[s, j], params[name])
         if p is None:
             # stateless layer (e.g. reshape/activation)
             if hasattr(layer, "apply"):

@@ -109,9 +109,26 @@ def load_tree(dirpath: str, target: Any, strict: bool = True) -> Any:
         arr = _from_storage(arr, entry["dtype"])
         tshape = tuple(getattr(tleaf, "shape", ()))
         if tuple(arr.shape) != tshape:
-            raise ValueError(
-                f"checkpoint leaf {key!r} has shape {arr.shape}, engine "
-                f"expects {tshape} — model/optimizer config mismatch")
+            # Pipeline-resize elastic restore: stage-local stacked leaves
+            # are [num_stages, layers_per_stage, ...]; stage ranges are
+            # contiguous, so flattening the two leading dims is a canonical
+            # layer order and a checkpoint saved at pp=2 reshapes losslessly
+            # onto a pp=4 engine (reference analogue: ZeRO checkpoint
+            # merge/re-partition across DP sizes, stage2.py:1712-1778).
+            if ("stack_" in key
+                    and len(arr.shape) >= 2 and len(tshape) >= 2
+                    and arr.shape[2:] == tshape[2:]
+                    and arr.shape[0] * arr.shape[1]
+                    == tshape[0] * tshape[1]):
+                arr = arr.reshape(tshape)
+                log_dist(
+                    f"checkpoint leaf {key!r}: restacked "
+                    f"{entry['shape']} -> {list(tshape)} (pipeline resize)",
+                    ranks=[0])
+            else:
+                raise ValueError(
+                    f"checkpoint leaf {key!r} has shape {arr.shape}, engine "
+                    f"expects {tshape} — model/optimizer config mismatch")
         sharding = getattr(tleaf, "sharding", None)
         tdtype = getattr(tleaf, "dtype", arr.dtype)
         arr = arr.astype(tdtype) if arr.dtype != tdtype else arr

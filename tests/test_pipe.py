@@ -249,10 +249,13 @@ def test_3d_parallel_pipeline_tp_dp():
     }, world_size=2)
     pm = build_gpt2_pipe(cfg_model, num_stages=2)
     engine = PipelineEngine(pm, cfg, mesh)
-    # TP placement really applied: qkv_w sharded over model axis
-    qkv = engine.state.master_params["layer_1"]["qkv_w"]
+    # TP placement really applied AND stage-local storage: the stacked
+    # block params are [S, k, d, 3d] sharded over pipe (stage dim) and
+    # model (tensor dim)
+    qkv = engine.state.master_params["stack_0"]["qkv_w"]
     spec = qkv.sharding.spec
     assert "model" in str(spec), f"expected model-axis sharding, got {spec}"
+    assert "pipe" in str(spec), f"expected pipe-axis sharding, got {spec}"
     rng = np.random.default_rng(0)
     losses = []
     for s in range(4):

@@ -70,8 +70,14 @@ class DeepSpeedZeroConfig:
             zero, C.ZERO_MAX_ELEMENTS_PER_COMM, C.ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT)
         self.cpu_offload = get_scalar_param(
             zero, C.ZERO_CPU_OFFLOAD, C.ZERO_CPU_OFFLOAD_DEFAULT)
+        self.offload_impl = get_scalar_param(
+            zero, C.ZERO_OFFLOAD_IMPL, C.ZERO_OFFLOAD_IMPL_DEFAULT)
         self.elastic_checkpoint = get_scalar_param(
             zero, C.ZERO_ELASTIC_CHECKPOINT, C.ZERO_ELASTIC_CHECKPOINT_DEFAULT)
+        if self.offload_impl not in ("auto", "xla", "host"):
+            raise DeepSpeedConfigError(
+                f"{C.ZERO_OFFLOAD_IMPL} must be 'auto', 'xla', or 'host', "
+                f"got {self.offload_impl!r}")
 
         if not isinstance(self.stage, int) or not (
                 C.ZERO_OPTIMIZATION_DISABLED <= self.stage <= C.MAX_STAGE_ZERO_OPTIMIZATION):

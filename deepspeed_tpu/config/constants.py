@@ -111,6 +111,14 @@ ZERO_ALLGATHER_BUCKET_SIZE = "allgather_bucket_size"
 ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT = 500_000_000
 ZERO_CPU_OFFLOAD = "cpu_offload"
 ZERO_CPU_OFFLOAD_DEFAULT = False
+# TPU extension: which offload tier implements cpu_offload.
+#   'xla'  — optimizer state in pinned_host memory; cast + Adam run as XLA
+#            host computations inside the one compiled step (server-side
+#            PCIe streaming, XLA-scheduled overlap).
+#   'host' — single-controller numpy tier + native C++ CPU Adam.
+#   'auto' — 'xla' on TPU meshes, 'host' elsewhere.
+ZERO_OFFLOAD_IMPL = "offload_impl"
+ZERO_OFFLOAD_IMPL_DEFAULT = "auto"
 ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_ELASTIC_CHECKPOINT_DEFAULT = True
 ZERO_MAX_ELEMENTS_PER_COMM = "max_elements_per_comm"

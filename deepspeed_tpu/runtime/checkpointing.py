@@ -283,7 +283,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
 
         master = jax.tree.map(_promote, state.master_params,
                               loaded["module"])
-        if getattr(engine, "_offload", False):
+        if getattr(engine, "_offload_host", False):
             # host tier rebuilds its own fresh moments in
             # _sync_offload_from_state; materializing device fp32 moments
             # here would transiently cost 2× model size in HBM — the exact

@@ -120,17 +120,59 @@ class DeepSpeedEngine:
 
         scaler, self.loss_scale_config = precision.from_fp16_config(config.fp16)
         self._offload = bool(config.zero_config.cpu_offload)
+        self._offload_impl = None
         if self._offload:
-            # ZeRO-Offload: fp32 master + moments live in HOST memory and
-            # are updated by the native CPU Adam (runtime/offload.py); the
-            # device keeps only compute-dtype params.
-            from .offload import HostOffloadOptimizer
+            impl = config.zero_config.offload_impl
+            if impl == "auto":
+                platform = next(iter(self.mesh.devices.flat)).platform
+                impl = "xla" if platform == "tpu" else "host"
+            self._offload_impl = impl
+        self._offload_host = self._offload_impl == "host"
+        if self._offload:
             name = config.optimizer_name or C.ADAM_OPTIMIZER
             if name != C.ADAM_OPTIMIZER or optimizer is not None:
                 raise ValueError(
                     "cpu_offload requires the built-in Adam optimizer "
                     "(the reference's offload whitelist likewise admits "
                     "only Adam-family, zero/utils.py:26-40)")
+        if self._offload and not self._offload_host:
+            # ZeRO-Offload, XLA-native tier: fp32 master + moments live in
+            # the TPU host's memory (``pinned_host`` kind) and the cast /
+            # Adam update run as XLA host computations inside the ONE
+            # compiled step — the PCIe streaming and its overlap with
+            # device compute are scheduled by XLA, replacing the
+            # reference's hand-built pinned-buffer double-buffering
+            # (reference: csrc/adam/cpu_adam.cpp:64-113,
+            # deepspeed/runtime/zero/stage2.py:743-900).
+            master_shardings = self.zero_plan.master_shardings(master)
+            host_shardings = jax.tree.map(
+                lambda s: s.with_memory_kind("pinned_host"),
+                master_shardings,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
+            self._host_master_shardings = host_shardings
+            master = _device_put_tree(master, host_shardings)
+            opt_state = jax.jit(
+                self.optimizer.init,
+                out_shardings=self.zero_plan.opt_state_shardings(
+                    jax.eval_shape(self.optimizer.init, master), master),
+            )(master)
+            cspecs = self.zero_plan.compute_param_specs(master)
+            self._compute_shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), cspecs,
+                is_leaf=lambda x: isinstance(x, P))
+        elif self._offload:
+            # ZeRO-Offload, single-controller numpy tier: fp32 master +
+            # moments live in THIS process's memory and are updated by the
+            # native C++ CPU Adam (runtime/offload.py); the device keeps
+            # only compute-dtype params.
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "cpu_offload with offload_impl='host' is single-"
+                    "controller: it stages the FULL gradient on one host "
+                    "and cannot address multi-process arrays. Use "
+                    "offload_impl='xla' (per-device pinned_host staging) "
+                    "for multi-host runs.")
+            from .offload import HostOffloadOptimizer
             oparams = dict(config.optimizer_params)
             lr = self._lr_schedule or float(oparams.get("lr", 1e-3))
             self._host_opt = HostOffloadOptimizer(
@@ -169,9 +211,12 @@ class DeepSpeedEngine:
         )
 
         # ---- compiled steps ----
-        if self._offload:
+        if self._offload_host:
             self._grad_step = self._build_offload_grad_step()
             self._offload_eval_step = self._build_offload_eval_step()
+        elif self._offload:
+            self._train_step = self._build_xla_offload_step()
+            self._eval_step = self._build_xla_offload_eval_step()
         else:
             self._train_step = self._build_train_step()
             self._eval_step = self._build_eval_step()
@@ -433,6 +478,133 @@ class DeepSpeedEngine:
 
         return jax.jit(eval_step)
 
+    # ------------------------------------------------------------------
+    # ZeRO-Offload, XLA tier: one compiled step; optimizer state lives in
+    # pinned_host memory, cast + Adam run as XLA host computations.
+    # ------------------------------------------------------------------
+    def _xla_offload_cast_up(self, master):
+        """Host-side cast to compute dtype + PCIe upload (half the bytes of
+        shipping fp32 and casting on device)."""
+        from jax.experimental import compute_on
+        compute_dtype = self.compute_dtype
+
+        with compute_on.compute_on("device_host"):
+            lowp = jax.tree.map(
+                lambda m: m.astype(compute_dtype)
+                if jnp.issubdtype(m.dtype, jnp.floating) else m, master)
+        return jax.tree.map(jax.device_put, lowp, self._compute_shardings)
+
+    def _build_xla_offload_step(self):
+        from jax.experimental import compute_on
+        module = self.module
+        optimizer = self.optimizer
+        plan = self.zero_plan
+        compute_dtype = self.compute_dtype
+        grad_acc = self._scan_grad_acc
+        clip = self.gradient_clipping
+        scale_config = self.loss_scale_config
+        lr_schedule = self._lr_schedule
+        cfg_lr = float(self.config.optimizer_params.get("lr", 1e-3))
+        grad_host_shardings = self._host_master_shardings
+        host_scalar = NamedSharding(self.mesh, P()).with_memory_kind(
+            "pinned_host")
+
+        def lr_at(count):
+            if lr_schedule is not None:
+                return jnp.asarray(lr_schedule(count), jnp.float32)
+            return jnp.asarray(cfg_lr, jnp.float32)
+
+        def train_step(state: TrainState, batch):
+            scaler = state.scaler
+            step_rng = jax.random.fold_in(state.rng, state.global_steps)
+            params = self._xla_offload_cast_up(state.master_params)
+
+            def micro_loss(p, mb, rng):
+                loss = module.loss_fn(p, mb, rng, train=True)
+                return precision.scale_loss(
+                    loss.astype(jnp.float32), scaler)
+
+            grad_fn = jax.value_and_grad(micro_loss)
+
+            def acc_body(carry, mb):
+                gsum, i = carry
+                rng = jax.random.fold_in(step_rng, i)
+                scaled_loss, g = grad_fn(params, mb, rng)
+                g = constrain_grads(g, plan)
+                gsum = jax.tree.map(
+                    lambda a, b: a + b.astype(jnp.float32), gsum, g)
+                return (gsum, i + 1), scaled_loss
+
+            gsum0 = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            gsum0 = constrain_grads(gsum0, plan)
+            (gsum, _), scaled_losses = jax.lax.scan(
+                acc_body, (gsum0, jnp.asarray(0, jnp.int32)), batch)
+
+            inv = (1.0 / (scaler.loss_scale * grad_acc)).astype(jnp.float32)
+            grads = jax.tree.map(lambda g: g * inv, gsum)
+            grads = constrain_grads(grads, plan)
+            finite = precision.grads_finite(grads)
+            grad_norm = global_norm(grads)
+            if clip > 0:
+                grads, _ = clip_by_global_norm(grads, clip, norm=grad_norm)
+
+            # PCIe down: compute-dtype grads (the reference likewise stages
+            # fp16 gradients into pinned host buffers, stage2.py:793-816)
+            gh = jax.tree.map(
+                lambda g, s: jax.device_put(g.astype(compute_dtype), s),
+                grads, grad_host_shardings)
+            finite_h = jax.device_put(finite, host_scalar)
+
+            with compute_on.compute_on("device_host"):
+                g32 = jax.tree.map(
+                    lambda g: g.astype(jnp.float32), gh)
+                updates, opt2 = optimizer.update(
+                    g32, state.opt_state, state.master_params)
+                master2 = optax.apply_updates(state.master_params, updates)
+                # overflow-skip as elementwise select (control flow stays
+                # out of the host section; the state write-back is masked)
+                new_master = jax.tree.map(
+                    lambda n, o: jnp.where(finite_h, n, o),
+                    master2, state.master_params)
+                new_opt = jax.tree.map(
+                    lambda n, o: jnp.where(finite_h, n, o),
+                    opt2, state.opt_state)
+
+            new_scaler = precision.update_scale(scaler, finite, scale_config)
+            new_skipped = (state.skipped_steps
+                           + (1 - finite.astype(jnp.int32)))
+            new_global = state.global_steps + 1
+            new_state = TrainState(
+                master_params=new_master,
+                opt_state=new_opt,
+                scaler=new_scaler,
+                global_steps=new_global,
+                skipped_steps=new_skipped,
+                rng=state.rng,
+            )
+            mean_loss = jnp.mean(scaled_losses) / scaler.loss_scale
+            applied = new_global - new_skipped
+            packed = jnp.stack([
+                mean_loss.astype(jnp.float32),
+                grad_norm.astype(jnp.float32),
+                scaler.loss_scale.astype(jnp.float32),
+                (~finite).astype(jnp.float32),
+                lr_at(applied),
+            ])
+            return new_state, packed
+
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    def _build_xla_offload_eval_step(self):
+        module = self.module
+
+        def eval_step(state: TrainState, batch, rng):
+            params = self._xla_offload_cast_up(state.master_params)
+            return module.loss_fn(params, batch, rng, train=False)
+
+        return jax.jit(eval_step)
+
     def _train_batch_offload(self, batch):
         scaler = self.state.scaler
         step_rng = jax.random.fold_in(self.state.rng,
@@ -571,7 +743,7 @@ class DeepSpeedEngine:
         if self.timers is not None:
             self.timers("train_batch_data").stop()
             self.timers("train_batch_step").start()
-        if self._offload:
+        if self._offload_host:
             metrics = self._train_batch_offload(sharded)
             self._last_metrics = metrics
             loss_out = metrics.loss
@@ -624,7 +796,7 @@ class DeepSpeedEngine:
         micro = jax.tree.map(np.asarray, batch)
         rng = jax.random.fold_in(self._data_rng, self.micro_steps)
         with self._pallas_scope():
-            if self._offload:
+            if self._offload_host:
                 return self._offload_eval_step(self._compute_params,
                                                micro, rng)
             return self._eval_step(self.state, micro, rng)
@@ -636,7 +808,7 @@ class DeepSpeedEngine:
         rng = jax.random.fold_in(self._data_rng, self.micro_steps)
         micro = jax.tree.map(np.asarray, batch)
         with self._pallas_scope():
-            if self._offload:
+            if self._offload_host:
                 loss = self._offload_eval_step(self._compute_params,
                                                micro, rng)
             else:
@@ -686,7 +858,7 @@ class DeepSpeedEngine:
             load_optimizer_states=load_optimizer_states,
             load_lr_scheduler_states=load_lr_scheduler_states,
             load_module_only=load_module_only)
-        if self._offload and result[0] is not None:
+        if self._offload_host and result[0] is not None:
             self._sync_offload_from_state()
         return result
 

@@ -28,6 +28,23 @@ def _lr_at(lr: ScalarOrSchedule, count):
     return lr(count) if callable(lr) else jnp.asarray(lr, jnp.float32)
 
 
+def adam_moments(grads, mu, nu, b1: float, b2: float):
+    """One EMA step of the first/second moments (shared by the device
+    optimizer and the engine's XLA host-offload section so both paths use
+    identical numerics)."""
+    mu2 = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, mu, grads)
+    nu2 = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * (g * g), nu, grads)
+    return mu2, nu2
+
+
+def adam_direction(mu, nu, c1, c2, eps: float):
+    """Bias-corrected update direction m̂/(√v̂+eps); c1/c2 are the bias
+    correction denominators (pass 1.0 to disable)."""
+    def d(m, v):
+        return (m / c1) / (jnp.sqrt(v / c2) + eps)
+    return jax.tree.map(d, mu, nu)
+
+
 def fused_adam(lr: ScalarOrSchedule = 1e-3,
                betas: Tuple[float, float] = (0.9, 0.999),
                eps: float = 1e-8,
@@ -63,9 +80,7 @@ def fused_adam(lr: ScalarOrSchedule = 1e-3,
                 lambda g, p, m: g + weight_decay * p if m else g,
                 grads, params, decay_mask)
 
-        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)
-        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * (g * g),
-                          state.nu, grads)
+        mu, nu = adam_moments(grads, state.mu, state.nu, b1, b2)
 
         if bias_correction:
             c1 = 1 - b1 ** count.astype(jnp.float32)
@@ -73,12 +88,7 @@ def fused_adam(lr: ScalarOrSchedule = 1e-3,
         else:
             c1 = c2 = jnp.asarray(1.0, jnp.float32)
 
-        def adam_update(m, v):
-            m_hat = m / c1
-            v_hat = v / c2
-            return m_hat / (jnp.sqrt(v_hat) + eps)
-
-        updates = jax.tree.map(adam_update, mu, nu)
+        updates = adam_direction(mu, nu, c1, c2, eps)
 
         if weight_decay != 0.0 and adam_w_mode:
             decay_mask = (weight_decay_mask(params) if weight_decay_mask

@@ -133,11 +133,16 @@ def load_tree(dirpath: str, target: Any, strict: bool = True) -> Any:
         tdtype = getattr(tleaf, "dtype", arr.dtype)
         arr = arr.astype(tdtype) if arr.dtype != tdtype else arr
         # Re-apply only mesh-aware placements; committing scalars to a single
-        # device would pin them and conflict with the mesh under jit.
+        # device would pin them and conflict with the mesh under jit.  numpy
+        # targets (offload host/flat staging templates) stay numpy — putting
+        # a multi-GB offloaded master on device here would defeat offload.
         from jax.sharding import NamedSharding
-        out.append(jax.device_put(arr, sharding)
-                   if isinstance(sharding, NamedSharding)
-                   else jax.numpy.asarray(arr))
+        if isinstance(sharding, NamedSharding):
+            out.append(jax.device_put(arr, sharding))
+        elif isinstance(tleaf, np.ndarray):
+            out.append(arr)
+        else:
+            out.append(jax.numpy.asarray(arr))
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
@@ -177,12 +182,17 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     os.makedirs(tmp_dir, exist_ok=True)
 
     from . import precision
+    # canonical (per-parameter tree) form: the XLA offload tier stores flat
+    # host vectors internally, but the checkpoint keeps the logical tree so
+    # offload <-> non-offload restores compose (reference merge/re-partition
+    # analogue, stage2.py:1712-1778)
+    master_tree, opt_tree = engine._canonical_state()
     module_params = precision.cast_to_compute(
-        state.master_params, engine.compute_dtype)
+        master_tree, engine.compute_dtype)
     save_tree(os.path.join(tmp_dir, "model"), {"module": module_params})
     save_tree(os.path.join(tmp_dir, "optim"), {
-        "master_params": state.master_params,
-        "opt_state": state.opt_state,
+        "master_params": master_tree,
+        "opt_state": opt_tree,
         "scaler": state.scaler,
         "rng": state.rng,
         "data_rng": engine._data_rng,
@@ -250,19 +260,20 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     use_optim = (load_optimizer_states and not load_module_only
                  and os.path.isdir(optim_dir))
     rng = state.rng
+    tmpl_master, tmpl_opt = engine._canonical_templates()
     if use_optim:
         # fp32 master restore (reference 'load_from_fp32_weights',
         # stage2.py:1780-1835); rng restore keeps dropout masks identical
         # to an uninterrupted run.
         loaded = load_tree(optim_dir, {
-            "master_params": state.master_params,
-            "opt_state": state.opt_state,
+            "master_params": tmpl_master,
+            "opt_state": tmpl_opt,
             "scaler": state.scaler,
             "rng": state.rng,
             "data_rng": engine._data_rng,
         })
-        master = loaded["master_params"]
-        opt_state = loaded["opt_state"]
+        master, opt_state = engine._adopt_loaded(
+            loaded["master_params"], loaded["opt_state"])
         scaler = loaded["scaler"]
         rng = loaded["rng"]
         engine._data_rng = loaded["data_rng"]
@@ -270,7 +281,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         # fp16-cast restore: module weights promoted to a fresh fp32 master
         from . import precision
         module_tmpl = precision.cast_to_compute(
-            state.master_params, engine.compute_dtype)
+            tmpl_master, engine.compute_dtype)
         loaded = load_tree(os.path.join(ckpt_dir, "model"),
                            {"module": module_tmpl})
         def _promote(cur, new):
@@ -281,29 +292,42 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                 return jax.device_put(arr, sharding)
             return arr
 
-        master = jax.tree.map(_promote, state.master_params,
-                              loaded["module"])
-        if getattr(engine, "_offload_host", False):
-            # host tier rebuilds its own fresh moments in
-            # _sync_offload_from_state; materializing device fp32 moments
-            # here would transiently cost 2× model size in HBM — the exact
-            # memory offload exists to avoid
+        master = jax.tree.map(_promote, tmpl_master, loaded["module"])
+        if getattr(engine, "_offload", False):
+            # offload tiers rebuild their own fresh moments (host tier in
+            # _sync_offload_from_state, xla tier in _adopt_loaded);
+            # materializing device fp32 moments here would transiently cost
+            # 2× model size in HBM — the exact memory offload exists to avoid
             opt_state = None
         else:
             opt_state = engine.optimizer.init(master)
+        master, opt_state = engine._adopt_loaded(master, opt_state)
         scaler = state.scaler
 
+    # Scalars get the same explicit replicated placement as engine init:
+    # bare jnp scalars would change the compiled step's cache key and
+    # silently recompile the whole program on the first post-restore step.
+    from jax.sharding import NamedSharding, PartitionSpec
+    dev_scalar = NamedSharding(engine.mesh, PartitionSpec())
+    place_scalar = lambda x: jax.device_put(jnp.asarray(x), dev_scalar)
     engine.state = TrainState(
         master_params=master,
         opt_state=opt_state,
-        scaler=scaler,
-        global_steps=jnp.asarray(meta["global_steps"], jnp.int32),
-        skipped_steps=jnp.asarray(meta["skipped_steps"], jnp.int32),
-        rng=rng,
+        scaler=jax.tree.map(place_scalar, scaler),
+        global_steps=place_scalar(
+            jnp.asarray(meta["global_steps"], jnp.int32)),
+        skipped_steps=place_scalar(
+            jnp.asarray(meta["skipped_steps"], jnp.int32)),
+        rng=place_scalar(rng),
     )
     engine.global_steps = meta["global_steps"]
     engine.micro_steps = meta["micro_steps"]
     engine.skipped_steps = meta["skipped_steps"]
+    if getattr(engine, "_offload_host", False):
+        # host tier: copy the loaded arrays back into the native host-Adam
+        # buffers here (not in the engine wrapper) so calling this public
+        # function directly leaves the engine consistent too
+        engine._sync_offload_from_state()
     log_dist(
         f"loaded checkpoint {ckpt_dir} (saved at dp={meta['dp_world_size']} "
         f"zero={meta['zero_stage']}; now dp={engine.dp_world_size} "

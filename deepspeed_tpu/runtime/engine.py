@@ -33,7 +33,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import DeepSpeedConfig
 from ..config import constants as C
-from ..ops.adam import fused_adam
+from ..ops.adam import (FusedAdamState, adam_direction, adam_moments,
+                        fused_adam)
 from ..ops.lamb import fused_lamb
 from ..parallel.mesh import DATA_AXIS, build_mesh, mesh_axis_size
 from ..utils.logging import log_dist, logger
@@ -136,30 +137,53 @@ class DeepSpeedEngine:
                     "(the reference's offload whitelist likewise admits "
                     "only Adam-family, zero/utils.py:26-40)")
         if self._offload and not self._offload_host:
-            # ZeRO-Offload, XLA-native tier: fp32 master + moments live in
-            # the TPU host's memory (``pinned_host`` kind) and the cast /
-            # Adam update run as XLA host computations inside the ONE
-            # compiled step — the PCIe streaming and its overlap with
-            # device compute are scheduled by XLA, replacing the
-            # reference's hand-built pinned-buffer double-buffering
-            # (reference: csrc/adam/cpu_adam.cpp:64-113,
-            # deepspeed/runtime/zero/stage2.py:743-900).
-            master_shardings = self.zero_plan.master_shardings(master)
-            host_shardings = jax.tree.map(
-                lambda s: s.with_memory_kind("pinned_host"),
-                master_shardings,
-                is_leaf=lambda x: isinstance(x, NamedSharding))
-            self._host_master_shardings = host_shardings
-            master = _device_put_tree(master, host_shardings)
-            opt_state = jax.jit(
-                self.optimizer.init,
-                out_shardings=self.zero_plan.opt_state_shardings(
-                    jax.eval_shape(self.optimizer.init, master), master),
-            )(master)
+            # ZeRO-Offload, XLA-native tier: fp32 master + Adam moments live
+            # in the TPU host's memory (``pinned_host`` kind) as ONE flat
+            # padded vector each, sharded over ``data`` — each process's
+            # host stages only its own reduce-scattered partition, the flat
+            # analogue of the reference's per-rank fp32 partitions
+            # (reference: deepspeed/runtime/zero/stage2.py:262-269,743-900;
+            # pinned-tile streaming: csrc/adam/cpu_adam.cpp:64-113, here
+            # scheduled by XLA inside the one compiled step).  Flat staging
+            # matters: a per-leaf host tier costs ~8 ms dispatch per leaf
+            # per direction (measured ~2.5× the flat round-trip on a v5e).
+            leaves, treedef = jax.tree.flatten(master)
+            if not all(jnp.issubdtype(l.dtype, jnp.floating)
+                       for l in leaves):
+                raise ValueError(
+                    "cpu_offload (xla tier) requires an all-float parameter "
+                    "tree; non-float leaves cannot be Adam-updated")
+            self._flat_treedef = treedef
+            self._flat_shapes = [tuple(l.shape) for l in leaves]
+            self._flat_sizes = [int(np.prod(s)) if s else 1
+                                for s in self._flat_shapes]
+            n = sum(self._flat_sizes)
+            dp = self.dp_world_size
+            self._flat_pad = (-n) % dp
+            self._flat_n = n + self._flat_pad
+            flat_dev = NamedSharding(self.mesh, P(DATA_AXIS))
+            # Off-TPU (CPU test meshes) host and device memory are the same
+            # space and XLA rejects sharded pinned_host placements — the
+            # tier still runs, just without a distinct host memory kind.
+            platform = next(iter(self.mesh.devices.flat)).platform
+            self._offload_real_host = platform == "tpu"
+            flat_host = (flat_dev.with_memory_kind("pinned_host")
+                         if self._offload_real_host else flat_dev)
+            self._flat_dev_sharding = flat_dev
+            self._flat_host_sharding = flat_host
             cspecs = self.zero_plan.compute_param_specs(master)
             self._compute_shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), cspecs,
                 is_leaf=lambda x: isinstance(x, P))
+            master = jax.jit(self._offload_flatten,
+                             out_shardings=flat_host)(master)
+            opt_state = FusedAdamState(
+                count=jax.device_put(jnp.zeros([], jnp.int32),
+                                     NamedSharding(self.mesh, P())),
+                mu=jax.device_put(jnp.zeros((self._flat_n,), jnp.float32),
+                                  flat_host),
+                nu=jax.device_put(jnp.zeros((self._flat_n,), jnp.float32),
+                                  flat_host))
         elif self._offload:
             # ZeRO-Offload, single-controller numpy tier: fp32 master +
             # moments live in THIS process's memory and are updated by the
@@ -201,13 +225,20 @@ class DeepSpeedEngine:
                 opt_state, master)
             opt_state = _device_put_tree(opt_state, opt_shardings)
 
+        # Scalar state gets an explicit replicated device placement: fresh
+        # jnp scalars carry no sharding, so the first compiled step's cache
+        # key (UnspecifiedValue) differs from every later step's (concrete
+        # device sharding) and the SECOND call silently recompiles the
+        # whole program.
+        dev_scalar = NamedSharding(self.mesh, P())
+        place_scalar = lambda x: jax.device_put(x, dev_scalar)
         self.state = TrainState(
             master_params=master,
             opt_state=opt_state,
-            scaler=scaler,
-            global_steps=jnp.asarray(0, jnp.int32),
-            skipped_steps=jnp.asarray(0, jnp.int32),
-            rng=jax.random.PRNGKey(seed + 1),
+            scaler=jax.tree.map(place_scalar, scaler),
+            global_steps=place_scalar(jnp.asarray(0, jnp.int32)),
+            skipped_steps=place_scalar(jnp.asarray(0, jnp.int32)),
+            rng=place_scalar(jax.random.PRNGKey(seed + 1)),
         )
 
         # ---- compiled steps ----
@@ -479,35 +510,86 @@ class DeepSpeedEngine:
         return jax.jit(eval_step)
 
     # ------------------------------------------------------------------
-    # ZeRO-Offload, XLA tier: one compiled step; optimizer state lives in
-    # pinned_host memory, cast + Adam run as XLA host computations.
+    # ZeRO-Offload, XLA tier: one compiled step; fp32 master + moments live
+    # in pinned_host memory as flat padded vectors, cast + Adam run as XLA
+    # host computations.
     # ------------------------------------------------------------------
-    def _xla_offload_cast_up(self, master):
-        """Host-side cast to compute dtype + PCIe upload (half the bytes of
-        shipping fp32 and casting on device)."""
-        from jax.experimental import compute_on
-        compute_dtype = self.compute_dtype
+    def _offload_flatten(self, tree, dtype=jnp.float32):
+        """Param-shaped tree -> one flat padded vector (traceable)."""
+        parts = [l.astype(dtype).reshape(-1) for l in jax.tree.leaves(tree)]
+        if self._flat_pad:
+            parts.append(jnp.zeros((self._flat_pad,), dtype))
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
-        with compute_on.compute_on("device_host"):
-            lowp = jax.tree.map(
-                lambda m: m.astype(compute_dtype)
-                if jnp.issubdtype(m.dtype, jnp.floating) else m, master)
-        return jax.tree.map(jax.device_put, lowp, self._compute_shardings)
+    def _offload_unflatten(self, flat):
+        """Flat vector -> param-shaped tree with compute shardings
+        (traceable).  On a dp mesh the slice of the data-sharded vector IS
+        the ZeRO param all-gather (reference stage2.py:1438-1471)."""
+        shard_leaves = jax.tree.leaves(
+            self._compute_shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+        out, off = [], 0
+        for shape, size, sh in zip(self._flat_shapes, self._flat_sizes,
+                                   shard_leaves):
+            arr = jax.lax.slice_in_dim(flat, off, off + size).reshape(shape)
+            out.append(jax.lax.with_sharding_constraint(arr, sh))
+            off += size
+        return jax.tree.unflatten(self._flat_treedef, out)
+
+    def _unflatten_numpy(self, flat):
+        """Host-side unflatten for checkpointing (no device memory cost)."""
+        arr = np.asarray(jax.device_get(flat))
+        out, off = [], 0
+        for shape, size in zip(self._flat_shapes, self._flat_sizes):
+            out.append(arr[off:off + size].reshape(shape))
+            off += size
+        return jax.tree.unflatten(self._flat_treedef, out)
+
+    def _flatten_numpy(self, tree):
+        parts = [np.asarray(jax.device_get(l)).astype(np.float32).reshape(-1)
+                 for l in jax.tree.leaves(tree)]
+        if self._flat_pad:
+            parts.append(np.zeros((self._flat_pad,), np.float32))
+        return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def _host_section(self):
+        """compute_on('device_host') on real TPUs; a no-op scope on CPU test
+        meshes (same memory space, and the host-compute partitioner rejects
+        sharded host placements there)."""
+        if self._offload_real_host:
+            from jax.experimental import compute_on
+            return compute_on.compute_on("device_host")
+        import contextlib
+        return contextlib.nullcontext()
+
+    def _xla_offload_cast_up(self, master_flat):
+        """Host-side cast to compute dtype + PCIe upload (half the bytes of
+        shipping fp32 and casting on device), then split into the tree."""
+        with self._host_section():
+            lowp = master_flat.astype(self.compute_dtype)
+        lowp = jax.device_put(lowp, self._flat_dev_sharding)
+        return self._offload_unflatten(lowp)
 
     def _build_xla_offload_step(self):
-        from jax.experimental import compute_on
         module = self.module
-        optimizer = self.optimizer
         plan = self.zero_plan
         compute_dtype = self.compute_dtype
         grad_acc = self._scan_grad_acc
         clip = self.gradient_clipping
         scale_config = self.loss_scale_config
         lr_schedule = self._lr_schedule
-        cfg_lr = float(self.config.optimizer_params.get("lr", 1e-3))
-        grad_host_shardings = self._host_master_shardings
-        host_scalar = NamedSharding(self.mesh, P()).with_memory_kind(
-            "pinned_host")
+        oparams = dict(self.config.optimizer_params)
+        cfg_lr = float(oparams.get("lr", 1e-3))
+        b1, b2 = (float(b) for b in oparams.get("betas", (0.9, 0.999)))
+        eps = float(oparams.get("eps", 1e-8))
+        wd = float(oparams.get("weight_decay", 0.0))
+        adam_w_mode = bool(oparams.get("adam_w_mode", True))
+        bias_correction = bool(oparams.get("bias_correction", True))
+        flat_dev = self._flat_dev_sharding
+        flat_host = self._flat_host_sharding
+        host_scalar = NamedSharding(self.mesh, P())
+        if self._offload_real_host:
+            host_scalar = host_scalar.with_memory_kind("pinned_host")
 
         def lr_at(count):
             if lr_schedule is not None:
@@ -549,28 +631,54 @@ class DeepSpeedEngine:
             if clip > 0:
                 grads, _ = clip_by_global_norm(grads, clip, norm=grad_norm)
 
-            # PCIe down: compute-dtype grads (the reference likewise stages
-            # fp16 gradients into pinned host buffers, stage2.py:793-816)
-            gh = jax.tree.map(
-                lambda g, s: jax.device_put(g.astype(compute_dtype), s),
-                grads, grad_host_shardings)
-            finite_h = jax.device_put(finite, host_scalar)
+            # The host section must be ALL-FLOAT: an s32 (the Adam step
+            # count) in pinned_host space trips XLA's host-compute alias
+            # assigner.  Count, bias correction, and lr are computed here on
+            # device and shipped over as f32 scalars.
+            opt = state.opt_state
+            count1 = opt.count + 1
+            count_f = count1.astype(jnp.float32)
+            if bias_correction:
+                c1 = 1 - b1 ** count_f
+                c2 = 1 - b2 ** count_f
+            else:
+                c1 = c2 = jnp.asarray(1.0, jnp.float32)
+            step_lr = lr_at(count1)
 
-            with compute_on.compute_on("device_host"):
-                g32 = jax.tree.map(
-                    lambda g: g.astype(jnp.float32), gh)
-                updates, opt2 = optimizer.update(
-                    g32, state.opt_state, state.master_params)
-                master2 = optax.apply_updates(state.master_params, updates)
+            # PCIe down: ONE flat compute-dtype grad buffer (the reference
+            # likewise stages fp16 gradients into flat pinned host buffers,
+            # stage2.py:793-816); the P('data') constraint makes the flatten
+            # consume each rank's reduce-scattered slice only.
+            gflat = jax.lax.with_sharding_constraint(
+                self._offload_flatten(grads, compute_dtype), flat_dev)
+            gh = jax.device_put(gflat, flat_host)
+            finite_f = jax.device_put(
+                finite.astype(jnp.float32), host_scalar)
+            c1_h = jax.device_put(c1, host_scalar)
+            c2_h = jax.device_put(c2, host_scalar)
+            lr_h = jax.device_put(step_lr, host_scalar)
+
+            master = state.master_params  # flat pinned_host f32
+            with self._host_section():
+                g32 = gh.astype(jnp.float32)
+                if wd != 0.0 and not adam_w_mode:
+                    g32 = g32 + wd * master
+                mu2, nu2 = adam_moments(g32, opt.mu, opt.nu, b1, b2)
+                upd = adam_direction(mu2, nu2, c1_h, c2_h, eps)
+                if wd != 0.0 and adam_w_mode:
+                    upd = upd + wd * master
+                master2 = master - lr_h * upd
                 # overflow-skip as elementwise select (control flow stays
-                # out of the host section; the state write-back is masked)
-                new_master = jax.tree.map(
-                    lambda n, o: jnp.where(finite_h, n, o),
-                    master2, state.master_params)
-                new_opt = jax.tree.map(
-                    lambda n, o: jnp.where(finite_h, n, o),
-                    opt2, state.opt_state)
+                # out of the host section; the state write-back is masked —
+                # finite crosses as f32 to keep the section bool/int-free)
+                keep = finite_f > 0.5
+                new_master = jnp.where(keep, master2, master)
+                new_mu = jnp.where(keep, mu2, opt.mu)
+                new_nu = jnp.where(keep, nu2, opt.nu)
 
+            new_opt = FusedAdamState(
+                count=opt.count + finite.astype(jnp.int32),
+                mu=new_mu, nu=new_nu)
             new_scaler = precision.update_scale(scaler, finite, scale_config)
             new_skipped = (state.skipped_steps
                            + (1 - finite.astype(jnp.int32)))
@@ -594,7 +702,16 @@ class DeepSpeedEngine:
             ])
             return new_state, packed
 
-        return jax.jit(train_step, donate_argnums=(0,))
+        # Outputs MUST be pinned to the state's canonical placement: without
+        # explicit out_shardings the host-section outputs surface in default
+        # device memory, the next call sees different avals, and every step
+        # retraces + recompiles (~40s/step observed on a v5e).
+        dev = NamedSharding(self.mesh, P())
+        state_shardings = jax.tree.map(lambda _: dev, self.state)._replace(
+            master_params=flat_host,
+            opt_state=FusedAdamState(count=dev, mu=flat_host, nu=flat_host))
+        return jax.jit(train_step, donate_argnums=(0,),
+                       out_shardings=(state_shardings, dev))
 
     def _build_xla_offload_eval_step(self):
         module = self.module
@@ -646,6 +763,64 @@ class DeepSpeedEngine:
             loss_scale=np.asarray(scaler.loss_scale),
             overflow=np.asarray(not finite_b),
             lr=np.asarray(lr, np.float32))
+
+    # --- canonical (tree-form) state for checkpointing -----------------
+    # The XLA offload tier stores master/moments as flat host vectors; the
+    # checkpoint keeps the logical per-parameter tree so a checkpoint saved
+    # with offload loads into a non-offload engine (and vice versa) — the
+    # analogue of the reference's merge/re-partition elastic restore
+    # (stage2.py:1712-1778).
+    @property
+    def _offload_xla(self) -> bool:
+        return self._offload and not self._offload_host
+
+    def _canonical_state(self):
+        """(master, opt_state) in per-parameter tree form, for saving."""
+        if self._offload_xla:
+            opt = self.state.opt_state
+            return (self._unflatten_numpy(self.state.master_params),
+                    FusedAdamState(count=opt.count,
+                                   mu=self._unflatten_numpy(opt.mu),
+                                   nu=self._unflatten_numpy(opt.nu)))
+        return self.state.master_params, self.state.opt_state
+
+    def _canonical_templates(self):
+        """Shape/dtype templates matching the saved (tree) form; numpy
+        broadcast views so no device or host memory is allocated."""
+        if self._offload_xla:
+            def tmpl():
+                leaves = [np.broadcast_to(np.zeros((), np.float32), s)
+                          for s in self._flat_shapes]
+                return jax.tree.unflatten(self._flat_treedef, leaves)
+            return tmpl(), FusedAdamState(
+                count=self.state.opt_state.count, mu=tmpl(), nu=tmpl())
+        return self.state.master_params, self.state.opt_state
+
+    def _adopt_loaded(self, master_tree, opt_tree):
+        """Convert loaded canonical trees to the engine's internal form."""
+        if not self._offload_xla:
+            return master_tree, opt_tree
+        dev = NamedSharding(self.mesh, P())
+        flat_master = jax.device_put(self._flatten_numpy(master_tree),
+                                     self._flat_host_sharding)
+        if opt_tree is None:
+            opt = FusedAdamState(
+                count=jax.device_put(jnp.zeros([], jnp.int32), dev),
+                mu=jax.device_put(
+                    jnp.zeros((self._flat_n,), jnp.float32),
+                    self._flat_host_sharding),
+                nu=jax.device_put(
+                    jnp.zeros((self._flat_n,), jnp.float32),
+                    self._flat_host_sharding))
+        else:
+            opt = FusedAdamState(
+                count=jax.device_put(
+                    jnp.asarray(opt_tree.count, jnp.int32), dev),
+                mu=jax.device_put(self._flatten_numpy(opt_tree.mu),
+                                  self._flat_host_sharding),
+                nu=jax.device_put(self._flatten_numpy(opt_tree.nu),
+                                  self._flat_host_sharding))
+        return flat_master, opt
 
     def _sync_offload_from_state(self):
         """After a checkpoint load replaced engine.state with device/loaded
@@ -853,14 +1028,14 @@ class DeepSpeedEngine:
                         load_lr_scheduler_states=True,
                         load_module_only=False):
         from .checkpointing import load_checkpoint
-        result = load_checkpoint(
+        # offload host-state sync happens inside load_checkpoint itself so
+        # the public runtime.checkpointing API is consistent when called
+        # directly (advisor finding, round 1)
+        return load_checkpoint(
             self, load_dir, tag=tag,
             load_optimizer_states=load_optimizer_states,
             load_lr_scheduler_states=load_lr_scheduler_states,
             load_module_only=load_module_only)
-        if self._offload_host and result[0] is not None:
-            self._sync_offload_from_state()
-        return result
 
     # ------------------------------------------------------------------
     # introspection / logging

@@ -96,11 +96,8 @@ class ZeroShardingPlan:
                 specs.append(base if base is not None else P())
         return jax.tree.unflatten(treedef, specs)
 
-    def _sharding(self, spec: P, host: bool = False) -> NamedSharding:
-        s = NamedSharding(self.mesh, spec)
-        if host and self.offload:
-            s = s.with_memory_kind("pinned_host")
-        return s
+    def _sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
 
     # -- public placement queries --------------------------------------
     def master_param_specs(self, params):
@@ -173,7 +170,10 @@ class ZeroShardingPlan:
                             is_leaf=lambda x: isinstance(x, P))
 
     def opt_state_shardings(self, opt_state, params):
-        return jax.tree.map(lambda s: self._sharding(s, host=True),
+        # Only the non-offload engine path consumes this (both offload
+        # tiers build their own flat host staging; see runtime/engine.py),
+        # so placement is plain device memory.
+        return jax.tree.map(self._sharding,
                             self.opt_state_specs(opt_state, params),
                             is_leaf=lambda x: isinstance(x, P))
 

@@ -276,6 +276,7 @@ def test_env_report_collects():
 # ---------------------------------------------------------------------------
 # engine integration of PLD / tensorboard / wall-clock breakdown
 # ---------------------------------------------------------------------------
+@pytest.mark.slow
 def test_engine_pld_tensorboard_timers(tmp_path):
     import sys
     sys.path.insert(0, "tests")

@@ -153,6 +153,7 @@ def test_missing_checkpoint_returns_none(tmp_path):
     assert path is None
 
 
+@pytest.mark.slow
 def test_pipeline_engine_roundtrip(tmp_path):
     from deepspeed_tpu.pipe.engine import PipelineEngine
     from deepspeed_tpu.models import GPT2Config

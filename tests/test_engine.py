@@ -59,6 +59,7 @@ def test_fp32_training():
     assert losses[-1] < losses[0] * 0.7
 
 
+@pytest.mark.slow
 def test_zero_stages_agree():
     """Stages 0/1/2/3 must produce (nearly) identical training curves —
     ZeRO is a memory layout, not an algorithm change (the TPU analogue of

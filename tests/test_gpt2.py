@@ -57,6 +57,7 @@ def test_param_count_formula():
     assert actual == TINY.num_params
 
 
+@pytest.mark.slow
 def test_gpt2_trains_with_zero2():
     mesh = build_mesh()
     cfg = DeepSpeedConfig(
@@ -69,6 +70,7 @@ def test_gpt2_trains_with_zero2():
     assert losses[-1] < losses[0]  # memorizes the repeated batch
 
 
+@pytest.mark.slow
 def test_gpt2_tensor_parallel_mesh():
     """dp=4 × tp=2 mesh: TP specs shard qkv over 'model' axis and training
     still runs (the Megatron-slice integration slot, reference
@@ -88,6 +90,7 @@ def test_gpt2_tensor_parallel_mesh():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_gpt2_dp_tp_matches_pure_dp():
     """Same seed, same data: (dp=8) and (dp=4,tp=2) must match numerics."""
     toks = _tokens(8, 33, TINY.vocab_size)

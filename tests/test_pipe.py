@@ -100,6 +100,7 @@ def test_pipeline_matches_sequential():
     np.testing.assert_allclose(pipe_losses, seq_losses, rtol=2e-2)
 
 
+@pytest.mark.slow
 def test_pipeline_pp4():
     mesh = build_mesh(pp=4, dp=2, tp=1)
     pm = PipelineModule(_specs(8), num_stages=4, loss_fn=mse_loss,
@@ -112,6 +113,7 @@ def test_pipeline_pp4():
     assert losses[-1] < losses[0] * 0.8, losses
 
 
+@pytest.mark.slow
 def test_pipeline_heterogeneous_stages():
     """Different layer widths inside stages; only boundaries must match."""
     specs = [LayerSpec(Linear, DIM, 32), LayerSpec(Linear, 32, DIM),
@@ -143,6 +145,7 @@ def test_pipeline_boundary_mismatch_raises():
     assert eng_err is not None and "boundar" in eng_err
 
 
+@pytest.mark.slow
 def test_pipeline_tied_layers():
     """TiedLayerSpec shares params across stages; grads flow from both uses
     (replaces the reference's tied-weight allreduce, pipe/module.py:405-474)."""
@@ -189,6 +192,7 @@ def test_pipeline_stage_mismatch_raises():
         PipelineEngine(pm, cfg, mesh)
 
 
+@pytest.mark.slow
 def test_pipeline_with_zero1():
     mesh = build_mesh(pp=2, dp=4, tp=1)
     pm = PipelineModule(_specs(4), num_stages=2, loss_fn=mse_loss,
@@ -201,6 +205,7 @@ def test_pipeline_with_zero1():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_gpt2_pipeline_trains():
     """GPT-2 as a pipeline module: tied embedding/head + block stages."""
     from deepspeed_tpu.models import GPT2Config
@@ -223,6 +228,7 @@ def test_gpt2_pipeline_trains():
     assert before_absent == []
 
 
+@pytest.mark.slow
 def test_3d_parallel_pipeline_tp_dp():
     """Full 3D: pipeline x data x tensor on one mesh, TP specs from the
     pipe layers (the reference's PipeModelDataParallelTopology slot,

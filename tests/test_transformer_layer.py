@@ -213,6 +213,7 @@ def test_bert_loss_finite_and_shapes():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_bert_trains_via_engine():
     import sys
     sys.path.insert(0, "tests")

@@ -176,7 +176,8 @@ def onebit_adam(lr: ScalarOrSchedule = 1e-3,
                 eps: float = 1e-8,
                 weight_decay: float = 0.0,
                 freeze_step: int = 100000,
-                data_axis: Optional[str] = None
+                data_axis: Optional[str] = None,
+                phase: Optional[str] = None
                 ) -> optax.GradientTransformation:
     """1-bit Adam as an optax transformation.
 
@@ -188,8 +189,18 @@ def onebit_adam(lr: ScalarOrSchedule = 1e-3,
     applied.  Warmup steps (1..freeze_step) are plain Adam, matching the
     reference's freeze transition (onebit_adam.py:366-369: compression
     starts on the step *after* ``step >= freeze_step``).
+
+    ``phase``: ``None`` resolves warm-vs-frozen per step with ``lax.cond``
+    (self-contained, but places collectives inside a conditional — a
+    fragile path in TPU SPMD lowering).  ``'warm'`` / ``'frozen'`` fix the
+    branch at trace time: the engine compiles TWO programs and selects
+    host-side at the freeze boundary, so the frozen program contains *only*
+    the uint8 collective (verifiable in its HLO) and no conditional
+    collectives exist.
     """
     b1, b2 = betas
+    if phase not in (None, "warm", "frozen"):
+        raise ValueError(f"phase must be None|'warm'|'frozen', got {phase!r}")
 
     def init_fn(params):
         return init_onebit_state(params, 1)
@@ -226,8 +237,13 @@ def onebit_adam(lr: ScalarOrSchedule = 1e-3,
                         flat, we, se)
                 return out.reshape(mu2.shape), nu, we2, se2
 
-            mu2, nu2, we2, se2 = jax.lax.cond(
-                count <= freeze_step, warm, frozen, operand=None)
+            if phase == "warm":
+                mu2, nu2, we2, se2 = warm(None)
+            elif phase == "frozen":
+                mu2, nu2, we2, se2 = frozen(None)
+            else:
+                mu2, nu2, we2, se2 = jax.lax.cond(
+                    count <= freeze_step, warm, frozen, operand=None)
             upd = mu2 / (jnp.sqrt(nu2) + eps)
             if weight_decay > 0.0:
                 upd = upd + weight_decay * p.astype(jnp.float32)

@@ -342,14 +342,29 @@ class PipelineEngine(DeepSpeedEngine):
         return 1  # all micro-batches live inside the pipelined program
 
     def eval_batch(self, batch):
-        raise NotImplementedError(
-            "pipeline eval_batch lands with the inference schedule")
+        """Forward-only pipelined evaluation (reference
+        PipelineEngine.eval_batch, pipe/engine.py:305-363, which executes
+        the InferenceSchedule).  Here the same compiled fill/drain scan
+        runs with ``train=False`` — no backward is taken, so XLA compiles a
+        forward-only program: the InferenceSchedule is the AD-less special
+        case of the train program rather than a second schedule.  The batch
+        is split into the engine's micro-batches exactly like training
+        (reference :329-335 builds the same micro-batch iterator)."""
+        def check(x):
+            x = np.asarray(x)
+            if x.shape[0] % self.micro_batches != 0:
+                raise ValueError(
+                    f"eval batch dim {x.shape[0]} must be divisible by "
+                    f"micro_batches ({self.micro_batches})")
+            return x
+        batch = jax.tree.map(check, batch)
+        return super().eval_batch(batch)
 
     def forward(self, batch):
         raise NotImplementedError(
             "the forward/backward/step facade is not supported on the "
-            "pipeline engine — use train_batch (reference parity: "
-            "PipelineEngine.train_batch is the only training entry there "
-            "too, pipe/engine.py:229)")
+            "pipeline engine — use train_batch/eval_batch (reference "
+            "parity: those are the only entries there too, "
+            "pipe/engine.py:229,305)")
 
     __call__ = forward

@@ -300,16 +300,16 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             # 2× model size in HBM — the exact memory offload exists to avoid
             opt_state = None
         else:
-            opt_state = engine.optimizer.init(master)
+            # engine-internal form (e.g. 1-bit Adam's stacked per-worker
+            # error buffers at dp>1 — plain optimizer.init would build a
+            # world=1 state the compiled shard_map step cannot consume)
+            opt_state = engine._fresh_opt_state(master)
         master, opt_state = engine._adopt_loaded(master, opt_state)
         scaler = state.scaler
 
-    # Scalars get the same explicit replicated placement as engine init:
-    # bare jnp scalars would change the compiled step's cache key and
-    # silently recompile the whole program on the first post-restore step.
-    from jax.sharding import NamedSharding, PartitionSpec
-    dev_scalar = NamedSharding(engine.mesh, PartitionSpec())
-    place_scalar = lambda x: jax.device_put(jnp.asarray(x), dev_scalar)
+    # Scalars get the same explicit replicated placement as engine init
+    # (cache-key stability; see DeepSpeedEngine._place_scalar).
+    place_scalar = engine._place_scalar
     engine.state = TrainState(
         master_params=master,
         opt_state=opt_state,

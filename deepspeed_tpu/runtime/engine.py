@@ -120,6 +120,20 @@ class DeepSpeedEngine:
             offload=config.zero_config.cpu_offload)
 
         scaler, self.loss_scale_config = precision.from_fp16_config(config.fp16)
+        # 1-bit Adam engages a dedicated shard_map step (local grads feed
+        # the compressed collective); ZeRO sharding does not compose with
+        # it — reference parity: OnebitAdam is excluded from the ZeRO
+        # whitelist (reference deepspeed/runtime/zero/utils.py:26-40) and
+        # runs under the fp16 wrapper at stage 0 there too.
+        self._onebit_path = (
+            config.optimizer_name == C.ONEBIT_ADAM_OPTIMIZER
+            and optimizer is None)
+        if self._onebit_path and config.zero_optimization_stage >= 1:
+            raise ValueError(
+                "OneBitAdam is not a ZeRO-supported optimizer (reference "
+                "zero/utils.py:26-40): its compressed collective replaces "
+                "the data-parallel gradient reduction, which conflicts with "
+                "ZeRO's sharded gradients/state. Use zero stage 0.")
         self._offload = bool(config.zero_config.cpu_offload)
         self._offload_impl = None
         if self._offload:
@@ -217,6 +231,10 @@ class DeepSpeedEngine:
                 self._host_opt.compute_params(), self._compute_shardings)
             master = self._host_opt.master       # host numpy identity
             opt_state = self._host_opt.state_tree()
+        elif self._onebit_path and self.dp_world_size > 1:
+            master_shardings = self.zero_plan.master_shardings(master)
+            master = _device_put_tree(master, master_shardings)
+            opt_state = self._init_onebit_opt_state(master, master_shardings)
         else:
             master_shardings = self.zero_plan.master_shardings(master)
             master = _device_put_tree(master, master_shardings)
@@ -225,29 +243,35 @@ class DeepSpeedEngine:
                 opt_state, master)
             opt_state = _device_put_tree(opt_state, opt_shardings)
 
-        # Scalar state gets an explicit replicated device placement: fresh
-        # jnp scalars carry no sharding, so the first compiled step's cache
-        # key (UnspecifiedValue) differs from every later step's (concrete
-        # device sharding) and the SECOND call silently recompiles the
-        # whole program.
-        dev_scalar = NamedSharding(self.mesh, P())
-        place_scalar = lambda x: jax.device_put(x, dev_scalar)
         self.state = TrainState(
             master_params=master,
             opt_state=opt_state,
-            scaler=jax.tree.map(place_scalar, scaler),
-            global_steps=place_scalar(jnp.asarray(0, jnp.int32)),
-            skipped_steps=place_scalar(jnp.asarray(0, jnp.int32)),
-            rng=place_scalar(jax.random.PRNGKey(seed + 1)),
+            scaler=jax.tree.map(self._place_scalar, scaler),
+            global_steps=self._place_scalar(jnp.asarray(0, jnp.int32)),
+            skipped_steps=self._place_scalar(jnp.asarray(0, jnp.int32)),
+            rng=self._place_scalar(jax.random.PRNGKey(seed + 1)),
         )
 
         # ---- compiled steps ----
+        self._onebit_steps = None
         if self._offload_host:
             self._grad_step = self._build_offload_grad_step()
             self._offload_eval_step = self._build_offload_eval_step()
         elif self._offload:
             self._train_step = self._build_xla_offload_step()
             self._eval_step = self._build_xla_offload_eval_step()
+        elif self._onebit_path and self.dp_world_size > 1:
+            # two compiled programs selected host-side at the freeze
+            # boundary: no collectives inside lax.cond (fragile in TPU SPMD
+            # lowering), and the frozen program's only grad-sized
+            # collective is the uint8 exchange — assertable from its HLO
+            freeze = int(self.config.optimizer_params.get(
+                "freeze_step", 100000))
+            self._onebit_steps = (
+                self._build_onebit_step("warm"),
+                self._build_onebit_step("frozen"),
+                freeze)
+            self._eval_step = self._build_eval_step()
         else:
             self._train_step = self._build_train_step()
             self._eval_step = self._build_eval_step()
@@ -350,14 +374,43 @@ class DeepSpeedEngine:
         live inside the pipelined program itself."""
         return self.gradient_accumulation_steps
 
-    def _build_train_step(self):
+    def _scan_scaled_grads(self, params, batch, scaler, step_rng,
+                           cast: bool = True, constrain: bool = True):
+        """Shared grad-accumulation core of every step builder: scan the
+        micro-batches, sum fp32 grads, unscale by loss_scale*grad_acc.
+        Returns (grads, scaled_losses).  ``cast=False`` when ``params`` are
+        already in compute dtype (offload tier casts on the host);
+        ``constrain=False`` on the 1-bit path (grads stay LOCAL there)."""
         module = self.module
-        optimizer = self.optimizer
         plan = self.zero_plan
         compute_dtype = self.compute_dtype
         grad_acc = self._scan_grad_acc
-        clip = self.gradient_clipping
-        scale_config = self.loss_scale_config
+        con = (lambda g: constrain_grads(g, plan)) if constrain \
+            else (lambda g: g)
+
+        def micro_loss(p, mb, rng):
+            pp = precision.cast_to_compute(p, compute_dtype) if cast else p
+            loss = module.loss_fn(pp, mb, rng, train=True)
+            return precision.scale_loss(loss.astype(jnp.float32), scaler)
+
+        grad_fn = jax.value_and_grad(micro_loss)
+
+        def acc_body(carry, mb):
+            gsum, i = carry
+            rng = jax.random.fold_in(step_rng, i)
+            scaled_loss, g = grad_fn(params, mb, rng)
+            gsum = jax.tree.map(
+                lambda a, b: a + b.astype(jnp.float32), gsum, con(g))
+            return (gsum, i + 1), scaled_loss
+
+        gsum0 = con(jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params))
+        (gsum, _), scaled_losses = jax.lax.scan(
+            acc_body, (gsum0, jnp.asarray(0, jnp.int32)), batch)
+        inv = (1.0 / (scaler.loss_scale * grad_acc)).astype(jnp.float32)
+        return con(jax.tree.map(lambda g: g * inv, gsum)), scaled_losses
+
+    def _lr_at_fn(self):
         lr_schedule = self._lr_schedule
         cfg_lr = float(self.config.optimizer_params.get("lr", 1e-3))
 
@@ -365,40 +418,33 @@ class DeepSpeedEngine:
             if lr_schedule is not None:
                 return jnp.asarray(lr_schedule(count), jnp.float32)
             return jnp.asarray(cfg_lr, jnp.float32)
+        return lr_at
+
+    @staticmethod
+    def _packed_metrics(mean_loss, grad_norm, scaler, finite, lr):
+        """Metrics leave the device as ONE packed f32 vector: each
+        np.asarray is a full host round-trip, so five separate fields would
+        cost 5× the latency.  Order must match ``last_metrics``."""
+        return jnp.stack([
+            mean_loss.astype(jnp.float32),
+            grad_norm.astype(jnp.float32),
+            scaler.loss_scale.astype(jnp.float32),
+            (~finite).astype(jnp.float32),
+            lr,
+        ])
+
+    def _build_train_step(self):
+        optimizer = self.optimizer
+        clip = self.gradient_clipping
+        scale_config = self.loss_scale_config
+        lr_at = self._lr_at_fn()
 
         def train_step(state: TrainState, batch):
             """batch leaves: [grad_acc, micro_global, ...]"""
             scaler = state.scaler
             step_rng = jax.random.fold_in(state.rng, state.global_steps)
-
-            def micro_loss(master, mb, rng):
-                params = precision.cast_to_compute(master, compute_dtype)
-                loss = module.loss_fn(params, mb, rng, train=True)
-                return precision.scale_loss(loss.astype(jnp.float32), scaler)
-
-            grad_fn = jax.value_and_grad(micro_loss)
-
-            def acc_body(carry, xs):
-                gsum, i = carry
-                mb = xs
-                rng = jax.random.fold_in(step_rng, i)
-                scaled_loss, g = grad_fn(state.master_params, mb, rng)
-                g = constrain_grads(g, plan)
-                gsum = jax.tree.map(
-                    lambda a, b: a + b.astype(jnp.float32), gsum, g)
-                return (gsum, i + 1), scaled_loss
-
-            gsum0 = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32),
-                state.master_params)
-            gsum0 = constrain_grads(gsum0, plan)
-            (gsum, _), scaled_losses = jax.lax.scan(
-                acc_body, (gsum0, jnp.asarray(0, jnp.int32)), batch)
-
-            # unscale: divide by loss_scale * grad_acc in one pass
-            inv = (1.0 / (scaler.loss_scale * grad_acc)).astype(jnp.float32)
-            grads = jax.tree.map(lambda g: g * inv, gsum)
-            grads = constrain_grads(grads, plan)
+            grads, scaled_losses = self._scan_scaled_grads(
+                state.master_params, batch, scaler, step_rng)
 
             finite = precision.grads_finite(grads)
             grad_norm = global_norm(grads)
@@ -435,19 +481,183 @@ class DeepSpeedEngine:
             # the optimizer's schedule actually used (skipped steps don't
             # advance the schedule).
             applied = new_global - new_skipped
-            # metrics leave the device as ONE packed f32 vector: each
-            # np.asarray is a full host round-trip (expensive through the
-            # axon tunnel), so five separate fields cost 5× the latency
-            packed = jnp.stack([
-                mean_loss.astype(jnp.float32),
-                grad_norm.astype(jnp.float32),
-                scaler.loss_scale.astype(jnp.float32),
-                (~finite).astype(jnp.float32),
-                lr_at(applied),
-            ])
+            packed = self._packed_metrics(mean_loss, grad_norm, scaler,
+                                          finite, lr_at(applied))
             return new_state, packed
 
         return jax.jit(train_step, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    # 1-bit Adam step: the whole step runs inside shard_map over ``data``
+    # with LOCAL (pre-reduction) gradients, so the compressed momentum
+    # exchange REPLACES the gradient psum — the wire saving the reference
+    # gets by disabling the engine allreduce at freeze
+    # (reference: onebit_adam.py:104-228, engine handoff :366-372).
+    # ------------------------------------------------------------------
+    def _build_onebit_step(self, phase: str):
+        from ..compress.onebit import OnebitAdamState, onebit_adam
+        clip = self.gradient_clipping
+        scale_config = self.loss_scale_config
+        lr_schedule = self._lr_schedule
+        mesh = self.mesh
+        oparams = dict(self.config.optimizer_params)
+        cfg_lr = float(oparams.get("lr", 1e-3))
+        tx = onebit_adam(
+            lr_schedule if lr_schedule is not None else cfg_lr,
+            betas=tuple(oparams.get("betas", (0.9, 0.999))),
+            eps=float(oparams.get("eps", 1e-8)),
+            weight_decay=float(oparams.get("weight_decay", 0.0)),
+            freeze_step=int(oparams.get("freeze_step", 100000)),
+            data_axis=DATA_AXIS, phase=phase)
+        lr_at = self._lr_at_fn()
+
+        squeeze0 = lambda t: jax.tree.map(lambda a: jnp.squeeze(a, 0), t)
+        stack0 = lambda t: jax.tree.map(lambda a: a[None], t)
+
+        def spmd(state: TrainState, batch):
+            scaler = state.scaler
+            widx = jax.lax.axis_index(DATA_AXIS)
+            # decorrelate dropout across workers (the GSPMD path partitions
+            # one random-bit tensor instead)
+            step_rng = jax.random.fold_in(
+                jax.random.fold_in(state.rng, state.global_steps), widx)
+            opt = state.opt_state
+            opt_local = opt._replace(
+                worker_error=squeeze0(opt.worker_error),
+                server_error=squeeze0(opt.server_error))
+
+            # grads stay LOCAL (constrain=False): the compressed collective
+            # below is the only cross-worker gradient-sized exchange
+            grads, scaled_losses = self._scan_scaled_grads(
+                state.master_params, batch, scaler, step_rng,
+                constrain=False)
+
+            # overflow anywhere -> every worker skips (scalar collective;
+            # reference CheckOverflow allreduces a MAX the same way,
+            # runtime/utils.py:41-137)
+            finite_local = precision.grads_finite(grads)
+            bad = jax.lax.psum(
+                (~finite_local).astype(jnp.float32), DATA_AXIS)
+            finite = bad == 0
+            # reporting norm: sqrt of the worker-mean squared local norm (a
+            # scalar collective; the true norm of the average gradient
+            # would require the very allreduce compression avoids)
+            norm2 = global_norm(grads) ** 2
+            grad_norm = jnp.sqrt(jax.lax.pmean(norm2, DATA_AXIS))
+            if clip > 0:
+                grads, _ = clip_by_global_norm(grads, clip, norm=grad_norm)
+
+            updates, new_opt_local = tx.update(
+                grads, opt_local, state.master_params)
+            master2 = optax.apply_updates(state.master_params, updates)
+
+            # overflow-skip as elementwise select: no lax.cond around code
+            # containing collectives (fragile in SPMD lowering)
+            keep = lambda n, o: jax.tree.map(
+                lambda a, b: jnp.where(finite, a, b), n, o)
+            new_master = keep(master2, state.master_params)
+            new_opt = OnebitAdamState(
+                count=opt.count + finite.astype(jnp.int32),
+                mu=keep(new_opt_local.mu, opt.mu),
+                nu=keep(new_opt_local.nu, opt.nu),
+                worker_error=stack0(
+                    keep(new_opt_local.worker_error,
+                         opt_local.worker_error)),
+                server_error=stack0(
+                    keep(new_opt_local.server_error,
+                         opt_local.server_error)))
+
+            new_scaler = precision.update_scale(scaler, finite, scale_config)
+            new_skipped = (state.skipped_steps
+                           + (1 - finite.astype(jnp.int32)))
+            new_global = state.global_steps + 1
+            new_state = TrainState(
+                master_params=new_master,
+                opt_state=new_opt,
+                scaler=new_scaler,
+                global_steps=new_global,
+                skipped_steps=new_skipped,
+                rng=state.rng,
+            )
+            mean_loss = jax.lax.pmean(
+                jnp.mean(scaled_losses) / scaler.loss_scale, DATA_AXIS)
+            applied = new_global - new_skipped
+            packed = self._packed_metrics(mean_loss, grad_norm, scaler,
+                                          finite, lr_at(applied))
+            return new_state, packed
+
+        err_spec = P(DATA_AXIS)
+        rep = lambda t: jax.tree.map(lambda _: P(), t)
+        state_specs = TrainState(
+            master_params=rep(self.state.master_params),
+            opt_state=self.state.opt_state.__class__(
+                count=P(),
+                mu=rep(self.state.opt_state.mu),
+                nu=rep(self.state.opt_state.nu),
+                worker_error=jax.tree.map(
+                    lambda _: err_spec, self.state.opt_state.worker_error),
+                server_error=jax.tree.map(
+                    lambda _: err_spec, self.state.opt_state.server_error)),
+            scaler=jax.tree.map(lambda _: P(), self.state.scaler),
+            global_steps=P(), skipped_steps=P(), rng=P())
+        batch_spec = P(None, DATA_AXIS)
+
+        sm = jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(state_specs, batch_spec),
+            out_specs=(state_specs, P()),
+            axis_names={DATA_AXIS},
+            check_vma=False)
+        return jax.jit(sm, donate_argnums=(0,))
+
+    def _init_onebit_opt_state(self, master, master_shardings=None):
+        """1-bit Adam multi-worker state: mu/nu are replicated (they hold
+        the post-collective common value), worker/server error buffers are
+        genuinely PER-WORKER — stored stacked [dp, n] and sharded over
+        ``data`` so each worker owns its own feedback (reference: per-rank
+        worker_error/server_error tensors, onebit_adam.py:287-309)."""
+        from ..compress.onebit import init_onebit_state
+        if master_shardings is None:
+            master_shardings = self.zero_plan.master_shardings(master)
+        dp = self.dp_world_size
+        st = init_onebit_state(master, dp)
+        stack = lambda t: jax.tree.map(
+            lambda l: jnp.broadcast_to(l, (dp,) + l.shape), t)
+        err_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        dev = NamedSharding(self.mesh, P())
+        return st._replace(
+            count=jax.device_put(st.count, dev),
+            mu=_device_put_tree(st.mu, master_shardings),
+            nu=_device_put_tree(st.nu, master_shardings),
+            worker_error=jax.tree.map(
+                lambda l: jax.device_put(l, err_sharding),
+                stack(st.worker_error)),
+            server_error=jax.tree.map(
+                lambda l: jax.device_put(l, err_sharding),
+                stack(st.server_error)))
+
+    def _fresh_opt_state(self, master):
+        """A brand-new optimizer state in the engine's INTERNAL form — used
+        by module-only checkpoint restores.  Offload tiers go through
+        _adopt_loaded(master, None); this covers the device paths."""
+        if self._onebit_path and self.dp_world_size > 1:
+            return self._init_onebit_opt_state(master)
+        return self.optimizer.init(master)
+
+    def _place_scalar(self, x):
+        """Explicit replicated device placement for scalar state — without
+        it, fresh jnp scalars change the compiled step's cache key and the
+        next call silently recompiles the whole program."""
+        return jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, P()))
+
+    def _select_onebit_step(self):
+        """Host-side freeze transition (the reference flips
+        enable_backward_allreduce at freeze, onebit_adam.py:366-372).
+        Selected on the dispatch-time step counter: overflow-skipped steps
+        count toward the freeze schedule here (under bf16 — the TPU-native
+        dtype — no steps skip, so this matches the reference exactly)."""
+        warm_fn, frozen_fn, freeze_step = self._onebit_steps
+        return warm_fn if self.global_steps < freeze_step else frozen_fn
 
     def _build_eval_step(self):
         module = self.module
@@ -571,15 +781,10 @@ class DeepSpeedEngine:
         return self._offload_unflatten(lowp)
 
     def _build_xla_offload_step(self):
-        module = self.module
-        plan = self.zero_plan
         compute_dtype = self.compute_dtype
-        grad_acc = self._scan_grad_acc
         clip = self.gradient_clipping
         scale_config = self.loss_scale_config
-        lr_schedule = self._lr_schedule
         oparams = dict(self.config.optimizer_params)
-        cfg_lr = float(oparams.get("lr", 1e-3))
         b1, b2 = (float(b) for b in oparams.get("betas", (0.9, 0.999)))
         eps = float(oparams.get("eps", 1e-8))
         wd = float(oparams.get("weight_decay", 0.0))
@@ -590,42 +795,15 @@ class DeepSpeedEngine:
         host_scalar = NamedSharding(self.mesh, P())
         if self._offload_real_host:
             host_scalar = host_scalar.with_memory_kind("pinned_host")
-
-        def lr_at(count):
-            if lr_schedule is not None:
-                return jnp.asarray(lr_schedule(count), jnp.float32)
-            return jnp.asarray(cfg_lr, jnp.float32)
+        lr_at = self._lr_at_fn()
 
         def train_step(state: TrainState, batch):
             scaler = state.scaler
             step_rng = jax.random.fold_in(state.rng, state.global_steps)
             params = self._xla_offload_cast_up(state.master_params)
-
-            def micro_loss(p, mb, rng):
-                loss = module.loss_fn(p, mb, rng, train=True)
-                return precision.scale_loss(
-                    loss.astype(jnp.float32), scaler)
-
-            grad_fn = jax.value_and_grad(micro_loss)
-
-            def acc_body(carry, mb):
-                gsum, i = carry
-                rng = jax.random.fold_in(step_rng, i)
-                scaled_loss, g = grad_fn(params, mb, rng)
-                g = constrain_grads(g, plan)
-                gsum = jax.tree.map(
-                    lambda a, b: a + b.astype(jnp.float32), gsum, g)
-                return (gsum, i + 1), scaled_loss
-
-            gsum0 = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            gsum0 = constrain_grads(gsum0, plan)
-            (gsum, _), scaled_losses = jax.lax.scan(
-                acc_body, (gsum0, jnp.asarray(0, jnp.int32)), batch)
-
-            inv = (1.0 / (scaler.loss_scale * grad_acc)).astype(jnp.float32)
-            grads = jax.tree.map(lambda g: g * inv, gsum)
-            grads = constrain_grads(grads, plan)
+            # params are already compute-dtype (the host cast above)
+            grads, scaled_losses = self._scan_scaled_grads(
+                params, batch, scaler, step_rng, cast=False)
             finite = precision.grads_finite(grads)
             grad_norm = global_norm(grads)
             if clip > 0:
@@ -693,13 +871,8 @@ class DeepSpeedEngine:
             )
             mean_loss = jnp.mean(scaled_losses) / scaler.loss_scale
             applied = new_global - new_skipped
-            packed = jnp.stack([
-                mean_loss.astype(jnp.float32),
-                grad_norm.astype(jnp.float32),
-                scaler.loss_scale.astype(jnp.float32),
-                (~finite).astype(jnp.float32),
-                lr_at(applied),
-            ])
+            packed = self._packed_metrics(mean_loss, grad_norm, scaler,
+                                          finite, lr_at(applied))
             return new_state, packed
 
         # Outputs MUST be pinned to the state's canonical placement: without
@@ -923,8 +1096,10 @@ class DeepSpeedEngine:
             self._last_metrics = metrics
             loss_out = metrics.loss
         else:
+            step_fn = self._train_step if self._onebit_steps is None \
+                else self._select_onebit_step()
             with self._pallas_scope():
-                self.state, packed = self._train_step(self.state, sharded)
+                self.state, packed = step_fn(self.state, sharded)
             # NO host sync here: every np.asarray is a full round-trip
             # (expensive through the axon tunnel) and a serialization
             # point.  The packed metrics vector stays on device; steps

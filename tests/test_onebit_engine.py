@@ -1,0 +1,154 @@
+"""Engine-integrated 1-bit Adam: the compressed collective REPLACES the
+gradient reduction on the wire.
+
+The reference gets its communication saving by disabling the engine's
+allreduce once frozen and exchanging error-compensated 1-bit momentum via
+MPI (reference: deepspeed/runtime/fp16/onebit_adam.py:104-228, engine
+handoff :366-372).  Here the engine compiles two shard_map programs (warm /
+frozen) selected host-side at the freeze boundary; these tests assert BOTH
+convergence across the boundary at dp=8 AND — from the compiled HLO — that
+the frozen program's only gradient-sized collectives are uint8.
+"""
+import re
+
+import numpy as np
+import jax
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfig
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+from simple_model import SimpleModel, base_config, random_batches
+
+FREEZE = 5
+
+
+def _engine(freeze=FREEZE, nlayers=2, hidden=16, lr=5e-3):
+    cfg_dict = base_config(micro_bs=8, grad_acc=1)
+    cfg_dict["optimizer"] = {
+        "type": "OneBitAdam",
+        "params": {"lr": lr, "freeze_step": freeze}}
+    cfg = DeepSpeedConfig(cfg_dict, world_size=8)
+    mesh = build_mesh(dp=8, devices=jax.devices())
+    return DeepSpeedEngine(
+        SimpleModel(hidden_dim=hidden, nlayers=nlayers), cfg,
+        mesh=mesh), cfg
+
+
+def _collectives(hlo_text):
+    """[(op, dtype, elems)] for every collective in an HLO dump."""
+    out = []
+    for m in re.finditer(
+            r"(all-reduce|all-to-all|all-gather|reduce-scatter|"
+            r"collective-permute)[^=]*\"?\s*=?\s*", hlo_text):
+        # the op's result type precedes the op name: scan the line
+        line = hlo_text[hlo_text.rfind("\n", 0, m.start()) + 1:
+                        hlo_text.find("\n", m.end())]
+        tm = re.search(r"(\w+)\[([\d,]*)\]", line)
+        if not tm:
+            continue
+        dtype = tm.group(1)
+        dims = tm.group(2)
+        elems = int(np.prod([int(d) for d in dims.split(",") if d])) \
+            if dims else 1
+        op = m.group(1)
+        out.append((op, dtype, elems))
+    return out
+
+
+def test_converges_across_freeze_boundary():
+    eng, cfg = _engine(freeze=10, lr=5e-3)
+    losses = []
+    for b in random_batches(cfg.train_batch_size, 16, num_batches=40,
+                            seed=7):
+        losses.append(float(np.asarray(eng.train_batch(b))))
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert eng.get_skipped_steps() == 0
+    # the frozen program really took over
+    assert eng.global_steps > 10
+    st = eng.state.opt_state
+    # error feedback engaged: worker error buffers are nonzero post-freeze
+    we = np.concatenate([np.asarray(l).ravel()
+                         for l in jax.tree.leaves(st.worker_error)])
+    assert np.abs(we).max() > 0
+
+
+def _float_collective_elems(hlo_text):
+    """Largest per-shard element count over float-typed collectives."""
+    return max((n for op, dt, n in _collectives(hlo_text)
+                if dt in ("f32", "bf16", "f16", "f64")), default=0)
+
+
+def test_frozen_hlo_wire_bytes_are_uint8():
+    """VERDICT #3's done-criterion: in the compiled frozen program every
+    float collective is scalar-sized bookkeeping (loss/overflow/norm
+    psums, the per-worker scale all-gathers — O(dp) elements); the
+    momentum exchange itself is uint8 all-to-all/all-gather.  The warm
+    program still carries the fp32 gradient reduction (biggest leaf)."""
+    eng, cfg = _engine(hidden=32)
+    biggest_leaf = max(int(np.prod(l.shape)) for l in
+                       jax.tree.leaves(eng.state.master_params))
+    assert biggest_leaf >= 1024
+
+    batch = next(random_batches(cfg.train_batch_size, 32, num_batches=1))
+    sharded = eng._shard_batch(batch)
+    warm_fn, frozen_fn, _ = eng._onebit_steps
+
+    frozen_txt = frozen_fn.lower(eng.state, sharded).compile().as_text()
+    warm_txt = warm_fn.lower(eng.state, sharded).compile().as_text()
+
+    # u8 momentum exchange is present...
+    u8 = [(op, n) for op, dt, n in _collectives(frozen_txt) if dt == "u8"]
+    assert any(op == "all-to-all" for op, _ in u8), u8
+    # ...and NO float collective approaches gradient size: the largest is
+    # the dp-sized scale gather, orders of magnitude below the fp32 grad
+    # reduction the warm program performs.
+    f_frozen = _float_collective_elems(frozen_txt)
+    f_warm = _float_collective_elems(warm_txt)
+    assert f_frozen <= 4 * 8, (
+        f"frozen program still moves float grad data: {f_frozen} elems")
+    assert f_warm >= biggest_leaf, (
+        f"warm program should carry the fp32 gradient reduction "
+        f"({f_warm} < {biggest_leaf})")
+
+
+def test_module_only_restore_keeps_stacked_error_buffers(tmp_path):
+    """Module-only restore must rebuild the engine-internal opt state
+    (stacked [dp, n] per-worker error buffers) — a plain optimizer.init
+    would produce a world=1 state the compiled shard_map step can't eat."""
+    eng, cfg = _engine(freeze=2)
+    batches = list(random_batches(cfg.train_batch_size, 16, num_batches=6,
+                                  seed=3))
+    for b in batches[:4]:
+        eng.train_batch(b)
+    eng.save_checkpoint(str(tmp_path), tag="t0")
+
+    e2, _ = _engine(freeze=2)
+    path, _ = e2.load_checkpoint(str(tmp_path), tag="t0",
+                                 load_module_only=True)
+    assert path is not None
+    we_leaf = jax.tree.leaves(e2.state.opt_state.worker_error)[0]
+    assert we_leaf.shape[0] == 8  # stacked per-worker
+    # resumed engine is already past freeze -> next step runs the frozen
+    # shard_map program against the restored state
+    l = float(np.asarray(e2.train_batch(batches[4])))
+    assert np.isfinite(l)
+
+
+def test_warm_phase_matches_reference_adam_semantics():
+    """Warm steps are plain (bias-correction-free) Adam on pmean'd grads —
+    the trajectory must be deterministic across the program pair: running
+    N<freeze steps gives identical params whether freeze is far or near."""
+    eng_a, cfg = _engine(freeze=100)
+    eng_b, _ = _engine(freeze=3)
+    batches = list(random_batches(cfg.train_batch_size, 16, num_batches=3,
+                                  seed=11))
+    for b in batches:
+        la = float(np.asarray(eng_a.train_batch(b)))
+        lb = float(np.asarray(eng_b.train_batch(b)))
+        assert la == pytest.approx(lb, abs=1e-6)
+    pa = jax.tree.leaves(eng_a.state.master_params)
+    pb = jax.tree.leaves(eng_b.state.master_params)
+    for a, b in zip(pa, pb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

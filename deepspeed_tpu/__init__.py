@@ -13,6 +13,7 @@ from typing import Any, Optional
 
 from .version import __version__
 from .config import DeepSpeedConfig, DeepSpeedConfigError
+from .parallel.distributed import init_distributed
 from .runtime.engine import DeepSpeedEngine
 from .runtime.module import TrainModule, FunctionalModule, FlaxModule
 from .runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
@@ -37,6 +38,10 @@ def initialize(args=None,
     Dispatches to the pipeline engine when ``model`` is a PipelineModule.
     """
     assert model is not None, "deepspeed_tpu.initialize requires a model"
+    # engine-owned process-group init, as in the reference
+    # (engine.py:125-145): join the multi-host runtime when the launcher's
+    # env contract is present — must happen before any mesh/device use
+    init_distributed()
     cfg_src = config if config is not None else config_params
     if cfg_src is None and args is not None:
         cfg_src = getattr(args, "deepspeed_config", None)

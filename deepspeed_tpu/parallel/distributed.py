@@ -1,0 +1,62 @@
+"""Multi-host process-group initialization.
+
+The reference engine owns distributed init: ``DeepSpeedEngine.__init__``
+calls ``dist.init_process_group('nccl')``, with MPI discovery feeding the
+env (reference: deepspeed/runtime/engine.py:125-145, 202-239).  The TPU
+equivalent is ``jax.distributed.initialize()`` consuming the env contract
+our per-node launcher exports (launcher/launch.py:49-63):
+
+  JAX_COORDINATOR_ADDRESS   host:port of process 0
+  JAX_NUM_PROCESSES         number of host processes
+  JAX_PROCESS_ID            this process's rank
+
+``deepspeed_tpu.initialize()`` calls :func:`init_distributed`
+automatically, so a script launched with ``bin/ds --hostfile ...`` joins
+the job-wide process group with no extra code — same UX as the reference
+(engine.py:130-139).  Direct engine users on a pod can call it themselves.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from ..utils.logging import log_dist
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids: Optional[Sequence[int]] = None
+                     ) -> bool:
+    """Join the multi-process JAX runtime if the launcher env contract (or
+    explicit arguments) describe one.  Returns True iff
+    ``jax.distributed.initialize`` was called.  Safe to call repeatedly
+    and in single-process runs (no-op there, like the reference's
+    ``dist.is_initialized()`` guard, engine.py:131-134)."""
+    global _initialized
+    if _initialized:
+        return False
+    coord = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = (num_processes if num_processes is not None
+             else int(os.environ.get("JAX_NUM_PROCESSES", "0") or 0))
+    pid = (process_id if process_id is not None
+           else int(os.environ.get("JAX_PROCESS_ID", "0") or 0))
+    if not coord or nproc <= 1:
+        return False
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=nproc,
+        process_id=pid,
+        local_device_ids=local_device_ids)
+    _initialized = True
+    log_dist(
+        f"jax.distributed initialized: process {pid}/{nproc} "
+        f"coordinator={coord}", ranks=[0])
+    return True

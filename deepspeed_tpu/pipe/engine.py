@@ -329,12 +329,16 @@ class PipelineEngine(DeepSpeedEngine):
 
     def _batch_leading_reshape(self, x):
         """The pipeline consumes all micro-batches in one program — no outer
-        grad-accum scan.  Present the batch as [1, total, ...] (the engine's
-        scan dim) sharded over ``data`` on the sample dim."""
-        expect = self.train_batch_size
+        grad-accum scan.  Present the batch as [1, local, ...] (the engine's
+        scan dim) sharded over ``data`` on the sample dim; multi-host feeds
+        per-process slices like the base engine."""
+        import jax as _jax
+        nproc = _jax.process_count()
+        expect = self.train_batch_size // nproc
         if x.shape[0] != expect:
             raise ValueError(
-                f"batch dim {x.shape[0]} != train_batch_size {expect}")
+                f"batch dim {x.shape[0]} != train_batch_size"
+                f"{'/process_count' if nproc > 1 else ''} {expect}")
         return x.reshape((1,) + x.shape)
 
     @property

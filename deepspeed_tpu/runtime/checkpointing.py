@@ -64,24 +64,115 @@ def _keystr(path) -> str:
 # ---------------------------------------------------------------------------
 # tree save / load
 # ---------------------------------------------------------------------------
+def _is_fully_addressable(leaf) -> bool:
+    return bool(getattr(leaf, "is_fully_addressable", True))
+
+
 def save_tree(dirpath: str, tree: Any) -> None:
-    """Write every leaf of ``tree`` as an .npy plus a manifest mapping
-    pytree key-paths to files."""
+    """Write every leaf of ``tree`` as .npy files plus a manifest mapping
+    pytree key-paths to files.
+
+    Multi-host: a leaf that is NOT fully addressable (its shards live on
+    several processes) is written as per-process shard files — each
+    process saves only the shards it owns (replica 0 of each), with the
+    global index recorded per shard.  This is the analogue of the
+    reference's per-DP-rank ``zero_pp_rank_D_...`` partitioned files
+    (reference engine.py:1218-1229); load merges them
+    (``stage2.py:1712-1778``'s merge without the repartition math, which
+    reshard-on-load makes unnecessary).  Every process must call this
+    function; process 0 writes the manifest.
+    """
     os.makedirs(dirpath, exist_ok=True)
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    pid = jax.process_index()
     manifest: Dict[str, Dict[str, Any]] = {}
     for i, (path, leaf) in enumerate(flat):
-        arr = np.asarray(jax.device_get(leaf))
-        store, logical = _to_storage(arr)
-        fname = f"leaf_{i:05d}.npy"
-        np.save(os.path.join(dirpath, fname), store, allow_pickle=False)
-        manifest[_keystr(path)] = {
-            "file": fname,
-            "dtype": logical,
-            "shape": list(arr.shape),
-        }
-    with open(os.path.join(dirpath, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=1)
+        if _is_fully_addressable(leaf):
+            if pid == 0:
+                arr = np.asarray(jax.device_get(leaf))
+                store, logical = _to_storage(arr)
+                fname = f"leaf_{i:05d}.npy"
+                np.save(os.path.join(dirpath, fname), store,
+                        allow_pickle=False)
+                manifest[_keystr(path)] = {
+                    "file": fname,
+                    "dtype": logical,
+                    "shape": list(arr.shape),
+                }
+            continue
+        # process-local shards (multi-host)
+        indices = []
+        logical = str(leaf.dtype)
+        store_dtype = logical
+        for k, shard in enumerate(leaf.addressable_shards):
+            if shard.replica_id != 0:
+                continue
+            arr = np.asarray(shard.data)
+            store, logical = _to_storage(arr)
+            store_dtype = store.dtype.name
+            fname = f"leaf_{i:05d}.proc{pid}_{k}.npy"
+            np.save(os.path.join(dirpath, fname), store, allow_pickle=False)
+            indices.append({
+                "file": fname,
+                "index": [[s.start, s.stop] for s in
+                          _normalize_index(shard.index, leaf.shape)],
+            })
+        if pid == 0:
+            manifest[_keystr(path)] = {
+                "sharded": True,
+                "leaf": i,
+                "dtype": logical,
+                "store_dtype": store_dtype,
+                "shape": list(leaf.shape),
+            }
+        # every process records its own shard index file
+        with open(os.path.join(
+                dirpath, f"leaf_{i:05d}.proc{pid}.json"), "w") as f:
+            json.dump(indices, f)
+    if pid == 0:
+        with open(os.path.join(dirpath, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1)
+
+
+def _normalize_index(index, shape):
+    """Shard index (tuple of slices) → concrete [start, stop] per dim."""
+    out = []
+    for dim, s in enumerate(index):
+        start = 0 if s.start is None else int(s.start)
+        stop = shape[dim] if s.stop is None else int(s.stop)
+        out.append(slice(start, stop))
+    return out
+
+
+def _addressable_ranges(tleaf):
+    """This process's addressable [start, stop] index boxes for a target
+    leaf, or None when unknown (numpy template / no sharding) — used to
+    skip reading other hosts' shard files on load."""
+    from jax.sharding import NamedSharding
+    sharding = getattr(tleaf, "sharding", None)
+    shape = tuple(getattr(tleaf, "shape", ()))
+    if not isinstance(sharding, NamedSharding) or jax.process_count() == 1:
+        return None
+    try:
+        imap = sharding.devices_indices_map(shape)
+    except Exception:
+        return None
+    boxes = []
+    for dev, idx in imap.items():
+        if dev.process_index != jax.process_index():
+            continue
+        boxes.append([[0 if s.start is None else int(s.start),
+                       shape[d] if s.stop is None else int(s.stop)]
+                      for d, s in enumerate(idx)])
+    return boxes
+
+
+def _ranges_intersect(shard_index, boxes) -> bool:
+    for box in boxes:
+        if all(a < bstop and b > bstart
+               for (a, b), (bstart, bstop) in zip(shard_index, box)):
+            return True
+    return False
 
 
 def load_tree(dirpath: str, target: Any, strict: bool = True) -> Any:
@@ -104,9 +195,37 @@ def load_tree(dirpath: str, target: Any, strict: bool = True) -> Any:
                      "keeping the engine's current value", ranks=[0])
             out.append(tleaf)
             continue
-        arr = np.load(os.path.join(dirpath, entry["file"]),
-                      allow_pickle=False)
-        arr = _from_storage(arr, entry["dtype"])
+        if entry.get("sharded"):
+            # merge-on-load of per-process shard files (reference
+            # stage2.py:1712-1778 merges per-rank partitions the same way)
+            import glob as _glob
+            store_dtype = entry.get("store_dtype", entry["dtype"])
+            # np.zeros is calloc-backed: pages only materialize where
+            # shards are written, so RAM cost ≈ the bytes actually needed
+            arr = np.zeros(tuple(entry["shape"]), np.dtype(store_dtype))
+            idx_files = sorted(_glob.glob(os.path.join(
+                dirpath, f"leaf_{entry['leaf']:05d}.proc*.json")))
+            if not idx_files:
+                raise FileNotFoundError(
+                    f"sharded checkpoint leaf {key!r}: no shard index "
+                    f"files in {dirpath} (were all processes' files "
+                    "copied to a shared location?)")
+            need = _addressable_ranges(tleaf)
+            for jf in idx_files:
+                with open(jf) as jfh:
+                    for shard in json.load(jfh):
+                        if need is not None and not _ranges_intersect(
+                                shard["index"], need):
+                            continue  # another host's slice — skip the I/O
+                        data = np.load(os.path.join(
+                            dirpath, shard["file"]), allow_pickle=False)
+                        sl = tuple(slice(a, b) for a, b in shard["index"])
+                        arr[sl] = data
+            arr = _from_storage(arr, entry["dtype"])
+        else:
+            arr = np.load(os.path.join(dirpath, entry["file"]),
+                          allow_pickle=False)
+            arr = _from_storage(arr, entry["dtype"])
         tshape = tuple(getattr(tleaf, "shape", ()))
         if tuple(arr.shape) != tshape:
             # Pipeline-resize elastic restore: stage-local stacked leaves
@@ -138,7 +257,14 @@ def load_tree(dirpath: str, target: Any, strict: bool = True) -> Any:
         # a multi-GB offloaded master on device here would defeat offload.
         from jax.sharding import NamedSharding
         if isinstance(sharding, NamedSharding):
-            out.append(jax.device_put(arr, sharding))
+            if jax.process_count() > 1:
+                # multi-controller: each process materializes only its own
+                # addressable shards of the global array
+                out.append(jax.make_array_from_callback(
+                    tuple(arr.shape), sharding,
+                    lambda idx, a=arr: a[idx]))
+            else:
+                out.append(jax.device_put(arr, sharding))
         elif isinstance(tleaf, np.ndarray):
             out.append(arr)
         else:
@@ -163,9 +289,17 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     reference's fp16-cast restore) independent of the optimizer plane, same
     as the reference's mp_rank/zero_pp_rank file split.
 
-    Multi-host: only process 0 writes (arrays here are either replicated or
-    fully addressable in the single-controller runs this framework targets;
-    reference engine.py:415-416 likewise writes from DP rank 0 only).
+    Multi-host: EVERY process MUST call this (same contract as the
+    reference, where every rank writes its ZeRO partition files,
+    engine.py:1218-1229) — guarding with ``if process_index() == 0`` will
+    DEADLOCK the job at the internal barrier.  Fully-addressable leaves
+    are written by process 0, non-addressable leaves as per-process shard
+    files (see save_tree), with a cross-process barrier before the atomic
+    rename.  Assumes a shared checkpoint directory (the pod-filesystem /
+    GCS-fuse case); per-host local dirs need the shard files merged before
+    load, which load_tree reports explicitly if missing.  (reference
+    engine.py:415-416 writes model files from DP rank 0 and ZeRO
+    partitions from every rank, engine.py:1218-1229.)
     """
     from .engine import TrainState  # local import to avoid cycle
 
@@ -173,12 +307,15 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     if tag is None:
         tag = f"global_step{engine.global_steps}"
     ckpt_dir = os.path.join(save_dir, str(tag))
-    if jax.process_count() > 1 and jax.process_index() != 0:
-        return ckpt_dir
+    multiproc = jax.process_count() > 1
+    proc0 = jax.process_index() == 0
     tmp_dir = ckpt_dir + ".tmp"
-    if os.path.isdir(tmp_dir):
+    if proc0 and os.path.isdir(tmp_dir):
         import shutil
         shutil.rmtree(tmp_dir)
+    if multiproc:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("ds_ckpt_clean")
     os.makedirs(tmp_dir, exist_ok=True)
 
     from . import precision
@@ -198,26 +335,34 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "data_rng": engine._data_rng,
     })
 
-    meta = {
-        "tag": str(tag),
-        "global_steps": int(engine.global_steps),
-        "micro_steps": int(engine.micro_steps),
-        "skipped_steps": int(state.skipped_steps),
-        "dp_world_size": int(engine.dp_world_size),
-        "zero_stage": int(engine.config.zero_optimization_stage),
-        "client_state": client_state or {},
-    }
-    with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=1)
-    if os.path.isdir(ckpt_dir):
-        import shutil
-        shutil.rmtree(ckpt_dir)
-    os.rename(tmp_dir, ckpt_dir)
-    if save_latest:
-        latest_tmp = os.path.join(save_dir, LATEST_FILE + ".tmp")
-        with open(latest_tmp, "w") as f:
-            f.write(str(tag))
-        os.replace(latest_tmp, os.path.join(save_dir, LATEST_FILE))
+    if multiproc:
+        # every process's shard files must be on disk before the rename
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("ds_ckpt_written")
+    if proc0:
+        meta = {
+            "tag": str(tag),
+            "global_steps": int(engine.global_steps),
+            "micro_steps": int(engine.micro_steps),
+            "skipped_steps": int(state.skipped_steps),
+            "dp_world_size": int(engine.dp_world_size),
+            "zero_stage": int(engine.config.zero_optimization_stage),
+            "client_state": client_state or {},
+        }
+        with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+        if os.path.isdir(ckpt_dir):
+            import shutil
+            shutil.rmtree(ckpt_dir)
+        os.rename(tmp_dir, ckpt_dir)
+        if save_latest:
+            latest_tmp = os.path.join(save_dir, LATEST_FILE + ".tmp")
+            with open(latest_tmp, "w") as f:
+                f.write(str(tag))
+            os.replace(latest_tmp, os.path.join(save_dir, LATEST_FILE))
+    if multiproc:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("ds_ckpt_done")
     log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
     return ckpt_dir
 
@@ -285,12 +430,15 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         loaded = load_tree(os.path.join(ckpt_dir, "model"),
                            {"module": module_tmpl})
         def _promote(cur, new):
-            arr = np.asarray(jax.device_get(new)).astype(cur.dtype)
             sharding = getattr(cur, "sharding", None)  # numpy (offload): none
             from jax.sharding import NamedSharding
             if isinstance(sharding, NamedSharding):
-                return jax.device_put(arr, sharding)
-            return arr
+                # on-device cast keeps this multi-host safe: `new` may be a
+                # global array spanning non-addressable devices, which
+                # device_get cannot fetch
+                return jax.jit(lambda x: x.astype(cur.dtype),
+                               out_shardings=sharding)(new)
+            return np.asarray(jax.device_get(new)).astype(cur.dtype)
 
         master = jax.tree.map(_promote, tmpl_master, loaded["module"])
         if getattr(engine, "_offload", False):

@@ -272,6 +272,9 @@ class DeepSpeedEngine:
                 self._build_onebit_step("frozen"),
                 freeze)
             self._eval_step = self._build_eval_step()
+        elif self._use_sparse_grads():
+            self._train_step = self._build_sparse_grad_step()
+            self._eval_step = self._build_eval_step()
         else:
             self._train_step = self._build_train_step()
             self._eval_step = self._build_eval_step()
@@ -433,6 +436,32 @@ class DeepSpeedEngine:
             lr,
         ])
 
+    def _step_epilogue(self, state, new_master, new_opt, finite,
+                       mean_loss, grad_norm, lr_at, scale_config):
+        """Shared step tail: loss-scale update, skip/step counters, the
+        next TrainState, and the packed metrics vector.  One copy so skip
+        semantics and the metrics contract can't drift across the step
+        builders."""
+        new_scaler = precision.update_scale(state.scaler, finite,
+                                            scale_config)
+        new_skipped = state.skipped_steps + (1 - finite.astype(jnp.int32))
+        new_global = state.global_steps + 1
+        new_state = TrainState(
+            master_params=new_master,
+            opt_state=new_opt,
+            scaler=new_scaler,
+            global_steps=new_global,
+            skipped_steps=new_skipped,
+            rng=state.rng,
+        )
+        # lr is reported at the *applied*-step count so it matches what
+        # the optimizer's schedule actually used (skipped steps don't
+        # advance the schedule)
+        applied = new_global - new_skipped
+        packed = self._packed_metrics(mean_loss, grad_norm, state.scaler,
+                                      finite, lr_at(applied))
+        return new_state, packed
+
     def _build_train_step(self):
         optimizer = self.optimizer
         clip = self.gradient_clipping
@@ -464,26 +493,10 @@ class DeepSpeedEngine:
                 finite, do_update, skip_update,
                 (state.master_params, state.opt_state))
 
-            new_scaler = precision.update_scale(scaler, finite, scale_config)
-            new_skipped = (state.skipped_steps
-                           + (1 - finite.astype(jnp.int32)))
-            new_global = state.global_steps + 1
-            new_state = TrainState(
-                master_params=new_master,
-                opt_state=new_opt,
-                scaler=new_scaler,
-                global_steps=new_global,
-                skipped_steps=new_skipped,
-                rng=state.rng,
-            )
             mean_loss = (jnp.mean(scaled_losses) / scaler.loss_scale)
-            # lr is reported at the *applied*-step count so it matches what
-            # the optimizer's schedule actually used (skipped steps don't
-            # advance the schedule).
-            applied = new_global - new_skipped
-            packed = self._packed_metrics(mean_loss, grad_norm, scaler,
-                                          finite, lr_at(applied))
-            return new_state, packed
+            return self._step_epilogue(state, new_master, new_opt, finite,
+                                       mean_loss, grad_norm, lr_at,
+                                       scale_config)
 
         return jax.jit(train_step, donate_argnums=(0,))
 
@@ -567,24 +580,11 @@ class DeepSpeedEngine:
                     keep(new_opt_local.server_error,
                          opt_local.server_error)))
 
-            new_scaler = precision.update_scale(scaler, finite, scale_config)
-            new_skipped = (state.skipped_steps
-                           + (1 - finite.astype(jnp.int32)))
-            new_global = state.global_steps + 1
-            new_state = TrainState(
-                master_params=new_master,
-                opt_state=new_opt,
-                scaler=new_scaler,
-                global_steps=new_global,
-                skipped_steps=new_skipped,
-                rng=state.rng,
-            )
             mean_loss = jax.lax.pmean(
                 jnp.mean(scaled_losses) / scaler.loss_scale, DATA_AXIS)
-            applied = new_global - new_skipped
-            packed = self._packed_metrics(mean_loss, grad_norm, scaler,
-                                          finite, lr_at(applied))
-            return new_state, packed
+            return self._step_epilogue(state, new_master, new_opt, finite,
+                                       mean_loss, grad_norm, lr_at,
+                                       scale_config)
 
         err_spec = P(DATA_AXIS)
         rep = lambda t: jax.tree.map(lambda _: P(), t)
@@ -605,6 +605,105 @@ class DeepSpeedEngine:
         sm = jax.shard_map(
             spmd, mesh=mesh,
             in_specs=(state_specs, batch_spec),
+            out_specs=(state_specs, P()),
+            axis_names={DATA_AXIS},
+            check_vma=False)
+        return jax.jit(sm, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    # CSR sparse-gradient step: embedding-style grads cross the data axis
+    # as (indices, values) allgathers instead of a dense [vocab, d] psum
+    # (reference: sparse_gradients + nn.Embedding detection at
+    # engine.py:177-183, CSR exchange at engine.py:1153-1209).
+    # ------------------------------------------------------------------
+    def _use_sparse_grads(self) -> bool:
+        if not self.config.sparse_gradients_enabled:
+            return False
+        hook = getattr(type(self.module), "sparse_grad_tokens", None)
+        if hook is None or hook is TrainModule.sparse_grad_tokens:
+            log_dist(
+                "sparse_gradients enabled but the module declares no "
+                "sparse params (sparse_grad_tokens) — dense path",
+                ranks=[0])
+            return False
+        if self.config.zero_optimization_stage >= 1:
+            # reference parity: the ZeRO optimizers' reduction machinery is
+            # dense-only; sparse_gradients only affects the stage-0
+            # allreduce path there too (engine.py:1137-1140)
+            log_dist(
+                "sparse_gradients ignored under ZeRO stage >= 1 "
+                "(reference parity: only the stage-0 allreduce path is "
+                "sparse there)", ranks=[0])
+            return False
+        return self.dp_world_size > 1
+
+    def _build_sparse_grad_step(self):
+        from .csr_tensor import csr_allgather, sparse_embedding_grad
+        module = self.module
+        optimizer = self.optimizer
+        clip = self.gradient_clipping
+        scale_config = self.loss_scale_config
+        mesh = self.mesh
+        dp = self.dp_world_size
+        lr_at = self._lr_at_fn()
+
+        def spmd(state: TrainState, batch):
+            scaler = state.scaler
+            widx = jax.lax.axis_index(DATA_AXIS)
+            step_rng = jax.random.fold_in(
+                jax.random.fold_in(state.rng, state.global_steps), widx)
+            # LOCAL grads; the combine below chooses dense pmean vs CSR
+            # allgather per leaf
+            grads, scaled_losses = self._scan_scaled_grads(
+                state.master_params, batch, scaler, step_rng,
+                constrain=False)
+
+            sparse_map = module.sparse_grad_tokens(batch) or {}
+            flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+            known = {jax.tree_util.keystr(p) for p, _ in flat}
+            unknown = set(sparse_map) - known
+            if unknown:
+                raise ValueError(
+                    f"sparse_grad_tokens declares params {sorted(unknown)} "
+                    f"that do not exist in the gradient tree; valid "
+                    f"keystrs: {sorted(known)}")
+            combined = []
+            for path, g in flat:
+                key = jax.tree_util.keystr(path)
+                if key in sparse_map:
+                    csr = sparse_embedding_grad(g, sparse_map[key])
+                    gathered = csr_allgather(csr, DATA_AXIS)
+                    combined.append(gathered.to_dense() / dp)
+                else:
+                    combined.append(jax.lax.pmean(g, DATA_AXIS))
+            grads = jax.tree_util.tree_unflatten(treedef, combined)
+
+            # combined grads are identical on every worker from here on —
+            # standard step semantics apply
+            finite = precision.grads_finite(grads)
+            grad_norm = global_norm(grads)
+            if clip > 0:
+                grads, _ = clip_by_global_norm(grads, clip, norm=grad_norm)
+
+            def do_update(operand):
+                master, opt_state = operand
+                updates, new_opt = optimizer.update(grads, opt_state, master)
+                return optax.apply_updates(master, updates), new_opt
+
+            new_master, new_opt = jax.lax.cond(
+                finite, do_update, lambda o: o,
+                (state.master_params, state.opt_state))
+
+            mean_loss = jax.lax.pmean(
+                jnp.mean(scaled_losses) / scaler.loss_scale, DATA_AXIS)
+            return self._step_epilogue(state, new_master, new_opt, finite,
+                                       mean_loss, grad_norm, lr_at,
+                                       scale_config)
+
+        state_specs = jax.tree.map(lambda _: P(), self.state)
+        sm = jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(state_specs, P(None, DATA_AXIS)),
             out_specs=(state_specs, P()),
             axis_names={DATA_AXIS},
             check_vma=False)
@@ -857,23 +956,10 @@ class DeepSpeedEngine:
             new_opt = FusedAdamState(
                 count=opt.count + finite.astype(jnp.int32),
                 mu=new_mu, nu=new_nu)
-            new_scaler = precision.update_scale(scaler, finite, scale_config)
-            new_skipped = (state.skipped_steps
-                           + (1 - finite.astype(jnp.int32)))
-            new_global = state.global_steps + 1
-            new_state = TrainState(
-                master_params=new_master,
-                opt_state=new_opt,
-                scaler=new_scaler,
-                global_steps=new_global,
-                skipped_steps=new_skipped,
-                rng=state.rng,
-            )
             mean_loss = jnp.mean(scaled_losses) / scaler.loss_scale
-            applied = new_global - new_skipped
-            packed = self._packed_metrics(mean_loss, grad_norm, scaler,
-                                          finite, lr_at(applied))
-            return new_state, packed
+            return self._step_epilogue(state, new_master, new_opt, finite,
+                                       mean_loss, grad_norm, lr_at,
+                                       scale_config)
 
         # Outputs MUST be pinned to the state's canonical placement: without
         # explicit out_shardings the host-section outputs surface in default
@@ -1039,28 +1125,42 @@ class DeepSpeedEngine:
             mesh=self.mesh)
 
     def _batch_leading_reshape(self, x: np.ndarray) -> np.ndarray:
-        """[train_batch, ...] → [grad_acc, micro_global, ...] (the engine's
-        accumulation-scan layout).  The pipeline engine overrides this —
-        it's the only part of batch placement that differs there."""
+        """[train_batch/nproc, ...] → [grad_acc, micro_rows, ...] (the
+        engine's accumulation-scan layout).  Multi-host: each process feeds
+        its OWN slice of the global batch (the reference's
+        DistributedSampler contract, dataloader.py:48-58 there), so the
+        expected leading dim divides by process_count.  The pipeline
+        engine overrides this — it's the only part of batch placement that
+        differs there."""
         ga, mb = self.gradient_accumulation_steps, self.micro_batch_size
+        nproc = jax.process_count()
         micro_global = mb * self.dp_world_size
-        expect = ga * micro_global
+        expect = ga * micro_global // nproc
         if x.shape[0] != expect:
             raise ValueError(
-                f"batch dim {x.shape[0]} != train_batch_size {expect} "
-                f"(grad_acc {ga} × micro {mb} × dp {self.dp_world_size})")
-        return x.reshape((ga, micro_global) + x.shape[1:])
+                f"batch dim {x.shape[0]} != train_batch_size"
+                f"{'/process_count' if nproc > 1 else ''} {expect} "
+                f"(grad_acc {ga} × micro {mb} × dp {self.dp_world_size}"
+                f"{f' ÷ {nproc} processes' if nproc > 1 else ''})")
+        return x.reshape((ga, micro_global // nproc) + x.shape[1:])
 
     def _shard_batch(self, batch):
         """Place a global batch as [leading, samples, ...] sharded over the
-        data axis on dim 1."""
+        data axis on dim 1.  Multi-host: every process contributes its
+        local rows via ``make_array_from_process_local_data`` — no process
+        ever materializes the global batch (reference: per-rank
+        DistributedSampler slices, dataloader.py:48-58)."""
         batch = jax.tree.map(
             lambda x: self._batch_leading_reshape(np.asarray(x)), batch)
+        nproc = jax.process_count()
 
         def shard(x):
             spec = [None] * x.ndim
             spec[1] = DATA_AXIS
-            return jax.device_put(x, NamedSharding(self.mesh, P(*spec)))
+            sharding = NamedSharding(self.mesh, P(*spec))
+            if nproc > 1:
+                return jax.make_array_from_process_local_data(sharding, x)
+            return jax.device_put(x, sharding)
 
         return jax.tree.map(shard, batch)
 

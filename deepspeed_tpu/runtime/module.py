@@ -31,6 +31,18 @@ class TrainModule:
     def param_partition_specs(self, params) -> Optional[Any]:
         return None
 
+    def sparse_grad_tokens(self, batch) -> dict:
+        """Optional: declare embedding-style params whose gradient rows are
+        only the batch's token rows.  Returns {param keystr: token-id
+        array}, where keystr is ``jax.tree_util.keystr`` of the param's
+        path and the tokens come from ``batch`` (called inside the traced
+        step with the per-worker batch ``[grad_acc, local_micro, ...]``).
+        With ``sparse_gradients`` enabled the engine exchanges these
+        params' grads as (indices, values) instead of dense — the
+        reference's nn.Embedding CSR allreduce (engine.py:177-183,
+        1153-1209)."""
+        return {}
+
 
 class FunctionalModule(TrainModule):
     """Wrap bare (init_fn, loss_fn) callables."""

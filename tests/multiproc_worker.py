@@ -1,0 +1,73 @@
+"""Worker body for the 2-process × 4-device CPU integration test — the
+analogue of the reference's @distributed_test harness
+(reference: tests/unit/common.py:14-100, which forks NCCL workers on
+localhost).  Launched by test_multiprocess.py with the launcher env
+contract set; everything here goes through the PUBLIC multi-host path:
+deepspeed_tpu.initialize -> init_distributed -> per-process batches ->
+sharded checkpoint save/load.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.parallel import build_mesh  # noqa: E402
+from simple_model import SimpleModel  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    # initialize() consumes JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    # JAX_PROCESS_ID from the env (the launcher contract)
+    deepspeed_tpu.init_distributed()
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, jax.devices()
+    pid = jax.process_index()
+
+    mesh = build_mesh(dp=8, devices=jax.devices())
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=32), config=cfg, mesh=mesh)
+
+    # per-process batch slices: global batch 32, each process feeds 16
+    rng = np.random.default_rng(0)
+    gx = rng.normal(size=(32, 32)).astype(np.float32)
+    gy = (0.5 * gx).astype(np.float32)
+    lo, hi = (0, 16) if pid == 0 else (16, 32)
+    losses = []
+    for _ in range(5):
+        loss = engine.train_batch((gx[lo:hi], gy[lo:hi]))
+        losses.append(float(np.asarray(loss)))
+    assert losses[-1] < losses[0], losses
+
+    # sharded checkpoint: every process writes its ZeRO shards
+    engine.save_checkpoint(out_dir, tag="mp")
+    ref = float(np.asarray(engine.train_batch((gx[lo:hi], gy[lo:hi]))))
+
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=32), config=cfg, mesh=mesh, seed=9)
+    path, _ = engine2.load_checkpoint(out_dir, tag="mp")
+    assert path is not None
+    got = float(np.asarray(engine2.train_batch((gx[lo:hi], gy[lo:hi]))))
+    assert abs(got - ref) < 1e-6, (got, ref)
+
+    print(f"WORKER_{pid}_OK loss={losses[-1]:.6f} resume={got:.6f}")
+
+
+if __name__ == "__main__":
+    main()

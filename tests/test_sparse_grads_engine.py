@@ -1,0 +1,119 @@
+"""Engine-integrated CSR sparse gradients.
+
+With ``sparse_gradients`` enabled and a module declaring embedding-style
+params (``sparse_grad_tokens``), the engine exchanges those grads across
+the data axis as (indices, values) allgathers instead of a dense
+[vocab, d] reduction — the reference's nn.Embedding CSR path (reference:
+deepspeed/runtime/engine.py:177-183,1153-1209, csr_tensor.py).
+"""
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfig
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime.module import TrainModule
+
+VOCAB, DIM, SEQ = 4096, 16, 8
+
+
+class BigEmbeddingModel(TrainModule):
+    """Embedding -> mean-pool -> linear head; the embedding grad touches
+    only the batch's token rows (the nn.Embedding sparse case)."""
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "emb": jax.random.normal(k1, (VOCAB, DIM), jnp.float32) * 0.1,
+            "head_w": jax.random.normal(k2, (DIM, DIM), jnp.float32) * 0.2,
+        }
+
+    def loss_fn(self, params, batch, rng, train=True):
+        tokens, target = batch
+        h = params["emb"].astype(jnp.float32)[tokens].mean(axis=1)
+        out = h @ params["head_w"].astype(jnp.float32)
+        return jnp.mean((out - target.astype(jnp.float32)) ** 2)
+
+    def sparse_grad_tokens(self, batch):
+        tokens, _ = batch
+        return {"['emb']": tokens}
+
+
+def _cfg(sparse: bool):
+    return DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "sparse_gradients": sparse,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 0},
+    }, world_size=8)
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, VOCAB, (32, SEQ), dtype=np.int32)
+    target = rng.normal(size=(32, DIM)).astype(np.float32)
+    return tokens, target
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(dp=8, devices=jax.devices())
+
+
+def test_sparse_matches_dense_path(mesh):
+    es = DeepSpeedEngine(BigEmbeddingModel(), _cfg(True), mesh=mesh, seed=5)
+    ed = DeepSpeedEngine(BigEmbeddingModel(), _cfg(False), mesh=mesh, seed=5)
+    assert es._use_sparse_grads()
+    for i in range(5):
+        b = _batch(i)
+        ls = float(np.asarray(es.train_batch(b)))
+        ld = float(np.asarray(ed.train_batch(b)))
+        assert ls == pytest.approx(ld, rel=2e-3), (i, ls, ld)
+    assert ls < float(np.asarray(es.eval_batch(_batch(0)))) * 5  # sane
+
+
+def test_wire_format_is_indices_values(mesh):
+    """The compiled HLO must carry NO collective of dense-embedding size
+    (VOCAB*DIM); the embedding exchange is the token-sized (indices,
+    values) gather."""
+    eng = DeepSpeedEngine(BigEmbeddingModel(), _cfg(True), mesh=mesh)
+    sharded = eng._shard_batch(_batch(0))
+    txt = eng._train_step.lower(eng.state, sharded).compile().as_text()
+    dense_elems = VOCAB * DIM
+    coll = []
+    for line in txt.splitlines():
+        if re.search(r"= .*(all-reduce|all-gather|all-to-all)\(", line):
+            for dt, dims in re.findall(r"(\w+)\[([\d,]+)\]", line):
+                coll.append(int(np.prod([int(d) for d in dims.split(",")])))
+    assert coll, "no collectives found in HLO"
+    assert max(coll) < dense_elems // 4, (
+        f"a dense-embedding-sized collective survived: max={max(coll)} "
+        f"vs dense={dense_elems}")
+
+
+def test_dense_fallbacks(mesh):
+    # no sparse hook -> dense path
+    from simple_model import SimpleModel
+    cfg = _cfg(True)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=16), cfg, mesh=mesh)
+    assert not eng._use_sparse_grads()
+    # zero >= 1 -> dense path (reference parity)
+    cfg2 = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "sparse_gradients": True,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+    }, world_size=8)
+    eng2 = DeepSpeedEngine(BigEmbeddingModel(), cfg2, mesh=mesh)
+    assert not eng2._use_sparse_grads()
+    assert np.isfinite(float(np.asarray(eng2.train_batch(_batch(0)))))

@@ -145,6 +145,19 @@ class SparseSelfAttention:
             self._lut_cache[seq_len] = build_lut(layout)
         return self._lut_cache[seq_len]
 
+    def _get_kernel_luts(self, seq_len: int):
+        """Per-seq-len cache of (layout, kernel LUTs) for the Pallas hot
+        path — the layout build + LUT scans are O(H·nb²) Python work that
+        must not run per forward call."""
+        if not hasattr(self, "_kernel_lut_cache"):
+            self._kernel_lut_cache = {}
+        if seq_len not in self._kernel_lut_cache:
+            from ..pallas.block_sparse_attention import build_kernel_luts
+            layout = np.asarray(self.sparsity_config.make_layout(seq_len))
+            self._kernel_lut_cache[seq_len] = (
+                layout, build_kernel_luts(layout))
+        return self._kernel_lut_cache[seq_len]
+
     def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
                  attn_mask=None):
         B, H, T, D = query.shape
@@ -155,12 +168,24 @@ class SparseSelfAttention:
             raise ValueError(
                 f"input has {H} heads but sparsity config was built for "
                 f"{self.sparsity_config.num_heads}")
+        block = self.sparsity_config.block
+        if rpe is None and key_padding_mask is None and attn_mask is None \
+                and T % block == 0:
+            # hot path: the fused Pallas kernel (LUT-driven online-softmax
+            # over active blocks only — the Triton sdd/softmax/dsd trio as
+            # one kernel; see ops/pallas/block_sparse_attention.py).
+            # rpe/mask features stay on the gathered-block XLA path below.
+            from ..pallas.block_sparse_attention import (
+                block_sparse_attention)
+            layout, luts = self._get_kernel_luts(T)
+            return block_sparse_attention(query, key, value, layout, block,
+                                          luts=luts)
         cols, valid = self.get_lut(T)
         scale = float(D) ** -0.5
         return _sparse_attn(query, key, value, jnp.asarray(cols),
                             jnp.asarray(valid), rpe, key_padding_mask,
                             attn_mask, scale,
-                            block=self.sparsity_config.block,
+                            block=block,
                             kp_mode=self.key_padding_mask_mode,
                             am_mode=self.attn_mask_mode)
 

@@ -50,11 +50,32 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if not coord or nproc <= 1:
         return False
     import jax
-    jax.distributed.initialize(
-        coordinator_address=coord,
-        num_processes=nproc,
-        process_id=pid,
-        local_device_ids=local_device_ids)
+    # user already joined the runtime themselves (reference analogue:
+    # dist.is_initialized() short-circuit, engine.py:131-134)
+    try:
+        from jax._src.distributed import global_state
+        if getattr(global_state, "client", None) is not None:
+            _initialized = True
+            return False
+    except ImportError:
+        pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=nproc,
+            process_id=pid,
+            local_device_ids=local_device_ids)
+    except RuntimeError as e:
+        # jax refuses to join after the XLA backend initialized (any
+        # jax.devices()/build_mesh call does that) — surface an actionable
+        # error instead of jax's generic one
+        raise RuntimeError(
+            "deepspeed_tpu found a multi-host launcher env "
+            f"(JAX_NUM_PROCESSES={nproc}) but the XLA backend is already "
+            "initialized, so this process cannot join the job-wide "
+            "runtime. Call deepspeed_tpu.init_distributed() (or "
+            "deepspeed_tpu.initialize()) BEFORE any jax.devices()/"
+            "build_mesh()/array call.") from e
     _initialized = True
     log_dist(
         f"jax.distributed initialized: process {pid}/{nproc} "

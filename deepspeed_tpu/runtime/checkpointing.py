@@ -200,9 +200,18 @@ def load_tree(dirpath: str, target: Any, strict: bool = True) -> Any:
             # stage2.py:1712-1778 merges per-rank partitions the same way)
             import glob as _glob
             store_dtype = entry.get("store_dtype", entry["dtype"])
+            try:
+                sd = np.dtype(store_dtype)
+            except TypeError:
+                # manifest written by a process that owned no replica-0
+                # shards records the LOGICAL dtype — map it to the storage
+                # view the shard files actually contain
+                sd = {"bfloat16": np.dtype(np.uint16),
+                      "float8_e4m3fn": np.dtype(np.uint8),
+                      "float8_e5m2": np.dtype(np.uint8)}[store_dtype]
             # np.zeros is calloc-backed: pages only materialize where
             # shards are written, so RAM cost ≈ the bytes actually needed
-            arr = np.zeros(tuple(entry["shape"]), np.dtype(store_dtype))
+            arr = np.zeros(tuple(entry["shape"]), sd)
             idx_files = sorted(_glob.glob(os.path.join(
                 dirpath, f"leaf_{entry['leaf']:05d}.proc*.json")))
             if not idx_files:

@@ -92,10 +92,11 @@ def main():
             results.append(rec)
             print(json.dumps(rec))
 
-    with open("BENCH_sparse.json", "w") as f:
-        json.dump({"device": str(jax.devices()[0]),
-                   "shape": {"B": B, "H": H, "D": D, "block": block},
-                   "results": results}, f, indent=1)
+    if on_tpu:  # never clobber the TPU-measured artifact with CPU smoke
+        with open("BENCH_sparse.json", "w") as f:
+            json.dump({"device": str(jax.devices()[0]),
+                       "shape": {"B": B, "H": H, "D": D, "block": block},
+                       "results": results}, f, indent=1)
 
 
 if __name__ == "__main__":

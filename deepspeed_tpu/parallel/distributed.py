@@ -68,14 +68,17 @@ def init_distributed(coordinator_address: Optional[str] = None,
     except RuntimeError as e:
         # jax refuses to join after the XLA backend initialized (any
         # jax.devices()/build_mesh call does that) — surface an actionable
-        # error instead of jax's generic one
-        raise RuntimeError(
-            "deepspeed_tpu found a multi-host launcher env "
-            f"(JAX_NUM_PROCESSES={nproc}) but the XLA backend is already "
-            "initialized, so this process cannot join the job-wide "
-            "runtime. Call deepspeed_tpu.init_distributed() (or "
-            "deepspeed_tpu.initialize()) BEFORE any jax.devices()/"
-            "build_mesh()/array call.") from e
+        # error instead of jax's generic one.  Only rewrite THAT failure;
+        # coordinator-connection/timeout RuntimeErrors pass through.
+        if "backend" in str(e).lower() and "initial" in str(e).lower():
+            raise RuntimeError(
+                "deepspeed_tpu found a multi-host launcher env "
+                f"(JAX_NUM_PROCESSES={nproc}) but the XLA backend is "
+                "already initialized, so this process cannot join the "
+                "job-wide runtime. Call deepspeed_tpu.init_distributed() "
+                "(or deepspeed_tpu.initialize()) BEFORE any jax.devices()/"
+                "build_mesh()/array call.") from e
+        raise
     _initialized = True
     log_dist(
         f"jax.distributed initialized: process {pid}/{nproc} "

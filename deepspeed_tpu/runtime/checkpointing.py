@@ -200,15 +200,16 @@ def load_tree(dirpath: str, target: Any, strict: bool = True) -> Any:
             # stage2.py:1712-1778 merges per-rank partitions the same way)
             import glob as _glob
             store_dtype = entry.get("store_dtype", entry["dtype"])
-            try:
-                sd = np.dtype(store_dtype)
-            except TypeError:
-                # manifest written by a process that owned no replica-0
-                # shards records the LOGICAL dtype — map it to the storage
-                # view the shard files actually contain
-                sd = {"bfloat16": np.dtype(np.uint16),
-                      "float8_e4m3fn": np.dtype(np.uint8),
-                      "float8_e5m2": np.dtype(np.uint8)}[store_dtype]
+            # a manifest written by a process that owned no replica-0
+            # shards records the LOGICAL dtype; map it to the storage view
+            # the shard files actually contain.  Must branch on the NAME:
+            # np.dtype('bfloat16') succeeds (ml_dtypes registers it), and
+            # an arr of bfloat16 would VALUE-cast the uint16 bit patterns
+            # instead of reinterpreting them.
+            sd = {"bfloat16": np.dtype(np.uint16),
+                  "float8_e4m3fn": np.dtype(np.uint8),
+                  "float8_e5m2": np.dtype(np.uint8)}.get(
+                store_dtype, None) or np.dtype(store_dtype)
             # np.zeros is calloc-backed: pages only materialize where
             # shards are written, so RAM cost ≈ the bytes actually needed
             arr = np.zeros(tuple(entry["shape"]), sd)

@@ -134,8 +134,17 @@ class BertModel(TrainModule):
 
     # ---------------- forward ----------------
     def encode(self, params, input_ids, token_type_ids=None,
-               attention_mask=None, rng=None, train: bool = True):
-        """→ sequence output [B, T, D]."""
+               attention_mask=None, rng=None, train: bool = True,
+               pld_theta=None):
+        """→ sequence output [B, T, D].
+
+        ``pld_theta``: progressive-layer-drop keep-probability scalar (the
+        engine injects it per step when ``progressive_layer_drop`` is
+        enabled, runtime/engine.py; schedule in
+        runtime/progressive_layer_drop.py — reference engine.py:189-190,
+        787-788).  Layer i keeps with p_i = 1 - (i/L)(1-θ) — deeper
+        layers drop more, per the PLD paper's depth schedule; dropped
+        layers pass the residual through unchanged.  Eval ignores it."""
         cfg = self.config
         B, T = input_ids.shape
         if T > cfg.max_position_embeddings:
@@ -160,24 +169,41 @@ class BertModel(TrainModule):
                         )[:, None, None, :] * -10000.0
 
         layer = self.layer
+        L = cfg.num_hidden_layers
 
         def body(carry, xs):
             h = carry
             lp, i = xs
             lrng = jax.random.fold_in(rng, i)
-            return layer(lp, h, add_mask, lrng, train), None
+            if pld_theta is not None and train:
+                # lax.cond (not where): a dropped layer must SKIP its
+                # FLOPs at runtime — the throughput gain is the point of
+                # PLD, not just the regularization
+                p_keep = 1.0 - (i.astype(jnp.float32) / L) * (
+                    1.0 - pld_theta.astype(jnp.float32))
+                keep = jax.random.bernoulli(
+                    jax.random.fold_in(lrng, 131), p_keep)
+                y = jax.lax.cond(
+                    keep,
+                    lambda hh: layer(lp, hh, add_mask, lrng, train),
+                    lambda hh: hh, h)
+            else:
+                y = layer(lp, h, add_mask, lrng, train)
+            return y, None
 
         body_fn = jax.checkpoint(body) if cfg.remat == "block" else body
         x, _ = jax.lax.scan(
-            body_fn, x,
-            (params["layers"], jnp.arange(cfg.num_hidden_layers)))
+            body_fn, x, (params["layers"], jnp.arange(L)))
         return x
 
     def apply(self, params, batch, rng=None, train: bool = True):
         """→ (mlm_logits [B, T, V], nsp_logits [B, 2])."""
+        pld = batch.get("pld_theta")
         seq = self.encode(params, batch["input_ids"],
                           batch.get("token_type_ids"),
-                          batch.get("attention_mask"), rng, train)
+                          batch.get("attention_mask"), rng, train,
+                          pld_theta=(pld.reshape(-1)[0]
+                                     if pld is not None else None))
         # MLM head
         h = seq @ params["mlm_transform_w"].astype(seq.dtype) \
             + params["mlm_transform_b"].astype(seq.dtype)

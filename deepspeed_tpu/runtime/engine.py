@@ -199,8 +199,13 @@ class DeepSpeedEngine:
             self._compute_shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), cspecs,
                 is_leaf=lambda x: isinstance(x, P))
-            master = jax.jit(self._offload_flatten,
-                             out_shardings=flat_host)(master)
+            # two-stage init staging: a plain jit flatten, then an eager
+            # device_put into host memory — jit-with-host-out_shardings is
+            # the one pattern the axon platform's compiler has been seen
+            # to stall on, and init is not worth the risk
+            master = jax.device_put(
+                jax.jit(self._offload_flatten,
+                        out_shardings=flat_dev)(master), flat_host)
             opt_state = FusedAdamState(
                 count=jax.device_put(jnp.zeros([], jnp.int32),
                                      NamedSharding(self.mesh, P())),

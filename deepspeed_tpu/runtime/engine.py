@@ -199,10 +199,13 @@ class DeepSpeedEngine:
             self._compute_shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), cspecs,
                 is_leaf=lambda x: isinstance(x, P))
-            # two-stage init staging: a plain jit flatten, then an eager
-            # device_put into host memory — jit-with-host-out_shardings is
-            # the one pattern the axon platform's compiler has been seen
-            # to stall on, and init is not worth the risk
+            # two-stage init staging: a plain jit flatten to device, then
+            # an eager device_put into host memory.  The init-time
+            # flatten-with-host-out_shardings compile was observed to
+            # stall on the axon platform (unconfirmed whether the step
+            # compile shares the trigger — it could not be re-tested while
+            # the TPU tunnel was down); init has a cheap workaround, so
+            # take it.
             master = jax.device_put(
                 jax.jit(self._offload_flatten,
                         out_shardings=flat_dev)(master), flat_host)

@@ -329,7 +329,6 @@ def test_bert_consumes_pld_theta():
     (round-1 verdict: only a test model did).  θ=1 keeps every layer
     (identical to no-PLD); θ<1 changes the traced output in train mode
     and leaves eval untouched."""
-    import jax.numpy as jnp
     from deepspeed_tpu.models import BertConfig, BertModel
 
     cfg = BertConfig(vocab_size=64, hidden_size=32, num_hidden_layers=4,

@@ -16,11 +16,47 @@ Environment knobs:
 """
 import json
 import os
+import signal
 import sys
 import time
 import traceback
 
 import numpy as np
+
+_T0 = time.perf_counter()
+
+
+def _mark(msg):
+    """Timestamped progress marker on stderr — the driver's log tail shows
+    where time went if a phase is slow (compile, init, transfers)."""
+    print(f"[bench {time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+class _Watchdog:
+    """SIGALRM deadline around the 1.5B attempt so a pathologically slow
+    phase degrades to the 124M fallback instead of eating the driver's
+    whole time budget.  Limitation: the handler fires between Python
+    bytecodes, so a hang fully inside one native call (a wedged tunnel
+    RPC) is not interruptible — but then the fallback's device calls would
+    hang on the same dead tunnel anyway, which is why this is in-process
+    rather than a kill-subprocess design (killing a TPU client mid-step
+    can wedge the tunnel for the fallback too)."""
+
+    def __init__(self, seconds: int):
+        self.seconds = seconds
+
+    def __enter__(self):
+        def on_alarm(signum, frame):
+            raise TimeoutError(f"bench watchdog fired after {self.seconds}s")
+        self._prev = signal.signal(signal.SIGALRM, on_alarm)
+        signal.alarm(self.seconds)
+        return self
+
+    def __exit__(self, *exc):
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, self._prev)
+        return False
 
 # Published bf16 peak FLOPs per chip by device kind.  Resolution must be
 # loud: an assumed peak silently misstates MFU (round-1 verdict).
@@ -94,10 +130,13 @@ def _bench_15b(jax):
         "zero_optimization": {"stage": 2, "cpu_offload": True,
                               "offload_impl": "xla"},
     }, world_size=1)
+    _mark("1.5B: constructing engine (param init + host staging)")
     engine = DeepSpeedEngine(GPT2Model(cfg_model), ds_cfg, mesh=mesh)
+    _mark("1.5B: engine ready; compiling + first step")
     tokens = np.random.default_rng(0).integers(
         0, cfg_model.vocab_size, (micro * ga, seq + 1), dtype=np.int32)
     dt, _ = _run(engine, tokens, steps)
+    _mark(f"1.5B: measured {dt:.2f}s/step")
     tokens_per_sec = micro * ga * seq / dt
     return cfg_model, seq, tokens_per_sec, "gpt2_1p5b_zero2_offload"
 
@@ -122,10 +161,13 @@ def _bench_124m(jax):
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "zero_optimization": {"stage": 0},
     }, world_size=1)
+    _mark("124M: constructing engine")
     engine = DeepSpeedEngine(GPT2Model(cfg_model), ds_cfg, mesh=mesh)
+    _mark("124M: engine ready; compiling + warmup")
     tokens = np.random.default_rng(0).integers(
         0, cfg_model.vocab_size, (batch, seq + 1), dtype=np.int32)
     dt, _ = _run(engine, tokens, steps, warmup=2)
+    _mark(f"124M: measured {dt:.3f}s/step")
     tokens_per_sec = batch * seq / dt
     return cfg_model, seq, tokens_per_sec, "gpt2_124m_zero0"
 
@@ -167,7 +209,9 @@ def main():
     result = None
     if not os.environ.get("BENCH_SMALL"):
         try:
-            result = _bench_15b(jax)
+            deadline = int(os.environ.get("BENCH_15B_TIMEOUT", "1500"))
+            with _Watchdog(deadline):
+                result = _bench_15b(jax)
         except Exception:
             # fall back OUTSIDE the except block: the live traceback pins
             # the failed attempt's engine/HBM buffers, which would make an
@@ -189,4 +233,12 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception:
+        # The driver parses exactly one JSON line; a crash must still
+        # produce one (value 0) rather than an empty record.
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({"metric": "bench_failed", "value": 0.0,
+                          "unit": "tokens/s", "vs_baseline": 0.0}))
+        sys.exit(0)

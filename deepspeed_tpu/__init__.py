@@ -13,10 +13,18 @@ from typing import Any, Optional
 
 from .version import __version__
 from .config import DeepSpeedConfig, DeepSpeedConfigError
+from .config.constants import ADAM_OPTIMIZER, LAMB_OPTIMIZER
 from .parallel.distributed import init_distributed
 from .runtime.engine import DeepSpeedEngine
 from .runtime.module import TrainModule, FunctionalModule, FlaxModule
 from .runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from .runtime.lr_schedules import add_tuning_arguments
+from .runtime.activation_checkpointing import checkpointing
+from .utils.logging import log_dist
+from .ops.transformer import (DeepSpeedTransformerLayer,
+                              DeepSpeedTransformerConfig)
+from .pipe.module import PipelineModule
+from .pipe.engine import PipelineEngine
 
 
 def initialize(args=None,

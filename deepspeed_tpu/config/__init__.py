@@ -7,5 +7,6 @@ from .config import (
     DeepSpeedActivationCheckpointingConfig,
     DeepSpeedSparseAttentionConfig,
     DeepSpeedPipelineConfig,
+    DeepSpeedConfigWriter,
 )
 from . import constants

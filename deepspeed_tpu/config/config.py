@@ -74,6 +74,9 @@ class DeepSpeedZeroConfig:
             zero, C.ZERO_OFFLOAD_IMPL, C.ZERO_OFFLOAD_IMPL_DEFAULT)
         self.elastic_checkpoint = get_scalar_param(
             zero, C.ZERO_ELASTIC_CHECKPOINT, C.ZERO_ELASTIC_CHECKPOINT_DEFAULT)
+        self.pg_correctness_test = get_scalar_param(
+            zero, C.ZERO_PG_CORRECTNESS_TEST,
+            C.ZERO_PG_CORRECTNESS_TEST_DEFAULT)
         if self.offload_impl not in ("auto", "xla", "host"):
             raise DeepSpeedConfigError(
                 f"{C.ZERO_OFFLOAD_IMPL} must be 'auto', 'xla', or 'host', "
@@ -178,6 +181,26 @@ class DeepSpeedPLDConfig:
         self.gamma = get_scalar_param(pld, C.PLD_GAMMA, C.PLD_GAMMA_DEFAULT)
 
 
+class DeepSpeedProfilerConfig:
+    """xplane trace window: capture steps ``[start_step,
+    start_step + num_steps)`` to ``output_path`` via jax.profiler."""
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        prof = param_dict.get(C.PROFILER) or {}
+        self.enabled = get_scalar_param(
+            prof, C.PROFILER_ENABLED, C.PROFILER_ENABLED_DEFAULT)
+        self.start_step = get_scalar_param(
+            prof, C.PROFILER_START_STEP, C.PROFILER_START_STEP_DEFAULT)
+        self.num_steps = get_scalar_param(
+            prof, C.PROFILER_NUM_STEPS, C.PROFILER_NUM_STEPS_DEFAULT)
+        self.output_path = get_scalar_param(
+            prof, C.PROFILER_OUTPUT_PATH, C.PROFILER_OUTPUT_PATH_DEFAULT)
+        if self.enabled and (self.start_step < 0 or self.num_steps < 1):
+            raise DeepSpeedConfigError(
+                f"profiler window invalid: start_step={self.start_step} "
+                f"num_steps={self.num_steps}")
+
+
 class DeepSpeedTensorboardConfig:
     def __init__(self, param_dict: Dict[str, Any]):
         tb = param_dict.get(C.TENSORBOARD) or {}
@@ -201,6 +224,27 @@ class DeepSpeedPipelineConfig:
         self.activation_checkpoint_interval = get_scalar_param(
             pipe, C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL,
             C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT)
+
+
+class DeepSpeedConfigWriter:
+    """Build/modify ds_config json files from templates
+    (reference: runtime/config.py:468-482 — used by launch scripts to
+    tweak parameters from the command line)."""
+
+    def __init__(self, data: Optional[Dict[str, Any]] = None):
+        self.data = data if data is not None else {}
+
+    def add_config(self, key: str, value: Any) -> None:
+        self.data[key] = value
+
+    def load_config(self, filename: str) -> None:
+        with open(filename) as f:
+            self.data = json.load(
+                f, object_pairs_hook=_dict_raise_error_on_duplicate_keys)
+
+    def write_config(self, filename: str) -> None:
+        with open(filename, "w") as outfile:
+            json.dump(self.data, outfile)
 
 
 class DeepSpeedConfig:
@@ -267,6 +311,7 @@ class DeepSpeedConfig:
         self.sparse_attention_config = DeepSpeedSparseAttentionConfig(pd)
         self.pld_config = DeepSpeedPLDConfig(pd)
         self.tensorboard_config = DeepSpeedTensorboardConfig(pd)
+        self.profiler_config = DeepSpeedProfilerConfig(pd)
         self.pipeline_config = DeepSpeedPipelineConfig(pd)
 
         self._solve_batch_triangle()

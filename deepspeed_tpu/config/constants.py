@@ -123,6 +123,12 @@ ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_ELASTIC_CHECKPOINT_DEFAULT = True
 ZERO_MAX_ELEMENTS_PER_COMM = "max_elements_per_comm"
 ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT = 500_000_000
+# Partitioning-correctness debug toggle (the reference's module-level
+# ``pg_correctness_test`` in stage2.py:23-25 — here a config key): the
+# engine diffs plan-sharded gradients against an unconstrained replicated
+# reduction on the first step and raises on mismatch.
+ZERO_PG_CORRECTNESS_TEST = "pg_correctness_test"
+ZERO_PG_CORRECTNESS_TEST_DEFAULT = False
 
 #############################################
 # Activation checkpointing (rematerialization on TPU)
@@ -204,6 +210,20 @@ MEMORY_BREAKDOWN_DEFAULT = False
 
 DUMP_STATE = "dump_state"
 DUMP_STATE_DEFAULT = False
+
+# XLA/xplane trace capture (TPU-native upgrade of the reference's
+# cuda-synchronized named timers, utils/timer.py there; SURVEY §5.1 notes
+# the reference ships no external tracer — on TPU the jax.profiler xplane
+# trace is the native equivalent, viewable in tensorboard-profile/xprof).
+PROFILER = "profiler"
+PROFILER_ENABLED = "enabled"
+PROFILER_ENABLED_DEFAULT = False
+PROFILER_START_STEP = "start_step"
+PROFILER_START_STEP_DEFAULT = 2       # skip compile on step 0/1
+PROFILER_NUM_STEPS = "num_steps"
+PROFILER_NUM_STEPS_DEFAULT = 3
+PROFILER_OUTPUT_PATH = "output_path"
+PROFILER_OUTPUT_PATH_DEFAULT = "/tmp/deepspeed_tpu_profile"
 
 TENSORBOARD = "tensorboard"
 TENSORBOARD_ENABLED = "enabled"

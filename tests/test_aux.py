@@ -393,3 +393,46 @@ def test_bert_pld_via_engine():
         loss = engine.train_batch(dict(batch))
     assert np.isfinite(float(np.asarray(loss)))
     assert engine.progressive_layer_drop.get_theta() < 1.0
+
+
+def test_profiler_trace_window(tmp_path):
+    """The profiler config block captures an xplane trace over the step
+    window (TPU-native tracer slot, SURVEY §5.1)."""
+    from simple_model import SimpleModel, base_config, random_batches
+    from deepspeed_tpu.config import DeepSpeedConfig
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    out = str(tmp_path / "trace")
+    cfg = DeepSpeedConfig(
+        base_config(micro_bs=4, stage=0,
+                    profiler={"enabled": True, "start_step": 1,
+                              "num_steps": 2, "output_path": out}),
+        world_size=8)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=8), cfg, mesh=build_mesh())
+    for b in random_batches(32, 8, num_batches=5):
+        eng.train_batch(b)
+    assert not eng._profiler_active  # window closed by step 3
+    import glob
+    traces = glob.glob(out + "/**/*.xplane.pb", recursive=True)
+    assert traces, f"no xplane trace under {out}"
+
+
+def test_profiler_stop_escape_hatch(tmp_path):
+    from simple_model import SimpleModel, base_config, random_batches
+    from deepspeed_tpu.config import DeepSpeedConfig
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    out = str(tmp_path / "trace2")
+    cfg = DeepSpeedConfig(
+        base_config(micro_bs=4, stage=0,
+                    profiler={"enabled": True, "start_step": 0,
+                              "num_steps": 100, "output_path": out}),
+        world_size=8)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=8), cfg, mesh=build_mesh())
+    eng.train_batch(next(random_batches(32, 8)))
+    assert eng._profiler_active
+    eng.stop_profiler()
+    assert not eng._profiler_active
+    eng.stop_profiler()  # idempotent

@@ -160,3 +160,29 @@ def test_unknown_optimizer_params_passthrough():
     }, world_size=1)
     assert cfg.optimizer_name == "lamb"
     assert cfg.optimizer_params["max_coeff"] == 5.0
+
+
+def test_config_writer_roundtrip(tmp_path):
+    """reference: runtime/config.py:468-482."""
+    from deepspeed_tpu.config import DeepSpeedConfigWriter
+
+    w = DeepSpeedConfigWriter({"train_batch_size": 8})
+    w.add_config("gradient_clipping", 1.0)
+    path = str(tmp_path / "ds_config.json")
+    w.write_config(path)
+
+    r = DeepSpeedConfigWriter()
+    r.load_config(path)
+    assert r.data == {"train_batch_size": 8, "gradient_clipping": 1.0}
+
+    # duplicate keys rejected on load, same as DeepSpeedConfig
+    bad = tmp_path / "dup.json"
+    bad.write_text('{"a": 1, "a": 2}')
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        r.load_config(str(bad))
+
+
+def test_ops_optimizer_aliases():
+    from deepspeed_tpu.ops import FusedAdam, FusedLamb, fused_adam, fused_lamb
+    assert FusedAdam is fused_adam and FusedLamb is fused_lamb

@@ -114,3 +114,54 @@ def test_engine_overflow_skips_step():
     assert eng.get_skipped_steps() == 1
     assert float(eng.state.scaler.loss_scale) == 2 ** 7
     np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+
+
+def test_lamb_fp16_unfused_contract():
+    """LAMB under fp16 — the reference routes this through the *unfused*
+    wrapper (runtime/fp16/unfused_optimizer.py:42-63: per-tensor fp32
+    masters, no flattening, because LAMB needs per-tensor norms).  Here the
+    master is a per-tensor fp32 pytree by construction; this test pins that
+    contract (mirrors reference test_fp16.py:54 test_lamb_fp16_basic)."""
+    cfg = DeepSpeedConfig(
+        base_config(micro_bs=4, stage=0, precision="fp16",
+                    optimizer={"type": "lamb",
+                               "params": {"lr": 1e-2}},
+                    **{"fp16": {"enabled": True,
+                                "initial_scale_power": 8}}),
+        world_size=8)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=8), cfg, mesh=build_mesh())
+
+    # per-tensor fp32 master: every leaf keeps its own shape and dtype
+    masters = jax.tree.leaves(eng.state.master_params)
+    assert all(m.dtype == jnp.float32 for m in masters)
+    assert len(masters) == len(jax.tree.leaves(eng.module.init(
+        jax.random.PRNGKey(0))))
+
+    losses = [float(np.asarray(eng.train_batch(b)))
+              for b in random_batches(32, 8, num_batches=6, seed=3)]
+    assert losses[-1] < losses[0]
+    assert eng.get_skipped_steps() == 0
+
+
+def test_lamb_fp16_overflow_skip():
+    """Overflow-skip must work on the LAMB path too (reference:
+    unfused_optimizer.py step/overflow handling + step_fused_lamb :118)."""
+    class ExplodingModel(SimpleModel):
+        def loss_fn(self, params, batch, rng, train=True):
+            return super().loss_fn(params, batch, rng, train) * 1e38
+
+    cfg = DeepSpeedConfig(
+        base_config(micro_bs=4, stage=0, precision="fp16",
+                    optimizer={"type": "lamb", "params": {"lr": 1e-2}},
+                    **{"fp16": {"enabled": True, "initial_scale_power": 8,
+                                "hysteresis": 1}}),
+        world_size=8)
+    eng = DeepSpeedEngine(ExplodingModel(hidden_dim=8), cfg,
+                          mesh=build_mesh())
+    before = jax.tree.leaves(eng.state.master_params)[0].copy()
+    eng.train_batch(next(random_batches(32, 8)))
+    assert eng.get_skipped_steps() == 1
+    assert float(eng.state.scaler.loss_scale) == 2 ** 7
+    np.testing.assert_array_equal(
+        np.asarray(before), np.asarray(jax.tree.leaves(
+            eng.state.master_params)[0]))

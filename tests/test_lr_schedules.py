@@ -73,3 +73,46 @@ def test_registry():
         assert np.isfinite(_at(sched, 5))
     with pytest.raises(ValueError):
         get_lr_schedule("Nope", {})
+
+
+def test_add_tuning_arguments_roundtrip():
+    """CLI tuning flags -> scheduler config block -> live schedule
+    (reference: lr_schedules.py:54-160)."""
+    import argparse
+    from deepspeed_tpu.runtime.lr_schedules import (
+        add_tuning_arguments, parse_arguments, schedule_params_from_args)
+
+    parser = add_tuning_arguments(argparse.ArgumentParser())
+    args = parser.parse_args(
+        ["--lr_schedule", "OneCycle", "--cycle_min_lr", "0.0",
+         "--cycle_max_lr", "1.0", "--cycle_first_step_size", "10",
+         "--cycle_second_step_size", "10"])
+    blk = schedule_params_from_args(args)
+    assert blk["type"] == "OneCycle"
+    sched = get_lr_schedule(blk["type"], blk["params"])
+    assert abs(_at(sched, 10) - 1.0) < 1e-6
+
+    # unset --lr_schedule -> no block (engine falls back to config json)
+    assert schedule_params_from_args(parser.parse_args([])) is None
+
+    # parse_arguments tolerates unknown flags (reference parse_known_args)
+    import sys
+    argv = sys.argv
+    sys.argv = ["prog", "--lr_schedule", "WarmupLR", "--not_a_ds_flag", "1"]
+    try:
+        parsed, unknown = parse_arguments()
+        assert parsed.lr_schedule == "WarmupLR"
+        assert "--not_a_ds_flag" in unknown
+    finally:
+        sys.argv = argv
+
+
+def test_top_level_export_parity():
+    """Reference deepspeed/__init__.py re-exports (SURVEY L6)."""
+    import deepspeed_tpu as ds
+    for name in ["initialize", "add_config_arguments", "add_tuning_arguments",
+                 "DeepSpeedEngine", "PipelineEngine", "DeepSpeedConfig",
+                 "PipelineModule", "DeepSpeedTransformerLayer",
+                 "DeepSpeedTransformerConfig", "log_dist", "checkpointing",
+                 "ADAM_OPTIMIZER", "LAMB_OPTIMIZER", "__version__"]:
+        assert hasattr(ds, name), name

@@ -304,8 +304,13 @@ class DeepSpeedEngine:
         # partitioning-correctness sweep on the first step when enabled
         # (reference stage2.py:23-25 pg_correctness_test)
         self._pg_check_pending = bool(
-            getattr(config.zero_config, "pg_correctness_test", False)
-            and not self._offload)
+            getattr(config.zero_config, "pg_correctness_test", False))
+        if self._pg_check_pending and self._offload:
+            logger.warning(
+                "pg_correctness_test is not supported with cpu_offload "
+                "(the offload tiers have their own differential tests); "
+                "the requested check will NOT run")
+            self._pg_check_pending = False
         self._pending_micros = []
         self._tb_pending = []
         self._last_metrics: Optional[StepMetrics] = None
@@ -533,7 +538,10 @@ class DeepSpeedEngine:
         if p is None:
             return
         if (not self._profiler_active
-                and self.global_steps >= p.start_step):
+                and p.start_step <= self.global_steps
+                < p.start_step + p.num_steps):
+            # upper bound matters: a run resumed from a checkpoint past the
+            # window must not open a stray one-step trace
             jax.profiler.start_trace(p.output_path)
             self._profiler_active = True
         elif (self._profiler_active

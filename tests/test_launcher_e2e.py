@@ -1,0 +1,91 @@
+"""Launcher end-to-end: ``bin/ds`` → per-node launch → user script, all on
+localhost (the reference tests only the parsing layer, test_run.py; the
+spawn chain itself is exercised here — single-node, ``--launcher local``).
+Also the argparse-injection analogue of reference test_ds_arguments.py."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_ds(tmp_path, extra_args, script_body, hostfile_lines=None,
+            timeout=60):
+    script = tmp_path / "user_script.py"
+    script.write_text(script_body)
+    out = tmp_path / "out.json"
+    args = [sys.executable, os.path.join(REPO, "bin", "ds")]
+    if hostfile_lines is not None:
+        hf = tmp_path / "hostfile"
+        hf.write_text("\n".join(hostfile_lines) + "\n")
+        args += ["--hostfile", str(hf)]
+    else:
+        args += ["--hostfile", str(tmp_path / "missing_hostfile")]
+    args += extra_args + [str(script), str(out)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(args, env=env, timeout=timeout,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(out.read_text())
+
+
+_ENV_DUMP = """\
+import json, os, sys
+keys = ["RANK", "WORLD_SIZE", "MASTER_ADDR", "MASTER_PORT",
+        "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+        "TPU_VISIBLE_CHIPS"]
+json.dump({k: os.environ.get(k) for k in keys}, open(sys.argv[1], "w"))
+"""
+
+
+def test_ds_single_node_hostfile_spawn_chain(tmp_path):
+    """hostfile path: runner encodes world info, launch decodes it and
+    execs the user script with the jax.distributed env contract."""
+    env = _run_ds(tmp_path, ["--launcher", "local"], _ENV_DUMP,
+                  hostfile_lines=["localhost slots=2"])
+    assert env["JAX_PROCESS_ID"] == "0"
+    assert env["JAX_NUM_PROCESSES"] == "1"
+    assert env["JAX_COORDINATOR_ADDRESS"].startswith("localhost:")
+    assert env["RANK"] == "0"
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+
+
+def test_ds_no_hostfile_direct_exec(tmp_path):
+    """No hostfile → in-place single-host exec with chip visibility."""
+    env = _run_ds(tmp_path, ["--num_gpus", "2"], _ENV_DUMP)
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert env["RANK"] is None  # no multi-host contract in this mode
+
+
+def test_ds_num_gpus_slices_slots(tmp_path):
+    env = _run_ds(tmp_path, ["--launcher", "local", "--num_gpus", "1"],
+                  _ENV_DUMP, hostfile_lines=["localhost slots=4"])
+    assert env["TPU_VISIBLE_CHIPS"] == "0"
+
+
+def test_add_config_arguments_parsing():
+    """reference: tests/unit/test_ds_arguments.py — argparse injection."""
+    import argparse
+    import deepspeed_tpu
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--local_rank", type=int, default=0)
+    parser = deepspeed_tpu.add_config_arguments(parser)
+
+    args = parser.parse_args(
+        ["--deepspeed", "--deepspeed_config", "ds.json"])
+    assert args.deepspeed is True
+    assert args.deepspeed_config == "ds.json"
+    assert args.local_rank == 0
+
+    # defaults when absent
+    args = parser.parse_args([])
+    assert args.deepspeed is False
+    assert args.deepspeed_config is None
+
+    # deprecated aliases accepted
+    args = parser.parse_args(["--deepscale", "--deepscale_config", "x.json"])
+    assert args.deepscale is True
+    assert args.deepscale_config == "x.json"

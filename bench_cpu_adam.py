@@ -1,0 +1,80 @@
+"""CPU-Adam kernel microbenchmark — the analogue of the reference's
+tests/perf/adam_test.py (it times DeepSpeedCPUAdam on large tensors; the
+reference claims 5-7x over torch.optim.Adam, ops/adam/cpu_adam.py:18 there).
+
+Times one optimizer step over a large fp32 parameter buffer for:
+  native   — the C++ SIMD/OpenMP kernel (csrc/cpu_adam.cpp) with fused
+             bf16 copy-out (the ZeRO-Offload hot loop)
+  numpy    — the pure-numpy fallback path
+  torch    — torch.optim.Adam (the reference's comparison target)
+
+Prints one JSON line; vs_baseline = torch_time / native_time / 5.0
+(>=1 matches the low end of the reference's 5-7x claim).
+"""
+import json
+import time
+
+import numpy as np
+
+N = 50_000_000  # 50M params ~ 200 MB fp32, matches the reference's scale
+STEPS = 5
+
+
+def _time(fn, steps=STEPS):
+    fn()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        fn()
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    import sys
+    sys.path.insert(0, ".")
+    from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam
+
+    rng = np.random.default_rng(0)
+    grads = rng.standard_normal(N).astype(np.float32) * 1e-3
+
+    results = {}
+
+    p_nat = rng.standard_normal(N).astype(np.float32)
+    opt_nat = DeepSpeedCPUAdam(lr=1e-3, use_native=True)
+    results["native_s"] = _time(
+        lambda: opt_nat.step(p_nat, grads, out_dtype="bfloat16"))
+
+    p_np = p_nat.copy()
+    opt_np = DeepSpeedCPUAdam(lr=1e-3, use_native=False)
+    results["numpy_s"] = _time(
+        lambda: opt_np.step(p_np, grads, out_dtype="bfloat16"))
+
+    try:
+        import torch
+        tp = torch.from_numpy(p_nat.copy())
+        tp.grad = torch.from_numpy(grads.copy())
+        topt = torch.optim.Adam([tp], lr=1e-3)
+        results["torch_s"] = _time(lambda: topt.step())
+    except Exception:
+        results["torch_s"] = None
+
+    native = results["native_s"]
+    speedup_torch = (results["torch_s"] / native
+                     if results["torch_s"] else 0.0)
+    speedup_numpy = results["numpy_s"] / native
+    import os
+    out = {
+        "metric": "cpu_adam_native_step_time_50m",
+        "value": round(native, 4),
+        "unit": "s/step",
+        "speedup_vs_torch": round(speedup_torch, 2),
+        "speedup_vs_numpy": round(speedup_numpy, 2),
+        # the reference's 5-7x is measured on many-core hosts; the OpenMP
+        # scaling that delivers it needs cores (record how many we had)
+        "cpu_count": os.cpu_count(),
+        "vs_baseline": round(speedup_torch / 5.0, 4),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""Peak trainable parameters on ONE chip with ZeRO-Offload — the second
+BASELINE metric (BASELINE.md:26; the reference's headline is 13B on a
+32 GB V100 with CPU offload vs 1.4B plain DP, features.md:115 there).
+
+Binary-searches GPT-2 depth (d_model fixed at 1600) for the largest model
+that completes one full training step, twice: with the XLA host-offload
+tier (fp32 master + moments in pinned host memory) and without offload
+(fp32 state in HBM).  Reports both and the ratio — the "10x larger models"
+claim is the ratio.  Writes BENCH_capacity.json.
+
+Each probe runs in a fresh subprocess: an OOM'd XLA client can leave HBM
+fragmented, and a clean exit releases everything deterministically.
+"""
+import json
+import os
+import subprocess
+import sys
+
+PROBE = """
+import sys
+import numpy as np
+import jax
+sys.path.insert(0, {repo!r})
+from deepspeed_tpu.config import DeepSpeedConfig
+from deepspeed_tpu.models import GPT2Config, GPT2Model
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+n_layer, offload = int(sys.argv[1]), bool(int(sys.argv[2]))
+if len(sys.argv) > 3 and sys.argv[3] == "smoke":  # CPU plumbing check
+    jax.config.update("jax_platforms", "cpu")
+    cfg_model = GPT2Config(d_model=64, n_layer=n_layer, n_head=4,
+                           vocab_size=256, n_positions=64, remat=None)
+else:
+    cfg_model = GPT2Config(d_model=1600, n_layer=n_layer, n_head=25,
+                           vocab_size=50257, n_positions=1024,
+                           remat="block", scan_layers=True)
+zero = {{"stage": 2, "cpu_offload": True, "offload_impl": "xla"}} if offload \
+    else {{"stage": 0}}
+ds_cfg = DeepSpeedConfig({{
+    "train_micro_batch_size_per_gpu": 1,
+    "gradient_accumulation_steps": 1,
+    "steps_per_print": 10 ** 9,
+    "bf16": {{"enabled": True}},
+    "optimizer": {{"type": "Adam", "params": {{"lr": 1e-4}}}},
+    "zero_optimization": zero,
+}}, world_size=1)
+engine = DeepSpeedEngine(GPT2Model(cfg_model), ds_cfg,
+                         mesh=build_mesh(devices=jax.devices()[:1]))
+tokens = np.zeros((1, min(cfg_model.n_positions, 1024) + 1), dtype=np.int32)
+loss = float(np.asarray(engine.train_batch(tokens)))
+assert np.isfinite(loss), loss
+print("PROBE_OK", cfg_model.num_params)
+"""
+
+
+def _probe(n_layer: int, offload: bool, timeout: int,
+           smoke: bool = False) -> int:
+    """Return param count if one step trains at this depth, else 0."""
+    argv = [sys.executable, "-u", "-c",
+            PROBE.format(repo=os.path.dirname(os.path.abspath(__file__))),
+            str(n_layer), str(int(offload))]
+    if smoke:
+        argv.append("smoke")
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # a wedged probe near the OOM boundary counts as a failed size —
+        # the bisection must continue, not abort
+        print(f"  probe n_layer={n_layer} offload={offload} timed out "
+              f"after {timeout}s", file=sys.stderr)
+        return 0
+    for line in proc.stdout.splitlines():
+        if line.startswith("PROBE_OK"):
+            return int(line.split()[1])
+    print(f"  probe n_layer={n_layer} offload={offload} failed "
+          f"(rc={proc.returncode}): {proc.stderr.strip()[-300:]}",
+          file=sys.stderr)
+    return 0
+
+
+def _search(offload: bool, lo: int, hi: int, timeout: int):
+    """Largest working n_layer in [lo, hi] by bisection (lo must work)."""
+    best_params = _probe(lo, offload, timeout)
+    if not best_params:
+        return 0, 0
+    best = lo
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        params = _probe(mid, offload, timeout)
+        if params:
+            best, best_params, lo = mid, params, mid
+        else:
+            hi = mid - 1
+    return best, best_params
+
+
+def main():
+    timeout = int(os.environ.get("CAPACITY_PROBE_TIMEOUT", "1200"))
+    if os.environ.get("CAPACITY_SMOKE"):
+        # validate the subprocess plumbing on CPU (no OOM boundary there)
+        ok = _probe(2, False, timeout, smoke=True)
+        ok_off = _probe(2, True, timeout, smoke=True)
+        print(json.dumps({"metric": "capacity_smoke", "value": 1.0,
+                          "unit": "ok",
+                          "vs_baseline": float(bool(ok and ok_off))}))
+        return
+    # v5e: 16 GB HBM.  no-offload holds 14 B/param of fp32 state + bf16
+    # copies -> O(1B); offload keeps only bf16 params+grads on chip.
+    plain_layers, plain_params = _search(False, 8, 96, timeout)
+    off_layers, off_params = _search(True, 32, 512, timeout)
+    ratio = off_params / plain_params if plain_params else 0.0
+    out = {
+        "metric": "offload_peak_trainable_params_per_chip",
+        "value": round(off_params / 1e9, 3),
+        "unit": "B params",
+        "no_offload_params_b": round(plain_params / 1e9, 3),
+        "offload_layers": off_layers,
+        "no_offload_layers": plain_layers,
+        "capacity_ratio": round(ratio, 2),
+        # reference: 10x larger models via offload (BASELINE.md:16)
+        "vs_baseline": round(ratio / 10.0, 4),
+    }
+    print(json.dumps(out))
+    with open("BENCH_capacity.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -58,20 +58,23 @@ def main():
         results["torch_s"] = None
 
     native = results["native_s"]
-    speedup_torch = (results["torch_s"] / native
-                     if results["torch_s"] else 0.0)
+    # None (not 0.0) when torch is unavailable: "comparison missing" must
+    # be distinguishable from "infinitely slower"
+    speedup_torch = (round(results["torch_s"] / native, 2)
+                     if results["torch_s"] else None)
     speedup_numpy = results["numpy_s"] / native
     import os
     out = {
         "metric": "cpu_adam_native_step_time_50m",
         "value": round(native, 4),
         "unit": "s/step",
-        "speedup_vs_torch": round(speedup_torch, 2),
+        "speedup_vs_torch": speedup_torch,
         "speedup_vs_numpy": round(speedup_numpy, 2),
         # the reference's 5-7x is measured on many-core hosts; the OpenMP
         # scaling that delivers it needs cores (record how many we had)
         "cpu_count": os.cpu_count(),
-        "vs_baseline": round(speedup_torch / 5.0, 4),
+        "vs_baseline": (round(speedup_torch / 5.0, 4)
+                        if speedup_torch is not None else 0.0),
     }
     print(json.dumps(out))
 

@@ -172,10 +172,23 @@ def _bench_124m(jax):
     return cfg_model, seq, tokens_per_sec, "gpt2_124m_zero0"
 
 
+def guarded_devices():
+    """jax.devices() under a deadline — enumeration itself can hang when
+    the TPU tunnel is wedged (observed: blocking indefinitely).  Shared by
+    every bench script; best-effort (SIGALRM can't interrupt a call that
+    never returns to Python, but then nothing could)."""
+    import jax
+    _mark("enumerating devices")
+    with _Watchdog(int(os.environ.get("BENCH_DEVICES_TIMEOUT", "300"))):
+        devices = jax.devices()
+    _mark(f"devices: {[d.device_kind for d in devices]}")
+    return devices
+
+
 def main():
     import jax
 
-    devices = jax.devices()
+    devices = guarded_devices()
     on_tpu = devices[0].platform != "cpu"
     sys.path.insert(0, ".")
 

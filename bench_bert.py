@@ -30,13 +30,13 @@ def main():
     import jax
 
     sys.path.insert(0, ".")
-    from bench import _resolve_peak, _mark
+    from bench import _resolve_peak, _mark, guarded_devices
     from deepspeed_tpu.config import DeepSpeedConfig
     from deepspeed_tpu.models.bert import BERT_LARGE, BertModel
     from deepspeed_tpu.parallel import build_mesh
     from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 
-    devices = jax.devices()
+    devices = guarded_devices()
     on_tpu = devices[0].platform != "cpu"
     peak = _resolve_peak(devices[0]) if on_tpu else 0.0
 

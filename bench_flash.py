@@ -29,10 +29,11 @@ def main():
     import jax.numpy as jnp
 
     sys.path.insert(0, ".")
+    from bench import guarded_devices
     from deepspeed_tpu.ops.attention import causal_attention
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-    on_tpu = jax.devices()[0].platform != "cpu"
+    on_tpu = guarded_devices()[0].platform != "cpu"
     iters = 20 if on_tpu else 2
     B, H, D = (4, 12, 64) if on_tpu else (1, 2, 32)
     seqs = [1024, 4096, 8192] if on_tpu else [128]

@@ -29,8 +29,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    on_tpu = jax.devices()[0].platform != "cpu"
     sys.path.insert(0, ".")
+    from bench import guarded_devices
+    on_tpu = guarded_devices()[0].platform != "cpu"
     from deepspeed_tpu.ops.pallas.block_sparse_attention import (
         block_sparse_attention)
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention

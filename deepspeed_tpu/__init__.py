@@ -12,6 +12,17 @@ import argparse
 from typing import Any, Optional
 
 from .version import __version__
+from . import git_version_info as _gvi
+
+
+def __getattr__(name):
+    # lazily resolved (git subprocesses on first access, not at import);
+    # NOTE: the bare name `version` stays bound to the version submodule
+    if name == "__git_hash__":
+        return _gvi.git_hash
+    if name == "__git_branch__":
+        return _gvi.git_branch
+    raise AttributeError(name)
 from .config import DeepSpeedConfig, DeepSpeedConfigError
 from .config.constants import ADAM_OPTIMIZER, LAMB_OPTIMIZER
 from .parallel.distributed import init_distributed

@@ -25,8 +25,14 @@ def collect_report() -> list:
         lines.append(("devices", f"unavailable ({e})"))
     from .ops.op_builder import cpu_ops_status
     lines.append(("native host ops", cpu_ops_status()))
+    # per-op compatibility matrix (the reference ds_report's main table)
+    from .git_version_info import compatible_ops
+    for op, ok in sorted(compatible_ops.items()):
+        lines.append((f"op {op}", "compatible" if ok else "UNAVAILABLE"))
     from . import __version__
-    lines.append(("deepspeed_tpu", __version__))
+    from .git_version_info import git_hash, git_branch
+    lines.append(("deepspeed_tpu", f"{__version__} "
+                  f"(git {git_hash}, {git_branch})"))
     return lines
 
 

@@ -1,0 +1,58 @@
+"""Build/version identity (reference: deepspeed/git_version_info.py —
+version + git hash/branch + per-op compatibility map consumed by
+ds_report and deepspeed.ops).
+
+The reference bakes these at install time; here the git facts are read
+lazily from the working tree when available (source checkouts are the
+normal deployment for this framework) and fall back to "unknown".
+"""
+from __future__ import annotations
+
+import subprocess
+
+from .version import __version__ as version
+
+
+def _git(*args: str) -> str:
+    try:
+        out = subprocess.run(
+            ("git",) + args, capture_output=True, text=True, timeout=5,
+            cwd=__file__.rsplit("/", 2)[0])
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def __getattr__(name):
+    # Lazy: importing the package must not pay git subprocess roundtrips
+    # or (worse) the cpu-op g++ build — these resolve on first access
+    # (ds_report, version banners), then cache on the module.
+    if name == "git_hash":
+        value = _git("rev-parse", "--short", "HEAD")
+    elif name == "git_branch":
+        value = _git("rev-parse", "--abbrev-ref", "HEAD")
+    elif name == "compatible_ops":
+        value = _op_compat()
+    else:
+        raise AttributeError(name)
+    globals()[name] = value
+    return value
+
+
+def _op_compat() -> dict:
+    """Op-name → installable-here map (reference exposes compatible_ops
+    for ds_report; the only native op on TPU is the host CPU Adam — the
+    rest are XLA/Pallas and always available with jax)."""
+    try:
+        from .ops.op_builder import cpu_ops_available
+        cpu_adam = bool(cpu_ops_available())
+    except Exception:
+        cpu_adam = False
+    return {
+        "cpu_adam": cpu_adam,
+        "fused_adam": True,        # XLA-fused
+        "fused_lamb": True,        # XLA-fused
+        "transformer": True,       # XLA + Pallas flash attention
+        "sparse_attn": True,       # Pallas block-sparse
+        "utils": True,             # pytree flatten (no native op needed)
+    }

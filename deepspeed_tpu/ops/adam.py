@@ -18,6 +18,18 @@ import optax
 ScalarOrSchedule = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
 
 
+def __getattr__(name):
+    # reference-parity namespace: deepspeed.ops.adam exposes FusedAdam and
+    # DeepSpeedCPUAdam (ops/adam/__init__.py there); lazy to avoid pulling
+    # the ctypes loader on ordinary imports
+    if name == "FusedAdam":
+        return fused_adam
+    if name == "DeepSpeedCPUAdam":
+        from .cpu_adam import DeepSpeedCPUAdam
+        return DeepSpeedCPUAdam
+    raise AttributeError(name)
+
+
 class FusedAdamState(NamedTuple):
     count: jnp.ndarray
     mu: optax.Updates

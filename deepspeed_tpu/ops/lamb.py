@@ -19,6 +19,13 @@ import optax
 ScalarOrSchedule = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
 
 
+def __getattr__(name):
+    # reference-parity namespace: deepspeed.ops.lamb.FusedLamb
+    if name == "FusedLamb":
+        return fused_lamb
+    raise AttributeError(name)
+
+
 class FusedLambState(NamedTuple):
     count: jnp.ndarray
     mu: optax.Updates

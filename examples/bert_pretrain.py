@@ -1,0 +1,77 @@
+"""BERT MLM+NSP pretraining with deepspeed_tpu (fused transformer blocks,
+optional sparse attention) — the BingBertSquad/bert-pretrain shape from the
+reference's examples.
+
+    python examples/bert_pretrain.py --cpu --steps 20
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models.bert import BertConfig, BertModel  # noqa: E402
+
+
+def parse_args():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--hidden", type=int, default=256)
+    parser.add_argument("--layers", type=int, default=4)
+    parser.add_argument("--heads", type=int, default=8)
+    parser.add_argument("--cpu", action="store_true")
+    parser = deepspeed_tpu.add_config_arguments(parser)
+    return parser.parse_args()
+
+
+def mlm_batches(vocab, seq, batch, mask_prob=0.15, seed=0):
+    rng = np.random.default_rng(seed)
+    while True:
+        ids = rng.integers(0, vocab, (batch, seq), dtype=np.int32)
+        labels = np.where(rng.random((batch, seq)) < mask_prob, ids,
+                          -100).astype(np.int32)
+        yield {
+            "input_ids": ids,
+            "masked_lm_labels": labels,
+            "next_sentence_label": rng.integers(0, 2, (batch,),
+                                                dtype=np.int32),
+        }
+
+
+def main():
+    args = parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    model = BertModel(BertConfig(
+        vocab_size=8192, hidden_size=args.hidden,
+        num_hidden_layers=args.layers, num_attention_heads=args.heads,
+        intermediate_size=4 * args.hidden,
+        max_position_embeddings=max(args.seq, 128)))
+
+    config = args.deepspeed_config or {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "lamb", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+
+    data = mlm_batches(8192, args.seq, engine.train_batch_size)
+    for step in range(args.steps):
+        loss = engine.train_batch(next(data))
+        if (step + 1) % 10 == 0:
+            print(f"step {step + 1}: loss {float(np.asarray(loss)):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -119,7 +119,11 @@ def _bench_15b(jax):
     cfg_model = GPT2Config(d_model=1600, n_layer=48, n_head=25,
                            vocab_size=50257, n_positions=1024,
                            remat="block", scan_layers=True)
-    micro, ga, seq, steps = 4, 16, 1024, 2
+    # env knobs for on-chip tuning: larger ga amortizes the per-step
+    # host<->HBM master/moment traffic over more compute
+    micro = int(os.environ.get("BENCH_15B_MICRO", "4"))
+    ga = int(os.environ.get("BENCH_15B_GA", "16"))
+    seq, steps = 1024, int(os.environ.get("BENCH_15B_STEPS", "2"))
     mesh = build_mesh(devices=jax.devices()[:1])
     ds_cfg = DeepSpeedConfig({
         "train_micro_batch_size_per_gpu": micro,

@@ -14,10 +14,20 @@ from .version import __version__ as version
 
 
 def _git(*args: str) -> str:
+    """Git facts about the checkout this package lives in — NOT whatever
+    repo happens to enclose a site-packages install: the resolved toplevel
+    must be an ancestor of the package directory."""
+    import os
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
     try:
+        top = subprocess.run(
+            ("git", "-C", pkg_dir, "rev-parse", "--show-toplevel"),
+            capture_output=True, text=True, timeout=5).stdout.strip()
+        if not top or not (pkg_dir + os.sep).startswith(top + os.sep):
+            return "unknown"
         out = subprocess.run(
-            ("git",) + args, capture_output=True, text=True, timeout=5,
-            cwd=__file__.rsplit("/", 2)[0])
+            ("git", "-C", pkg_dir) + args, capture_output=True, text=True,
+            timeout=5)
         return out.stdout.strip() or "unknown"
     except Exception:
         return "unknown"

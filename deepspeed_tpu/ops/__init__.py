@@ -1,9 +1,2 @@
-from .adam import fused_adam, FusedAdamState
-from .lamb import fused_lamb, FusedLambState
-from .cpu_adam import DeepSpeedCPUAdam
-
-# Reference-parity aliases (reference exposes torch Optimizer classes
-# FusedAdam/FusedLamb; here the same roles are optax-style gradient
-# transformations — the factory is the class analogue).
-FusedAdam = fused_adam
-FusedLamb = fused_lamb
+from .adam import fused_adam, FusedAdamState, FusedAdam, DeepSpeedCPUAdam
+from .lamb import fused_lamb, FusedLambState, FusedLamb

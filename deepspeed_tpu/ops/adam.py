@@ -18,18 +18,6 @@ import optax
 ScalarOrSchedule = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
 
 
-def __getattr__(name):
-    # reference-parity namespace: deepspeed.ops.adam exposes FusedAdam and
-    # DeepSpeedCPUAdam (ops/adam/__init__.py there); lazy to avoid pulling
-    # the ctypes loader on ordinary imports
-    if name == "FusedAdam":
-        return fused_adam
-    if name == "DeepSpeedCPUAdam":
-        from .cpu_adam import DeepSpeedCPUAdam
-        return DeepSpeedCPUAdam
-    raise AttributeError(name)
-
-
 class FusedAdamState(NamedTuple):
     count: jnp.ndarray
     mu: optax.Updates
@@ -113,3 +101,10 @@ def fused_adam(lr: ScalarOrSchedule = 1e-3,
         return updates, FusedAdamState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+# reference-parity namespace: deepspeed.ops.adam exposes FusedAdam and
+# DeepSpeedCPUAdam (ops/adam/__init__.py there).  Canonical aliases live
+# HERE; ops/__init__.py re-exports them.
+FusedAdam = fused_adam
+from .cpu_adam import DeepSpeedCPUAdam  # noqa: E402,F401

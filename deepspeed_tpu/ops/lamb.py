@@ -19,13 +19,6 @@ import optax
 ScalarOrSchedule = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
 
 
-def __getattr__(name):
-    # reference-parity namespace: deepspeed.ops.lamb.FusedLamb
-    if name == "FusedLamb":
-        return fused_lamb
-    raise AttributeError(name)
-
-
 class FusedLambState(NamedTuple):
     count: jnp.ndarray
     mu: optax.Updates
@@ -78,3 +71,7 @@ def fused_lamb(lr: ScalarOrSchedule = 1e-3,
         return updates, FusedLambState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+# reference-parity namespace alias (deepspeed.ops.lamb.FusedLamb there)
+FusedLamb = fused_lamb

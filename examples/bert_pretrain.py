@@ -28,14 +28,25 @@ def parse_args():
     return parser.parse_args()
 
 
+MASK_TOKEN = 1  # BERT's [MASK] id is 103; any reserved id works here
+
+
 def mlm_batches(vocab, seq, batch, mask_prob=0.15, seed=0):
+    """BERT masking recipe: labels carry the TRUE token at selected
+    positions (-100 elsewhere) and the inputs are corrupted — 80% [MASK],
+    10% random token, 10% left as-is — so the model cannot just copy."""
     rng = np.random.default_rng(seed)
     while True:
-        ids = rng.integers(0, vocab, (batch, seq), dtype=np.int32)
-        labels = np.where(rng.random((batch, seq)) < mask_prob, ids,
-                          -100).astype(np.int32)
+        ids = rng.integers(2, vocab, (batch, seq), dtype=np.int32)
+        selected = rng.random((batch, seq)) < mask_prob
+        labels = np.where(selected, ids, -100).astype(np.int32)
+        roll = rng.random((batch, seq))
+        corrupted = np.where(selected & (roll < 0.8), MASK_TOKEN, ids)
+        corrupted = np.where(
+            selected & (roll >= 0.8) & (roll < 0.9),
+            rng.integers(2, vocab, (batch, seq)), corrupted)
         yield {
-            "input_ids": ids,
+            "input_ids": corrupted.astype(np.int32),
             "masked_lm_labels": labels,
             "next_sentence_label": rng.integers(0, 2, (batch,),
                                                 dtype=np.int32),

@@ -62,7 +62,8 @@ def main():
         remat="block"))
 
     from deepspeed_tpu.runtime.lr_schedules import schedule_params_from_args
-    config = args.deepspeed_config or "examples/ds_config.json"
+    config = args.deepspeed_config or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "ds_config.json")
     sched_override = schedule_params_from_args(args)
     if sched_override is not None:
         import json

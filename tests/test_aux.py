@@ -436,3 +436,41 @@ def test_profiler_stop_escape_hatch(tmp_path):
     eng.stop_profiler()
     assert not eng._profiler_active
     eng.stop_profiler()  # idempotent
+
+
+def test_multinode_runner_command_construction(tmp_path, monkeypatch):
+    """pdsh/ssh fan-out builds one per-host command with distinct
+    node_rank and the env-export prefix (reference: runner.py:320-356,
+    multinode_runner.py:35-75 — their CI also only checks construction)."""
+    from deepspeed_tpu.launcher import runner as R
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("hostA slots=4\nhostB slots=4\n")
+
+    spawned = []
+
+    class FakeProc:
+        def __init__(self, argv):
+            spawned.append(argv)
+
+        def wait(self):
+            return 0
+
+    monkeypatch.setattr(R.subprocess, "Popen",
+                        lambda argv: FakeProc(argv))
+    monkeypatch.setattr(R.shutil, "which", lambda name: None)  # force ssh
+    monkeypatch.setenv("XLA_FLAGS", "--some_flag=1")
+    rc = R.main(["--hostfile", str(hf), "--launcher", "ssh",
+                 "--master_port", "29401", "train.py", "--foo", "1"])
+    assert rc == 0
+    assert len(spawned) == 2
+    for rank, argv in enumerate(spawned):
+        assert argv[0] == "ssh"
+        host, remote = argv[1], argv[2]
+        assert host == ("hostA", "hostB")[rank]
+        assert f"--node_rank={rank}" in remote
+        assert "--master_addr=hostA" in remote
+        assert "--master_port=29401" in remote
+        assert "deepspeed_tpu.launcher.launch" in remote
+        assert "XLA_FLAGS=" in remote          # env export propagated
+        assert remote.rstrip().endswith("train.py --foo 1")

@@ -108,6 +108,19 @@ def _run(engine, tokens, steps, warmup=1):
     return dt, loss
 
 
+def _15b_knobs():
+    """Tuning knobs, validated EAGERLY (main calls this before entering the
+    watchdog-guarded attempt): a typo'd env var must fail loudly, not get
+    swallowed into a silent 124M fallback.  Larger ga amortizes the
+    per-step host<->HBM master/moment traffic over more compute."""
+    micro = int(os.environ.get("BENCH_15B_MICRO", "4"))
+    ga = int(os.environ.get("BENCH_15B_GA", "16"))
+    steps = int(os.environ.get("BENCH_15B_STEPS", "2"))
+    if micro < 1 or ga < 1 or steps < 1:
+        raise ValueError(f"bad BENCH_15B knobs: {micro=} {ga=} {steps=}")
+    return micro, ga, steps
+
+
 def _bench_15b(jax):
     """North star: GPT-2 1.5B, ZeRO-2 + XLA host offload, one chip."""
     import jax.numpy as jnp  # noqa: F401
@@ -119,11 +132,8 @@ def _bench_15b(jax):
     cfg_model = GPT2Config(d_model=1600, n_layer=48, n_head=25,
                            vocab_size=50257, n_positions=1024,
                            remat="block", scan_layers=True)
-    # env knobs for on-chip tuning: larger ga amortizes the per-step
-    # host<->HBM master/moment traffic over more compute
-    micro = int(os.environ.get("BENCH_15B_MICRO", "4"))
-    ga = int(os.environ.get("BENCH_15B_GA", "16"))
-    seq, steps = 1024, int(os.environ.get("BENCH_15B_STEPS", "2"))
+    micro, ga, steps = _15b_knobs()
+    seq = 1024
     mesh = build_mesh(devices=jax.devices()[:1])
     ds_cfg = DeepSpeedConfig({
         "train_micro_batch_size_per_gpu": micro,
@@ -225,6 +235,7 @@ def main():
     peak = _resolve_peak(devices[0])
     result = None
     if not os.environ.get("BENCH_SMALL"):
+        _15b_knobs()  # validate env knobs OUTSIDE the fallback guard
         try:
             deadline = int(os.environ.get("BENCH_15B_TIMEOUT", "1500"))
             with _Watchdog(deadline):

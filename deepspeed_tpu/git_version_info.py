@@ -18,7 +18,9 @@ def _git(*args: str) -> str:
     repo happens to enclose a site-packages install: the resolved toplevel
     must be an ancestor of the package directory."""
     import os
-    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    # realpath on both sides: git prints the physical toplevel, so a
+    # symlinked checkout must be compared physically too
+    pkg_dir = os.path.dirname(os.path.realpath(__file__))
     try:
         top = subprocess.run(
             ("git", "-C", pkg_dir, "rev-parse", "--show-toplevel"),

@@ -106,3 +106,60 @@ def test_gpt2_dp_tp_matches_pure_dp():
     a = run(build_mesh(), 8)
     b = run(build_mesh(pp=1, dp=4, tp=2), 4)
     np.testing.assert_allclose(a, b, rtol=5e-3)
+
+
+def test_logits_match_huggingface_gpt2():
+    """Weights copied from a HuggingFace GPT2LMHeadModel; logits compared
+    (the reference's kernel-vs-HF differential pattern applied to the
+    causal-LM family)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    V, T, D, L, H = 97, 16, 48, 2, 4
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=V, n_positions=32, n_embd=D, n_layer=L, n_head=H,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+
+    def t2j(t):
+        return jnp.asarray(t.detach().numpy())
+
+    sd = dict(hf.named_parameters())
+
+    def stack(fmt):
+        return jnp.stack([t2j(sd[fmt.format(i)]) for i in range(L)])
+
+    # HF Conv1D stores weights [in, out] — same layout as ours, no .T
+    params = {
+        "wte": t2j(sd["transformer.wte.weight"]),
+        "wpe": t2j(sd["transformer.wpe.weight"]),
+        "ln_f_scale": t2j(sd["transformer.ln_f.weight"]),
+        "ln_f_bias": t2j(sd["transformer.ln_f.bias"]),
+        "blocks": {
+            "ln1_scale": stack("transformer.h.{}.ln_1.weight"),
+            "ln1_bias": stack("transformer.h.{}.ln_1.bias"),
+            "qkv_w": stack("transformer.h.{}.attn.c_attn.weight"),
+            "qkv_b": stack("transformer.h.{}.attn.c_attn.bias"),
+            "out_w": stack("transformer.h.{}.attn.c_proj.weight"),
+            "out_b": stack("transformer.h.{}.attn.c_proj.bias"),
+            "ln2_scale": stack("transformer.h.{}.ln_2.weight"),
+            "ln2_bias": stack("transformer.h.{}.ln_2.bias"),
+            "fc_w": stack("transformer.h.{}.mlp.c_fc.weight"),
+            "fc_b": stack("transformer.h.{}.mlp.c_fc.bias"),
+            "proj_w": stack("transformer.h.{}.mlp.c_proj.weight"),
+            "proj_b": stack("transformer.h.{}.mlp.c_proj.bias"),
+        },
+    }
+
+    model = GPT2Model(GPT2Config(
+        vocab_size=V, n_positions=32, d_model=D, n_layer=L, n_head=H,
+        dropout=0.0, embd_dropout=0.0, remat=None, attn_impl="dense"))
+    tokens = np.random.default_rng(0).integers(0, V, (2, T),
+                                               dtype=np.int32)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens).long()).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens),
+                                 jax.random.PRNGKey(0), train=False))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)

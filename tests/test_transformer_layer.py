@@ -250,3 +250,57 @@ def test_bert_large_param_count():
     shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
     n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
     assert 105e6 < n < 115e6  # BERT-base ≈ 110M
+
+
+def test_forward_matches_huggingface_bert_layer():
+    """The reference's exact differential pattern: weights copied from a
+    HuggingFace BertLayer, outputs compared (reference
+    tests/unit/test_cuda_forward.py:10-25 copies from the vendored HF
+    BertEncoder in tests/unit/modeling.py)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from transformers.models.bert.modeling_bert import BertLayer
+
+    B, T, D, H, I = 2, 33, 64, 4, 256
+    hf_cfg = transformers.BertConfig(
+        hidden_size=D, num_attention_heads=H, intermediate_size=I,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    hf_layer = BertLayer(hf_cfg).eval()
+
+    def t2j(t):
+        return jnp.asarray(t.detach().numpy())
+
+    sd = dict(hf_layer.named_parameters())
+    params = {
+        "attn_qkvw": jnp.concatenate(
+            [t2j(sd[f"attention.self.{n}.weight"]).T
+             for n in ("query", "key", "value")], axis=1),
+        "attn_qkvb": jnp.concatenate(
+            [t2j(sd[f"attention.self.{n}.bias"])
+             for n in ("query", "key", "value")]),
+        "attn_ow": t2j(sd["attention.output.dense.weight"]).T,
+        "attn_ob": t2j(sd["attention.output.dense.bias"]),
+        "attn_nw": t2j(sd["attention.output.LayerNorm.weight"]),
+        "attn_nb": t2j(sd["attention.output.LayerNorm.bias"]),
+        "inter_w": t2j(sd["intermediate.dense.weight"]).T,
+        "inter_b": t2j(sd["intermediate.dense.bias"]),
+        "output_w": t2j(sd["output.dense.weight"]).T,
+        "output_b": t2j(sd["output.dense.bias"]),
+        "norm_w": t2j(sd["output.LayerNorm.weight"]),
+        "norm_b": t2j(sd["output.LayerNorm.bias"]),
+    }
+
+    layer = DeepSpeedTransformerLayer(DeepSpeedTransformerConfig(
+        hidden_size=D, heads=H, intermediate_size=I,
+        attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+        pre_layer_norm=False))  # classic BERT is post-LN, like HF
+
+    x = np.random.default_rng(0).standard_normal((B, T, D)).astype(
+        np.float32)
+    with torch.no_grad():
+        want = hf_layer(torch.from_numpy(x))[0].numpy()
+    got = np.asarray(layer(params, jnp.asarray(x), attention_mask=None,
+                           rng=jax.random.PRNGKey(0), train=False))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)

@@ -116,9 +116,11 @@ def _15b_knobs():
     micro = int(os.environ.get("BENCH_15B_MICRO", "4"))
     ga = int(os.environ.get("BENCH_15B_GA", "16"))
     steps = int(os.environ.get("BENCH_15B_STEPS", "2"))
-    if micro < 1 or ga < 1 or steps < 1:
-        raise ValueError(f"bad BENCH_15B knobs: {micro=} {ga=} {steps=}")
-    return micro, ga, steps
+    deadline = int(os.environ.get("BENCH_15B_TIMEOUT", "1500"))
+    if micro < 1 or ga < 1 or steps < 1 or deadline < 1:
+        raise ValueError(
+            f"bad BENCH_15B knobs: {micro=} {ga=} {steps=} {deadline=}")
+    return micro, ga, steps, deadline
 
 
 def _bench_15b(jax):
@@ -132,7 +134,7 @@ def _bench_15b(jax):
     cfg_model = GPT2Config(d_model=1600, n_layer=48, n_head=25,
                            vocab_size=50257, n_positions=1024,
                            remat="block", scan_layers=True)
-    micro, ga, steps = _15b_knobs()
+    micro, ga, steps, _ = _15b_knobs()
     seq = 1024
     mesh = build_mesh(devices=jax.devices()[:1])
     ds_cfg = DeepSpeedConfig({
@@ -235,9 +237,10 @@ def main():
     peak = _resolve_peak(devices[0])
     result = None
     if not os.environ.get("BENCH_SMALL"):
-        _15b_knobs()  # validate env knobs OUTSIDE the fallback guard
+        # parse/validate ALL env knobs outside the fallback guard: a typo
+        # must fail loudly, not silently demote the run to 124M
+        _, _, _, deadline = _15b_knobs()
         try:
-            deadline = int(os.environ.get("BENCH_15B_TIMEOUT", "1500"))
             with _Watchdog(deadline):
                 result = _bench_15b(jax)
         except Exception:

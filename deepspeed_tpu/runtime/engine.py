@@ -103,9 +103,14 @@ class DeepSpeedEngine:
 
         self.compute_dtype = precision.select_compute_dtype(
             config.fp16_enabled, config.bf16_enabled)
-        self.micro_batch_size = config.train_micro_batch_size_per_gpu
-        self.gradient_accumulation_steps = config.gradient_accumulation_steps
-        self.train_batch_size = config.train_batch_size
+        # _CallableInt/_CallableFloat: value semantics for this codebase's
+        # attribute style AND the reference's method-call style
+        # (engine.train_batch_size() at engine.py:296 there) in one name
+        self.micro_batch_size = _CallableInt(
+            config.train_micro_batch_size_per_gpu)
+        self.gradient_accumulation_steps = _CallableInt(
+            config.gradient_accumulation_steps)
+        self.train_batch_size = _CallableInt(config.train_batch_size)
 
         # ---- optimizer + lr schedule (reference _configure_optimizer,
         # engine.py:527-615) ----
@@ -113,9 +118,10 @@ class DeepSpeedEngine:
         self.optimizer = (optimizer if optimizer is not None
                           else self._build_basic_optimizer())
         if config.gradient_clipping and config.gradient_clipping > 0:
-            self.gradient_clipping = float(config.gradient_clipping)
+            self.gradient_clipping = _CallableFloat(
+                float(config.gradient_clipping))
         else:
-            self.gradient_clipping = 0.0
+            self.gradient_clipping = _CallableFloat(0.0)
 
         # ---- ZeRO placement plan ----
         init_rng, self._data_rng = jax.random.split(jax.random.PRNGKey(seed))
@@ -303,6 +309,7 @@ class DeepSpeedEngine:
         self.skipped_steps = 0
         # partitioning-correctness sweep on the first step when enabled
         # (reference stage2.py:23-25 pg_correctness_test)
+        self._train_mode = True
         self._pg_check_pending = bool(
             getattr(config.zero_config, "pg_correctness_test", False))
         if self._pg_check_pending and self._offload:
@@ -1520,6 +1527,80 @@ class DeepSpeedEngine:
         """The resolved step→lr callable (config- or client-provided)."""
         return self._lr_schedule
 
+    # ---- reference accessor surface (engine.py:241-392 there: config
+    # facts exposed as zero-arg methods) ----
+    def pld_enabled(self):
+        return self.config.pld_config.enabled
+
+    def pld_params(self):
+        if not self.config.pld_config.enabled:
+            return False
+        return {"theta": self.config.pld_config.theta,
+                "gamma": self.config.pld_config.gamma}
+
+    def tensorboard_enabled(self):
+        return self.config.tensorboard_config.enabled
+
+    def tensorboard_output_path(self):
+        return self.config.tensorboard_config.output_path
+
+    def tensorboard_job_name(self):
+        return self.config.tensorboard_config.job_name
+
+    def train_micro_batch_size_per_gpu(self):
+        return int(self.micro_batch_size)
+
+    def optimizer_name(self):
+        return self.config.optimizer_name
+
+    def optimizer_params(self):
+        return self.config.optimizer_params
+
+    def scheduler_name(self):
+        return self.config.scheduler_name
+
+    def scheduler_params(self):
+        return self.config.scheduler_params
+
+    def zero_optimization(self):
+        return self.config.zero_optimization_stage > 0
+
+    def zero_optimization_stage(self):
+        return self.config.zero_optimization_stage
+
+    def zero_cpu_offload(self):
+        return bool(self.config.zero_config.cpu_offload)
+
+    def loss_scale(self):
+        return self.get_loss_scale()
+
+    def dynamic_loss_scale(self):
+        return self.loss_scale_config.dynamic
+
+    def steps_per_print(self):
+        return self.config.steps_per_print
+
+    def wall_clock_breakdown(self):
+        return self.config.wall_clock_breakdown
+
+    def memory_breakdown(self):
+        return self.config.memory_breakdown
+
+    def sparse_gradients_enabled(self):
+        return bool(self.config.sparse_gradients_enabled)
+
+    def train(self, mode: bool = True):
+        """Mode record for API parity (reference engine.py:745-758 —
+        nn.Module train()/eval() there).  Train-vs-eval behavior (dropout,
+        PLD) is decided per compiled program here — train_batch always
+        trains, eval_batch/forward never do — so the flag is bookkeeping,
+        not a behavior switch."""
+        self._train_mode = bool(mode)
+        return self
+
+    def eval(self):
+        return self.train(False)
+
     def get_lr(self):
         if self._lr_schedule is not None:
             applied = self.global_steps - self.get_skipped_steps()
@@ -1554,6 +1635,20 @@ class DeepSpeedEngine:
             f"loss_scale={float(metrics.loss_scale):.1f} "
             f"skipped={self.get_skipped_steps()} "
             f"samples/sec={tput:.1f}", ranks=[0])
+
+
+class _CallableInt(int):
+    """int that also answers the reference's method-call accessor style
+    (engine.train_batch_size() — engine.py:296 there — vs this codebase's
+    engine.train_batch_size attribute)."""
+
+    def __call__(self):
+        return int(self)
+
+
+class _CallableFloat(float):
+    def __call__(self):
+        return float(self)
 
 
 def _device_put_tree(tree, shardings):

@@ -178,3 +178,37 @@ def test_pg_correctness_config_flag_runs_on_first_step():
     loss = eng.train_batch(next(random_batches(32, 8)))
     assert np.isfinite(float(np.asarray(loss)))
     assert not eng._pg_check_pending  # consumed on step 1
+
+
+def test_reference_accessor_surface():
+    """Config facts exposed as zero-arg methods (reference engine.py:241-392)
+    plus the dual attribute/method batch accessors."""
+    cfg = DeepSpeedConfig(
+        base_config(micro_bs=4, grad_acc=2, stage=2,
+                    **{"gradient_clipping": 1.0,
+                       "scheduler": {"type": "WarmupLR",
+                                     "params": {"warmup_num_steps": 5}}}),
+        world_size=8)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=8), cfg, mesh=build_mesh())
+
+    # dual style: attribute (this codebase) AND call (reference)
+    assert eng.train_batch_size == 64 and eng.train_batch_size() == 64
+    assert eng.gradient_accumulation_steps() == 2
+    assert eng.train_micro_batch_size_per_gpu() == 4
+    assert eng.gradient_clipping == 1.0 and eng.gradient_clipping() == 1.0
+
+    assert eng.zero_optimization() is True
+    assert eng.zero_optimization_stage() == 2
+    assert eng.zero_cpu_offload() is False
+    assert eng.optimizer_name() == "adam"
+    assert eng.scheduler_name() == "WarmupLR"
+    assert eng.scheduler_params() == {"warmup_num_steps": 5}
+    assert eng.pld_enabled() is False and eng.pld_params() is False
+    assert eng.tensorboard_enabled() is False
+    assert eng.dynamic_loss_scale() is False  # bf16: no loss scaling
+    assert eng.loss_scale() == 1.0
+    assert eng.steps_per_print() == 1000
+    assert eng.wall_clock_breakdown() is False
+    assert eng.sparse_gradients_enabled() is False
+    assert eng.train() is eng and eng._train_mode
+    assert eng.eval() is eng and not eng._train_mode

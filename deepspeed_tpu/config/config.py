@@ -305,6 +305,25 @@ class DeepSpeedConfig:
 
         self.fp16 = DeepSpeedFP16Config(pd)
         self.bf16 = DeepSpeedBF16Config(pd)
+        # Apex AMP block (reference constants.py:162-172): no apex on TPU —
+        # enabled => native bf16 mixed precision, the closest equivalent
+        amp = pd.get(C.AMP) or {}
+        self.amp_enabled = bool(amp.get(C.AMP_ENABLED, C.AMP_ENABLED_DEFAULT))
+        self.amp_params = {k: v for k, v in amp.items()
+                           if k != C.AMP_ENABLED}
+        if self.amp_enabled:
+            if self.fp16.enabled:
+                raise DeepSpeedConfigError(
+                    "amp and fp16 are mutually exclusive (reference "
+                    "config sanity: engine chooses ONE precision scheme)")
+            if not self.bf16.enabled:
+                logger.warning(
+                    "amp has no apex on TPU; mapping to native bf16 "
+                    "mixed precision (amp_params recorded, not applied)")
+                self.bf16.enabled = True
+        self.zero_allow_untested_optimizer = pd.get(
+            C.ZERO_ALLOW_UNTESTED_OPTIMIZER,
+            C.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
         self.zero_config = DeepSpeedZeroConfig(pd)
         self.activation_checkpointing_config = (
             DeepSpeedActivationCheckpointingConfig(pd))

@@ -67,6 +67,19 @@ BF16_ENABLED_DEFAULT = False
 GRADIENT_CLIPPING = "gradient_clipping"
 GRADIENT_CLIPPING_DEFAULT = 0.0
 
+# Apex AMP block (reference constants.py:162-172).  Apex has no TPU
+# analogue; the block is accepted for ds_config compatibility and, when
+# enabled, maps to native bf16 mixed precision (the closest equivalent).
+AMP = "amp"
+AMP_ENABLED = "enabled"
+AMP_ENABLED_DEFAULT = False
+
+# reference constants.py:73 — client optimizers outside the ZeRO whitelist.
+# Under GSPMD any optax transformation's state shards generically, so the
+# key is accepted and recorded (nothing to gate).
+ZERO_ALLOW_UNTESTED_OPTIMIZER = "zero_allow_untested_optimizer"
+ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT = False
+
 PRESCALE_GRADIENTS = "prescale_gradients"
 PRESCALE_GRADIENTS_DEFAULT = False
 

@@ -1574,6 +1574,24 @@ class DeepSpeedEngine:
     def loss_scale(self):
         return self.get_loss_scale()
 
+    def amp_enabled(self):
+        return self.config.amp_enabled
+
+    def amp_params(self):
+        return self.config.amp_params
+
+    def zero_allow_untested_optimizer(self):
+        return self.config.zero_allow_untested_optimizer
+
+    def postscale_gradients(self):
+        return not self.config.prescale_gradients
+
+    def gradient_predivide_factor(self):
+        return self.config.gradient_predivide_factor
+
+    def dump_state(self):
+        return self.config.dump_state
+
     def dynamic_loss_scale(self):
         return self.loss_scale_config.dynamic
 

@@ -186,3 +186,34 @@ def test_config_writer_roundtrip(tmp_path):
 def test_ops_optimizer_aliases():
     from deepspeed_tpu.ops import FusedAdam, FusedLamb, fused_adam, fused_lamb
     assert FusedAdam is fused_adam and FusedLamb is fused_lamb
+
+
+def test_amp_block_maps_to_bf16():
+    """Apex AMP block accepted for ds_config compatibility; enabled maps
+    to native bf16 (reference constants.py:162-172)."""
+    from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "amp": {"enabled": True, "opt_level": "O1"},
+    }, world_size=1)
+    assert cfg.amp_enabled and cfg.bf16.enabled
+    assert cfg.amp_params == {"opt_level": "O1"}
+
+    import pytest as _pytest
+    with _pytest.raises(DeepSpeedConfigError, match="mutually exclusive"):
+        DeepSpeedConfig({
+            "train_micro_batch_size_per_gpu": 2,
+            "fp16": {"enabled": True},
+            "amp": {"enabled": True},
+        }, world_size=1)
+
+
+def test_zero_allow_untested_optimizer_key():
+    from deepspeed_tpu.config import DeepSpeedConfig
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 2,
+        "zero_allow_untested_optimizer": True,
+    }, world_size=1)
+    assert cfg.zero_allow_untested_optimizer is True

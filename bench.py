@@ -123,8 +123,13 @@ def _15b_knobs():
     return micro, ga, steps, deadline
 
 
-def _bench_15b(jax):
-    """North star: GPT-2 1.5B, ZeRO-2 + XLA host offload, one chip."""
+def _bench_15b(jax, impl: str = "xla"):
+    """North star: GPT-2 1.5B, ZeRO-2 + host offload, one chip.
+
+    ``impl``: 'xla' — master/moments in pinned_host memory, Adam as an XLA
+    host computation (fastest path, but exercises compute_on through the
+    axon tunnel); 'host' — numpy staging + native C++ Adam (plan B: plain
+    jit step, no host-compute sections)."""
     import jax.numpy as jnp  # noqa: F401
     from deepspeed_tpu.models import GPT2Config, GPT2Model
     from deepspeed_tpu.parallel import build_mesh
@@ -144,17 +149,17 @@ def _bench_15b(jax):
         "bf16": {"enabled": True},
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "zero_optimization": {"stage": 2, "cpu_offload": True,
-                              "offload_impl": "xla"},
+                              "offload_impl": impl},
     }, world_size=1)
-    _mark("1.5B: constructing engine (param init + host staging)")
+    _mark(f"1.5B[{impl}]: constructing engine (param init + host staging)")
     engine = DeepSpeedEngine(GPT2Model(cfg_model), ds_cfg, mesh=mesh)
-    _mark("1.5B: engine ready; compiling + first step")
+    _mark(f"1.5B[{impl}]: engine ready; compiling + first step")
     tokens = np.random.default_rng(0).integers(
         0, cfg_model.vocab_size, (micro * ga, seq + 1), dtype=np.int32)
     dt, _ = _run(engine, tokens, steps)
-    _mark(f"1.5B: measured {dt:.2f}s/step")
+    _mark(f"1.5B[{impl}]: measured {dt:.2f}s/step")
     tokens_per_sec = micro * ga * seq / dt
-    return cfg_model, seq, tokens_per_sec, "gpt2_1p5b_zero2_offload"
+    return cfg_model, seq, tokens_per_sec, f"gpt2_1p5b_zero2_offload_{impl}"
 
 
 def _bench_124m(jax):
@@ -240,16 +245,31 @@ def main():
         # parse/validate ALL env knobs outside the fallback guard: a typo
         # must fail loudly, not silently demote the run to 124M
         _, _, _, deadline = _15b_knobs()
-        try:
-            with _Watchdog(deadline):
-                result = _bench_15b(jax)
-        except Exception:
-            # fall back OUTSIDE the except block: the live traceback pins
-            # the failed attempt's engine/HBM buffers, which would make an
-            # OOM fallback OOM too
-            traceback.print_exc(file=sys.stderr)
-            print("1.5B offload bench failed; falling back to 124M",
-                  file=sys.stderr)
+        impls = [s.strip() for s in
+                 os.environ.get("BENCH_15B_IMPL", "xla,host").split(",")]
+        bad = [s for s in impls if s not in ("xla", "host")]
+        if bad:
+            raise ValueError(f"BENCH_15B_IMPL contains {bad}; valid: "
+                             "xla, host")
+        # ONE deadline shared across the whole chain: two wedged attempts
+        # must not double the worst-case bound before the 124M fallback
+        chain_deadline = time.monotonic() + deadline
+        for impl in impls:
+            left = int(chain_deadline - time.monotonic())
+            if left <= 0:
+                print("1.5B chain deadline exhausted", file=sys.stderr)
+                break
+            try:
+                with _Watchdog(left):
+                    result = _bench_15b(jax, impl=impl)
+                break
+            except Exception:
+                # fall through OUTSIDE the except block: the live traceback
+                # pins the failed attempt's engine/HBM buffers, which would
+                # make an OOM fallback OOM too
+                traceback.print_exc(file=sys.stderr)
+                print(f"1.5B offload bench (impl={impl}) failed; "
+                      "trying next fallback", file=sys.stderr)
     if result is None:
         result = _bench_124m(jax)
     cfg, seq, tps, name = result

@@ -178,8 +178,7 @@ def test_config_writer_roundtrip(tmp_path):
     # duplicate keys rejected on load, same as DeepSpeedConfig
     bad = tmp_path / "dup.json"
     bad.write_text('{"a": 1, "a": 2}')
-    import pytest as _pytest
-    with _pytest.raises(Exception):
+    with pytest.raises(Exception):
         r.load_config(str(bad))
 
 
@@ -201,8 +200,7 @@ def test_amp_block_maps_to_bf16():
     assert cfg.amp_enabled and cfg.bf16.enabled
     assert cfg.amp_params == {"opt_level": "O1"}
 
-    import pytest as _pytest
-    with _pytest.raises(DeepSpeedConfigError, match="mutually exclusive"):
+    with pytest.raises(DeepSpeedConfigError, match="mutually exclusive"):
         DeepSpeedConfig({
             "train_micro_batch_size_per_gpu": 2,
             "fp16": {"enabled": True},

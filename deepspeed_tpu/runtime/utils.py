@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def global_norm(tree) -> jnp.ndarray:
@@ -68,3 +69,81 @@ def memory_status(message: str = "") -> str:
     from ..utils.logging import log_dist
     log_dist(report, ranks=[0])
     return report
+
+
+class PartitionedTensor:
+    """A tensor uniformly partitioned along a named mesh axis, with the
+    reference's meta encoding (reference: runtime/utils.py:379-482 —
+    used by the pipeline engine to ship tensor-parallel activations as
+    per-rank slices and reconstruct with an all-gather).
+
+    Inside ``shard_map`` over ``axis_name``, ``local_data`` is this
+    shard's flat slice and ``full()`` reconstructs the original tensor
+    with one ``all_gather``.  The meta vector is layout-compatible with
+    the reference: ``[ndims, *shape, num_parts, rank, 0, *cumparts]``.
+    """
+
+    @staticmethod
+    def _row_ptr(numel: int, parts: int):
+        # equal ceil-sized slices (padded) — static shapes for the gather;
+        # the rowptr is clamped to numel so meta matches the logical tensor
+        per = -(-numel // parts)
+        return [min(i * per, numel) for i in range(parts + 1)], per
+
+    def __init__(self, tensor, axis_name: str, _local=None, _shape=None):
+        self.axis_name = axis_name
+        self.num_parts = jax.lax.axis_size(axis_name)
+        self.rank = jax.lax.axis_index(axis_name)
+        if _local is not None:
+            self.local_data, self.orig_shape = _local, tuple(_shape)
+            self.partition, _ = self._row_ptr(
+                int(np.prod(self.orig_shape)), self.num_parts)
+            return
+        self.orig_shape = tuple(tensor.shape)
+        numel = int(np.prod(self.orig_shape))
+        self.partition, per = self._row_ptr(numel, self.num_parts)
+        flat = jnp.pad(tensor.reshape(-1),
+                       (0, per * self.num_parts - numel))
+        self.local_data = jax.lax.dynamic_slice_in_dim(
+            flat, self.rank * per, per)
+
+    def to_meta(self) -> np.ndarray:
+        """Meta vector in the reference's encoding (int32):
+        ``[ndims, *shape, num_parts, rank, 0, *row_ptr[1:]]``.
+
+        Returns CONCRETE numpy even under jit — every field is static at
+        trace time (shapes, axis size, row pointers); the rank slot is -1
+        because the receiver's own ``axis_index`` is the authoritative
+        rank (the reference's assert rank==meta[1] compares pipe peers at
+        the same coordinate, runtime/utils.py:411 there)."""
+        shape = list(self.orig_shape)
+        return np.asarray(
+            [len(shape)] + shape + [self.num_parts, -1, 0]
+            + list(self.partition)[1:], np.int32)
+
+    @classmethod
+    def from_meta(cls, meta, local_part, axis_name: str):
+        meta = np.asarray(meta)
+        nd = int(meta[0])
+        shape = tuple(int(x) for x in meta[1:1 + nd])
+        num_parts = int(meta[1 + nd])
+        obj = cls(None, axis_name, _local=local_part, _shape=shape)
+        if num_parts != obj.num_parts:
+            raise ValueError(
+                f"meta was produced over {num_parts} parts but axis "
+                f"{axis_name!r} has {obj.num_parts}")
+        _, per = obj._row_ptr(int(np.prod(shape)), obj.num_parts)
+        if int(local_part.shape[0]) != per:
+            raise ValueError(
+                f"local slice has {local_part.shape[0]} elements; layout "
+                f"expects {per}")
+        return obj
+
+    def full_size(self):
+        return self.orig_shape
+
+    def full(self) -> jnp.ndarray:
+        flat = jax.lax.all_gather(self.local_data, self.axis_name,
+                                  tiled=True)
+        numel = int(np.prod(self.orig_shape))
+        return flat[:numel].reshape(self.orig_shape)

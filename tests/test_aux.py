@@ -474,3 +474,41 @@ def test_multinode_runner_command_construction(tmp_path, monkeypatch):
         assert "deepspeed_tpu.launcher.launch" in remote
         assert "XLA_FLAGS=" in remote          # env export propagated
         assert remote.rstrip().endswith("train.py --foo 1")
+
+
+def test_partitioned_tensor_roundtrip():
+    """PartitionedTensor meta/slice/full over a mesh axis (reference
+    runtime/utils.py:379-482 — pipe TP activation shipping)."""
+    from deepspeed_tpu.runtime.utils import PartitionedTensor
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    x = np.arange(3 * 7, dtype=np.float32).reshape(3, 7)  # numel=21, odd
+
+    def body(xin):
+        pt = PartitionedTensor(xin, "data")
+        meta = pt.to_meta()  # concrete numpy even under jit
+        assert isinstance(meta, np.ndarray) and meta.dtype == np.int32
+        assert meta[0] == 2 and tuple(meta[1:3]) == (3, 7)
+        assert meta[3] == 8  # num_parts
+        # reconstruct on the "receiver" from the shipped meta + slice
+        rt = PartitionedTensor.from_meta(meta, pt.local_data, "data")
+        return rt.full()
+
+    fn = shard_map(body, mesh=mesh, in_specs=P(), out_specs=P())
+    out = np.asarray(jax.jit(fn)(jnp.asarray(x)))
+    np.testing.assert_allclose(out, x)
+
+    # size-mismatch validation: meta from an 8-part layout must be
+    # rejected on a different-width axis
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("data",))
+
+    def bad(xin):
+        pt = PartitionedTensor(xin, "data")
+        wrong = pt.to_meta().copy()
+        wrong[3] = 8  # claim 8 parts on a 4-wide axis
+        PartitionedTensor.from_meta(wrong, pt.local_data, "data")
+        return pt.full()
+
+    with pytest.raises(ValueError, match="8 parts"):
+        jax.jit(shard_map(bad, mesh=mesh4, in_specs=P(),
+                          out_specs=P()))(jnp.asarray(x))

@@ -79,8 +79,12 @@ class PartitionedTensor:
 
     Inside ``shard_map`` over ``axis_name``, ``local_data`` is this
     shard's flat slice and ``full()`` reconstructs the original tensor
-    with one ``all_gather``.  The meta vector is layout-compatible with
-    the reference: ``[ndims, *shape, num_parts, rank, 0, *cumparts]``.
+    with one ``all_gather``.  The meta vector follows the reference's
+    field order (``[ndims, *shape, num_parts, rank, 0, *cumparts]``) but
+    the partitioning itself is equal-ceil slices (padded), NOT the
+    reference's base+remainder split — static slice shapes are what make
+    the single fused all_gather possible; ``from_meta`` validates the
+    layout so mixed-layout interop fails loudly rather than corrupting.
     """
 
     @staticmethod

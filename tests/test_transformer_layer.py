@@ -252,21 +252,18 @@ def test_bert_large_param_count():
     assert 105e6 < n < 115e6  # BERT-base ≈ 110M
 
 
-def test_forward_matches_huggingface_bert_layer():
-    """The reference's exact differential pattern: weights copied from a
-    HuggingFace BertLayer, outputs compared (reference
-    tests/unit/test_cuda_forward.py:10-25 copies from the vendored HF
-    BertEncoder in tests/unit/modeling.py)."""
-    torch = pytest.importorskip("torch")
-    transformers = pytest.importorskip("transformers")
+def _hf_bert_layer_and_params(D, H, I, seed):
+    """Build an HF BertLayer and map its weights into our param dict
+    (shared by the forward and backward differential tests)."""
+    import torch
+    import transformers
     from transformers.models.bert.modeling_bert import BertLayer
 
-    B, T, D, H, I = 2, 33, 64, 4, 256
     hf_cfg = transformers.BertConfig(
         hidden_size=D, num_attention_heads=H, intermediate_size=I,
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
         attn_implementation="eager")
-    torch.manual_seed(0)
+    torch.manual_seed(seed)
     hf_layer = BertLayer(hf_cfg).eval()
 
     def t2j(t):
@@ -291,11 +288,23 @@ def test_forward_matches_huggingface_bert_layer():
         "norm_w": t2j(sd["output.LayerNorm.weight"]),
         "norm_b": t2j(sd["output.LayerNorm.bias"]),
     }
-
     layer = DeepSpeedTransformerLayer(DeepSpeedTransformerConfig(
         hidden_size=D, heads=H, intermediate_size=I,
         attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
         pre_layer_norm=False))  # classic BERT is post-LN, like HF
+    return hf_layer, layer, params
+
+
+def test_forward_matches_huggingface_bert_layer():
+    """The reference's exact differential pattern: weights copied from a
+    HuggingFace BertLayer, outputs compared (reference
+    tests/unit/test_cuda_forward.py:10-25 copies from the vendored HF
+    BertEncoder in tests/unit/modeling.py)."""
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+
+    B, T, D, H, I = 2, 33, 64, 4, 256
+    hf_layer, layer, params = _hf_bert_layer_and_params(D, H, I, seed=0)
 
     x = np.random.default_rng(0).standard_normal((B, T, D)).astype(
         np.float32)
@@ -304,3 +313,44 @@ def test_forward_matches_huggingface_bert_layer():
     got = np.asarray(layer(params, jnp.asarray(x), attention_mask=None,
                            rng=jax.random.PRNGKey(0), train=False))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_backward_matches_huggingface_bert_layer():
+    """Gradient differential against torch autograd through the HF layer
+    (reference tests/unit/test_cuda_backward.py)."""
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+
+    B, T, D, H, I = 2, 17, 64, 4, 256
+    hf_layer, layer, params = _hf_bert_layer_and_params(D, H, I, seed=1)
+
+    x = np.random.default_rng(1).standard_normal((B, T, D)).astype(
+        np.float32)
+
+    # torch side: sum-of-squares loss, grads wrt input and all params
+    tx = torch.from_numpy(x).requires_grad_(True)
+    tloss = (hf_layer(tx)[0] ** 2).sum()
+    tloss.backward()
+    want_dx = tx.grad.numpy()
+    want_qkvw = torch.cat(
+        [hf_layer.attention.self.query.weight.grad.T,
+         hf_layer.attention.self.key.weight.grad.T,
+         hf_layer.attention.self.value.weight.grad.T], dim=1).numpy()
+    want_ow = hf_layer.attention.output.dense.weight.grad.T.numpy()
+    want_norm_b = hf_layer.output.LayerNorm.bias.grad.numpy()
+
+    def loss_fn(p, xin):
+        out = layer(p, xin, attention_mask=None,
+                    rng=jax.random.PRNGKey(0), train=False)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    gp, gx = jax.grad(loss_fn, argnums=(0, 1))(params, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(gx), want_dx,
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(gp["attn_qkvw"]), want_qkvw,
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(gp["attn_ow"]), want_ow,
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(gp["norm_b"]), want_norm_b,
+                               rtol=2e-3, atol=2e-3)
+

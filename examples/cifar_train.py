@@ -1,0 +1,108 @@
+"""CIFAR-10-shaped CNN training — ladder rung 1 (BASELINE.json configs[0]:
+the reference's DeepSpeedExamples/cifar tutorial, ZeRO stage 0).
+
+Uses synthetic 32x32x3 images (this environment has no dataset egress);
+swap ``synthetic_cifar`` for a real loader to train CIFAR-10 proper.
+
+    python examples/cifar_train.py --cpu --steps 30
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.runtime.module import TrainModule  # noqa: E402
+
+
+class CifarCNN(TrainModule):
+    """conv-pool x2 -> dense, cross-entropy over 10 classes (the tutorial
+    network's shape, expressed as a loss-returning TrainModule)."""
+
+    def init(self, rng):
+        import jax
+        import jax.numpy as jnp
+        k = jax.random.split(rng, 4)
+        he = lambda key, shape, fan: (
+            jax.random.normal(key, shape, jnp.float32)
+            * np.sqrt(2.0 / fan))
+        return {
+            "conv1": he(k[0], (5, 5, 3, 16), 5 * 5 * 3),
+            "conv2": he(k[1], (5, 5, 16, 32), 5 * 5 * 16),
+            "fc1_w": he(k[2], (8 * 8 * 32, 128), 8 * 8 * 32),
+            "fc1_b": jnp.zeros((128,), jnp.float32),
+            "fc2_w": he(k[3], (128, 10), 128),
+            "fc2_b": jnp.zeros((10,), jnp.float32),
+        }
+
+    def loss_fn(self, params, batch, rng, train=True):
+        import jax
+        import jax.numpy as jnp
+        x, y = batch
+        x = x.astype(jnp.float32)
+
+        def block(h, w):
+            h = jax.lax.conv_general_dilated(
+                h, w.astype(h.dtype), (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            h = jax.nn.relu(h)
+            return jax.lax.reduce_window(
+                h, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1),
+                "VALID")
+
+        h = block(x, params["conv1"])
+        h = block(h, params["conv2"])
+        h = h.reshape(h.shape[0], -1)
+        h = jax.nn.relu(h @ params["fc1_w"].astype(h.dtype)
+                        + params["fc1_b"].astype(h.dtype))
+        logits = (h @ params["fc2_w"].astype(h.dtype)
+                  + params["fc2_b"].astype(h.dtype)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+def synthetic_cifar(batch, seed=0):
+    """Class-conditional gaussian blobs — learnable, so accuracy/loss
+    actually move like the tutorial's."""
+    rng = np.random.default_rng(seed)
+    prototypes = rng.standard_normal((10, 32, 32, 3)).astype(np.float32)
+    while True:
+        y = rng.integers(0, 10, (batch,), dtype=np.int32)
+        x = prototypes[y] + 0.5 * rng.standard_normal(
+            (batch, 32, 32, 3)).astype(np.float32)
+        yield (x, y)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--cpu", action="store_true")
+    parser = deepspeed_tpu.add_config_arguments(parser)
+    args = parser.parse_args()
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    config = args.deepspeed_config or {
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=CifarCNN(),
+                                               config=config)
+    data = synthetic_cifar(engine.train_batch_size)
+    for step in range(args.steps):
+        loss = engine.train_batch(next(data))
+        if (step + 1) % 10 == 0:
+            print(f"step {step + 1}: loss {float(np.asarray(loss)):.4f}")
+
+
+if __name__ == "__main__":
+    main()

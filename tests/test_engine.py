@@ -212,3 +212,26 @@ def test_reference_accessor_surface():
     assert eng.sparse_gradients_enabled() is False
     assert eng.train() is eng and eng._train_mode
     assert eng.eval() is eng and not eng._train_mode
+
+
+def test_unused_parameter_trains_under_zero2():
+    """Models with parameters not touched by the loss must still train
+    (reference test_fp16.py exercises unused-parameter edge cases — eager
+    autograd leaves .grad=None there; under jax.grad unused leaves get
+    zeros, and the ZeRO sharding plan must handle them)."""
+    class PartiallyUsedModel(SimpleModel):
+        def init(self, rng):
+            params = super().init(rng)
+            params["never_used"] = jnp.ones((4, 4), jnp.float32)
+            return params
+
+    cfg = DeepSpeedConfig(base_config(micro_bs=4, stage=2), world_size=8)
+    eng = DeepSpeedEngine(PartiallyUsedModel(hidden_dim=8), cfg,
+                          mesh=build_mesh())
+    before = np.asarray(eng.state.master_params["never_used"])
+    losses = [float(np.asarray(eng.train_batch(b)))
+              for b in random_batches(32, 8, num_batches=4)]
+    assert losses[-1] < losses[0]
+    # zero grad + zero Adam moments -> the unused leaf must not move
+    np.testing.assert_array_equal(
+        before, np.asarray(eng.state.master_params["never_used"]))

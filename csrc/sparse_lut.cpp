@@ -1,0 +1,58 @@
+// Block-sparse attention lookup-table build.
+//
+// TPU-native equivalent of the reference's C++ segmentation pass
+// (reference: csrc/sparse_attention/utils.cpp:14 segment_blocks — it
+// greedily packs the block layout into max-width LUTs for the Triton
+// kernels).  The Pallas kernel here consumes a simpler row-gather LUT:
+// for every (head, query-block-row), the list of active key-block columns
+// padded to the global max row population.  This file is that build as a
+// single O(H*nb*nb) native pass (the numpy fallback lives in
+// ops/sparse_attention/sparse_self_attention.py).
+//
+// C ABI via ctypes, matching csrc/cpu_adam.cpp.
+
+#include <cstdint>
+
+extern "C" {
+
+// Max active blocks in any (head, row) — the LUT width.
+int64_t ds_lut_width(int64_t H, int64_t nb, const int32_t* layout) {
+  int64_t width = 1;
+  for (int64_t h = 0; h < H; ++h) {
+    for (int64_t r = 0; r < nb; ++r) {
+      const int32_t* row = layout + (h * nb + r) * nb;
+      int64_t count = 0;
+      for (int64_t c = 0; c < nb; ++c) count += (row[c] != 0);
+      if (count > width) width = count;
+    }
+  }
+  return width;
+}
+
+// Fill cols [H, nb, width] (int32, zero-padded) and valid [H, nb, width]
+// (0/1 bytes) from layout [H, nb, nb].
+void ds_build_lut(int64_t H, int64_t nb, const int32_t* layout,
+                  int64_t width, int32_t* cols, uint8_t* valid) {
+#pragma omp parallel for collapse(2)
+  for (int64_t h = 0; h < H; ++h) {
+    for (int64_t r = 0; r < nb; ++r) {
+      const int32_t* row = layout + (h * nb + r) * nb;
+      int32_t* out_c = cols + (h * nb + r) * width;
+      uint8_t* out_v = valid + (h * nb + r) * width;
+      int64_t k = 0;
+      for (int64_t c = 0; c < nb; ++c) {
+        if (row[c] != 0 && k < width) {
+          out_c[k] = static_cast<int32_t>(c);
+          out_v[k] = 1;
+          ++k;
+        }
+      }
+      for (; k < width; ++k) {
+        out_c[k] = 0;
+        out_v[k] = 0;
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -40,12 +40,12 @@ def _source_hash(sources) -> str:
 
 
 def build_cpu_ops(verbose: bool = False) -> Path:
-    """Compile csrc/cpu_adam.cpp → _build/libds_cpu_ops_<hash>.so."""
-    sources = [_CSRC / "cpu_adam.cpp"]
-    missing = [str(s) for s in sources if not s.exists()]
-    if missing:
+    """Compile every csrc/*.cpp → _build/libds_cpu_ops_<hash>.so (the glob
+    keeps new sources and the cache hash in sync automatically)."""
+    sources = sorted(_CSRC.glob("*.cpp"))
+    if not sources:
         raise OpBuilderError(
-            f"native sources not found: {missing} — wheel installs ship "
+            f"no native sources under {_CSRC} — wheel installs ship "
             "without csrc/; use a source checkout (or the sdist) for the "
             "native host ops")
     tag = _source_hash(sources)
@@ -98,6 +98,12 @@ def load_cpu_ops() -> ctypes.CDLL:
     lib.ds_cpu_adam_step.restype = None
     lib.ds_f32_to_bf16.argtypes = [i64, fp, u16p]
     lib.ds_f32_to_bf16.restype = None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ds_lut_width.argtypes = [i64, i64, i32p]
+    lib.ds_lut_width.restype = i64
+    lib.ds_build_lut.argtypes = [i64, i64, i32p, i64, i32p, u8p]
+    lib.ds_build_lut.restype = None
     lib.ds_cpu_ops_version.restype = ctypes.c_int
     _lib = lib
     return lib

@@ -36,16 +36,39 @@ from .sparsity_config import FixedSparsityConfig, SparsityConfig
 _NEG_INF = float(np.finfo(np.float32).min)
 
 
-def build_lut(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+def build_lut(layout: np.ndarray,
+              use_native: bool = True) -> Tuple[np.ndarray, np.ndarray]:
     """Layout [H, nb, nb] → (cols [H, nb, width], valid [H, nb, width]).
 
     ``cols[h, r]`` lists the active key-block indices of query-block row r
     (padded with 0), ``valid`` flags real entries.  ``width`` is the max
     active count over all heads/rows — the TPU analogue of the reference's
     ``segment_blocks`` lookup-table build (csrc/sparse_attention/
-    utils.cpp:14), done in numpy because it is trace-time metadata.
+    utils.cpp:14): the native C++ pass (csrc/sparse_lut.cpp) when the
+    toolchain is available, numpy otherwise (trace-time metadata either
+    way).
     """
     H, nb, _ = layout.shape
+    if use_native:
+        from ..op_builder import OpBuilderError, load_cpu_ops
+        import ctypes
+        try:
+            lib = load_cpu_ops()
+            lay = np.ascontiguousarray(layout, dtype=np.int32)
+            lp = lay.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            width = int(lib.ds_lut_width(H, nb, lp))
+            cols = np.zeros((H, nb, width), dtype=np.int32)
+            valid = np.zeros((H, nb, width), dtype=np.uint8)
+            lib.ds_build_lut(
+                H, nb, lp, width,
+                cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+            return cols, valid.astype(bool)
+        except OpBuilderError:
+            # toolchain unavailable — numpy fallback below; any OTHER
+            # failure (ABI drift, missing symbol) must propagate, not
+            # silently demote to numpy forever
+            pass
     width = max(int(layout.sum(-1).max()), 1)
     cols = np.zeros((H, nb, width), dtype=np.int32)
     valid = np.zeros((H, nb, width), dtype=bool)

@@ -255,3 +255,21 @@ def test_bert_sparse_self_attention_shapes_and_grad():
     assert out.shape == (2, 4 * BLOCK, 64)
     g = jax.grad(lambda p: jnp.sum(layer(p, x, mask) ** 2))(params)
     assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+
+
+def test_native_lut_matches_numpy():
+    """csrc/sparse_lut.cpp vs the numpy fallback (the reference's
+    segment_blocks is likewise C++, csrc/sparse_attention/utils.cpp:14)."""
+    from deepspeed_tpu.ops.op_builder import cpu_ops_available
+    from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+        build_lut)
+
+    if not cpu_ops_available():
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(0)
+    layout = (rng.random((3, 16, 16)) < 0.3).astype(np.int64)
+    layout[:, 0, :] = 0  # an empty row must not break the width calc
+    c_nat, v_nat = build_lut(layout, use_native=True)
+    c_np, v_np = build_lut(layout, use_native=False)
+    np.testing.assert_array_equal(c_nat, c_np)
+    np.testing.assert_array_equal(v_nat, v_np)

@@ -89,10 +89,13 @@ class DeepSpeedCPUAdam:
         return float(self.lr)
 
     def step(self, params, grads, out_dtype=None):
-        """params/grads: pytrees with matching numpy fp32 leaves (params
-        updated IN PLACE).  out_dtype: None | 'bfloat16' | 'float16' —
-        fused low-precision copies returned as a matching pytree of uint16
-        views reinterpreted via numpy dtype."""
+        """params: pytree of numpy fp32 leaves (updated IN PLACE).
+        grads: matching pytree whose leaves may be numpy OR jax Arrays —
+        each leaf goes through np.asarray inside the loop, so callers can
+        start async D2H copies for all leaves and have later transfers
+        overlap earlier leaves' Adam compute.  out_dtype: None |
+        'bfloat16' | 'float16' — fused low-precision copies returned as a
+        matching pytree of reinterpreted uint16 views."""
         import jax
         self.step_count += 1
         lr = self._lr_now()

@@ -85,6 +85,15 @@ def load_cpu_ops() -> ctypes.CDLL:
     try:
         path = build_cpu_ops()
         lib = ctypes.CDLL(str(path))
+        # a partial csrc/ (stray sdist) can compile yet miss ops — surface
+        # that as the documented OpBuilderError, not a bind AttributeError
+        required = ("ds_cpu_adam_step", "ds_f32_to_bf16", "ds_lut_width",
+                    "ds_build_lut", "ds_cpu_ops_version")
+        absent = [s for s in required if not hasattr(lib, s)]
+        if absent:
+            raise OpBuilderError(
+                f"built library is missing symbols {absent} — csrc/ is "
+                "incomplete")
     except (OpBuilderError, OSError) as e:
         _compile_error = str(e)
         raise OpBuilderError(_compile_error) from None
@@ -107,6 +116,13 @@ def load_cpu_ops() -> ctypes.CDLL:
     lib.ds_cpu_ops_version.restype = ctypes.c_int
     _lib = lib
     return lib
+
+
+def cpu_ops_loaded():
+    """The already-loaded library, or None — never triggers a build.
+    For callers whose fast paths are optional (the sparse LUT build) and
+    must not pay a g++ compile on first use."""
+    return _lib
 
 
 def cpu_ops_available() -> bool:

@@ -37,7 +37,8 @@ _NEG_INF = float(np.finfo(np.float32).min)
 
 
 def build_lut(layout: np.ndarray,
-              use_native: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+              use_native: Optional[bool] = None
+              ) -> Tuple[np.ndarray, np.ndarray]:
     """Layout [H, nb, nb] → (cols [H, nb, width], valid [H, nb, width]).
 
     ``cols[h, r]`` lists the active key-block indices of query-block row r
@@ -45,30 +46,32 @@ def build_lut(layout: np.ndarray,
     active count over all heads/rows — the TPU analogue of the reference's
     ``segment_blocks`` lookup-table build (csrc/sparse_attention/
     utils.cpp:14): the native C++ pass (csrc/sparse_lut.cpp) when the
-    toolchain is available, numpy otherwise (trace-time metadata either
-    way).
+    host-ops library is up, numpy otherwise (trace-time metadata either
+    way).  ``use_native=None`` (default) uses the library only if some
+    other component (the offload tier) already built/loaded it — sparse
+    attention alone never pays a g++ compile for microseconds of metadata;
+    ``True`` forces a build, ``False`` forces numpy.
     """
     H, nb, _ = layout.shape
-    if use_native:
-        from ..op_builder import OpBuilderError, load_cpu_ops
+    if use_native or (use_native is None):
+        from ..op_builder import (OpBuilderError, cpu_ops_loaded,
+                                  load_cpu_ops)
         import ctypes
+        from ..cpu_adam import _np_ptr
         try:
-            lib = load_cpu_ops()
+            lib = load_cpu_ops() if use_native else cpu_ops_loaded()
+        except OpBuilderError:
+            lib = None  # toolchain unavailable — numpy fallback below
+        if lib is not None:
             lay = np.ascontiguousarray(layout, dtype=np.int32)
-            lp = lay.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
-            width = int(lib.ds_lut_width(H, nb, lp))
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            width = int(lib.ds_lut_width(H, nb, _np_ptr(lay, i32p)))
             cols = np.zeros((H, nb, width), dtype=np.int32)
             valid = np.zeros((H, nb, width), dtype=np.uint8)
-            lib.ds_build_lut(
-                H, nb, lp, width,
-                cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+            lib.ds_build_lut(H, nb, _np_ptr(lay, i32p), width,
+                             _np_ptr(cols, i32p), _np_ptr(valid, u8p))
             return cols, valid.astype(bool)
-        except OpBuilderError:
-            # toolchain unavailable — numpy fallback below; any OTHER
-            # failure (ABI drift, missing symbol) must propagate, not
-            # silently demote to numpy forever
-            pass
     width = max(int(layout.sum(-1).max()), 1)
     cols = np.zeros((H, nb, width), dtype=np.int32)
     valid = np.zeros((H, nb, width), dtype=bool)

@@ -1162,16 +1162,19 @@ class DeepSpeedEngine:
                 self._compute_params, batch, scaler.loss_scale, step_rng)
         finite_b = bool(finite)
         if finite_b:
-            # Device → host staging, then the native host Adam with fused
-            # bf16 copy-back, then upload.  Single-controller: device_get
-            # assembles the FULL gradient on this host and the host Adam
-            # updates the full master (host RAM is the resource offload
-            # spends; HBM is what it frees).  A multi-host offload would
-            # pull only the local reduce-scattered shard per process —
-            # not implemented yet.
-            host_grads = jax.tree.map(
-                lambda g: np.asarray(jax.device_get(g)), grads)
-            lowp = self._host_opt.step(host_grads)
+            # Device → host staging overlapped with the host Adam: start
+            # EVERY leaf's D2H transfer asynchronously, then hand the jax
+            # arrays straight to the optimizer — its per-leaf np.asarray
+            # blocks only for that leaf while later leaves stream behind
+            # the C++ Adam of earlier ones (the reference's pinned-tile
+            # double buffering, csrc/adam/cpu_adam.cpp:64-113, done by the
+            # transfer engine instead of hand-rolled buffers).
+            # Single-controller: this host assembles the FULL gradient and
+            # owns the full master (host RAM is the resource offload
+            # spends; HBM is what it frees).
+            for g in jax.tree.leaves(grads):
+                g.copy_to_host_async()
+            lowp = self._host_opt.step(grads)
             self._compute_params = _device_put_tree(
                 lowp, self._compute_shardings)
         new_scaler = precision.update_scale(

@@ -82,7 +82,9 @@ class HostOffloadOptimizer:
     def step(self, host_grads):
         """Update master/moments in place; return upload copies in the
         configured compute dtype (fp32 configs get fp32 copies — no silent
-        bf16 downgrade)."""
+        bf16 downgrade).  Grad leaves may be numpy OR jax Arrays — the
+        inner optimizer converts per leaf via np.asarray, which lets the
+        engine overlap D2H transfers with the C++ Adam compute."""
         out = self.opt.step(self.master, host_grads,
                             out_dtype=self._out_dtype)
         if self._out_dtype is None:

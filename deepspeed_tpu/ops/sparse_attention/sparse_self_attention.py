@@ -50,18 +50,18 @@ def build_lut(layout: np.ndarray,
     way).  ``use_native=None`` (default) uses the library only if some
     other component (the offload tier) already built/loaded it — sparse
     attention alone never pays a g++ compile for microseconds of metadata;
-    ``True`` forces a build, ``False`` forces numpy.
+    ``True`` forces a build (raising OpBuilderError if the toolchain is
+    missing), ``False`` forces numpy.
     """
     H, nb, _ = layout.shape
     if use_native or (use_native is None):
-        from ..op_builder import (OpBuilderError, cpu_ops_loaded,
-                                  load_cpu_ops)
+        from ..op_builder import cpu_ops_loaded, load_cpu_ops
         import ctypes
         from ..cpu_adam import _np_ptr
-        try:
-            lib = load_cpu_ops() if use_native else cpu_ops_loaded()
-        except OpBuilderError:
-            lib = None  # toolchain unavailable — numpy fallback below
+        # use_native=True: build/raise loudly (OpBuilderError when the
+        # toolchain is missing — the caller explicitly forced native);
+        # auto: only a library someone else already loaded
+        lib = load_cpu_ops() if use_native else cpu_ops_loaded()
         if lib is not None:
             lay = np.ascontiguousarray(layout, dtype=np.int32)
             i32p = ctypes.POINTER(ctypes.c_int32)

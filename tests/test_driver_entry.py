@@ -1,0 +1,60 @@
+"""Guard the driver-facing artifacts: bench.py must print one JSON line,
+__graft_entry__.entry() must jit, dryrun_multichip must run on a small
+virtual mesh.  A regression in any of these costs a whole round."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # force the CPU backend in the child (the pinned platform of THIS
+    # process does not inherit)
+    env["JAX_PLATFORMS"] = "cpu"
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run(args, cwd=REPO, env=env, timeout=timeout,
+                          capture_output=True, text=True)
+
+
+@pytest.mark.slow
+def test_bench_cpu_smoke_emits_one_json_line():
+    # the env var alone cannot pin the platform (sitecustomize forces the
+    # TPU backend); pin via jax.config before running the script
+    runner = ("import jax; jax.config.update('jax_platforms','cpu'); "
+              "import runpy, sys; sys.argv=['bench.py']; "
+              "runpy.run_path('bench.py', run_name='__main__')")
+    proc = _run([sys.executable, "-c", runner], timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["value"] > 0
+
+
+def test_graft_entry_fn_jits():
+    sys.path.insert(0, REPO)
+    import __graft_entry__ as ge
+    import jax
+
+    fn, args = ge.entry()
+    loss = jax.jit(fn)(*args)
+    import numpy as np
+    assert np.isfinite(float(np.asarray(loss)))
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_four_devices():
+    proc = _run([sys.executable, "__graft_entry__.py", "4"], timeout=480,
+                extra_env={"XLA_FLAGS":
+                           "--xla_force_host_platform_device_count=4"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    oks = [l for l in proc.stdout.splitlines() if l.endswith("OK")]
+    assert len(oks) >= 3, proc.stdout  # zero3+tp, pp, pp+zero3, offload

@@ -134,7 +134,13 @@ def cpu_ops_available() -> bool:
 
 
 def cpu_ops_status() -> str:
-    """ds_report-style one-liner for env_report."""
-    if cpu_ops_available():
-        return f"cpu_ops ... compatible (v{load_cpu_ops().ds_cpu_ops_version()})"
-    return f"cpu_ops ... NOT compatible ({_compile_error})"
+    """ds_report-style one-liner.  The diagnostic report must DESCRIBE a
+    broken library (the incomplete-csrc RuntimeError), not die on it —
+    only here; runtime callers still get the loud error."""
+    try:
+        if cpu_ops_available():
+            return ("cpu_ops ... compatible "
+                    f"(v{load_cpu_ops().ds_cpu_ops_version()})")
+        return f"cpu_ops ... NOT compatible ({_compile_error})"
+    except RuntimeError as e:
+        return f"cpu_ops ... BROKEN ({e})"

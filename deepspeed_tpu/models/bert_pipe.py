@@ -21,7 +21,7 @@ from ..ops.transformer import (DeepSpeedTransformerConfig,
                                DeepSpeedTransformerLayer)
 from ..parallel.mesh import MODEL_AXIS
 from ..pipe.module import LayerSpec, TiedLayerSpec, PipelineModule
-from .bert import BertConfig
+from .bert import BertConfig, _dropout, _layer_norm
 
 
 def _layer_cfg(cfg: BertConfig) -> DeepSpeedTransformerConfig:
@@ -33,7 +33,11 @@ def _layer_cfg(cfg: BertConfig) -> DeepSpeedTransformerConfig:
         hidden_dropout_ratio=cfg.hidden_dropout_prob,
         num_hidden_layers=cfg.num_hidden_layers,
         initializer_range=cfg.initializer_range,
-        pre_layer_norm=cfg.pre_layer_norm)
+        pre_layer_norm=cfg.pre_layer_norm,
+        normalize_invertible=cfg.normalize_invertible,
+        gelu_checkpoint=cfg.gelu_checkpoint,
+        attn_dropout_checkpoint=cfg.attn_dropout_checkpoint,
+        stochastic_mode=cfg.stochastic_mode)
 
 
 class BertEmbeddingPipe:
@@ -60,8 +64,11 @@ class BertEmbeddingPipe:
                 "ln_scale": P(), "ln_bias": P()}
 
     def apply(self, params, input_ids, rng, train: bool = True):
-        from .bert import _dropout, _layer_norm
         T = input_ids.shape[1]
+        if T > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {T} exceeds max_position_embeddings="
+                f"{self.cfg.max_position_embeddings}")
         # pipe batches carry no token_type_ids: segment 0 for every token,
         # which is tte row 0 broadcast (no per-token gather needed)
         x = (params["wte"][input_ids] + params["wpe"][:T][None]
@@ -116,7 +123,6 @@ class BertMLMTransformPipe:
         }
 
     def apply(self, params, x, rng, train: bool = True):
-        from .bert import _layer_norm
         h = x @ params["w"].astype(x.dtype) + params["b"].astype(x.dtype)
         h = jax.nn.gelu(h, approximate=False)
         return _layer_norm(h, params["ln_scale"], params["ln_bias"])

@@ -235,3 +235,34 @@ def test_unused_parameter_trains_under_zero2():
     # zero grad + zero Adam moments -> the unused leaf must not move
     np.testing.assert_array_equal(
         before, np.asarray(eng.state.master_params["never_used"]))
+
+
+def test_flax_module_adapter_trains():
+    """A flax linen model through FlaxModule + initialize — the adapter
+    path for the broader jax ecosystem."""
+    flax = pytest.importorskip("flax")
+    import flax.linen as nn
+    from deepspeed_tpu.runtime.module import FlaxModule
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(8)(x)
+
+    def loss(apply_fn, variables, batch, rng, train):
+        x, y = batch
+        pred = apply_fn(variables, x)
+        return jnp.mean((pred.astype(jnp.float32)
+                         - y.astype(jnp.float32)) ** 2)
+
+    example = next(random_batches(32, 8))
+    module = FlaxModule(MLP(), loss, example_batch=example[0])
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, config=DeepSpeedConfig(
+            base_config(micro_bs=4, stage=1), world_size=8),
+        mesh=build_mesh())
+    losses = [float(np.asarray(engine.train_batch(b)))
+              for b in random_batches(32, 8, num_batches=6, seed=5)]
+    assert losses[-1] < losses[0]

@@ -234,6 +234,12 @@ class DeepSpeedEngine:
                     "and cannot address multi-process arrays. Use "
                     "offload_impl='xla' (per-device pinned_host staging) "
                     "for multi-host runs.")
+            if config.zero_optimization_stage >= 3:
+                raise ValueError(
+                    "ZeRO-3 × cpu_offload requires offload_impl='xla' "
+                    "(data-sharded compute params); the host tier places "
+                    "replicated compute params and would silently lose "
+                    "stage 3's memory savings.")
             from .offload import HostOffloadOptimizer
             oparams = dict(config.optimizer_params)
             lr = self._lr_schedule or float(oparams.get("lr", 1e-3))
@@ -986,10 +992,13 @@ class DeepSpeedEngine:
 
     def _offload_unflatten(self, flat):
         """Flat vector -> param-shaped tree with compute shardings
-        (traceable).  On the cast-up path the input arrives already
-        replicated (_xla_offload_cast_up all-gathers once — the ZeRO param
-        all-gather, reference stage2.py:1438-1471), so the slices are
-        local and the per-leaf constraints only re-shard TP-split leaves."""
+        (traceable).  Stages ≤ 2: the cast-up path all-gathers the flat
+        vector first (the fused ZeRO param all-gather, reference
+        stage2.py:1438-1471), so slices are local and per-leaf constraints
+        only re-shard TP-split leaves.  Stage 3: the input stays
+        P('data')-sharded and the per-leaf constraints place each
+        data-sharded compute slice (real resharding, by design — ZeRO-3
+        never materializes the replica)."""
         shard_leaves = jax.tree.leaves(
             self._compute_shardings,
             is_leaf=lambda x: isinstance(x, NamedSharding))
@@ -1041,8 +1050,13 @@ class DeepSpeedEngine:
         with self._host_section():
             lowp = master_flat.astype(self.compute_dtype)
         lowp = jax.device_put(lowp, self._flat_dev_sharding)
-        lowp = jax.lax.with_sharding_constraint(
-            lowp, NamedSharding(self.mesh, P()))
+        if self.zero_plan.stage < 3:
+            # stages ≤ 2 compute on replicated params — gather once.
+            # Stage 3 (ZeRO-3 × offload, the 13B ladder rung) must NOT:
+            # its compute params stay data-sharded and the per-leaf
+            # constraints below place each slice directly.
+            lowp = jax.lax.with_sharding_constraint(
+                lowp, NamedSharding(self.mesh, P()))
         return self._offload_unflatten(lowp)
 
     def _build_xla_offload_step(self):

@@ -1040,13 +1040,14 @@ class DeepSpeedEngine:
         """Host-side cast to compute dtype + PCIe upload (half the bytes of
         shipping fp32 and casting on device), then split into the tree.
 
-        The flat vector is all-gathered ONCE before the split: per-leaf
-        resharding of slices of a dp-sharded vector fragments into hundreds
-        of tiny collectives (SPMD "involuntary full rematerialization";
-        this one constraint dropped the step's collective count 370 → 235
-        on an 8-way mesh).  This is the ZeRO param all-gather, fused —
-        peak-memory-neutral because the compute params are materialized
-        replicated either way."""
+        Stages ≤ 2: the flat vector is all-gathered ONCE before the split —
+        per-leaf resharding of slices of a dp-sharded vector fragments into
+        hundreds of tiny collectives (SPMD "involuntary full
+        rematerialization"; this one constraint dropped the step's
+        collective count 370 → 235 on an 8-way mesh).  That is the ZeRO
+        param all-gather, fused, and peak-memory-neutral there because
+        stages ≤ 2 materialize replicated compute params anyway.
+        Stage 3 skips the gather: compute params stay data-sharded."""
         with self._host_section():
             lowp = master_flat.astype(self.compute_dtype)
         lowp = jax.device_put(lowp, self._flat_dev_sharding)

@@ -218,6 +218,20 @@ def gpt2_block_forward(cfg: GPT2Config, bp, x, rng, train: bool):
             "sequence-parallel attention has no probability-dropout path")
         am = jax.sharding.get_abstract_mesh()
         sp = dict(getattr(am, "shape", {})).get(SEQ_AXIS, 1)
+        if sp > 1 and am.manual_axes:
+            # inside another manual computation (the pipeline engine's
+            # shard_map over 'pipe'; the 1-bit and CSR engine steps'
+            # shard_map over 'data') a nested shard_map over 'seq' is
+            # rejected by the partitioner — fail with the real story
+            # instead of an MLIR verification crash.  Direct attribute
+            # access on purpose: if jax renames manual_axes this guard
+            # must break loudly, not silently disable.
+            raise NotImplementedError(
+                "sequence-parallel attention cannot run inside a manual "
+                f"SPMD program (nested shard_map over '{SEQ_AXIS}' under "
+                f"manual axes {am.manual_axes}); sp composes with the "
+                "plain dp/tp/ZeRO engine paths only — not the pipeline, "
+                "1-bit, or sparse-gradient engines")
         if sp > 1:
             impl = (ring_attention if cfg.attn_impl == "ring"
                     else ulysses_attention)

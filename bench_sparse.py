@@ -66,6 +66,16 @@ def main():
                 q, k, v, causal=False))
             t_sparse = _bench(sparse_fn, q, k, v)
             t_flash = _bench(flash_fn, q, k, v)
+            # fwd+bwd (the training shape of the claim): grad of a scalar
+            # reduction through each kernel
+            sparse_g = jax.jit(jax.grad(lambda q, k, v, lay=layout: (
+                block_sparse_attention(q, k, v, lay, block)
+                .astype(jnp.float32).sum()), argnums=(0, 1, 2)))
+            flash_g = jax.jit(jax.grad(lambda q, k, v: (
+                flash_attention(q, k, v, causal=False)
+                .astype(jnp.float32).sum()), argnums=(0, 1, 2)))
+            t_sparse_bwd = _bench(sparse_g, q, k, v)
+            t_flash_bwd = _bench(flash_g, q, k, v)
             t_dense = None
             if T <= 8192:  # dense scores get big fast
 
@@ -89,6 +99,10 @@ def main():
                 "speedup_vs_flash": round(t_flash / t_sparse, 2),
                 "speedup_vs_dense": (round(t_dense / t_sparse, 2)
                                      if t_dense else None),
+                "sparse_fwdbwd_ms": round(t_sparse_bwd * 1e3, 3),
+                "flash_fwdbwd_ms": round(t_flash_bwd * 1e3, 3),
+                "speedup_vs_flash_fwdbwd": round(
+                    t_flash_bwd / t_sparse_bwd, 2),
             }
             results.append(rec)
             print(json.dumps(rec))

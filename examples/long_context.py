@@ -40,8 +40,13 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax
 
-    n = len(jax.devices())
+    # multi-host contract: join the jax.distributed world BEFORE the
+    # first device enumeration (initialize() does this internally when it
+    # builds the mesh; here we build our own)
+    deepspeed_tpu.init_distributed()
     sp = args.sp if args.attn in ("ring", "ulysses") else 1
+    if args.seq % max(sp, 1):
+        parser.error(f"--seq {args.seq} must be divisible by --sp {sp}")
     mesh = build_mesh(pp=1, sp=sp, tp=1, devices=jax.devices())
     model = GPT2Model(GPT2Config(
         vocab_size=4096, n_positions=args.seq, d_model=128, n_layer=2,

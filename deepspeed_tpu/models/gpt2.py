@@ -41,7 +41,8 @@ class GPT2Config:
     dropout: float = 0.0
     embd_dropout: float = 0.0
     remat: Optional[str] = "block"   # None | 'block'
-    attn_impl: str = "flash"         # 'flash' (Pallas kernel) | 'dense'
+    attn_impl: str = "flash"         # 'flash' (Pallas) | 'dense' |
+                                     # 'ring' | 'ulysses' (seq-parallel)
     scan_layers: bool = True         # False: unroll (≈25% faster on TPU —
                                      # XLA optimizes across layer bounds —
                                      # at the cost of depth-linear compile)

@@ -245,8 +245,13 @@ def main():
         # parse/validate ALL env knobs outside the fallback guard: a typo
         # must fail loudly, not silently demote the run to 124M
         _, _, _, deadline = _15b_knobs()
+        # host tier first: it is a plain jit step (no compute_on host
+        # sections), the same program shape as the known-good 124M path.
+        # The xla tier stalled natively for >9 min through the axon tunnel
+        # once (BENCH_NOTES.md) and a native stall is not watchdoggable —
+        # an un-produced artifact is worse than a slower one.
         impls = [s.strip() for s in
-                 os.environ.get("BENCH_15B_IMPL", "xla,host").split(",")]
+                 os.environ.get("BENCH_15B_IMPL", "host,xla").split(",")]
         bad = [s for s in impls if s not in ("xla", "host")]
         if bad:
             raise ValueError(f"BENCH_15B_IMPL contains {bad}; valid: "

@@ -2,4 +2,5 @@ from .gpt2 import (
     GPT2Config, GPT2Model,
     GPT2_SMALL, GPT2_MEDIUM, GPT2_LARGE, GPT2_XL,
 )
+from .gpt2_moe import GPT2MoEConfig, GPT2MoEModel
 from .bert import BertConfig, BertModel, BERT_BASE, BERT_LARGE

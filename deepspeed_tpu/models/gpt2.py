@@ -185,11 +185,30 @@ class GPT2Model(TrainModule):
 
 def gpt2_block_forward(cfg: GPT2Config, bp, x, rng, train: bool):
     """One pre-LN transformer block over unstacked per-layer params — the
-    single source of the block math, shared by the scan-over-layers model
-    and the pipeline flavor (models/gpt2_pipe.py)."""
+    single source of the block math, shared by the scan-over-layers model,
+    the pipeline flavor (models/gpt2_pipe.py), and the MoE flavor
+    (models/gpt2_moe.py, which swaps the FFN sublayer)."""
+    r_attn, r3 = jax.random.split(rng)
+    drop = cfg.dropout if train else 0.0
+    x = gpt2_attn_sublayer(cfg, bp, x, r_attn, train)
+    h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    h = gpt2_ffn(bp, h)
+    return x + _dropout(h, drop, r3)
+
+
+def gpt2_ffn(bp, h):
+    """fc → gelu → proj over already-normalized input (dense FFN body,
+    shared with the MoE flavor's dense blocks)."""
+    h = h @ bp["fc_w"].astype(h.dtype) + bp["fc_b"].astype(h.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    return h @ bp["proj_w"].astype(h.dtype) + bp["proj_b"].astype(h.dtype)
+
+
+def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
+    """ln1 → attention → residual (the block minus its FFN sublayer)."""
     B, T, D = x.shape
     H, Dh = cfg.n_head, cfg.d_head
-    r1, r2, r3 = jax.random.split(rng, 3)
+    r1, r2 = jax.random.split(rng)
     drop = cfg.dropout if train else 0.0
 
     h = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
@@ -250,13 +269,7 @@ def gpt2_block_forward(cfg: GPT2Config, bp, x, rng, train: bool):
             "'ring', or 'ulysses'")
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
     attn = attn @ bp["out_w"].astype(h.dtype) + bp["out_b"].astype(h.dtype)
-    x = x + _dropout(attn, drop, r2)
-
-    h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
-    h = h @ bp["fc_w"].astype(h.dtype) + bp["fc_b"].astype(h.dtype)
-    h = jax.nn.gelu(h, approximate=True)
-    h = h @ bp["proj_w"].astype(h.dtype) + bp["proj_b"].astype(h.dtype)
-    return x + _dropout(h, drop, r3)
+    return x + _dropout(attn, drop, r2)
 
 
 def _layer_norm(x, scale, bias, eps: float = 1e-5):

@@ -1,0 +1,235 @@
+"""GPT-2 Mixture-of-Experts flavor — expert parallelism over the mesh.
+
+Expert parallelism is absent from the reference snapshot (SURVEY.md §2.4
+lists EP/MoE as not present in v0.3.2); this model fills that modern slot
+the way DeepSpeed-MoE later does — alternating dense/MoE transformer
+blocks, top-1/2 token routing with capacity, experts sharded over the
+data-parallel group (ep ⊆ dp) — but as placement on one compiled program
+rather than explicit expert process groups: the expert dim of the stacked
+MoE weights carries ``P('data', ...)`` (see moe/layer.py) and the
+dispatch/combine all_to_alls are inserted by GSPMD.
+
+Blocks run unrolled (not scanned): dense and MoE blocks alternate, so the
+layer loop is heterogeneous; depth-linear compile is the usual trade for
+MoE models at the sizes this flavor targets.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..moe.layer import MoEConfig, init_moe_params, moe_ffn, moe_param_specs
+from ..parallel.mesh import MODEL_AXIS
+from ..runtime.module import TrainModule
+from .gpt2 import (GPT2Config, _dropout, _layer_norm, gpt2_attn_sublayer,
+                   gpt2_ffn)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2MoEConfig(GPT2Config):
+    n_experts: int = 8
+    moe_top_k: int = 1
+    moe_layer_freq: int = 2           # every freq-th block is MoE
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    aux_loss_weight: float = 1e-2
+    router_z_loss_weight: float = 0.0
+    router_jitter: float = 0.0
+    # the dense/MoE block alternation makes the layer loop heterogeneous:
+    # this flavor always unrolls (no lax.scan over layers)
+    scan_layers: bool = False
+
+    def __post_init__(self):
+        if self.scan_layers:
+            raise ValueError(
+                "GPT2MoEModel always unrolls its heterogeneous layer "
+                "loop; scan_layers=True is not supported")
+        if self.moe_layer_freq < 1:
+            raise ValueError(
+                f"moe_layer_freq must be >= 1, got {self.moe_layer_freq}")
+        if not any(self.is_moe_layer(i) for i in range(self.n_layer)):
+            raise ValueError(
+                f"GPT2MoEConfig with n_layer={self.n_layer}, "
+                f"moe_layer_freq={self.moe_layer_freq} yields zero MoE "
+                "layers — use GPT2Config/GPT2Model for a dense model")
+        self.moe_cfg()  # validate the routing knobs at config time
+
+    def moe_cfg(self) -> MoEConfig:
+        return MoEConfig(
+            n_experts=self.n_experts, d_model=self.d_model,
+            d_ff=4 * self.d_model, top_k=self.moe_top_k,
+            capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            aux_loss_weight=self.aux_loss_weight,
+            z_loss_weight=self.router_z_loss_weight,
+            router_jitter=self.router_jitter)
+
+    def is_moe_layer(self, i: int) -> bool:
+        # MoE on the last block of each freq-group (layer 1, 3, ... for
+        # freq=2) — DeepSpeed-MoE's alternating placement.
+        return (i % self.moe_layer_freq) == self.moe_layer_freq - 1
+
+    @property
+    def moe_layers(self):
+        return [i for i in range(self.n_layer) if self.is_moe_layer(i)]
+
+    @property
+    def num_params(self) -> int:
+        """Accurate MoE count (overrides the dense formula): each MoE
+        block swaps the dense FFN for E experts plus the router."""
+        d, L, E = self.d_model, self.n_layer, self.n_experts
+        n_moe = len(self.moe_layers)
+        attn_per_block = (4 * d            # ln1/ln2 scales+biases
+                          + d * 3 * d + 3 * d
+                          + d * d + d)
+        dense_ffn = d * 4 * d + 4 * d + 4 * d * d + d
+        moe_ffn_params = d * E + E * (d * 4 * d + 4 * d
+                                      + 4 * d * d + d)
+        return (self.vocab_size * d + self.n_positions * d + 2 * d
+                + L * attn_per_block
+                + (L - n_moe) * dense_ffn + n_moe * moe_ffn_params)
+
+
+class GPT2MoEModel(TrainModule):
+    """Causal LM where alternate blocks use a top-k routed expert FFN."""
+
+    def __init__(self, config: GPT2MoEConfig):
+        self.config = config
+
+    # ---------------- init ----------------
+    def init(self, rng) -> Dict[str, Any]:
+        cfg = self.config
+        d, L = cfg.d_model, cfg.n_layer
+        keys = jax.random.split(rng, 8)
+        std = 0.02
+        resid_std = std / jnp.sqrt(2.0 * L)
+
+        def norm(key, shape, s=std):
+            return jax.random.normal(key, shape, jnp.float32) * s
+
+        # attention sublayer params for ALL blocks, stacked [L, ...]
+        attn = {
+            "ln1_scale": jnp.ones((L, d), jnp.float32),
+            "ln1_bias": jnp.zeros((L, d), jnp.float32),
+            "qkv_w": norm(keys[2], (L, d, 3 * d)),
+            "qkv_b": jnp.zeros((L, 3 * d), jnp.float32),
+            "out_w": norm(keys[3], (L, d, d), resid_std),
+            "out_b": jnp.zeros((L, d), jnp.float32),
+            "ln2_scale": jnp.ones((L, d), jnp.float32),
+            "ln2_bias": jnp.zeros((L, d), jnp.float32),
+        }
+        # dense FFN params for the non-MoE blocks, stacked [L_dense, ...]
+        Ld = L - len(cfg.moe_layers)
+        dense = {
+            "fc_w": norm(keys[4], (Ld, d, 4 * d)),
+            "fc_b": jnp.zeros((Ld, 4 * d), jnp.float32),
+            "proj_w": norm(keys[5], (Ld, 4 * d, d), resid_std),
+            "proj_b": jnp.zeros((Ld, d), jnp.float32),
+        }
+        # MoE params stacked over the MoE layers [L_moe, E, ...]
+        # (__post_init__ guarantees at least one MoE layer)
+        mcfg = cfg.moe_cfg()
+        mkeys = jax.random.split(keys[6], len(cfg.moe_layers))
+        moe_leaves = [init_moe_params(k, mcfg, std=std, out_std=resid_std)
+                      for k in mkeys]
+        moe = jax.tree.map(lambda *ls: jnp.stack(ls), *moe_leaves)
+        return {
+            "wte": norm(keys[0], (cfg.vocab_size, d)),
+            "wpe": norm(keys[1], (cfg.n_positions, d)),
+            "ln_f_scale": jnp.ones((d,), jnp.float32),
+            "ln_f_bias": jnp.zeros((d,), jnp.float32),
+            "attn": attn,
+            "dense_ffn": dense,
+            "moe": moe,
+        }
+
+    # ---------------- EP/TP declaration ----------------
+    def param_partition_specs(self, params) -> Dict[str, Any]:
+        m = MODEL_AXIS
+        return {
+            "wte": P(m, None),
+            "wpe": P(),
+            "ln_f_scale": P(),
+            "ln_f_bias": P(),
+            "attn": {
+                "ln1_scale": P(), "ln1_bias": P(),
+                "qkv_w": P(None, None, m),
+                "qkv_b": P(None, m),
+                "out_w": P(None, m, None),
+                "out_b": P(),
+                "ln2_scale": P(), "ln2_bias": P(),
+            },
+            "dense_ffn": {
+                "fc_w": P(None, None, m),
+                "fc_b": P(None, m),
+                "proj_w": P(None, m, None),
+                "proj_b": P(),
+            },
+            "moe": moe_param_specs(tp_axis=m, stacked=True),
+        }
+
+    # ---------------- forward ----------------
+    def apply(self, params, tokens: jnp.ndarray, rng, train: bool = True):
+        """tokens [B, T] → (logits [B, T, vocab], total weighted aux)."""
+        cfg = self.config
+        B, T = tokens.shape
+        if T > cfg.n_positions:
+            raise ValueError(
+                f"sequence length {T} exceeds n_positions={cfg.n_positions}")
+        x = params["wte"][tokens] + params["wpe"][:T][None]
+        x = _dropout(x, cfg.embd_dropout if train else 0.0,
+                     jax.random.fold_in(rng, 997))
+
+        mcfg = cfg.moe_cfg()
+        drop = cfg.dropout if train else 0.0
+
+        def dense_block(x, ap, dp, lrng):
+            r_attn, r_ffn = jax.random.split(lrng)
+            x = gpt2_attn_sublayer(cfg, ap, x, r_attn, train)
+            h = _layer_norm(x, ap["ln2_scale"], ap["ln2_bias"])
+            y = gpt2_ffn(dp, h)
+            return x + _dropout(y, drop, jax.random.fold_in(r_ffn, 1))
+
+        def moe_block(x, ap, mp, lrng):
+            r_attn, r_ffn = jax.random.split(lrng)
+            x = gpt2_attn_sublayer(cfg, ap, x, r_attn, train)
+            h = _layer_norm(x, ap["ln2_scale"], ap["ln2_bias"])
+            y, aux = moe_ffn(mcfg, mp, h, r_ffn, train)
+            return x + _dropout(y, drop, jax.random.fold_in(r_ffn, 1)), aux
+
+        if cfg.remat == "block":
+            dense_block = jax.checkpoint(dense_block)
+            moe_block = jax.checkpoint(moe_block)
+
+        aux_total = jnp.zeros((), jnp.float32)
+        d_idx = m_idx = 0
+        for i in range(cfg.n_layer):
+            lrng = jax.random.fold_in(rng, i)
+            ap = jax.tree.map(lambda a, i=i: a[i], params["attn"])
+            if cfg.is_moe_layer(i):
+                mp = jax.tree.map(lambda a, j=m_idx: a[j], params["moe"])
+                x, aux = moe_block(x, ap, mp, lrng)
+                aux_total = aux_total + aux
+                m_idx += 1
+            else:
+                dp = jax.tree.map(
+                    lambda a, j=d_idx: a[j], params["dense_ffn"])
+                x = dense_block(x, ap, dp, lrng)
+                d_idx += 1
+
+        x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+        logits = x @ params["wte"].astype(x.dtype).T
+        return logits, aux_total
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        tokens = batch["input_ids"] if isinstance(batch, dict) else batch
+        logits, aux = self.apply(params, tokens[:, :-1], rng, train)
+        targets = tokens[:, 1:]
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.mean(nll) + aux

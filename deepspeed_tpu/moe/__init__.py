@@ -1,0 +1,3 @@
+from .layer import (MoEConfig, init_moe_params, moe_param_specs, moe_ffn)
+
+__all__ = ["MoEConfig", "init_moe_params", "moe_param_specs", "moe_ffn"]

@@ -1,0 +1,210 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``data`` axis.
+
+The reference (DeepSpeed v0.3.2) predates DeepSpeed-MoE — SURVEY.md §2.4
+records expert parallelism as absent — so, like sequence parallelism
+(parallel/sequence.py), this fills the modern feature slot the way the
+framework's later versions do, designed TPU-first rather than ported:
+
+  - routing, dispatch, and combine are dense one-hot einsums (the GShard
+    formulation): no scatter/gather, no dynamic shapes — every op tiles
+    onto the MXU and the dispatch/combine "communication" lowers to XLA
+    all_to_alls when the expert dim is sharded;
+  - expert parallelism is a *placement decision*, exactly like ZeRO and
+    Megatron TP elsewhere in this codebase: expert-stacked weights
+    ``[E, d, f]`` declare ``P('data', ...)`` on the expert dim
+    (``moe_param_specs``) and GSPMD partitions the expert compute over the
+    data-parallel group — the same ep⊆dp mapping DeepSpeed-MoE uses for
+    its expert groups;
+  - expert weights can ALSO shard their feature dim over ``model``
+    (column/row-parallel experts), composing EP × TP in one spec;
+  - capacity is static (``ceil(top_k · cf · tokens / E)``): overflow
+    tokens are dropped (their combine weight is zero) and flow through
+    the residual connection, the standard Switch/GShard contract.
+
+Gating runs in fp32 regardless of compute dtype; the auxiliary
+load-balancing loss (Switch: ``E · Σ_e fraction_routed_e · mean_prob_e``)
+and the router z-loss are returned for the model to fold into its total
+loss.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int
+    d_model: int
+    d_ff: int
+    top_k: int = 1                    # 1 = Switch, 2 = GShard
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    aux_loss_weight: float = 1e-2
+    z_loss_weight: float = 0.0
+    router_jitter: float = 0.0        # multiplicative input noise, train only
+
+    def __post_init__(self):
+        if self.top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
+        if self.n_experts < 1:
+            raise ValueError(f"n_experts must be >= 1, got {self.n_experts}")
+
+    def capacity(self, tokens_per_group: int, train: bool) -> int:
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        c = math.ceil(self.top_k * cf * tokens_per_group / self.n_experts)
+        return max(1, min(tokens_per_group, c))
+
+
+def init_moe_params(rng, cfg: MoEConfig, std: float = 0.02,
+                    out_std: Optional[float] = None) -> Dict[str, Any]:
+    """Expert-stacked FFN weights + router. ``out_std`` scales the output
+    projection (models pass their residual-scaled std)."""
+    E, d, f = cfg.n_experts, cfg.d_model, cfg.d_ff
+    k_g, k_i, k_o = jax.random.split(rng, 3)
+    return {
+        "wg": jax.random.normal(k_g, (d, E), jnp.float32) * std,
+        "wi": jax.random.normal(k_i, (E, d, f), jnp.float32) * std,
+        "bi": jnp.zeros((E, f), jnp.float32),
+        "wo": jax.random.normal(k_o, (E, f, d), jnp.float32)
+        * (std if out_std is None else out_std),
+        "bo": jnp.zeros((E, d), jnp.float32),
+    }
+
+
+def moe_param_specs(ep_axis: str = DATA_AXIS,
+                    tp_axis: Optional[str] = MODEL_AXIS,
+                    stacked: bool = False) -> Dict[str, P]:
+    """Placement: expert dim over ``ep_axis`` (expert parallelism), hidden
+    feature dim over ``tp_axis`` (column/row-parallel experts).  With
+    ``stacked`` the specs gain a leading ``None`` for a layer axis."""
+    lead = (None,) if stacked else ()
+    tp = tp_axis  # None disables the TP split
+    return {
+        "wg": P(*lead),                        # tiny; replicate
+        "wi": P(*lead, ep_axis, None, tp),     # column parallel
+        "bi": P(*lead, ep_axis, tp),
+        "wo": P(*lead, ep_axis, tp, None),     # row parallel
+        "bo": P(*lead, ep_axis, None),
+    }
+
+
+def _constrain(x, spec: P):
+    """Sharding constraint that is a no-op when no mesh context is set
+    (pure single-device unit tests) — the engine always runs its step
+    under ``jax.set_mesh``, where the constraint binds."""
+    mesh = jax.sharding.get_abstract_mesh()
+    # Direct attribute access on purpose (mirrors gpt2.py's sp guard): if
+    # jax renames manual_axes this must break loudly, not silently start
+    # constraining inside manual computations.
+    if mesh is None or not mesh.shape or mesh.manual_axes:
+        return x
+    return jax.lax.with_sharding_constraint(x, P(*spec))
+
+
+def _top1_dispatch(probs, capacity: int):
+    """probs [G,S,E] → (dispatch [G,S,E,C] {0,1}, combine [G,S,E,C])."""
+    E = probs.shape[-1]
+    idx = jnp.argmax(probs, axis=-1)
+    mask = jax.nn.one_hot(idx, E, dtype=probs.dtype)          # [G,S,E]
+    gate = jnp.sum(probs * mask, axis=-1)                     # [G,S]
+    pos = jnp.cumsum(mask, axis=1) * mask - 1.0               # [G,S,E]
+    keep = (pos >= 0) & (pos < capacity)
+    dispatch = jax.nn.one_hot(
+        pos.astype(jnp.int32), capacity, dtype=probs.dtype) \
+        * (mask * keep)[..., None]                            # [G,S,E,C]
+    combine = gate[..., None, None] * dispatch
+    return dispatch, combine, mask
+
+
+def _top2_dispatch(probs, capacity: int):
+    """GShard top-2: second expert's gate renormalized against the first;
+    its capacity positions come after all top-1 assignments."""
+    E = probs.shape[-1]
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=probs.dtype)
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=probs.dtype)
+    g1 = jnp.sum(probs * mask1, axis=-1)
+    g2 = jnp.sum(probs * mask2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    pos1 = jnp.cumsum(mask1, axis=1) * mask1 - 1.0
+    # second choices queue behind every first-choice assignment in the group
+    count1 = jnp.sum(mask1, axis=1, keepdims=True)            # [G,1,E]
+    pos2 = (jnp.cumsum(mask2, axis=1) + count1) * mask2 - 1.0
+
+    def one_hot_disp(pos, mask):
+        keep = (pos >= 0) & (pos < capacity)
+        return jax.nn.one_hot(
+            pos.astype(jnp.int32), capacity, dtype=probs.dtype) \
+            * (mask * keep)[..., None]
+
+    d1 = one_hot_disp(pos1, mask1)
+    d2 = one_hot_disp(pos2, mask2)
+    dispatch = d1 + d2
+    combine = g1[..., None, None] * d1 + g2[..., None, None] * d2
+    return dispatch, combine, mask1
+
+
+def moe_ffn(cfg: MoEConfig, mp: Dict[str, Any], x: jnp.ndarray, rng,
+            train: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x [G, S, d] → (y [G, S, d], weighted aux-loss scalar fp32).
+
+    Dropped (over-capacity) tokens produce y=0 at their positions; the
+    caller's residual connection carries them through unchanged.
+    """
+    G, S, d = x.shape
+    E = cfg.n_experts
+    C = cfg.capacity(S, train)
+    x_gate = x.astype(jnp.float32)
+    if train and cfg.router_jitter > 0.0:
+        eps = cfg.router_jitter
+        x_gate = x_gate * jax.random.uniform(
+            jax.random.fold_in(rng, 11), x_gate.shape, jnp.float32,
+            1.0 - eps, 1.0 + eps)
+    logits = x_gate @ mp["wg"]                                # [G,S,E] fp32
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    if cfg.top_k == 1:
+        dispatch, combine, mask1 = _top1_dispatch(probs, C)
+    else:
+        dispatch, combine, mask1 = _top2_dispatch(probs, C)
+
+    # Switch load-balance loss: E · Σ_e (fraction of tokens routed to e) ·
+    # (mean router prob of e); 1.0 at perfect balance.  The returned term
+    # is already weighted — the caller just adds it to its loss.
+    density = jnp.mean(mask1, axis=(0, 1))
+    density_proxy = jnp.mean(probs, axis=(0, 1))
+    aux = cfg.aux_loss_weight * E * jnp.sum(density * density_proxy)
+    if cfg.z_loss_weight > 0.0:
+        z = jax.scipy.special.logsumexp(logits, axis=-1)
+        aux = aux + cfg.z_loss_weight * jnp.mean(z * z)
+
+    dt = x.dtype
+    dispatch = dispatch.astype(dt)
+    combine = combine.astype(dt)
+    # dispatch: tokens → per-expert capacity slots.  With the expert dim
+    # sharded over ``data`` and the batch dim likewise, GSPMD lowers the
+    # resharding below to an all_to_all over the data axis — the dispatch
+    # communication DeepSpeed-MoE issues explicitly.
+    ein = jnp.einsum("gsec,gsd->egcd", dispatch, x)
+    ein = _constrain(ein, P(DATA_AXIS, None, None, None))
+    h = jnp.einsum("egcd,edf->egcf", ein, mp["wi"].astype(dt))
+    h = h + mp["bi"].astype(dt)[:, None, None, :]
+    h = jax.nn.gelu(h, approximate=True)
+    eo = jnp.einsum("egcf,efd->egcd", h, mp["wo"].astype(dt))
+    eo = eo + mp["bo"].astype(dt)[:, None, None, :]
+    eo = _constrain(eo, P(DATA_AXIS, None, None, None))
+    y = jnp.einsum("gsec,egcd->gsd", combine, eo)             # combine a2a
+    y = _constrain(y, P(DATA_AXIS, None, None))
+    return y, aux

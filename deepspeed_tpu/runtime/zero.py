@@ -52,6 +52,14 @@ def shard_spec_for_leaf(shape: tuple,
     base += [None] * (len(shape) - len(base))
     if axis_size <= 1:
         return P(*base)
+    # A base spec may already consume the axis (expert-parallel weights
+    # shard their expert dim over ``data``); a mesh axis can appear at most
+    # once in a PartitionSpec, so ZeRO then has nothing to add.
+    def _uses_axis(entry) -> bool:
+        return (axis_name in entry if isinstance(entry, tuple)
+                else entry == axis_name)
+    if any(_uses_axis(e) for e in base if e is not None):
+        return P(*base)
     for i, d in enumerate(shape):
         if base[i] is None and d % axis_size == 0 and d > 0:
             base[i] = axis_name

@@ -129,11 +129,14 @@ class DeepSpeedEngine:
         master = jax.tree.map(
             lambda x: x.astype(jnp.float32)
             if jnp.issubdtype(x.dtype, jnp.floating) else x, raw_params)
-        base_specs = model.param_partition_specs(master)
         self.zero_plan = ZeroShardingPlan(
             stage=config.zero_optimization_stage, mesh=self.mesh,
-            base_param_specs=base_specs,
-            offload=config.zero_config.cpu_offload)
+            base_param_specs=model.param_partition_specs(master),
+            offload=config.zero_config.cpu_offload,
+            params=master)
+        # sanitized in the plan: indivisible dims fall back to replication
+        # (e.g. 4 experts declared over an 8-way data axis)
+        base_specs = self.zero_plan.base_param_specs
 
         scaler, self.loss_scale_config = precision.from_fp16_config(config.fp16)
         # 1-bit Adam engages a dedicated shard_map step (local grads feed

@@ -67,20 +67,55 @@ def shard_spec_for_leaf(shape: tuple,
     return P(*base)  # too small / indivisible: replicate (no padding on TPU)
 
 
+def sanitize_base_spec(spec: Optional[P], shape: tuple, mesh: Mesh) -> \
+        Optional[P]:
+    """Drop base-spec axis assignments whose leaf dim is not divisible by
+    the mesh-axis size (product, for tuple entries) — the leaf falls back
+    to replication on that dim, the same no-padding rule ZeRO applies to
+    its own ``data``-axis sharding above.  Concretely: a model declaring
+    expert-parallel ``P('data', ...)`` on a 4-expert weight keeps training
+    on a dp=8 mesh instead of failing NamedSharding validation."""
+    if spec is None:
+        return None
+    if len(spec) > len(shape):
+        raise ValueError(
+            f"partition spec {spec} has more entries than array rank "
+            f"{len(shape)} (shape {shape}) — model param_partition_specs "
+            "and param tree disagree")
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    out = []
+    for i, e in enumerate(entries):
+        if e is None:
+            out.append(None)
+            continue
+        names = e if isinstance(e, tuple) else (e,)
+        size = int(np.prod([mesh.shape.get(n, 1) for n in names]))
+        out.append(e if size > 0 and shape[i] % size == 0 else None)
+    return P(*out)
+
+
 class ZeroShardingPlan:
     """Per-stage placement rules for the train-state pytree."""
 
     def __init__(self, stage: int, mesh: Mesh,
                  base_param_specs: Optional[Any] = None,
-                 offload: bool = False):
+                 offload: bool = False,
+                 params: Optional[Any] = None):
         if not 0 <= stage <= 3:
             raise ValueError(f"ZeRO stage must be 0..3, got {stage}")
         self.stage = stage
         self.mesh = mesh
         self.offload = offload
         self.dp = mesh.shape.get(DATA_AXIS, 1)
-        # base specs carry tensor-parallel ('model' axis) placement decided by
-        # the model; ZeRO composes the 'data' axis on top.
+        # base specs carry tensor/expert-parallel placement decided by the
+        # model; ZeRO composes the 'data' axis on top.  Sanitized ONCE here
+        # (indivisible dims → replicated); ``params`` supplies leaf shapes.
+        if base_param_specs is not None and params is not None:
+            base_param_specs = jax.tree.map(
+                lambda s, l: sanitize_base_spec(
+                    s, _leaf_shape(l), mesh),
+                base_param_specs, params,
+                is_leaf=lambda x: isinstance(x, P))
         self.base_param_specs = base_param_specs
 
     # -- helpers --------------------------------------------------------

@@ -145,6 +145,19 @@ def test_moe_engine_ep_tp_compose():
     assert np.isfinite(loss)
 
 
+def test_moe_indivisible_experts_fall_back_to_replication():
+    """4 experts on a dp=8 mesh: the EP spec's expert dim is indivisible,
+    so it must be dropped (replicated) rather than failing NamedSharding
+    validation — ZeRO then shards a divisible dim of the master copy."""
+    model, cfg = _moe_model(n_experts=4)
+    mesh = build_mesh(dp=8)
+    eng = _engine(model, mesh, zero_stage=2, micro=1, ga=1)
+    spec = eng.state.master_params["moe"]["wi"].sharding.spec
+    assert "data" not in (spec[1],), f"indivisible expert dim kept: {spec}"
+    loss = float(np.asarray(eng.train_batch(_tokens(8))))
+    assert np.isfinite(loss)
+
+
 def test_moe_matches_dense_when_single_expert():
     """A 1-expert MoE GPT-2 trains to the same loss trajectory as an
     equivalent routing-free computation (smoke parity, bf16 tolerance)."""

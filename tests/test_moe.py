@@ -97,6 +97,9 @@ def test_router_grads_flow():
 
 
 def _moe_model(n_layer=2, n_experts=4, **kw):
+    # remat=None: these are routing/placement tests, and skipping the
+    # checkpoint-policy tracing roughly halves their compile time
+    kw.setdefault("remat", None)
     cfg = GPT2MoEConfig(vocab_size=128, n_positions=32, d_model=32,
                         n_layer=n_layer, n_head=4, attn_impl="dense",
                         n_experts=n_experts, **kw)

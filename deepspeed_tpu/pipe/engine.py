@@ -345,7 +345,7 @@ class PipelineEngine(DeepSpeedEngine):
     def _scan_grad_acc(self) -> int:
         return 1  # all micro-batches live inside the pipelined program
 
-    def eval_batch(self, batch):
+    def eval_batch(self, batch=None, data_iter=None):
         """Forward-only pipelined evaluation (reference
         PipelineEngine.eval_batch, pipe/engine.py:305-363, which executes
         the InferenceSchedule).  Here the same compiled fill/drain scan
@@ -354,6 +354,12 @@ class PipelineEngine(DeepSpeedEngine):
         case of the train program rather than a second schedule.  The batch
         is split into the engine's micro-batches exactly like training
         (reference :329-335 builds the same micro-batch iterator)."""
+        if batch is None:
+            it = data_iter or self._training_iter()
+            if it is None:
+                raise ValueError("eval_batch needs a batch or a data_iter")
+            batch = next(it)
+
         def check(x):
             x = np.asarray(x)
             if x.shape[0] % self.micro_batches != 0:

@@ -1460,7 +1460,15 @@ class DeepSpeedEngine:
                                      else iter(loader))
         return self._train_data_iter
 
-    def eval_batch(self, batch):
+    def eval_batch(self, batch=None, data_iter=None):
+        """Forward-only loss on one batch; like ``train_batch`` it also
+        accepts a ``data_iter`` (the reference's eval_batch signature,
+        pipe/engine.py:305 there)."""
+        if batch is None:
+            it = data_iter or self._training_iter()
+            if it is None:
+                raise ValueError("eval_batch needs a batch or a data_iter")
+            batch = next(it)
         micro = jax.tree.map(np.asarray, batch)
         rng = jax.random.fold_in(self._data_rng, self.micro_steps)
         with self._pallas_scope():

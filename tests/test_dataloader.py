@@ -76,3 +76,7 @@ def test_initialize_with_training_data_trains():
     losses = [float(np.asarray(engine.train_batch(data_iter=it)))
               for _ in range(4)]
     assert all(np.isfinite(l) for l in losses)
+    # eval_batch shares the data_iter signature (reference
+    # pipe/engine.py:305 there)
+    ev = float(np.asarray(engine.eval_batch(data_iter=it)))
+    assert np.isfinite(ev)

@@ -9,9 +9,11 @@ rather than explicit expert process groups: the expert dim of the stacked
 MoE weights carries ``P('data', ...)`` (see moe/layer.py) and the
 dispatch/combine all_to_alls are inserted by GSPMD.
 
-Blocks run unrolled (not scanned): dense and MoE blocks alternate, so the
-layer loop is heterogeneous; depth-linear compile is the usual trade for
-MoE models at the sizes this flavor targets.
+The per-layer loop is heterogeneous (dense and MoE blocks alternate), so
+blocks run unrolled by default; ``scan_groups=True`` instead scans over
+homogeneous groups of ``moe_layer_freq`` blocks (freq-1 dense + 1 MoE) —
+one compiled group body, compile time O(1) in depth, bit-identical math
+and RNG streams to the unrolled path.
 """
 from __future__ import annotations
 
@@ -39,9 +41,13 @@ class GPT2MoEConfig(GPT2Config):
     aux_loss_weight: float = 1e-2
     router_z_loss_weight: float = 0.0
     router_jitter: float = 0.0
-    # the dense/MoE block alternation makes the layer loop heterogeneous:
-    # this flavor always unrolls (no lax.scan over layers)
+    # the dense/MoE block alternation makes the per-LAYER loop
+    # heterogeneous, so GPT2Config's scan_layers is not supported; the
+    # depth-scalable equivalent is scan_groups: lax.scan over homogeneous
+    # groups of moe_layer_freq blocks (freq-1 dense + 1 MoE) — one
+    # compiled group body, compile time O(1) in depth
     scan_layers: bool = False
+    scan_groups: bool = False
 
     def __post_init__(self):
         if self.scan_layers:
@@ -56,6 +62,23 @@ class GPT2MoEConfig(GPT2Config):
                 f"GPT2MoEConfig with n_layer={self.n_layer}, "
                 f"moe_layer_freq={self.moe_layer_freq} yields zero MoE "
                 "layers — use GPT2Config/GPT2Model for a dense model")
+        if self.scan_groups:
+            if self.n_layer % self.moe_layer_freq != 0:
+                raise ValueError(
+                    f"scan_groups needs n_layer ({self.n_layer}) divisible "
+                    f"by moe_layer_freq ({self.moe_layer_freq}) — the scan "
+                    "body is one homogeneous group")
+            # the scan body hardcodes MoE-last-in-group; bind that to
+            # is_moe_layer so an overridden placement cannot silently
+            # diverge from the unrolled path
+            freq = self.moe_layer_freq
+            expect = [g * freq + freq - 1
+                      for g in range(self.n_layer // freq)]
+            if self.moe_layers != expect:
+                raise ValueError(
+                    f"scan_groups assumes MoE on the last block of each "
+                    f"group (layers {expect}), but is_moe_layer yields "
+                    f"{self.moe_layers} — use the unrolled path")
         self.moe_cfg()  # validate the routing knobs at config time
 
     def moe_cfg(self) -> MoEConfig:
@@ -201,25 +224,64 @@ class GPT2MoEModel(TrainModule):
             y, aux = moe_ffn(mcfg, mp, h, r_ffn, train)
             return x + _dropout(y, drop, jax.random.fold_in(r_ffn, 1)), aux
 
-        if cfg.remat == "block":
-            dense_block = jax.checkpoint(dense_block)
-            moe_block = jax.checkpoint(moe_block)
+        aux0 = jnp.zeros((), jnp.float32)
+        if cfg.scan_groups:
+            # One compiled group body regardless of depth: the layer loop
+            # scans over groups of ``freq`` blocks (freq-1 dense + 1 MoE,
+            # the fixed pattern is_moe_layer defines), with the stored
+            # [L, ...] / [L_dense, ...] stacks reshaped to per-group
+            # leading dims.  Same math and RNG streams as the unrolled
+            # path (layer i = g*freq + j keys identically); remat='block'
+            # checkpoints the whole group.
+            freq = cfg.moe_layer_freq
+            G = cfg.n_layer // freq
 
-        aux_total = jnp.zeros((), jnp.float32)
-        d_idx = m_idx = 0
-        for i in range(cfg.n_layer):
-            lrng = jax.random.fold_in(rng, i)
-            ap = jax.tree.map(lambda a, i=i: a[i], params["attn"])
-            if cfg.is_moe_layer(i):
-                mp = jax.tree.map(lambda a, j=m_idx: a[j], params["moe"])
-                x, aux = moe_block(x, ap, mp, lrng)
-                aux_total = aux_total + aux
-                m_idx += 1
-            else:
-                dp = jax.tree.map(
-                    lambda a, j=d_idx: a[j], params["dense_ffn"])
-                x = dense_block(x, ap, dp, lrng)
-                d_idx += 1
+            def regroup(tree_, sub):
+                return jax.tree.map(
+                    lambda a: a.reshape((G, sub) + a.shape[1:]), tree_)
+
+            attn_g = regroup(params["attn"], freq)
+            dense_g = regroup(params["dense_ffn"], freq - 1)
+
+            def group_body(carry, xs):
+                x, aux = carry
+                ag, dg, mg, g = xs
+                for j in range(freq - 1):
+                    apj = jax.tree.map(lambda a, j=j: a[j], ag)
+                    dpj = jax.tree.map(lambda a, j=j: a[j], dg)
+                    x = dense_block(
+                        x, apj, dpj, jax.random.fold_in(rng, g * freq + j))
+                apm = jax.tree.map(lambda a: a[freq - 1], ag)
+                x, a = moe_block(
+                    x, apm, mg,
+                    jax.random.fold_in(rng, g * freq + freq - 1))
+                return (x, aux + a), None
+
+            if cfg.remat == "block":
+                group_body = jax.checkpoint(group_body)
+            (x, aux_total), _ = jax.lax.scan(
+                group_body, (x, aux0),
+                (attn_g, dense_g, params["moe"], jnp.arange(G)))
+        else:
+            if cfg.remat == "block":
+                dense_block = jax.checkpoint(dense_block)
+                moe_block = jax.checkpoint(moe_block)
+            aux_total = aux0
+            d_idx = m_idx = 0
+            for i in range(cfg.n_layer):
+                lrng = jax.random.fold_in(rng, i)
+                ap = jax.tree.map(lambda a, i=i: a[i], params["attn"])
+                if cfg.is_moe_layer(i):
+                    mp = jax.tree.map(
+                        lambda a, j=m_idx: a[j], params["moe"])
+                    x, aux = moe_block(x, ap, mp, lrng)
+                    aux_total = aux_total + aux
+                    m_idx += 1
+                else:
+                    dp = jax.tree.map(
+                        lambda a, j=d_idx: a[j], params["dense_ffn"])
+                    x = dense_block(x, ap, dp, lrng)
+                    d_idx += 1
 
         x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
         logits = x @ params["wte"].astype(x.dtype).T

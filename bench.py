@@ -217,15 +217,53 @@ def _enable_compile_cache():
         print(f"compile cache unavailable: {e}", file=sys.stderr)
 
 
+def _probe_tunnel(deadline: int):
+    """Backend-init health probe in a SUBPROCESS.  A wedged TPU tunnel
+    blocks ``jax.devices()`` inside a native call forever — SIGALRM never
+    fires — so the parent must not be the first process to touch it.  The
+    child only *initializes* a backend (it never runs a step), so killing
+    it at the deadline cannot wedge device state the way killing a mid-step
+    job does (BENCH_NOTES.md); the parent then fails loudly with one JSON
+    line instead of hanging the driver's whole bench slot."""
+    import subprocess
+    _mark(f"probing TPU tunnel (subprocess, {deadline}s deadline)")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print('PROBE_OK', len(d), d[0].platform)"],
+            capture_output=True, text=True, timeout=deadline)
+    except subprocess.TimeoutExpired:
+        raise RuntimeError(
+            f"TPU tunnel unresponsive: backend init did not complete "
+            f"within {deadline}s (wedged-tunnel signature, BENCH_NOTES.md)")
+    if "PROBE_OK" not in r.stdout:
+        tail = (r.stderr or r.stdout).strip().splitlines()[-5:]
+        raise RuntimeError(
+            "TPU backend init failed in probe subprocess: "
+            + " | ".join(tail))
+    _mark(f"tunnel probe: {r.stdout.strip().splitlines()[-1]}")
+
+
 def guarded_devices():
-    """jax.devices() under a deadline — enumeration itself can hang when
-    the TPU tunnel is wedged (observed: blocking indefinitely).  Shared by
-    every bench script; best-effort (SIGALRM can't interrupt a call that
-    never returns to Python, but then nothing could)."""
+    """jax.devices() with wedged-tunnel protection — enumeration itself
+    can hang indefinitely when the tunnel is down (observed).  Shared by
+    every bench script.  Layer 1: subprocess probe (catches the native
+    hang SIGALRM can't).  Layer 2: in-process SIGALRM watchdog (catches
+    slow-but-returning paths)."""
     import jax
     _enable_compile_cache()
+    deadline = int(os.environ.get("BENCH_DEVICES_TIMEOUT", "300"))
+    # probe unless the RESOLVED platform list is cpu-ONLY.  Only
+    # jax.config is authoritative: this image's sitecustomize rewrites
+    # even an explicit JAX_PLATFORMS=cpu env var to 'axon,cpu', which
+    # still initializes the TPU tunnel first.
+    plats = str(jax.config.jax_platforms or "")
+    cpu_pinned = [p.strip() for p in plats.split(",") if p.strip()] == ["cpu"]
+    if not cpu_pinned and os.environ.get("BENCH_SKIP_TUNNEL_PROBE") != "1":
+        _probe_tunnel(deadline)
     _mark("enumerating devices")
-    with _Watchdog(int(os.environ.get("BENCH_DEVICES_TIMEOUT", "300"))):
+    with _Watchdog(deadline):
         devices = jax.devices()
     _mark(f"devices: {[d.device_kind for d in devices]}")
     return devices

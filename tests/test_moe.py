@@ -252,6 +252,28 @@ def test_moe_fp16_loss_scaling():
 
 
 @pytest.mark.slow
+def test_moe_elastic_mesh_resize(tmp_path):
+    """An EP checkpoint reshards on load into a different mesh (dp=8 EP →
+    dp=4×tp=2 EP×TP): sharding is load-time policy, not file layout —
+    the reference's elastic restore extended to expert-parallel state."""
+    model, _ = _moe_model(n_experts=8)
+    eng = _engine(model, build_mesh(dp=8), zero_stage=2, micro=1, ga=1)
+    eng.train_batch(_tokens(8))
+    eng.save_checkpoint(str(tmp_path), tag="ep")
+
+    eng2 = _engine(model, build_mesh(dp=4, tp=2), zero_stage=1,
+                   micro=2, ga=1)
+    path, _ = eng2.load_checkpoint(str(tmp_path), tag="ep")
+    assert path is not None
+    for a, b in zip(jax.tree.leaves(eng.state.master_params),
+                    jax.tree.leaves(eng2.state.master_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+    loss = float(np.asarray(eng2.train_batch(_tokens(8, seed=9))))
+    assert np.isfinite(loss)
+
+
+@pytest.mark.slow
 def test_moe_checkpoint_roundtrip(tmp_path):
     model, _ = _moe_model(n_experts=4)
     mesh = build_mesh(dp=4, tp=2)

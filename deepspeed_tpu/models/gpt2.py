@@ -219,7 +219,7 @@ def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
         return t.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
 
     if cfg.attn_impl == "flash":
-        # Pallas flash kernel (falls back to dense when prob-dropout on).
+        # Pallas flash kernel (prob-dropout fused in-kernel).
         from ..ops.pallas.flash_attention import mha
         attn = mha(heads(q), heads(k), heads(v),
                    dropout_rate=drop, dropout_rng=r1, causal=True)

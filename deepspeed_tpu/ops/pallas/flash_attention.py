@@ -20,12 +20,16 @@ Numerics: softmax statistics and all accumulators are fp32 regardless of
 input dtype (matching the reference kernel's fp32 softmax accumulation
 for fp16 inputs).
 
-Dropout inside the kernel is not supported; the module-level `mha`
-wrapper falls back to the dense XLA path (ops/attention.py) when
-attention-probability dropout is active (training with
-attn_dropout > 0), which the reference also treats as the
-memory-hungry path (attn_dropout_checkpoint knob,
-reference: deepspeed/ops/transformer/transformer.py:108-117).
+Attention-probability dropout runs INSIDE the kernel (the reference
+fuses dropout into its CUDA attention the same way,
+csrc/transformer/dropout_kernels.cu composed at
+ds_transformer_cuda.cpp:99-121): the keep mask is a counter-based hash
+of (batch·head, q position, k position, seed), so the backward kernels
+regenerate bit-identical masks from the same coordinates instead of
+storing an O(T²) mask — dropout costs no extra HBM.  The same hash,
+evaluated in plain jnp over full index grids, is the differential-test
+oracle (tests compare kernel fwd+grads against a dense reference using
+the exact same mask).
 """
 from __future__ import annotations
 
@@ -34,6 +38,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -54,6 +59,40 @@ def _pad_seq(x, block, axis):
     widths[axis] = (0, pad)
     return jnp.pad(x, widths)
 
+
+
+def _fmix32(x):
+    """murmur3 finalizer — a cheap, well-mixed u32→u32 bijection (not
+    cryptographic; dropout only needs decorrelation)."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def dropout_keep_mask(q_ids, k_ids, bh, seed, rate: float):
+    """Counter-based keep mask: u32 hash of (bh, q position, k position,
+    seed) compared against rate.  Pure jnp on index arrays, so the SAME
+    function serves the forward kernel, both backward kernels (bit-equal
+    regeneration — no stored mask), and the dense test oracle.  All of
+    q_ids/k_ids/bh broadcast; returns bool of the broadcast shape."""
+    x = (q_ids.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         + k_ids.astype(jnp.uint32))
+    x = x ^ (jnp.uint32(bh) * jnp.uint32(0x85EBCA6B))
+    x = _fmix32(x ^ jnp.uint32(seed))
+    thresh = jnp.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+    return x >= thresh
+
+
+def _block_keep(iq, ik, b, seed, *, rate, block_q, block_k):
+    """Keep mask for one (q-block, k-block) tile, from global positions."""
+    q_ids = jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 0) \
+        + jnp.uint32(iq * block_q)
+    k_ids = jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 1) \
+        + jnp.uint32(ik * block_k)
+    return dropout_keep_mask(q_ids, k_ids, b, seed, rate)
 
 
 def _masked_scores(q, k, iq, ik, *, sm_scale, causal, block_q, block_k,
@@ -79,10 +118,11 @@ def _masked_scores(q, k, iq, ik, *, sm_scale, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
                 *, sm_scale: float, causal: bool, block_q: int,
-                block_k: int, seq_len: int):
+                block_k: int, seq_len: int, dropout_rate: float):
+    b = pl.program_id(0)
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -111,9 +151,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                          # [bq, bk]
         alpha = jnp.exp(m_prev - m_new)                 # [bq, 1]
+        # dropout scales probabilities AFTER normalisation; since the
+        # final o = acc/l is linear in acc, masking p here (and keeping
+        # the normaliser l on the UNdropped p) is exactly
+        # dropout(softmax(s)) @ v
         l_new = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
+        pd = p
+        if dropout_rate > 0.0:
+            keep = _block_keep(iq, ik, b, seed_ref[0, 0],
+                               rate=dropout_rate, block_q=block_q,
+                               block_k=block_k)
+            pd = p * keep.astype(p.dtype) / (1.0 - dropout_rate)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            pd.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -133,7 +183,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, block_q))
 
 
-def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
+def _seed_arr(seed):
+    """Seed as a (1, 1) uint32 operand (traced — a new step's seed does
+    not recompile); every grid step maps to the same block."""
+    return jnp.asarray(seed, jnp.uint32).reshape(1, 1)
+
+
+_SEED_SPEC = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0))
+
+
+def _fwd(q, k, v, seed, *, sm_scale, causal, block_q, block_k,
+         dropout_rate, interpret):
     bh, t, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, max(t, 8))
@@ -146,7 +206,8 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_len=tk)
+        block_q=block_q, block_k=block_k, seq_len=tk,
+        dropout_rate=dropout_rate)
     if causal:
         # clamp the K/V block index at the causal diagonal: skipped
         # (fully-masked) grid steps revisit the previous block, and Pallas
@@ -166,6 +227,7 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), kv_im),
             pl.BlockSpec((1, block_k, d), kv_im),
+            _SEED_SPEC,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -181,7 +243,7 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp)
+    )(qp, kp, vp, _seed_arr(seed))
     return out[:, :t], lse[:, :, 0, :].reshape(bh, tq_p)[:, :t]
 
 
@@ -190,8 +252,11 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, sm_scale, causal, block_q, block_k, seq_len):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   seed_ref, dq_ref, dq_scr,
+                   *, sm_scale, causal, block_q, block_k, seq_len,
+                   dropout_rate):
+    b = pl.program_id(0)
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -219,6 +284,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)         # [bq, bk]
+        if dropout_rate > 0.0:
+            # dS = P ∘ (mask/(1-r) ∘ (dO·Vᵀ) − Δ); Δ = rowsum(dO ∘ O)
+            # already absorbs the dropped terms (O was built from the
+            # dropped probabilities)
+            keep = _block_keep(iq, ik, b, seed_ref[0, 0],
+                               rate=dropout_rate, block_q=block_q,
+                               block_k=block_k)
+            dp = dp * keep.astype(dp.dtype) / (1.0 - dropout_rate)
         ds = p * (dp - delta) * sm_scale
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -230,8 +303,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, sm_scale, causal, block_q, block_k, seq_len):
+                    seed_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, sm_scale, causal, block_q, block_k, seq_len,
+                    dropout_rate):
+    b = pl.program_id(0)
     ik, iq = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -257,12 +332,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                            block_q=block_q, block_k=block_k,
                            seq_len=seq_len)
         p = jnp.exp(s - lse)                            # [bq, bk]
-        # dV += Pᵀ · dO
-        dv_scr[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        pd = p
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _block_keep(iq, ik, b, seed_ref[0, 0],
+                               rate=dropout_rate, block_q=block_q,
+                               block_k=block_k)
+            scale = keep.astype(p.dtype) / (1.0 - dropout_rate)
+            pd = p * scale      # dropped probabilities (forward's P̃)
+            dp = dp * scale
+        # dV += P̃ᵀ · dO
+        dv_scr[:] += jax.lax.dot_general(
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale                # [bq, bk]
         # dK += dSᵀ · Q
@@ -276,8 +359,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, out, lse, do, *, sm_scale, causal, block_q, block_k,
-         interpret):
+def _bwd(q, k, v, out, lse, do, seed, *, sm_scale, causal, block_q,
+         block_k, dropout_rate, interpret):
     bh, t, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, max(t, 8))
@@ -315,15 +398,16 @@ def _bwd(q, k, v, out, lse, do, *, sm_scale, causal, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=tk),
+                          block_q=block_q, block_k=block_k, seq_len=tk,
+                          dropout_rate=dropout_rate),
         grid=(bh, nq, nk),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec,
-                  row_spec],
+                  row_spec, _SEED_SPEC],
         out_specs=q_spec_i,
         out_shape=jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed))
 
     # dK/dV: k blocks outer, q blocks inner.
     if causal:
@@ -345,17 +429,18 @@ def _bwd(q, k, v, out, lse, do, *, sm_scale, causal, block_q, block_k,
     row_spec_j = pl.BlockSpec((1, 1, 8, block_q), row_im_j)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=tk),
+                          block_q=block_q, block_k=block_k, seq_len=tk,
+                          dropout_rate=dropout_rate),
         grid=(bh, nk, nq),
         in_specs=[q_spec_j, kv_spec_i, kv_spec_i, q_spec_j, row_spec_j,
-                  row_spec_j],
+                  row_spec_j, _SEED_SPEC],
         out_specs=[kv_spec_i, kv_spec_i],
         out_shape=[jax.ShapeDtypeStruct((bh, tk_p, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, tk_p, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed))
     return dq[:, :t], dk[:, :tk], dv[:, :tk]
 
 
@@ -364,25 +449,32 @@ def _bwd(q, k, v, out, lse, do, *, sm_scale, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, sm_scale=sm_scale, causal=causal,
-                  block_q=block_q, block_k=block_k, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, seed, sm_scale, causal, block_q, block_k,
+           dropout_rate, interpret):
+    out, _ = _fwd(q, k, v, seed, sm_scale=sm_scale, causal=causal,
+                  block_q=block_q, block_k=block_k,
+                  dropout_rate=dropout_rate, interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, lse = _fwd(q, k, v, sm_scale=sm_scale, causal=causal,
-                    block_q=block_q, block_k=block_k, interpret=interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, seed, sm_scale, causal, block_q, block_k,
+               dropout_rate, interpret):
+    out, lse = _fwd(q, k, v, seed, sm_scale=sm_scale, causal=causal,
+                    block_q=block_q, block_k=block_k,
+                    dropout_rate=dropout_rate, interpret=interpret)
+    return out, (q, k, v, seed, out, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, out, lse = res
-    dq, dk, dv = _bwd(q, k, v, out, lse, do, sm_scale=sm_scale,
+def _flash_bwd(sm_scale, causal, block_q, block_k, dropout_rate,
+               interpret, res, do):
+    q, k, v, seed, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, out, lse, do, seed, sm_scale=sm_scale,
                       causal=causal, block_q=block_q, block_k=block_k,
-                      interpret=interpret)
-    return dq, dk, dv
+                      dropout_rate=dropout_rate, interpret=interpret)
+    # integer-dtype primal (the seed) takes a float0 cotangent
+    dseed = np.zeros(np.shape(res[3]), jax.dtypes.float0)
+    return dq, dk, dv, dseed
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -393,11 +485,15 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     sm_scale: Optional[float] = None,
                     block_q: int = 512,
                     block_k: int = 512,
+                    dropout_rate: float = 0.0,
+                    dropout_rng=None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over [B, H, T, Dh] inputs (differentiable).
 
-    Drop-in for ops.attention.causal_attention with dropout_rate=0; use
-    `mha` for the dropout-aware dispatcher.
+    Attention-probability dropout runs inside the kernel when
+    ``dropout_rate > 0`` (requires ``dropout_rng``): the keep mask is
+    hashed from positions + a seed derived from the rng, regenerated
+    bit-identically in the backward kernels.
     """
     assert q.ndim == 4, f"expected [B, H, T, D], got {q.shape}"
     b, h, t, d = q.shape
@@ -412,24 +508,26 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         sm_scale = float(d) ** -0.5
     if interpret is None:
         interpret = _use_interpret()
+    dropout_rate = float(dropout_rate)
+    assert 0.0 <= dropout_rate < 1.0, f"bad dropout_rate {dropout_rate}"
+    if dropout_rate > 0.0:
+        assert dropout_rng is not None, \
+            "dropout_rate > 0 requires dropout_rng"
+        seed = jax.random.bits(dropout_rng, (), jnp.uint32)
+    else:
+        seed = jnp.zeros((), jnp.uint32)
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
-    out = _flash(qf, kf, vf, sm_scale, causal, block_q, block_k, interpret)
+    out = _flash(qf, kf, vf, seed, sm_scale, causal, block_q, block_k,
+                 dropout_rate, interpret)
     return out.reshape(b, h, t, d)
 
 
 def mha(q, k, v, dropout_rate: float = 0.0, dropout_rng=None,
         causal: bool = True, **kwargs):
-    """Attention dispatcher: Pallas flash kernel unless probability
-    dropout is active (then the dense XLA path, which supports it)."""
-    if dropout_rate > 0.0 and dropout_rng is not None:
-        from ..attention import causal_attention
-        assert causal, "dense fallback is causal-only"
-        unsupported = set(kwargs) - {"sm_scale", "block_q", "block_k"}
-        if unsupported:
-            raise TypeError(f"mha dense fallback: unsupported {unsupported}")
-        return causal_attention(q, k, v, dropout_rate=dropout_rate,
-                                dropout_rng=dropout_rng,
-                                sm_scale=kwargs.get("sm_scale"))
-    return flash_attention(q, k, v, causal=causal, **kwargs)
+    """Attention dispatcher (kept for callers of the old dense-fallback
+    API): dropout now runs inside the flash kernel."""
+    return flash_attention(q, k, v, causal=causal,
+                           dropout_rate=dropout_rate,
+                           dropout_rng=dropout_rng, **kwargs)

@@ -94,12 +94,109 @@ def test_cross_attention_lengths():
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
-def test_mha_dropout_falls_back_to_dense():
+def _dense_dropout_oracle(q, k, v, rate, rng, causal=True):
+    """Dense attention applying the kernel's EXACT keep mask (same hash,
+    same seed derivation) — fwd and grads must match the kernel bitwise
+    up to fp32 reduction noise."""
+    from deepspeed_tpu.ops.pallas.flash_attention import dropout_keep_mask
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    scale = float(d) ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((t, tk), bool)), s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    seed = jax.random.bits(rng, (), jnp.uint32)
+    q_ids = jnp.arange(t, dtype=jnp.uint32)[None, :, None]
+    k_ids = jnp.arange(tk, dtype=jnp.uint32)[None, None, :]
+    bh = jnp.arange(b * h, dtype=jnp.uint32)[:, None, None]
+    keep = dropout_keep_mask(q_ids, k_ids, bh, seed, rate)
+    pd = p * keep.reshape(b, h, t, tk).astype(p.dtype) / (1.0 - rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", pd.astype(q.dtype), v)
+
+
+def test_dropout_zero_rate_is_identity():
+    q, k, v = _rand_qkv(1, 2, 96, 32)
+    base = flash_attention(q, k, v)
+    out = flash_attention(q, k, v, dropout_rate=0.0,
+                          dropout_rng=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_dropout_forward_matches_masked_oracle(causal):
+    q, k, v = _rand_qkv(2, 2, 128, 32, seed=3)
+    rng = jax.random.PRNGKey(7)
+    out = flash_attention(q, k, v, causal=causal, dropout_rate=0.2,
+                          dropout_rng=rng)
+    ref = _dense_dropout_oracle(q, k, v, 0.2, rng, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_dropout_multiblock_mask_offsets():
+    """Small blocks: the in-kernel mask must hash GLOBAL positions, so a
+    multi-block run agrees with the one-block oracle."""
+    q, k, v = _rand_qkv(1, 2, 200, 32, seed=4)
+    rng = jax.random.PRNGKey(11)
+    out = flash_attention(q, k, v, dropout_rate=0.3, dropout_rng=rng,
+                          block_q=64, block_k=64)
+    ref = _dense_dropout_oracle(q, k, v, 0.3, rng)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_dropout_backward_matches_masked_oracle():
+    q, k, v = _rand_qkv(1, 2, 128, 32, seed=5)
+    rng = jax.random.PRNGKey(13)
+    wt = jnp.asarray(np.random.RandomState(9).randn(*q.shape), q.dtype)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, dropout_rate=0.25,
+                                       dropout_rng=rng) * wt)
+
+    def loss_oracle(q, k, v):
+        return jnp.sum(_dense_dropout_oracle(q, k, v, 0.25, rng) * wt)
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gk, go, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_dropout_seed_sensitivity_and_determinism():
+    q, k, v = _rand_qkv(1, 1, 96, 32)
+    r1, r2 = jax.random.PRNGKey(0), jax.random.PRNGKey(1)
+    a1 = flash_attention(q, k, v, dropout_rate=0.5, dropout_rng=r1)
+    a1b = flash_attention(q, k, v, dropout_rate=0.5, dropout_rng=r1)
+    a2 = flash_attention(q, k, v, dropout_rate=0.5, dropout_rng=r2)
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a1b))
+    assert np.abs(np.asarray(a1) - np.asarray(a2)).max() > 0
+
+
+def test_dropout_is_unbiased():
+    """Averaged over many seeds, dropped attention approaches the
+    undropped output (inverted-dropout scaling)."""
+    q, k, v = _rand_qkv(1, 1, 64, 32)
+    base = np.asarray(flash_attention(q, k, v))
+    acc = np.zeros_like(base)
+    n = 48
+    for s in range(n):
+        acc += np.asarray(flash_attention(
+            q, k, v, dropout_rate=0.3, dropout_rng=jax.random.PRNGKey(s)))
+    err = np.abs(acc / n - base).mean() / np.abs(base).mean()
+    assert err < 0.15, f"dropout mean deviates {err:.3f} from base"
+
+
+def test_mha_routes_dropout_into_kernel():
     q, k, v = _rand_qkv(1, 1, 64, 32)
     rng = jax.random.PRNGKey(0)
     out = mha(q, k, v, dropout_rate=0.1, dropout_rng=rng)
-    ref = causal_attention(q, k, v, dropout_rate=0.1, dropout_rng=rng)
-    np.testing.assert_allclose(out, ref, atol=1e-6)
+    ref = flash_attention(q, k, v, dropout_rate=0.1, dropout_rng=rng)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
 def test_jit_compiles_once():

@@ -234,8 +234,6 @@ def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
         from jax.sharding import PartitionSpec as P
         from ..parallel.sequence import (SEQ_AXIS, ring_attention,
                                          ulysses_attention)
-        assert drop == 0.0, (
-            "sequence-parallel attention has no probability-dropout path")
         am = jax.sharding.get_abstract_mesh()
         sp = dict(getattr(am, "shape", {})).get(SEQ_AXIS, 1)
         if sp > 1 and am.manual_axes:
@@ -252,17 +250,34 @@ def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
                 f"manual axes {am.manual_axes}); sp composes with the "
                 "plain dp/tp/ZeRO engine paths only — not the pipeline, "
                 "1-bit, or sparse-gradient engines")
+        seed = (jax.random.bits(r1, (), jnp.uint32) if drop > 0.0
+                else jnp.zeros((), jnp.uint32))
         if sp > 1:
             impl = (ring_attention if cfg.attn_impl == "ring"
                     else ulysses_attention)
             spec = P(None, None, SEQ_AXIS, None)
+            # dropout mask is hashed from GLOBAL positions (the flash
+            # kernel's hash), so the seed is a replicated scalar and the
+            # realization is identical for any seq-shard count (incl.
+            # the sp==1 fallback below)
             fn = jax.shard_map(
-                lambda q, k, v: impl(q, k, v, SEQ_AXIS, causal=True),
-                in_specs=(spec, spec, spec), out_specs=spec,
+                lambda q, k, v, seed: impl(
+                    q, k, v, SEQ_AXIS, causal=True, dropout_rate=drop,
+                    dropout_seed=seed),
+                in_specs=(spec, spec, spec, P()), out_specs=spec,
                 axis_names={SEQ_AXIS}, check_vma=False)
-            attn = fn(heads(q), heads(k), heads(v))
-        else:  # mesh has no seq shards: plain dense attention
-            attn = causal_attention(heads(q), heads(k), heads(v))
+            attn = fn(heads(q), heads(k), heads(v), seed)
+        else:  # mesh has no seq shards: dense attention, same hash mask
+            keep = None
+            if drop > 0.0:
+                from ..ops.pallas.flash_attention import dropout_keep_mask
+                ids = jnp.arange(T, dtype=jnp.uint32)
+                keep = dropout_keep_mask(
+                    ids[None, None, :, None], ids[None, None, None, :],
+                    jnp.arange(B * H, dtype=jnp.uint32).reshape(B, H, 1, 1),
+                    seed, drop)
+            attn = causal_attention(heads(q), heads(k), heads(v),
+                                    dropout_rate=drop, dropout_keep=keep)
     else:
         raise ValueError(
             f"attn_impl={cfg.attn_impl!r}: expected 'flash', 'dense', "

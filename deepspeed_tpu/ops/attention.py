@@ -19,12 +19,19 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      dropout_rate: float = 0.0,
                      dropout_rng: Optional[jax.Array] = None,
                      mask: Optional[jnp.ndarray] = None,
-                     sm_scale: Optional[float] = None) -> jnp.ndarray:
+                     sm_scale: Optional[float] = None,
+                     dropout_keep: Optional[jnp.ndarray] = None) -> \
+        jnp.ndarray:
     """Multi-head causal attention.
 
     q, k, v: [B, H, T, Dh].  Softmax accumulates in fp32 (matching the
     reference kernel's fp32 softmax accumulation for fp16 inputs,
     csrc/transformer/softmax_kernels.cu) and returns q.dtype.
+
+    ``dropout_keep`` (a precomputed boolean keep mask, e.g. the flash
+    kernel's position hash) takes precedence over ``dropout_rng``'s
+    bernoulli draw — callers use it to keep dropout realizations
+    identical across the dense/flash/sequence-parallel layouts.
     """
     B, H, T, Dh = q.shape
     scale = (jnp.asarray(sm_scale, jnp.float32) if sm_scale is not None
@@ -37,7 +44,9 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if mask is not None:
         scores = jnp.where(mask, scores, neg)
     probs = jax.nn.softmax(scores, axis=-1)
-    if dropout_rate > 0.0 and dropout_rng is not None:
+    if dropout_rate > 0.0 and dropout_keep is not None:
+        probs = jnp.where(dropout_keep, probs / (1.0 - dropout_rate), 0.0)
+    elif dropout_rate > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
                                     probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)

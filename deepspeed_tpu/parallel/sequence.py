@@ -46,16 +46,27 @@ def _block_scores(q, k, sm_scale):
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str = SEQ_AXIS,
                    causal: bool = True,
-                   sm_scale: Optional[float] = None) -> jnp.ndarray:
+                   sm_scale: Optional[float] = None,
+                   dropout_rate: float = 0.0,
+                   dropout_seed=None) -> jnp.ndarray:
     """Ring attention over a sharded sequence.
 
     q, k, v: this shard's slice [B, H, T_local, D] (sequence dim sharded
     over ``axis_name``).  Returns the local output shard [B, H, T_local, D].
+
+    ``dropout_rate`` > 0 applies attention-probability dropout using the
+    flash kernel's position-hashed keep mask (global coordinates —
+    shard-layout-independent), seeded by ``dropout_seed`` (uint32 scalar,
+    replicated).
     """
     B, H, T, D = q.shape
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     scale = float(D) ** -0.5 if sm_scale is None else sm_scale
+    if dropout_rate > 0.0:
+        assert dropout_seed is not None, \
+            "dropout_rate > 0 requires dropout_seed"
+        from ..ops.pallas.flash_attention import dropout_keep_mask
 
     q32 = q.astype(jnp.float32)
     pos_local = jnp.arange(T)
@@ -76,8 +87,16 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
         l_new = l * alpha + p.sum(-1)
+        pd = p
+        if dropout_rate > 0.0:
+            keep = dropout_keep_mask(
+                q_pos.astype(jnp.uint32)[None, None, :, None],
+                k_pos.astype(jnp.uint32)[None, None, None, :],
+                jnp.arange(B * H, dtype=jnp.uint32).reshape(B, H, 1, 1),
+                dropout_seed, dropout_rate)
+            pd = p * keep.astype(p.dtype) / (1.0 - dropout_rate)
         o_new = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+            "bhqk,bhkd->bhqd", pd, vc.astype(jnp.float32))
         return o_new, m_new, l_new
 
     def body(carry, step):
@@ -105,17 +124,25 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis_name: str = SEQ_AXIS,
                       causal: bool = True,
-                      sm_scale: Optional[float] = None) -> jnp.ndarray:
+                      sm_scale: Optional[float] = None,
+                      dropout_rate: float = 0.0,
+                      dropout_seed=None) -> jnp.ndarray:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme).
 
     q, k, v: [B, H, T_local, D] with the sequence sharded over
     ``axis_name``; H must be divisible by the axis size.  Internally each
-    device attends the FULL sequence for H/n heads.
+    device attends the FULL sequence for H/n heads.  Dropout uses the
+    same position-hashed mask as ring_attention (global head indices), so
+    all three layouts — dense, ring, Ulysses — agree for one seed.
     """
     B, H, T, D = q.shape
     n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
     assert H % n == 0, (
         f"ulysses needs heads ({H}) divisible by sequence shards ({n})")
+    if dropout_rate > 0.0:
+        assert dropout_seed is not None, \
+            "dropout_rate > 0 requires dropout_seed"
 
     def seq2head(x):
         # [B, H, T_local, D] → [B, H/n, T_global, D]
@@ -140,6 +167,19 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         mask = jnp.tril(jnp.ones((Tg, Tg), bool))
         s = jnp.where(mask[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        from ..ops.pallas.flash_attention import dropout_keep_mask
+        Tg = p.shape[-1]
+        # this device holds global heads idx*(H/n) .. (idx+1)*(H/n)-1
+        heads = (jnp.uint32(idx) * jnp.uint32(H // n)
+                 + jnp.arange(H // n, dtype=jnp.uint32))
+        bh = (jnp.arange(B, dtype=jnp.uint32)[:, None, None, None]
+              * jnp.uint32(H) + heads[None, :, None, None])
+        keep = dropout_keep_mask(
+            jnp.arange(Tg, dtype=jnp.uint32)[None, None, :, None],
+            jnp.arange(Tg, dtype=jnp.uint32)[None, None, None, :],
+            bh, dropout_seed, dropout_rate)
+        p = p * keep.astype(p.dtype) / (1.0 - dropout_rate)
     og = jnp.einsum("bhqk,bhkd->bhqd", p,
                     vg.astype(jnp.float32)).astype(q.dtype)
     return head2seq(og)

@@ -1,0 +1,32 @@
+"""Shared dense-attention dropout oracle.
+
+One implementation of "dense attention with the flash kernel's
+position-hashed keep mask" pins the dropout semantics that the Pallas
+kernel, ring attention, and Ulysses must all reproduce — a single
+source so the oracle cannot drift between test families.
+"""
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas.flash_attention import dropout_keep_mask
+
+
+def dense_dropout_oracle(q, k, v, rate, seed, causal=True):
+    """q/k/v: [B, H, T, D]; ``seed``: uint32 scalar (callers holding a
+    PRNGKey derive it with jax.random.bits(key, (), jnp.uint32), the same
+    derivation flash_attention uses)."""
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    scale = float(d) ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((t, tk), bool)), s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    keep = dropout_keep_mask(
+        jnp.arange(t, dtype=jnp.uint32)[None, None, :, None],
+        jnp.arange(tk, dtype=jnp.uint32)[None, None, None, :],
+        jnp.arange(b * h, dtype=jnp.uint32).reshape(b, h, 1, 1),
+        seed, rate)
+    pd = p * keep.astype(p.dtype) / (1.0 - rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", pd.astype(q.dtype), v)

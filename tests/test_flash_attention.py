@@ -98,22 +98,9 @@ def _dense_dropout_oracle(q, k, v, rate, rng, causal=True):
     """Dense attention applying the kernel's EXACT keep mask (same hash,
     same seed derivation) — fwd and grads must match the kernel bitwise
     up to fp32 reduction noise."""
-    from deepspeed_tpu.ops.pallas.flash_attention import dropout_keep_mask
-    b, h, t, d = q.shape
-    tk = k.shape[2]
-    scale = float(d) ** -0.5
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    if causal:
-        s = jnp.where(jnp.tril(jnp.ones((t, tk), bool)), s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
+    from attention_oracles import dense_dropout_oracle
     seed = jax.random.bits(rng, (), jnp.uint32)
-    q_ids = jnp.arange(t, dtype=jnp.uint32)[None, :, None]
-    k_ids = jnp.arange(tk, dtype=jnp.uint32)[None, None, :]
-    bh = jnp.arange(b * h, dtype=jnp.uint32)[:, None, None]
-    keep = dropout_keep_mask(q_ids, k_ids, bh, seed, rate)
-    pd = p * keep.reshape(b, h, t, tk).astype(p.dtype) / (1.0 - rate)
-    return jnp.einsum("bhqk,bhkd->bhqd", pd.astype(q.dtype), v)
+    return dense_dropout_oracle(q, k, v, rate, seed, causal=causal)
 
 
 def test_dropout_zero_rate_is_identity():

@@ -270,12 +270,8 @@ def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
         else:  # mesh has no seq shards: dense attention, same hash mask
             keep = None
             if drop > 0.0:
-                from ..ops.pallas.flash_attention import dropout_keep_mask
-                ids = jnp.arange(T, dtype=jnp.uint32)
-                keep = dropout_keep_mask(
-                    ids[None, None, :, None], ids[None, None, None, :],
-                    jnp.arange(B * H, dtype=jnp.uint32).reshape(B, H, 1, 1),
-                    seed, drop)
+                from ..ops.pallas.flash_attention import dense_keep_mask
+                keep = dense_keep_mask(B, H, T, T, seed, drop)
             attn = causal_attention(heads(q), heads(k), heads(v),
                                     dropout_rate=drop, dropout_keep=keep)
     else:

@@ -86,6 +86,20 @@ def dropout_keep_mask(q_ids, k_ids, bh, seed, rate: float):
     return x >= thresh
 
 
+def dense_keep_mask(B, H, Tq, Tk, seed, rate: float, bh_ids=None):
+    """Full-array keep mask [B, H, Tq, Tk] — the dense-layout evaluation
+    of the kernel's hash (single source of the broadcast recipe, used by
+    the model's dense fallback, Ulysses' dense debug path, and the test
+    oracle).  ``bh_ids``: optional [B·H] global batch·head ids."""
+    if bh_ids is None:
+        bh_ids = jnp.arange(B * H, dtype=jnp.uint32)
+    return dropout_keep_mask(
+        jnp.arange(Tq, dtype=jnp.uint32)[None, None, :, None],
+        jnp.arange(Tk, dtype=jnp.uint32)[None, None, None, :],
+        jnp.asarray(bh_ids, jnp.uint32).reshape(B, H, 1, 1),
+        seed, rate)
+
+
 def _block_keep(iq, ik, b, seed, *, rate, block_q, block_k):
     """Keep mask for one (q-block, k-block) tile, from global positions."""
     q_ids = jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 0) \
@@ -118,11 +132,10 @@ def _masked_scores(q, k, iq, ik, *, sm_scale, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, bh_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
                 *, sm_scale: float, causal: bool, block_q: int,
                 block_k: int, seq_len: int, dropout_rate: float):
-    b = pl.program_id(0)
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -158,7 +171,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
         l_new = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
         pd = p
         if dropout_rate > 0.0:
-            keep = _block_keep(iq, ik, b, seed_ref[0, 0],
+            keep = _block_keep(iq, ik, bh_ref[0, 0], seed_ref[0, 0],
                                rate=dropout_rate, block_q=block_q,
                                block_k=block_k)
             pd = p * keep.astype(p.dtype) / (1.0 - dropout_rate)
@@ -190,9 +203,17 @@ def _seed_arr(seed):
 
 
 _SEED_SPEC = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0))
+# per-grid-row batch·head id for the dropout hash ([bh, 1] uint32)
+_BH_SPEC = pl.BlockSpec((1, 1), lambda b, i, j: (b, 0))
 
 
-def _fwd(q, k, v, seed, *, sm_scale, causal, block_q, block_k,
+def _bh_arr(bh_ids, bh):
+    # flash_attention always materializes bh_ids before _flash (a None
+    # could not be a custom_vjp primal anyway)
+    return jnp.asarray(bh_ids, jnp.uint32).reshape(bh, 1)
+
+
+def _fwd(q, k, v, seed, bh_ids, *, sm_scale, causal, block_q, block_k,
          dropout_rate, interpret):
     bh, t, d = q.shape
     tk = k.shape[1]
@@ -228,6 +249,7 @@ def _fwd(q, k, v, seed, *, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), kv_im),
             pl.BlockSpec((1, block_k, d), kv_im),
             _SEED_SPEC,
+            _BH_SPEC,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -243,7 +265,7 @@ def _fwd(q, k, v, seed, *, sm_scale, causal, block_q, block_k,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp, _seed_arr(seed))
+    )(qp, kp, vp, _seed_arr(seed), _bh_arr(bh_ids, bh))
     return out[:, :t], lse[:, :, 0, :].reshape(bh, tq_p)[:, :t]
 
 
@@ -253,10 +275,9 @@ def _fwd(q, k, v, seed, *, sm_scale, causal, block_q, block_k,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   seed_ref, dq_ref, dq_scr,
+                   seed_ref, bh_ref, dq_ref, dq_scr,
                    *, sm_scale, causal, block_q, block_k, seq_len,
                    dropout_rate):
-    b = pl.program_id(0)
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -288,7 +309,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             # dS = P ∘ (mask/(1-r) ∘ (dO·Vᵀ) − Δ); Δ = rowsum(dO ∘ O)
             # already absorbs the dropped terms (O was built from the
             # dropped probabilities)
-            keep = _block_keep(iq, ik, b, seed_ref[0, 0],
+            keep = _block_keep(iq, ik, bh_ref[0, 0], seed_ref[0, 0],
                                rate=dropout_rate, block_q=block_q,
                                block_k=block_k)
             dp = dp * keep.astype(dp.dtype) / (1.0 - dropout_rate)
@@ -303,10 +324,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    seed_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    seed_ref, bh_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                     *, sm_scale, causal, block_q, block_k, seq_len,
                     dropout_rate):
-    b = pl.program_id(0)
     ik, iq = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -337,7 +357,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
-            keep = _block_keep(iq, ik, b, seed_ref[0, 0],
+            keep = _block_keep(iq, ik, bh_ref[0, 0], seed_ref[0, 0],
                                rate=dropout_rate, block_q=block_q,
                                block_k=block_k)
             scale = keep.astype(p.dtype) / (1.0 - dropout_rate)
@@ -359,8 +379,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, out, lse, do, seed, *, sm_scale, causal, block_q,
-         block_k, dropout_rate, interpret):
+def _bwd(q, k, v, out, lse, do, seed, bh_ids, *, sm_scale, causal,
+         block_q, block_k, dropout_rate, interpret):
     bh, t, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, max(t, 8))
@@ -402,12 +422,12 @@ def _bwd(q, k, v, out, lse, do, seed, *, sm_scale, causal, block_q,
                           dropout_rate=dropout_rate),
         grid=(bh, nq, nk),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec,
-                  row_spec, _SEED_SPEC],
+                  row_spec, _SEED_SPEC, _BH_SPEC],
         out_specs=q_spec_i,
         out_shape=jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed))
+    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed), _bh_arr(bh_ids, bh))
 
     # dK/dV: k blocks outer, q blocks inner.
     if causal:
@@ -433,14 +453,14 @@ def _bwd(q, k, v, out, lse, do, seed, *, sm_scale, causal, block_q,
                           dropout_rate=dropout_rate),
         grid=(bh, nk, nq),
         in_specs=[q_spec_j, kv_spec_i, kv_spec_i, q_spec_j, row_spec_j,
-                  row_spec_j, _SEED_SPEC],
+                  row_spec_j, _SEED_SPEC, _BH_SPEC],
         out_specs=[kv_spec_i, kv_spec_i],
         out_shape=[jax.ShapeDtypeStruct((bh, tk_p, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, tk_p, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed))
+    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed), _bh_arr(bh_ids, bh))
     return dq[:, :t], dk[:, :tk], dv[:, :tk]
 
 
@@ -449,32 +469,34 @@ def _bwd(q, k, v, out, lse, do, seed, *, sm_scale, causal, block_q,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, seed, sm_scale, causal, block_q, block_k,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, seed, bh_ids, sm_scale, causal, block_q, block_k,
            dropout_rate, interpret):
-    out, _ = _fwd(q, k, v, seed, sm_scale=sm_scale, causal=causal,
+    out, _ = _fwd(q, k, v, seed, bh_ids, sm_scale=sm_scale, causal=causal,
                   block_q=block_q, block_k=block_k,
                   dropout_rate=dropout_rate, interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, seed, sm_scale, causal, block_q, block_k,
+def _flash_fwd(q, k, v, seed, bh_ids, sm_scale, causal, block_q, block_k,
                dropout_rate, interpret):
-    out, lse = _fwd(q, k, v, seed, sm_scale=sm_scale, causal=causal,
-                    block_q=block_q, block_k=block_k,
+    out, lse = _fwd(q, k, v, seed, bh_ids, sm_scale=sm_scale,
+                    causal=causal, block_q=block_q, block_k=block_k,
                     dropout_rate=dropout_rate, interpret=interpret)
-    return out, (q, k, v, seed, out, lse)
+    return out, (q, k, v, seed, bh_ids, out, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, dropout_rate,
                interpret, res, do):
-    q, k, v, seed, out, lse = res
-    dq, dk, dv = _bwd(q, k, v, out, lse, do, seed, sm_scale=sm_scale,
-                      causal=causal, block_q=block_q, block_k=block_k,
-                      dropout_rate=dropout_rate, interpret=interpret)
-    # integer-dtype primal (the seed) takes a float0 cotangent
-    dseed = np.zeros(np.shape(res[3]), jax.dtypes.float0)
-    return dq, dk, dv, dseed
+    q, k, v, seed, bh_ids, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, out, lse, do, seed, bh_ids,
+                      sm_scale=sm_scale, causal=causal, block_q=block_q,
+                      block_k=block_k, dropout_rate=dropout_rate,
+                      interpret=interpret)
+    # integer-dtype primals (seed, bh ids) take float0 cotangents
+    dseed = np.zeros(np.shape(seed), jax.dtypes.float0)
+    dbh = np.zeros(np.shape(bh_ids), jax.dtypes.float0)
+    return dq, dk, dv, dseed, dbh
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -487,13 +509,18 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     block_k: int = 512,
                     dropout_rate: float = 0.0,
                     dropout_rng=None,
+                    dropout_seed=None,
+                    bh_ids=None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over [B, H, T, Dh] inputs (differentiable).
 
     Attention-probability dropout runs inside the kernel when
-    ``dropout_rate > 0`` (requires ``dropout_rng``): the keep mask is
-    hashed from positions + a seed derived from the rng, regenerated
-    bit-identically in the backward kernels.
+    ``dropout_rate > 0``: the keep mask is hashed from positions + a
+    seed (``dropout_seed`` uint32 scalar, or derived from
+    ``dropout_rng``), regenerated bit-identically in the backward
+    kernels.  ``bh_ids`` ([B·H] uint32) overrides the batch·head ids the
+    hash sees — sharded callers (Ulysses) pass GLOBAL head ids so the
+    realization matches the unsharded layout.
     """
     assert q.ndim == 4, f"expected [B, H, T, D], got {q.shape}"
     b, h, t, d = q.shape
@@ -511,15 +538,21 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     dropout_rate = float(dropout_rate)
     assert 0.0 <= dropout_rate < 1.0, f"bad dropout_rate {dropout_rate}"
     if dropout_rate > 0.0:
-        assert dropout_rng is not None, \
-            "dropout_rate > 0 requires dropout_rng"
-        seed = jax.random.bits(dropout_rng, (), jnp.uint32)
+        if dropout_seed is not None:
+            seed = jnp.asarray(dropout_seed, jnp.uint32)
+        else:
+            assert dropout_rng is not None, \
+                "dropout_rate > 0 requires dropout_rng or dropout_seed"
+            seed = jax.random.bits(dropout_rng, (), jnp.uint32)
     else:
         seed = jnp.zeros((), jnp.uint32)
+    if bh_ids is None:
+        bh_ids = jnp.arange(b * h, dtype=jnp.uint32)
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
-    out = _flash(qf, kf, vf, seed, sm_scale, causal, block_q, block_k,
+    out = _flash(qf, kf, vf, seed, jnp.asarray(bh_ids, jnp.uint32),
+                 sm_scale, causal, block_q, block_k,
                  dropout_rate, interpret)
     return out.reshape(b, h, t, d)
 

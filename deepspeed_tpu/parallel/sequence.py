@@ -16,10 +16,15 @@ sequence dimension sharded):
 
   ulysses_attention(q, k, v, axis_name, causal=True)
       DeepSpeed-Ulysses-style: ``all_to_all`` re-shards [seq → heads], each
-      device computes full-sequence attention for H/N heads (any local
-      kernel — here the fp32-accumulating dense path), then ``all_to_all``
-      back.  Requires num_heads % ring_size == 0; communication 2
-      all-to-alls of the activations.
+      device computes full-sequence attention for H/N heads — through the
+      Pallas flash kernel by default (O(T_global·D) per-device attention
+      memory; ``local_impl='dense'`` keeps the fp32 einsum path for
+      debugging) — then ``all_to_all`` back.  Requires num_heads %
+      ring_size == 0; communication 2 all-to-alls of the activations.
+
+Both support attention-probability dropout via the flash kernel's
+position-hashed keep mask over GLOBAL coordinates (seeded by a
+replicated uint32), so the realization is layout-independent.
 
 Both are differentiable (ppermute/all_to_all transpose to themselves under
 AD) and validated against dense full-sequence attention in
@@ -126,41 +131,60 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       causal: bool = True,
                       sm_scale: Optional[float] = None,
                       dropout_rate: float = 0.0,
-                      dropout_seed=None) -> jnp.ndarray:
+                      dropout_seed=None,
+                      local_impl: str = "flash") -> jnp.ndarray:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme).
 
     q, k, v: [B, H, T_local, D] with the sequence sharded over
     ``axis_name``; H must be divisible by the axis size.  Internally each
-    device attends the FULL sequence for H/n heads.  Dropout uses the
-    same position-hashed mask as ring_attention (global head indices), so
-    all three layouts — dense, ring, Ulysses — agree for one seed.
+    device attends the FULL sequence for H/n heads — by default through
+    the Pallas flash kernel (``local_impl='flash'``), so per-device
+    attention memory is O(T_global·D) rather than the O(T_global²)
+    scores the dense path materialises; ``local_impl='dense'`` keeps the
+    einsum path for debugging.  Dropout uses the same position-hashed
+    mask as ring_attention with GLOBAL head indices, so dense, ring, and
+    Ulysses realizations agree for one seed.
     """
     B, H, T, D = q.shape
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     assert H % n == 0, (
         f"ulysses needs heads ({H}) divisible by sequence shards ({n})")
+    assert local_impl in ("flash", "dense"), local_impl
     if dropout_rate > 0.0:
         assert dropout_seed is not None, \
             "dropout_rate > 0 requires dropout_seed"
 
     def seq2head(x):
-        # [B, H, T_local, D] → [B, H/n, T_global, D]
-        x = x.reshape(B, n, H // n, T, D)
-        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0,
-                               tiled=False)
-        # all_to_all with split axis 1 (the n groups) and concat on a new
-        # leading axis: [n, B, 1·(H/n), T, D] → transpose seq chunks in order
-        return x.transpose(1, 2, 0, 3, 4).reshape(B, H // n, n * T, D)
+        # [B, H, T_local, D] → [B, H/n, T_global, D].  Tiled all_to_all:
+        # head dim splits n ways, received seq chunks concatenate in
+        # device order (= sequence order).  The tiled form's AD transpose
+        # is the same-shape tiled all_to_all — the untiled axis-
+        # inserting form mis-lowered under grad for B > 1.
+        x = x.reshape(B, H, T, D)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                               tiled=True)          # [B, H/n, n·T, D]
+        return x
 
     def head2seq(x):
         # [B, H/n, T_global, D] → [B, H, T_local, D]
-        x = x.reshape(B, H // n, n, T, D).transpose(2, 0, 1, 3, 4)
-        x = jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1)
-        return x.reshape(B, H, T, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
 
     qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
     scale = float(D) ** -0.5 if sm_scale is None else sm_scale
+    # this device holds global heads idx*(H/n) .. (idx+1)*(H/n)-1
+    heads = (jnp.uint32(idx) * jnp.uint32(H // n)
+             + jnp.arange(H // n, dtype=jnp.uint32))
+    bh_global = (jnp.arange(B, dtype=jnp.uint32)[:, None] * jnp.uint32(H)
+                 + heads[None, :])                      # [B, H/n]
+    if local_impl == "flash":
+        from ..ops.pallas.flash_attention import flash_attention
+        og = flash_attention(qg, kg, vg, causal=causal, sm_scale=scale,
+                             dropout_rate=dropout_rate,
+                             dropout_seed=dropout_seed,
+                             bh_ids=bh_global.reshape(-1))
+        return head2seq(og)
     s = _block_scores(qg.astype(jnp.float32), kg.astype(jnp.float32), scale)
     if causal:
         Tg = s.shape[-1]
@@ -168,17 +192,10 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         s = jnp.where(mask[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     if dropout_rate > 0.0:
-        from ..ops.pallas.flash_attention import dropout_keep_mask
+        from ..ops.pallas.flash_attention import dense_keep_mask
         Tg = p.shape[-1]
-        # this device holds global heads idx*(H/n) .. (idx+1)*(H/n)-1
-        heads = (jnp.uint32(idx) * jnp.uint32(H // n)
-                 + jnp.arange(H // n, dtype=jnp.uint32))
-        bh = (jnp.arange(B, dtype=jnp.uint32)[:, None, None, None]
-              * jnp.uint32(H) + heads[None, :, None, None])
-        keep = dropout_keep_mask(
-            jnp.arange(Tg, dtype=jnp.uint32)[None, None, :, None],
-            jnp.arange(Tg, dtype=jnp.uint32)[None, None, None, :],
-            bh, dropout_seed, dropout_rate)
+        keep = dense_keep_mask(B, H // n, Tg, Tg, dropout_seed,
+                               dropout_rate, bh_ids=bh_global.reshape(-1))
         p = p * keep.astype(p.dtype) / (1.0 - dropout_rate)
     og = jnp.einsum("bhqk,bhkd->bhqd", p,
                     vg.astype(jnp.float32)).astype(q.dtype)

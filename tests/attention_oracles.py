@@ -8,7 +8,7 @@ source so the oracle cannot drift between test families.
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.ops.pallas.flash_attention import dropout_keep_mask
+from deepspeed_tpu.ops.pallas.flash_attention import dense_keep_mask
 
 
 def dense_dropout_oracle(q, k, v, rate, seed, causal=True):
@@ -23,10 +23,6 @@ def dense_dropout_oracle(q, k, v, rate, seed, causal=True):
     if causal:
         s = jnp.where(jnp.tril(jnp.ones((t, tk), bool)), s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    keep = dropout_keep_mask(
-        jnp.arange(t, dtype=jnp.uint32)[None, None, :, None],
-        jnp.arange(tk, dtype=jnp.uint32)[None, None, None, :],
-        jnp.arange(b * h, dtype=jnp.uint32).reshape(b, h, 1, 1),
-        seed, rate)
+    keep = dense_keep_mask(b, h, t, tk, seed, rate)
     pd = p * keep.astype(p.dtype) / (1.0 - rate)
     return jnp.einsum("bhqk,bhkd->bhqd", pd.astype(q.dtype), v)

@@ -100,8 +100,9 @@ def _moe_model(n_layer=2, n_experts=4, **kw):
     # remat=None: these are routing/placement tests, and skipping the
     # checkpoint-policy tracing roughly halves their compile time
     kw.setdefault("remat", None)
+    kw.setdefault("attn_impl", "dense")
     cfg = GPT2MoEConfig(vocab_size=128, n_positions=32, d_model=32,
-                        n_layer=n_layer, n_head=4, attn_impl="dense",
+                        n_layer=n_layer, n_head=4,
                         n_experts=n_experts, **kw)
     return GPT2MoEModel(cfg), cfg
 
@@ -205,6 +206,18 @@ def test_scan_groups_trains_with_remat():
     mesh = build_mesh(dp=8)
     eng = _engine(model, mesh, zero_stage=2, micro=1, ga=2)
     losses = [float(np.asarray(eng.train_batch(_tokens(16, seed=s))))
+              for s in range(3)]
+    assert all(np.isfinite(losses))
+
+
+@pytest.mark.slow
+def test_moe_sequence_parallel_composes():
+    """EP (data) × SP (seq, ring attention): the MoE dispatch einsums run
+    under GSPMD while attention shard_maps over 'seq' only."""
+    model, _ = _moe_model(n_experts=4, attn_impl="ring")
+    mesh = build_mesh(dp=4, sp=2, tp=1)
+    eng = _engine(model, mesh, zero_stage=2, micro=1, ga=1)
+    losses = [float(np.asarray(eng.train_batch(_tokens(4, seed=s))))
               for s in range(3)]
     assert all(np.isfinite(losses))
 

@@ -30,6 +30,10 @@ def main():
                         help="sequence-parallel shards (ring attention)")
     parser.add_argument("--attn", type=str, default="ring",
                         choices=("ring", "ulysses", "flash"))
+    parser.add_argument("--dropout", type=float, default=0.0,
+                        help="attention-probability dropout — runs inside "
+                        "the sequence-parallel schemes via the "
+                        "position-hashed mask (layout-independent)")
     parser.add_argument("--cpu", action="store_true")
     parser = deepspeed_tpu.add_config_arguments(parser)
     args = parser.parse_args()
@@ -50,8 +54,8 @@ def main():
     mesh = build_mesh(pp=1, sp=sp, tp=1, devices=jax.devices())
     model = GPT2Model(GPT2Config(
         vocab_size=4096, n_positions=args.seq, d_model=128, n_layer=2,
-        n_head=8, dropout=0.0, embd_dropout=0.0, attn_impl=args.attn,
-        remat="block"))
+        n_head=8, dropout=args.dropout, embd_dropout=0.0,
+        attn_impl=args.attn, remat="block"))
 
     config = args.deepspeed_config or {
         "train_micro_batch_size_per_gpu": 1,

@@ -56,6 +56,13 @@ class MoEConfig:
             raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
         if self.n_experts < 1:
             raise ValueError(f"n_experts must be >= 1, got {self.n_experts}")
+        if self.n_experts < self.top_k:
+            # top_k > n_experts would double-assign tokens to expert 0 with
+            # half gates (probs2 is all-zero after masking, argmax re-picks
+            # 0) — a silent half-weighting, not a meaningful routing.
+            raise ValueError(
+                f"n_experts ({self.n_experts}) must be >= top_k "
+                f"({self.top_k})")
 
     def capacity(self, tokens_per_group: int, train: bool) -> int:
         cf = self.capacity_factor if train else self.eval_capacity_factor

@@ -82,7 +82,11 @@ def dropout_keep_mask(q_ids, k_ids, bh, seed, rate: float):
          + k_ids.astype(jnp.uint32))
     x = x ^ (jnp.uint32(bh) * jnp.uint32(0x85EBCA6B))
     x = _fmix32(x ^ jnp.uint32(seed))
-    thresh = jnp.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+    # round() (not int() truncation) so the realized drop probability is
+    # unbiased to the nearest 2^-32; rates within 2^-32 of 1.0 still
+    # saturate at 2^32-1 (a keep probability of exactly 0 would need a
+    # 33-bit threshold — irrelevant at practical dropout rates).
+    thresh = jnp.uint32(min(round(rate * 2.0 ** 32), 2 ** 32 - 1))
     return x >= thresh
 
 

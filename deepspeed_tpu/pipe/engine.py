@@ -355,10 +355,12 @@ class PipelineEngine(DeepSpeedEngine):
         is split into the engine's micro-batches exactly like training
         (reference :329-335 builds the same micro-batch iterator)."""
         if batch is None:
-            it = data_iter or self._training_iter()
-            if it is None:
-                raise ValueError("eval_batch needs a batch or a data_iter")
-            batch = next(it)
+            if data_iter is None:
+                raise ValueError(
+                    "eval_batch needs a batch or a data_iter; it does not "
+                    "fall back to the training iterator (that would consume "
+                    "and advance the training data stream)")
+            batch = next(data_iter)
 
         def check(x):
             x = np.asarray(x)

@@ -67,6 +67,63 @@ class StepMetrics(NamedTuple):
     lr: jnp.ndarray
 
 
+class _FlatLeaf(NamedTuple):
+    """Per-leaf record of the offload tier's partition-major flat layout.
+
+    ``data_dim`` is the leaf dim the ZeRO plan shards over ``data`` (the
+    dim is moved to the front before flattening so each rank's chunk of
+    the flat vector is exactly its shard — all reshapes stay sharding-
+    natural and collective-free).  ``None`` means the leaf has no leading
+    data sharding; it is padded to a multiple of dp and row-chunked.
+    ``w`` is the leaf's per-rank width in the (dp, W) flat view."""
+    shape: tuple
+    size: int
+    data_dim: Optional[int]
+    w: int
+    pad: int
+
+
+def _flat_leaf_layout(shape: tuple, size: int, spec, dp: int) -> _FlatLeaf:
+    """Choose the flat-layout record for one leaf from its ZeRO grad/param
+    spec.  A dim qualifies as ``data_dim`` when the spec shards it over
+    ``data`` either alone or as the MAJOR axis of a tuple entry (GSPMD
+    tuple shardings are major-to-minor, so moving that dim to the front
+    keeps the reshape split (dp, d/dp, ...) natural)."""
+    data_dim = None
+    for i, entry in enumerate(spec or ()):
+        if entry == DATA_AXIS or (isinstance(entry, tuple) and entry
+                                  and entry[0] == DATA_AXIS):
+            data_dim = i
+            break
+    if dp > 1 and data_dim is not None and shape[data_dim] % dp == 0:
+        return _FlatLeaf(shape, size, data_dim, size // dp, 0)
+    pad = (-size) % dp
+    return _FlatLeaf(shape, size, None, (size + pad) // dp, pad)
+
+
+def _pack_leaf(x, rec: _FlatLeaf, dp: int, xp):
+    """Leaf array (already dtype-cast) -> its (dp, w) flat piece.  ONE
+    implementation parameterized over ``xp`` (jnp for the traceable pair,
+    np for the checkpoint pair) so the device layout and the checkpoint
+    layout cannot desynchronize."""
+    if rec.data_dim is not None:
+        return xp.moveaxis(x, rec.data_dim, 0).reshape(dp, rec.w)
+    v = x.reshape(-1)
+    if rec.pad:
+        v = xp.concatenate([v, xp.zeros((rec.pad,), v.dtype)])
+    return v.reshape(dp, rec.w)
+
+
+def _unpack_leaf(sl, rec: _FlatLeaf, xp):
+    """Inverse of ``_pack_leaf``: a (dp, w) slice -> the leaf shape."""
+    if rec.data_dim is not None:
+        moved = ((rec.shape[rec.data_dim],)
+                 + tuple(d for i, d in enumerate(rec.shape)
+                         if i != rec.data_dim))
+        return xp.moveaxis(sl.reshape(moved), 0, rec.data_dim)
+    return sl.reshape(-1)[:rec.size].reshape(rec.shape)
+
+
 class DeepSpeedEngine:
     def __init__(self,
                  model: TrainModule,
@@ -190,10 +247,7 @@ class DeepSpeedEngine:
             self._flat_shapes = [tuple(l.shape) for l in leaves]
             self._flat_sizes = [int(np.prod(s)) if s else 1
                                 for s in self._flat_shapes]
-            n = sum(self._flat_sizes)
             dp = self.dp_world_size
-            self._flat_pad = (-n) % dp
-            self._flat_n = n + self._flat_pad
             flat_dev = NamedSharding(self.mesh, P(DATA_AXIS))
             # Off-TPU (CPU test meshes) host and device memory are the same
             # space and XLA rejects sharded pinned_host placements — the
@@ -208,6 +262,30 @@ class DeepSpeedEngine:
             self._compute_shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), cspecs,
                 is_leaf=lambda x: isinstance(x, P))
+            # Partition-major flat layout: the flat vector is logically
+            # (dp, W) with rank r's contiguous chunk holding the r-th
+            # data-shard of every leaf (the leaf's data-sharded dim moved
+            # to the front).  This makes every slice/reshape between the
+            # flat buffer and the per-leaf ZeRO shardings *sharding-
+            # natural*, so the SPMD partitioner emits zero collectives for
+            # the data-sharded legs — the naive offset-major layout forced
+            # an involuntary full rematerialization (replicate + re-
+            # partition) of every ZeRO-3 param on the cast-up path and of
+            # every reduce-scattered grad on the flatten path.  Layout dims
+            # come from grad_specs: identical to the stage-3 compute specs
+            # and additionally correct for stage-2's reduce-scattered
+            # grads (compute params are replicated there, so unflatten is
+            # local either way after the stage<3 all-gather).
+            gspec_leaves = jax.tree.leaves(
+                self.zero_plan.grad_specs(master),
+                is_leaf=lambda x: isinstance(x, P))
+            self._flat_layout = [
+                _flat_leaf_layout(shape, size, spec, dp)
+                for shape, size, spec in zip(
+                    self._flat_shapes, self._flat_sizes, gspec_leaves)]
+            self._flat_w = sum(rec.w for rec in self._flat_layout)
+            self._flat_pad = sum(rec.pad for rec in self._flat_layout)
+            self._flat_n = dp * self._flat_w
             # two-stage init staging: a plain jit flatten to device, then
             # an eager device_put into host memory.  The init-time
             # flatten-with-host-out_shardings compile was observed to
@@ -498,11 +576,13 @@ class DeepSpeedEngine:
                 "offload tiers have their own differential test "
                 "(tests/test_cpu_adam.py, tests/test_offload_xla.py)")
         if batch is None:
-            it = data_iter or self._training_iter()
-            if it is None:
+            if data_iter is None:
+                # like eval_batch: never silently consume (and skew) the
+                # training data stream from a diagnostic call
                 raise ValueError(
-                    "verify_gradient_partitioning needs a batch or data_iter")
-            batch = next(it)
+                    "verify_gradient_partitioning needs a batch or "
+                    "data_iter")
+            batch = next(data_iter)
         return self._run_pg_correctness(self._shard_batch(batch),
                                         rtol=rtol, atol=atol)
 
@@ -987,11 +1067,19 @@ class DeepSpeedEngine:
     # host computations.
     # ------------------------------------------------------------------
     def _offload_flatten(self, tree, dtype=jnp.float32):
-        """Param-shaped tree -> one flat padded vector (traceable)."""
-        parts = [l.astype(dtype).reshape(-1) for l in jax.tree.leaves(tree)]
-        if self._flat_pad:
-            parts.append(jnp.zeros((self._flat_pad,), dtype))
-        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        """Param-shaped tree -> one flat partition-major vector
+        (traceable).  Each leaf's data-sharded dim is moved to the front
+        and split into dp rows, so a leaf carrying its ZeRO reduce-scatter
+        / stage-3 sharding flattens into the P('data') flat buffer with
+        ZERO collectives — every reshape is sharding-natural (see
+        ``_FlatLeaf``)."""
+        dp = self.dp_world_size
+        pieces = [_pack_leaf(leaf.astype(dtype), rec, dp, jnp)
+                  for leaf, rec in zip(jax.tree.leaves(tree),
+                                       self._flat_layout)]
+        flat2d = (pieces[0] if len(pieces) == 1
+                  else jnp.concatenate(pieces, axis=1))
+        return flat2d.reshape(-1)
 
     def _offload_unflatten(self, flat):
         """Flat vector -> param-shaped tree with compute shardings
@@ -999,35 +1087,43 @@ class DeepSpeedEngine:
         vector first (the fused ZeRO param all-gather, reference
         stage2.py:1438-1471), so slices are local and per-leaf constraints
         only re-shard TP-split leaves.  Stage 3: the input stays
-        P('data')-sharded and the per-leaf constraints place each
-        data-sharded compute slice (real resharding, by design — ZeRO-3
+        P('data')-sharded and, because the layout is partition-major,
+        each slice/reshape/moveaxis lands exactly on the leaf's
+        data-sharded compute spec — no resharding collectives (ZeRO-3
         never materializes the replica)."""
+        dp = self.dp_world_size
         shard_leaves = jax.tree.leaves(
             self._compute_shardings,
             is_leaf=lambda x: isinstance(x, NamedSharding))
+        flat2d = flat.reshape(dp, self._flat_w)
         out, off = [], 0
-        for shape, size, sh in zip(self._flat_shapes, self._flat_sizes,
-                                   shard_leaves):
-            arr = jax.lax.slice_in_dim(flat, off, off + size).reshape(shape)
-            out.append(jax.lax.with_sharding_constraint(arr, sh))
-            off += size
+        for rec, sh in zip(self._flat_layout, shard_leaves):
+            sl = jax.lax.slice_in_dim(flat2d, off, off + rec.w, axis=1)
+            out.append(jax.lax.with_sharding_constraint(
+                _unpack_leaf(sl, rec, jnp), sh))
+            off += rec.w
         return jax.tree.unflatten(self._flat_treedef, out)
 
     def _unflatten_numpy(self, flat):
-        """Host-side unflatten for checkpointing (no device memory cost)."""
-        arr = np.asarray(jax.device_get(flat))
+        """Host-side unflatten for checkpointing (no device memory cost).
+        Inverts the same partition-major layout as the traceable pair."""
+        dp = self.dp_world_size
+        arr2d = np.asarray(jax.device_get(flat)).reshape(dp, self._flat_w)
         out, off = [], 0
-        for shape, size in zip(self._flat_shapes, self._flat_sizes):
-            out.append(arr[off:off + size].reshape(shape))
-            off += size
+        for rec in self._flat_layout:
+            out.append(_unpack_leaf(arr2d[:, off:off + rec.w], rec, np))
+            off += rec.w
         return jax.tree.unflatten(self._flat_treedef, out)
 
     def _flatten_numpy(self, tree):
-        parts = [np.asarray(jax.device_get(l)).astype(np.float32).reshape(-1)
-                 for l in jax.tree.leaves(tree)]
-        if self._flat_pad:
-            parts.append(np.zeros((self._flat_pad,), np.float32))
-        return np.concatenate(parts) if len(parts) > 1 else parts[0]
+        dp = self.dp_world_size
+        pieces = [
+            _pack_leaf(np.asarray(jax.device_get(l)).astype(np.float32),
+                       rec, dp, np)
+            for l, rec in zip(jax.tree.leaves(tree), self._flat_layout)]
+        flat2d = (pieces[0] if len(pieces) == 1
+                  else np.concatenate(pieces, axis=1))
+        return flat2d.reshape(-1)
 
     def _host_section(self):
         """compute_on('device_host') on real TPUs; a no-op scope on CPU test
@@ -1463,12 +1559,17 @@ class DeepSpeedEngine:
     def eval_batch(self, batch=None, data_iter=None):
         """Forward-only loss on one batch; like ``train_batch`` it also
         accepts a ``data_iter`` (the reference's eval_batch signature,
-        pipe/engine.py:305 there)."""
+        pipe/engine.py:305 there).  Unlike ``train_batch``, a no-arg call
+        raises instead of falling back to the training iterator — silently
+        consuming training batches during evaluation would skew the
+        training stream (the reference requires an explicit data_iter)."""
         if batch is None:
-            it = data_iter or self._training_iter()
-            if it is None:
-                raise ValueError("eval_batch needs a batch or a data_iter")
-            batch = next(it)
+            if data_iter is None:
+                raise ValueError(
+                    "eval_batch needs a batch or a data_iter; it does not "
+                    "fall back to the training iterator (that would consume "
+                    "and advance the training data stream)")
+            batch = next(data_iter)
         micro = jax.tree.map(np.asarray, batch)
         rng = jax.random.fold_in(self._data_rng, self.micro_steps)
         with self._pallas_scope():

@@ -89,8 +89,22 @@ def sanitize_base_spec(spec: Optional[P], shape: tuple, mesh: Mesh) -> \
             out.append(None)
             continue
         names = e if isinstance(e, tuple) else (e,)
-        size = int(np.prod([mesh.shape.get(n, 1) for n in names]))
-        out.append(e if size > 0 and shape[i] % size == 0 else None)
+        # Greedy major-to-minor retention: keep each sub-axis while the
+        # running product still divides the dim, so a tuple entry like
+        # ('data', 'model') on a dim divisible by dp but not dp*tp keeps
+        # the 'data' sharding instead of replicating wholesale.
+        kept, prod = [], 1
+        for n in names:
+            s = int(mesh.shape.get(n, 1))
+            if shape[i] % (prod * s) == 0:
+                kept.append(n)
+                prod *= s
+        if not kept:
+            out.append(None)
+        elif not isinstance(e, tuple):
+            out.append(kept[0])
+        else:
+            out.append(tuple(kept))
     return P(*out)
 
 

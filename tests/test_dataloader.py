@@ -80,3 +80,6 @@ def test_initialize_with_training_data_trains():
     # pipe/engine.py:305 there)
     ev = float(np.asarray(engine.eval_batch(data_iter=it)))
     assert np.isfinite(ev)
+    # a no-arg eval_batch must NOT silently consume the training iterator
+    with pytest.raises(ValueError, match="does not fall back"):
+        engine.eval_batch()

@@ -149,6 +149,13 @@ def test_moe_engine_ep_tp_compose():
     assert np.isfinite(loss)
 
 
+def test_moe_config_rejects_top_k_over_n_experts():
+    """top_k > n_experts would silently double-assign tokens to expert 0
+    with half gates — rejected at config time."""
+    with pytest.raises(ValueError, match="top_k"):
+        MoEConfig(n_experts=1, d_model=16, d_ff=32, top_k=2)
+
+
 def test_moe_indivisible_experts_fall_back_to_replication():
     """4 experts on a dp=8 mesh: the EP spec's expert dim is indivisible,
     so it must be dropped (replicated) rather than failing NamedSharding

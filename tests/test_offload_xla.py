@@ -75,13 +75,23 @@ def test_weight_decay_paths(mesh):
 
 
 def test_flat_padding_and_sharding(mesh):
+    """Partition-major layout contract: the flat vector is (dp, W) row-
+    chunked, leaves without a leading data shard are padded per-leaf to a
+    multiple of dp, and the flatten/unflatten pair is an exact inverse."""
     eng = DeepSpeedEngine(SimpleModel(hidden_dim=32), _cfg(True), mesh=mesh)
     n_raw = sum(int(np.prod(s)) for s in eng._flat_shapes)
-    assert eng._flat_n % 4 == 0              # padded to dp
-    assert eng._flat_n - n_raw == eng._flat_pad < 4
+    assert eng._flat_n % 4 == 0              # dp rows of equal width
+    assert eng._flat_n == 4 * eng._flat_w
+    assert eng._flat_n - n_raw == eng._flat_pad  # per-leaf padding total
+    assert all(rec.pad < 4 for rec in eng._flat_layout)
     assert eng.state.master_params.shape == (eng._flat_n,)
     spec = eng.state.master_params.sharding.spec
     assert "data" in str(spec)               # per-rank host partitions
+    # exact numpy roundtrip through the layout
+    tree = eng._unflatten_numpy(eng.state.master_params)
+    again = eng._flatten_numpy(tree)
+    np.testing.assert_array_equal(
+        again, np.asarray(jax.device_get(eng.state.master_params)))
 
 
 def test_checkpoint_roundtrip_and_cross_load(mesh, tmp_path):
@@ -181,3 +191,40 @@ def test_zero3_offload_composition(mesh):
     sharded2 = eng2._shard_batch(_batch(9))
     hlo2 = eng2._train_step.lower(eng2.state, sharded2).compile().as_text()
     assert full_gathers(hlo2), "stage-2 control should show the gather"
+
+
+def test_zero3_layout_roundtrip_is_collective_free(mesh):
+    """The partition-major flat layout makes the stage-3 unflatten (flat
+    P('data') → per-leaf data-sharded compute params) and the reverse
+    flatten sharding-natural: the compiled roundtrip must contain NO
+    collectives at all.  The naive offset-major layout compiled this to an
+    involuntary full rematerialization (replicate + re-partition) of every
+    param — the SPMD warning the r02 dryrun log carried."""
+    import jax.numpy as jnp
+    cfg3 = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3, "cpu_offload": True,
+                              "offload_impl": "xla"},
+    }, world_size=4)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg3, mesh=mesh)
+
+    def roundtrip(flat):
+        return eng._offload_flatten(eng._offload_unflatten(flat),
+                                    jnp.float32)
+
+    fn = jax.jit(roundtrip, in_shardings=eng._flat_dev_sharding,
+                 out_shardings=eng._flat_dev_sharding)
+    hlo = fn.lower(jax.ShapeDtypeStruct((eng._flat_n,),
+                                        jnp.float32)).compile().as_text()
+    for op in ("all-gather", "all-reduce", "all-to-all",
+               "collective-permute", "reduce-scatter"):
+        assert op not in hlo, f"stage-3 layout roundtrip emits {op}"
+    # and it is an exact identity on the data
+    x = np.arange(eng._flat_n, dtype=np.float32)
+    y = np.asarray(jax.device_get(fn(jax.device_put(
+        x, eng._flat_dev_sharding))))
+    np.testing.assert_array_equal(x, y)

@@ -1,0 +1,65 @@
+"""Unit tests for the ZeRO spec helpers (runtime/zero.py).
+
+The sanitize rule is the no-padding contract: an axis assignment survives
+only if the leaf dim is divisible by the mesh-axis size; tuple entries are
+retained greedily major-to-minor (reference ZeRO likewise pads nothing and
+falls back per-tensor, stage2.py partitioning)."""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime.zero import sanitize_base_spec, shard_spec_for_leaf
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    # dp=4 × tp=2 on the 8-device CPU mesh
+    return build_mesh(dp=4, tp=2)
+
+
+def test_divisible_entry_kept(mesh):
+    assert sanitize_base_spec(P("data", None), (8, 3), mesh) == P("data",
+                                                                  None)
+
+
+def test_indivisible_entry_dropped(mesh):
+    assert sanitize_base_spec(P("data", None), (6, 3), mesh) == P(None, None)
+
+
+def test_tuple_entry_retains_divisible_major_axes(mesh):
+    # dim 8 divides dp=4 but not dp*tp=8? 8 % 8 == 0 → full tuple kept
+    assert sanitize_base_spec(P(("data", "model"),), (8,), mesh) == P(
+        ("data", "model"))
+    # dim 4 divides dp=4 but not dp*tp=8 → keep the major 'data' sub-axis
+    # instead of replicating the whole dim
+    assert sanitize_base_spec(P(("data", "model"),), (4,), mesh) == P(
+        ("data",))
+    # dim 2: 'data' (4) fails but 'model' (2) divides — the minor axis is
+    # retained alone (any divisible sub-axis set is a valid placement;
+    # the fallback shards as much as divisibility allows)
+    assert sanitize_base_spec(P(("data", "model"),), (2,), mesh) == P(
+        "model")
+    # nothing divides a prime dim
+    assert sanitize_base_spec(P(("data", "model"),), (3,), mesh) == P(None)
+
+
+def test_rank_mismatch_raises(mesh):
+    with pytest.raises(ValueError, match="more entries"):
+        sanitize_base_spec(P("data", None, None), (4, 4), mesh)
+
+
+def test_shard_spec_first_divisible_dim():
+    assert shard_spec_for_leaf((3, 8), 4) == P(None, "data")
+    assert shard_spec_for_leaf((3, 5), 4) == P(None, None)
+    assert shard_spec_for_leaf((4,), 1) == P(None)
+
+
+def test_shard_spec_respects_base():
+    # base consumes 'data' (expert-parallel weights): nothing to add
+    assert shard_spec_for_leaf((8, 16), 4, base_spec=P("data")) == P(
+        "data", None)
+    # base TP spec on dim 1; ZeRO takes dim 0
+    assert shard_spec_for_leaf((8, 16), 4, base_spec=P(None, "model")) == P(
+        "data", "model")

@@ -224,6 +224,8 @@ class DeepSpeedPipelineConfig:
         self.activation_checkpoint_interval = get_scalar_param(
             pipe, C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL,
             C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT)
+        self.schedule = get_scalar_param(
+            pipe, C.PIPELINE_SCHEDULE, C.PIPELINE_SCHEDULE_DEFAULT)
 
 
 class DeepSpeedConfigWriter:

@@ -118,7 +118,8 @@ class BertModel(TrainModule):
             "token_type_embeddings": P(),
             "emb_ln_scale": P(), "emb_ln_bias": P(),
             "layers": {
-                "attn_qkvw": P(None, None, m), "attn_qkvb": P(None, m),
+                "attn_qkvw": P(None, None, None, m),
+                "attn_qkvb": P(None, None, m),
                 "attn_ow": P(None, m, None), "attn_ob": P(),
                 "attn_nw": P(), "attn_nb": P(),
                 "inter_w": P(None, None, m), "inter_b": P(None, m),

@@ -70,8 +70,12 @@ class BertEmbeddingPipe:
                 f"sequence length {T} exceeds max_position_embeddings="
                 f"{self.cfg.max_position_embeddings}")
         # pipe batches carry no token_type_ids: segment 0 for every token,
-        # which is tte row 0 broadcast (no per-token gather needed)
-        x = (params["wte"][input_ids] + params["wpe"][:T][None]
+        # which is tte row 0 broadcast (no per-token gather needed).
+        # one-hot contraction for the word lookup — scatter-free VJP under
+        # the pipeline's manual/auto nesting (see gpt2_pipe equivalent)
+        wte = params["wte"]
+        onehot = jax.nn.one_hot(input_ids, wte.shape[0], dtype=wte.dtype)
+        x = (onehot @ wte + params["wpe"][:T][None]
              + params["tte"][0][None, None])
         x = _layer_norm(x, params["ln_scale"], params["ln_bias"])
         return _dropout(x, self.cfg.hidden_dropout_prob if train else 0.0,
@@ -92,7 +96,7 @@ class BertLayerPipe:
     def param_partition_specs(self):
         m = MODEL_AXIS
         return {
-            "attn_qkvw": P(None, m), "attn_qkvb": P(m),
+            "attn_qkvw": P(None, None, m), "attn_qkvb": P(None, m),
             "attn_ow": P(m, None), "attn_ob": P(),
             "attn_nw": P(), "attn_nb": P(),
             "inter_w": P(None, m), "inter_b": P(m),
@@ -137,7 +141,9 @@ def bert_mlm_loss_head(params, hidden, labels):
     logp = jax.nn.log_softmax(logits, axis=-1)
     mask = labels != -100
     safe = jnp.where(mask, labels, 0)
-    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    # one-hot contraction (scatter-free VJP; see gpt2_pipe.gpt2_loss_head)
+    onehot = jax.nn.one_hot(safe, logp.shape[-1], dtype=logp.dtype)
+    nll = -jnp.sum(logp * onehot, axis=-1)
     denom = jnp.maximum(mask.sum(), 1)
     return jnp.sum(jnp.where(mask, nll, 0.0)) / denom
 

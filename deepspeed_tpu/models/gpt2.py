@@ -95,8 +95,13 @@ class GPT2Model(TrainModule):
             "blocks": {
                 "ln1_scale": jnp.ones((L, d), jnp.float32),
                 "ln1_bias": jnp.zeros((L, d), jnp.float32),
-                "qkv_w": norm(keys[2], (L, d, 3 * d)),
-                "qkv_b": jnp.zeros((L, 3 * d), jnp.float32),
+                # [L, d, 3, d] (not [L, d, 3d]): the q/k/v boundary lives
+                # on its own unsharded dim so the TP 'model' shard on the
+                # feature dim never straddles it — the fused-[3d] layout
+                # forced GSPMD halo collective-permutes at every q/k/v
+                # split (same values: reshape of the fused layout).
+                "qkv_w": norm(keys[2], (L, d, 3, d)),
+                "qkv_b": jnp.zeros((L, 3, d), jnp.float32),
                 "out_w": norm(keys[3], (L, d, d), resid_std),
                 "out_b": jnp.zeros((L, d), jnp.float32),
                 "ln2_scale": jnp.ones((L, d), jnp.float32),
@@ -120,8 +125,8 @@ class GPT2Model(TrainModule):
             "ln_f_bias": P(),
             "blocks": {
                 "ln1_scale": P(), "ln1_bias": P(),
-                "qkv_w": P(None, None, m),   # column parallel
-                "qkv_b": P(None, m),
+                "qkv_w": P(None, None, None, m),  # column parallel (per-
+                "qkv_b": P(None, None, m),        # q/k/v feature shards)
                 "out_w": P(None, m, None),   # row parallel
                 "out_b": P(),
                 "ln2_scale": P(), "ln2_bias": P(),
@@ -212,8 +217,11 @@ def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
     drop = cfg.dropout if train else 0.0
 
     h = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
-    qkv = h @ bp["qkv_w"].astype(h.dtype) + bp["qkv_b"].astype(h.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    # contraction keeps q/k/v on a dedicated unsharded dim — slicing it is
+    # local under TP (see the qkv_w layout note in GPT2Model.init)
+    qkv = (jnp.einsum("btd,dke->btke", h, bp["qkv_w"].astype(h.dtype))
+           + bp["qkv_b"].astype(h.dtype))
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
     def heads(t):
         return t.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)

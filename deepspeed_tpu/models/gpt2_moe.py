@@ -138,8 +138,8 @@ class GPT2MoEModel(TrainModule):
         attn = {
             "ln1_scale": jnp.ones((L, d), jnp.float32),
             "ln1_bias": jnp.zeros((L, d), jnp.float32),
-            "qkv_w": norm(keys[2], (L, d, 3 * d)),
-            "qkv_b": jnp.zeros((L, 3 * d), jnp.float32),
+            "qkv_w": norm(keys[2], (L, d, 3, d)),
+            "qkv_b": jnp.zeros((L, 3, d), jnp.float32),
             "out_w": norm(keys[3], (L, d, d), resid_std),
             "out_b": jnp.zeros((L, d), jnp.float32),
             "ln2_scale": jnp.ones((L, d), jnp.float32),
@@ -180,8 +180,8 @@ class GPT2MoEModel(TrainModule):
             "ln_f_bias": P(),
             "attn": {
                 "ln1_scale": P(), "ln1_bias": P(),
-                "qkv_w": P(None, None, m),
-                "qkv_b": P(None, m),
+                "qkv_w": P(None, None, None, m),
+                "qkv_b": P(None, None, m),
                 "out_w": P(None, m, None),
                 "out_b": P(),
                 "ln2_scale": P(), "ln2_bias": P(),

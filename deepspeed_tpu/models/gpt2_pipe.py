@@ -43,7 +43,15 @@ class GPT2EmbeddingPipe:
         if T > cfg.n_positions:
             raise ValueError(
                 f"sequence length {T} exceeds n_positions={cfg.n_positions}")
-        x = params["wte"][tokens] + params["wpe"][:T][None]
+        # one-hot contraction, not wte[tokens]: the gather's VJP is a
+        # scatter-add into the (possibly vocab-sharded) table, which the
+        # SPMD partitioner cannot handle inside the pipeline's
+        # manual(pipe)/auto(model) nesting — and the one-hot dot runs on
+        # the MXU where the scatter serializes.  ~V/d extra FLOPs on a
+        # layer that is <<1% of the model's compute.
+        wte = params["wte"]
+        onehot = jax.nn.one_hot(tokens, wte.shape[0], dtype=wte.dtype)
+        x = onehot @ wte + params["wpe"][:T][None]
         return _dropout(x, cfg.embd_dropout if train else 0.0, rng)
 
 
@@ -64,8 +72,9 @@ class GPT2BlockPipe:
         return {
             "ln1_scale": jnp.ones((d,), jnp.float32),
             "ln1_bias": jnp.zeros((d,), jnp.float32),
-            "qkv_w": jax.random.normal(ks[0], (d, 3 * d), jnp.float32) * std,
-            "qkv_b": jnp.zeros((3 * d,), jnp.float32),
+            "qkv_w": jax.random.normal(
+                ks[0], (d, 3, d), jnp.float32) * std,
+            "qkv_b": jnp.zeros((3, d), jnp.float32),
             "out_w": jax.random.normal(ks[1], (d, d), jnp.float32) * resid_std,
             "out_b": jnp.zeros((d,), jnp.float32),
             "ln2_scale": jnp.ones((d,), jnp.float32),
@@ -83,7 +92,7 @@ class GPT2BlockPipe:
         m = MODEL_AXIS
         return {
             "ln1_scale": P(), "ln1_bias": P(),
-            "qkv_w": P(None, m), "qkv_b": P(m),
+            "qkv_w": P(None, None, m), "qkv_b": P(None, m),
             "out_w": P(m, None), "out_b": P(),
             "ln2_scale": P(), "ln2_bias": P(),
             "fc_w": P(None, m), "fc_b": P(m),
@@ -114,7 +123,14 @@ def gpt2_loss_head(params, hidden, labels):
     logits = hidden @ wte.astype(hidden.dtype).T
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
+    # one-hot contraction, not take_along_axis: its VJP is a dense
+    # multiply (XLA fuses the one-hot into a masked reduce), whereas the
+    # gather's VJP is a scatter-add — which the SPMD partitioner cannot
+    # handle inside the pipeline's manual(pipe)/auto(model) nesting (and
+    # scatters onto a vocab-sharded logit cotangent are slow on TPU
+    # regardless).
+    onehot = jax.nn.one_hot(labels, logp.shape[-1], dtype=logp.dtype)
+    nll = -jnp.sum(logp * onehot, axis=-1)
     return jnp.mean(nll)
 
 

@@ -130,8 +130,11 @@ class DeepSpeedTransformerLayer:
         ks = jax.random.split(rng, 4)
         n = jax.random.normal
         return {
-            "attn_qkvw": n(ks[0], (d, 3 * d), jnp.float32) * std,
-            "attn_qkvb": jnp.zeros((3 * d,), jnp.float32),
+            # [d, 3, d]: q/k/v on a dedicated dim so a TP 'model' shard of
+            # the feature dim never straddles the q/k/v boundary (the
+            # fused-[3d] layout forces GSPMD halo exchanges at the split)
+            "attn_qkvw": n(ks[0], (d, 3, d), jnp.float32) * std,
+            "attn_qkvb": jnp.zeros((3, d), jnp.float32),
             "attn_ow": n(ks[1], (d, d), jnp.float32) * out_std,
             "attn_ob": jnp.zeros((d,), jnp.float32),
             "attn_nw": jnp.ones((d,), jnp.float32),
@@ -150,9 +153,10 @@ class DeepSpeedTransformerLayer:
         B, T, D = h.shape
         H = cfg.heads
         Dh = D // H
-        qkv = h @ params["attn_qkvw"].astype(h.dtype) \
-            + params["attn_qkvb"].astype(h.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qkv = (jnp.einsum("btd,dke->btke",
+                          h, params["attn_qkvw"].astype(h.dtype))
+               + params["attn_qkvb"].astype(h.dtype))
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         split = lambda t: t.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
         q, k, v = split(q), split(k), split(v)
 

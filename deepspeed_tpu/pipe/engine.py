@@ -146,33 +146,41 @@ class _PipelinedTrainModule(TrainModule):
                     f"{first.shape}/{first.dtype} — adjust the partition")
         return structs[0]
 
-    def loss_fn(self, params, batch, rng, train: bool = True):
+    def _split_micro(self, tree):
+        """[B, ...] -> [M, B/M, ...] sharded over data on the sample dim."""
+        M, mesh = self.num_micro, self.mesh
+
+        def r(x):
+            if x.shape[0] % M != 0:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} not divisible by "
+                    f"micro count {M}")
+            x = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(None, DATA_AXIS)))
+        return jax.tree.map(r, tree)
+
+    def _prepare(self, params, batch, rng):
+        """Shared front half of both schedules: micro split + boundary."""
         if not (isinstance(batch, (tuple, list)) and len(batch) == 2):
             raise ValueError(
                 "pipeline batch must be a (inputs, labels) pair")
         inputs, labels = batch
-        pm, S, M = self.pm, self.num_stages, self.num_micro
-        mesh = self.mesh
-        plan = pm.stack_plan()
-
-        def split_micro(tree):
-            def r(x):
-                if x.shape[0] % M != 0:
-                    raise ValueError(
-                        f"batch dim {x.shape[0]} not divisible by "
-                        f"micro count {M}")
-                x = x.reshape((M, x.shape[0] // M) + x.shape[1:])
-                return jax.lax.with_sharding_constraint(
-                    x, NamedSharding(mesh, P(None, DATA_AXIS)))
-            return jax.tree.map(r, tree)
-
-        micros_in = split_micro(inputs)
-        micros_lb = split_micro(labels)
-
+        micros_in = self._split_micro(inputs)
+        micros_lb = self._split_micro(labels)
         sample_in = jax.tree.map(lambda x: jax.ShapeDtypeStruct(
             x.shape[1:], x.dtype), micros_in)
         boundary = self._boundary_struct(params, sample_in, rng)
-        parts = [pm.stage_layer_range(s) for s in range(S)]
+        parts = [self.pm.stage_layer_range(s)
+                 for s in range(self.num_stages)]
+        return micros_in, micros_lb, boundary, parts
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        pm, S, M = self.pm, self.num_stages, self.num_micro
+        mesh = self.mesh
+        plan = pm.stack_plan()
+        micros_in, micros_lb, boundary, parts = self._prepare(
+            params, batch, rng)
 
         # ALL params cross the shard_map boundary in fp32 so gradient
         # accumulation across the scan's ticks happens in fp32 (the per-tick
@@ -295,6 +303,216 @@ class _PipelinedTrainModule(TrainModule):
             check_vma=False)
         return sm(place(params), micros_in, micros_lb, rng)
 
+    # -----------------------------------------------------------------
+    # 1F1B: hand-scheduled backward inside the same compiled scan.
+    #
+    # The GPipe path above differentiates the whole fill/drain scan with
+    # AD, which stores one stage-boundary activation per tick — O(M) live
+    # boundaries.  Here the backward is part of the schedule itself (the
+    # reference's TrainSchedule, runtime/pipe/schedule.py:189-247): each
+    # stage alternates Forward and Backward ticks, so a micro-batch's
+    # boundary activation is freed after at most 2(S-s) ticks and the
+    # activation store is a ring of min(S, M) slots — the compiled
+    # analogue of the reference's buffer bound
+    # min(stages - stage_id + 1, micro_batches) (schedule.py:243-247).
+    #
+    # Timetable (T = 2(M+S-1) ticks, the reference TrainSchedule's step
+    # count): stage s runs F(m) at tick 2m + s and B(m) at tick
+    # 2m + 2S - 1 - s.  F-ticks have parity s, B-ticks parity s+1, so
+    # every tick is exactly one of the two; the F handoff (ppermute
+    # s->s+1) and the cotangent handoff (ppermute s+1->s) both run every
+    # tick, carrying zeros on the off-parity.  The per-stage backward is
+    # jax.vjp of the stage body (recomputing its forward — the same
+    # whole-stage remat granularity the GPipe path uses), seeded at the
+    # last stage by grad(loss * scale / M).
+    # -----------------------------------------------------------------
+    def value_and_grads(self, params, batch, rng, loss_scale):
+        """(scaled mean loss, grads) with 1F1B activation liveness.
+
+        ``params`` arrive in compute dtype; gradients accumulate in fp32
+        in the scan carry (the per-tick vjp cotangents are compute-dtype,
+        exactly like the AD path's per-tick transposes).  Returned grads
+        are d(loss_scale * mean_loss)/dparams, matching what
+        ``jax.grad`` of the scaled GPipe loss would produce."""
+        pm, S, M = self.pm, self.num_stages, self.num_micro
+        mesh = self.mesh
+        plan = pm.stack_plan()
+        micros_in, micros_lb, boundary, parts = self._prepare(
+            params, batch, rng)
+        D = min(S, M)                 # ring depth: max in-flight micros
+        T = 2 * (M + S - 1)
+
+        param_in_specs = {
+            k: jax.tree.map(lambda _: P(PIPE_AXIS) if k in plan else P(),
+                            v)
+            for k, v in params.items()}
+
+        def place(tree):
+            out = {}
+            for k, v in tree.items():
+                spec = P(PIPE_AXIS) if k in plan else P()
+                out[k] = jax.tree.map(
+                    lambda l, spec=spec: jax.lax.with_sharding_constraint(
+                        l, NamedSharding(mesh, spec)), v)
+            return out
+
+        def spmd(params_in, micros_in, micros_lb, rng, scale):
+            stage = jax.lax.axis_index(PIPE_AXIS)
+            local = {k: (jax.tree.map(lambda a: jnp.squeeze(a, 0), v)
+                         if k in plan else v)
+                     for k, v in params_in.items()}
+
+            def stage_fwd(s, tree, x, mrng):
+                start, stop = parts[s]
+                view = pm.stage_view(tree, s, local=True)
+                return pm.forward_range(view, x, mrng, start, stop,
+                                        train=True)
+
+            # ---- forward tick ----
+            def f_branch(carry, t):
+                buf_f, buf_ct, ring, gacc, loss_sum = carry
+                m = (t - stage) // 2
+                m_idx = jnp.clip(m, 0, M - 1)
+                active = (m >= 0) & (m < M)
+
+                def fb(s):
+                    def run(buf):
+                        mrng = jax.random.fold_in(rng, m_idx)
+                        x = (jax.tree.map(lambda a: a[m_idx], micros_in)
+                             if s == 0 else buf)
+                        return stage_fwd(s, local, x, mrng)
+                    return run
+
+                y = jax.lax.switch(stage, [fb(s) for s in range(S)], buf_f)
+                y = jnp.where(active, y, jnp.zeros_like(y))
+                # stash this micro's stage INPUT for the backward tick
+                # (stage 0 re-reads micros_in instead; its slot is unused).
+                # dynamic_update_slice, NOT .at[].set: a traced-index
+                # scatter trips a GSPMD check when partitioning mixed
+                # manual(pipe)/auto(model,data) collectives.
+                slot = m_idx % D
+                cur = jax.lax.dynamic_index_in_dim(ring, slot, 0,
+                                                   keepdims=False)
+                ring = jax.lax.dynamic_update_slice_in_dim(
+                    ring, jnp.where(active, buf_f, cur)[None], slot, 0)
+                return y, jnp.zeros(boundary.shape, boundary.dtype), \
+                    ring, gacc, loss_sum
+
+            # ---- backward tick ----
+            def b_branch(carry, t):
+                buf_f, buf_ct, ring, gacc, loss_sum = carry
+                m = (t - (2 * S - 1 - stage)) // 2
+                m_idx = jnp.clip(m, 0, M - 1)
+                active = (m >= 0) & (m < M)
+
+                def bb(s):
+                    # stage 0 consumes raw batch inputs (possibly integer
+                    # tokens) — never differentiated w.r.t. x; its input
+                    # cotangent has no consumer anyway.
+                    wrt_x = s > 0
+
+                    def run(ct_in):
+                        mrng = jax.random.fold_in(rng, m_idx)
+                        x = (jax.tree.map(lambda a: a[m_idx], micros_in)
+                             if s == 0 else jax.lax.dynamic_index_in_dim(
+                                 ring, m_idx % D, 0, keepdims=False))
+                        zero_gx = jnp.zeros(boundary.shape, boundary.dtype)
+
+                        def compute(_):
+                            if s == S - 1:
+                                def head(tree, xx):
+                                    yy = stage_fwd(s, tree, xx, mrng)
+                                    lb = jax.tree.map(
+                                        lambda a: a[m_idx], micros_lb)
+                                    if self._loss_takes_params:
+                                        lp = _ReplicatedParamsView(
+                                            pm.replicated_view(tree))
+                                        lv = pm.loss_fn(lp, yy, lb)
+                                    else:
+                                        lv = pm.loss_fn(yy, lb)
+                                    return (lv.astype(jnp.float32)
+                                            * (scale / M))
+                                if wrt_x:
+                                    lv, (gl, gx) = jax.value_and_grad(
+                                        head, argnums=(0, 1))(local, x)
+                                else:
+                                    lv, gl = jax.value_and_grad(head)(
+                                        local, x)
+                                    gx = zero_gx
+                                return lv, gl, gx.astype(boundary.dtype)
+                            if wrt_x:
+                                _, vjp = jax.vjp(
+                                    lambda tree, xx: stage_fwd(
+                                        s, tree, xx, mrng), local, x)
+                                gl, gx = vjp(ct_in)
+                            else:
+                                _, vjp = jax.vjp(
+                                    lambda tree: stage_fwd(
+                                        s, tree, x, mrng), local)
+                                (gl,) = vjp(ct_in)
+                                gx = zero_gx
+                            return (jnp.asarray(0.0, jnp.float32), gl,
+                                    gx.astype(boundary.dtype))
+
+                        def skip(_):
+                            return (jnp.asarray(0.0, jnp.float32),
+                                    jax.tree.map(jnp.zeros_like, local),
+                                    zero_gx)
+                        return jax.lax.cond(active, compute, skip, None)
+                    return run
+
+                lv, gl, gx = jax.lax.switch(
+                    stage, [bb(s) for s in range(S)], buf_ct)
+                gacc = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), gacc, gl)
+                return jnp.zeros(boundary.shape, boundary.dtype), \
+                    gx, ring, gacc, loss_sum + lv
+
+            def tick(carry, t):
+                is_f = ((t - stage) % 2) == 0
+                y_out, ct_out, ring, gacc, loss_sum = jax.lax.cond(
+                    is_f, f_branch, b_branch, carry, t)
+                buf_f = jax.lax.ppermute(
+                    y_out, PIPE_AXIS,
+                    perm=[(i, i + 1) for i in range(S - 1)])
+                buf_ct = jax.lax.ppermute(
+                    ct_out, PIPE_AXIS,
+                    perm=[(i + 1, i) for i in range(S - 1)])
+                return (buf_f, buf_ct, ring, gacc, loss_sum), None
+
+            buf0 = jnp.zeros(boundary.shape, boundary.dtype)
+            ring0 = jnp.zeros((D,) + tuple(boundary.shape), boundary.dtype)
+            gacc0 = jax.tree.map(
+                lambda l: jnp.zeros(l.shape, jnp.float32), local)
+            carry0 = (buf0, jnp.zeros(boundary.shape, boundary.dtype),
+                      ring0, gacc0, jnp.asarray(0.0, jnp.float32))
+            (_, _, _, gacc, loss_sum), _ = jax.lax.scan(
+                tick, carry0, jnp.arange(T))
+
+            loss = jax.lax.psum(loss_sum, PIPE_AXIS)
+            grads = {}
+            for k, v in gacc.items():
+                if k in plan:
+                    # stage-local grads: restore the leading pipe dim
+                    grads[k] = jax.tree.map(
+                        lambda a: jnp.expand_dims(a, 0), v)
+                else:
+                    # pipe-replicated params: sum stage contributions
+                    grads[k] = jax.tree.map(
+                        lambda a: jax.lax.psum(a, PIPE_AXIS), v)
+            return loss, grads
+
+        sm = jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(param_in_specs, P(), P(), P(), P()),
+            # grads mirror the param placement exactly (stacked keys local
+            # to their pipe rank, the rest replicated-after-psum)
+            out_specs=(P(), param_in_specs),
+            axis_names={PIPE_AXIS},
+            check_vma=False)
+        return sm(place(params), micros_in, micros_lb, rng,
+                  jnp.asarray(loss_scale, jnp.float32))
+
 
 class PipelineEngine(DeepSpeedEngine):
     """DeepSpeedEngine whose step runs the compiled pipeline.
@@ -305,15 +523,28 @@ class PipelineEngine(DeepSpeedEngine):
 
     def __init__(self, model: PipelineModule, config, mesh,
                  optimizer=None, lr_schedule=None, training_data=None,
-                 collate_fn=None, seed: int = 0, params=None):
+                 collate_fn=None, seed: int = 0, params=None,
+                 schedule: Optional[str] = None):
         if not isinstance(model, PipelineModule):
             raise TypeError("PipelineEngine requires a PipelineModule")
+        if schedule is None:
+            # config key pipeline.schedule (default "1f1b") — reachable
+            # from the initialize() entry point, so users can fall back
+            # to "gpipe" without constructing the engine directly
+            schedule = getattr(
+                getattr(config, "pipeline_config", None), "schedule",
+                "1f1b")
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(
+                f"pipeline schedule must be '1f1b' or 'gpipe', "
+                f"got {schedule!r}")
         pp = mesh_axis_size(mesh, PIPE_AXIS)
         if pp != model.num_stages:
             raise ValueError(
                 f"mesh pipe axis ({pp}) != PipelineModule.num_stages "
                 f"({model.num_stages})")
         self.pipeline_module = model
+        self.schedule = schedule
         num_micro = config.gradient_accumulation_steps
         adapter = _PipelinedTrainModule(model, mesh, num_micro)
         super().__init__(adapter, config, mesh=mesh, optimizer=optimizer,
@@ -324,8 +555,38 @@ class PipelineEngine(DeepSpeedEngine):
         self.micro_batches = num_micro
         log_dist(
             f"PipelineEngine: stages={self.num_stages} "
-            f"micro_batches={self.micro_batches} parts={model.parts}",
+            f"micro_batches={self.micro_batches} parts={model.parts} "
+            f"schedule={schedule}",
             ranks=[0])
+
+    def _scan_scaled_grads(self, params, batch, scaler, step_rng,
+                           cast: bool = True, constrain: bool = True):
+        """Under the 1F1B schedule the backward is hand-scheduled inside
+        the pipelined program (value_and_grads) instead of produced by AD
+        over the GPipe forward — activation liveness drops from O(M)
+        stage-boundary buffers to a ring of min(S, M) (the reference
+        TrainSchedule's buffer bound, runtime/pipe/schedule.py:243-247).
+        Same contract as the base implementation: fp32 mean grads and the
+        per-scan-iteration scaled losses."""
+        if self.schedule != "1f1b":
+            return super()._scan_scaled_grads(
+                params, batch, scaler, step_rng, cast=cast,
+                constrain=constrain)
+        from ..runtime import precision
+        from ..runtime.zero import constrain_grads
+        pp = (precision.cast_to_compute(params, self.compute_dtype)
+              if cast else params)
+        # the engine presents the batch as [1, local, ...] (its outer
+        # grad-accum scan dim); the pipeline consumes all micros at once
+        mb = jax.tree.map(lambda x: x[0], batch)
+        rng = jax.random.fold_in(step_rng, 0)
+        scaled_loss, grads = self.module.value_and_grads(
+            pp, mb, rng, scaler.loss_scale)
+        if constrain:
+            grads = constrain_grads(grads, self.zero_plan)
+        inv = (1.0 / scaler.loss_scale).astype(jnp.float32)
+        grads = jax.tree.map(lambda g: g * inv, grads)
+        return grads, scaled_loss.reshape(1)
 
     def _batch_leading_reshape(self, x):
         """The pipeline consumes all micro-batches in one program — no outer

@@ -156,8 +156,12 @@ def test_logits_match_huggingface_gpt2():
         "blocks": {
             "ln1_scale": stack("transformer.h.{}.ln_1.weight"),
             "ln1_bias": stack("transformer.h.{}.ln_1.bias"),
-            "qkv_w": stack("transformer.h.{}.attn.c_attn.weight"),
-            "qkv_b": stack("transformer.h.{}.attn.c_attn.bias"),
+            # HF fuses qkv on one [d, 3d] dim; ours keeps q/k/v on a
+            # dedicated dim [d, 3, d] (same values, TP-shard-aligned)
+            "qkv_w": stack("transformer.h.{}.attn.c_attn.weight").reshape(
+                L, D, 3, D),
+            "qkv_b": stack("transformer.h.{}.attn.c_attn.bias").reshape(
+                L, 3, D),
             "out_w": stack("transformer.h.{}.attn.c_proj.weight"),
             "out_b": stack("transformer.h.{}.attn.c_proj.bias"),
             "ln2_scale": stack("transformer.h.{}.ln_2.weight"),

@@ -209,8 +209,24 @@ def test_initialize_dispatches_pipeline():
     engine, *_ = deepspeed_tpu.initialize(
         model=pm, config=_pipe_cfg(), mesh=mesh)
     assert isinstance(engine, PipelineEngine)
+    assert engine.schedule == "1f1b"  # pipeline.schedule default
     loss = engine.train_batch(_batch(engine.train_batch_size))
     assert np.isfinite(float(loss))
+
+
+def test_initialize_respects_pipeline_schedule_config():
+    """pipeline.schedule in the ds_config reaches the engine through the
+    initialize() entry point (the fallback knob for the gpipe path)."""
+    mesh = build_mesh(pp=2, dp=4, tp=1)
+    pm = PipelineModule(_specs(4), num_stages=2, loss_fn=mse_loss,
+                        partition_method="uniform")
+    engine, *_ = deepspeed_tpu.initialize(
+        model=pm, config=_pipe_cfg(pipeline={"schedule": "gpipe"}),
+        mesh=mesh)
+    assert engine.schedule == "gpipe"
+    with pytest.raises(ValueError, match="schedule"):
+        PipelineEngine(pm, DeepSpeedConfig(_pipe_cfg(), world_size=4),
+                       mesh, schedule="bogus")
 
 
 def test_pipeline_stage_mismatch_raises():

@@ -140,6 +140,65 @@ def test_pipeline_resize_restore(tmp_path):
     np.testing.assert_allclose(loss4, loss2, rtol=5e-2)
 
 
+def test_1f1b_matches_gpipe():
+    """The hand-scheduled 1F1B backward computes the same gradients as AD
+    over the GPipe scan: identical training trajectories (bf16 noise from
+    a different reduction order only)."""
+    cfg_model = _model_cfg(n_layer=4)
+    mesh = build_mesh(pp=2, dp=4, tp=1)
+    e1 = PipelineEngine(build_gpt2_pipe(cfg_model, num_stages=2),
+                        _cfg(grad_acc=4), mesh, schedule="1f1b")
+    eg = PipelineEngine(build_gpt2_pipe(cfg_model, num_stages=2),
+                        _cfg(grad_acc=4), mesh, schedule="gpipe")
+    toks = np.random.default_rng(3).integers(
+        0, 128, (e1.train_batch_size, 17), dtype=np.int32)
+    for _ in range(4):
+        l1 = float(np.asarray(e1.train_batch(split_gpt2_batch(toks))))
+        lg = float(np.asarray(eg.train_batch(split_gpt2_batch(toks))))
+        assert abs(l1 - lg) < 3e-2, (l1, lg)
+    # parameters stay together step after step (not just the loss)
+    p1 = jax.tree.leaves(e1.state.master_params)
+    pg = jax.tree.leaves(eg.state.master_params)
+    for a, b in zip(p1, pg):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=5e-3)
+
+
+def test_1f1b_activation_memory_independent_of_micro_count():
+    """The 1F1B ring bounds live boundary activations at min(S, M): the
+    compiled step's temp bytes must stay ~flat as M grows, while the
+    GPipe/AD schedule stores one boundary per tick (O(M)) — the reference
+    TrainSchedule's buffer bound min(stages - stage_id + 1, micro_batches)
+    (reference deepspeed/runtime/pipe/schedule.py:243-247).  M is scaled
+    4x at fixed micro size; per-micro batch data scales with M and is an
+    operand (donated input), not a temp."""
+    cfg_model = _model_cfg(n_layer=2)
+    mesh = build_mesh(pp=2, dp=2, tp=1, devices=jax.devices()[:4])
+
+    def temp_bytes(schedule, grad_acc):
+        eng = PipelineEngine(build_gpt2_pipe(cfg_model, num_stages=2),
+                             _cfg(grad_acc=grad_acc, world_size=2), mesh,
+                             schedule=schedule)
+        toks = np.random.default_rng(0).integers(
+            0, 128, (eng.train_batch_size, 17), dtype=np.int32)
+        sharded = eng._shard_batch(split_gpt2_batch(toks))
+        compiled = eng._train_step.lower(eng.state, sharded).compile()
+        ma = compiled.memory_analysis()
+        if ma is None:
+            pytest.skip("backend exposes no memory analysis")
+        return int(ma.temp_size_in_bytes)
+
+    t4 = temp_bytes("1f1b", 4)
+    t16 = temp_bytes("1f1b", 16)
+    # 4x the micro-batches, ~flat activation temp (ring is min(S,M)=2
+    # boundaries; allow slack for per-tick scan bookkeeping)
+    assert t16 < 1.6 * t4, (t4, t16)
+    # the metric is real: the AD/GPipe schedule DOES grow with M
+    g4 = temp_bytes("gpipe", 4)
+    g16 = temp_bytes("gpipe", 16)
+    assert g16 > 1.8 * g4, (g4, g16)
+
+
 @pytest.mark.slow
 def test_heterogeneous_stages_fall_back_to_replicated():
     """Stages with non-matching layer fingerprints keep the general

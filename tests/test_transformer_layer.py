@@ -33,7 +33,9 @@ def ref_bert_layer(p, x, mask, heads, pre_ln=False):
     Dh = D // heads
 
     def attn(h):
-        qkv = h @ p["attn_qkvw"] + p["attn_qkvb"]
+        # layer stores [d, 3, d]; the fused-[3d] view is its reshape
+        qkv = (h @ p["attn_qkvw"].reshape(D, 3 * D)
+               + p["attn_qkvb"].reshape(3 * D))
         q, k, v = jnp.split(qkv, 3, axis=-1)
         sh = lambda t: t.reshape(B, T, heads, Dh).transpose(0, 2, 1, 3)
         q, k, v = sh(q), sh(k), sh(v)
@@ -271,12 +273,12 @@ def _hf_bert_layer_and_params(D, H, I, seed):
 
     sd = dict(hf_layer.named_parameters())
     params = {
-        "attn_qkvw": jnp.concatenate(
+        "attn_qkvw": jnp.stack(
             [t2j(sd[f"attention.self.{n}.weight"]).T
              for n in ("query", "key", "value")], axis=1),
-        "attn_qkvb": jnp.concatenate(
+        "attn_qkvb": jnp.stack(
             [t2j(sd[f"attention.self.{n}.bias"])
-             for n in ("query", "key", "value")]),
+             for n in ("query", "key", "value")], axis=0),
         "attn_ow": t2j(sd["attention.output.dense.weight"]).T,
         "attn_ob": t2j(sd["attention.output.dense.bias"]),
         "attn_nw": t2j(sd["attention.output.LayerNorm.weight"]),
@@ -332,7 +334,7 @@ def test_backward_matches_huggingface_bert_layer():
     tloss = (hf_layer(tx)[0] ** 2).sum()
     tloss.backward()
     want_dx = tx.grad.numpy()
-    want_qkvw = torch.cat(
+    want_qkvw = torch.stack(
         [hf_layer.attention.self.query.weight.grad.T,
          hf_layer.attention.self.key.weight.grad.T,
          hf_layer.attention.self.value.weight.grad.T], dim=1).numpy()

@@ -1,0 +1,22 @@
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8 --xla_dump_to=/tmp/leg5new --xla_dump_hlo_as_text --xla_dump_hlo_pass_re=spmd"
+import jax; jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, "/root/repo")
+import numpy as np
+from deepspeed_tpu.config import DeepSpeedConfig
+from deepspeed_tpu.models import GPT2Config, GPT2Model
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+devices = jax.devices("cpu")[:8]
+cfg_model = GPT2Config(vocab_size=256, n_positions=64, d_model=64, n_layer=2, n_head=4, remat="block")
+mesh5 = build_mesh(pp=1, dp=8, tp=1, devices=devices)
+cfg5 = DeepSpeedConfig({
+    "train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 2,
+    "steps_per_print": 10**9, "bf16": {"enabled": True},
+    "zero_optimization": {"stage": 3, "cpu_offload": True, "offload_impl": "xla"},
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}, world_size=8)
+with jax.default_device(devices[0]):
+    eng5 = DeepSpeedEngine(GPT2Model(cfg_model), cfg5, mesh=mesh5)
+    toks5 = np.random.default_rng(5).integers(0, 256, (cfg5.train_batch_size, 33), dtype=np.int32)
+    loss5 = eng5.train_batch(toks5)
+print("leg5 loss", float(loss5))

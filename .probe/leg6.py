@@ -1,0 +1,24 @@
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, "/root/repo")
+import dataclasses
+import numpy as np
+from deepspeed_tpu.config import DeepSpeedConfig
+from deepspeed_tpu.models import GPT2Config, GPT2Model
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+devices = jax.devices("cpu")[:8]
+cfg_model = GPT2Config(vocab_size=256, n_positions=64, d_model=64, n_layer=2, n_head=4, remat="block")
+mesh6 = build_mesh(pp=1, dp=2, sp=2, tp=2, devices=devices)
+cfg6 = DeepSpeedConfig({
+    "train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 2,
+    "steps_per_print": 10**9, "bf16": {"enabled": True},
+    "zero_optimization": {"stage": 2},
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}, world_size=2)
+cfg_sp = dataclasses.replace(cfg_model, attn_impl="ring", dropout=0.0, remat=None)
+with jax.default_device(devices[0]):
+    eng6 = DeepSpeedEngine(GPT2Model(cfg_sp), cfg6, mesh=mesh6)
+    toks6 = np.random.default_rng(6).integers(0, 256, (cfg6.train_batch_size, 33), dtype=np.int32)
+    loss6 = eng6.train_batch(toks6)
+print("leg6 loss", float(loss6))

@@ -1,0 +1,19 @@
+"""Run dryrun legs individually to locate the SPMD involuntary-remat warning."""
+import os, sys, subprocess
+legs = {
+    "leg5": "zero3+offload-xla",
+    "leg6": "sp2",
+}
+# Simplest: run full dryrun but capture stderr unbuffered and tag lines.
+env = dict(os.environ)
+env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+env["JAX_PLATFORMS"] = "cpu"
+p = subprocess.run([sys.executable, "-u", "__graft_entry__.py", "8"],
+                   capture_output=True, text=True, env=env, cwd="/root/repo")
+out = []
+for line in p.stderr.splitlines():
+    if "rematerialization" in line or "spmd" in line.lower():
+        out.append("STDERR: " + line)
+print(p.stdout)
+print("\n".join(out))
+print("rc", p.returncode)

@@ -1,0 +1,8 @@
+import json, sys, time
+t0 = time.time()
+try:
+    import jax
+    devs = jax.devices()
+    print(json.dumps({"ok": True, "n": len(devs), "kind": devs[0].device_kind, "platform": devs[0].platform, "secs": round(time.time()-t0,1)}))
+except Exception as e:
+    print(json.dumps({"ok": False, "err": str(e)[:500], "secs": round(time.time()-t0,1)}))

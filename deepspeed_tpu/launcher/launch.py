@@ -38,6 +38,28 @@ def decode_world_info(encoded: str) -> dict:
     return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
 
 
+# env vars the MPI launchers leave behind, in resolution order
+_MPI_RANK_VARS = ("OMPI_COMM_WORLD_RANK", "MV2_COMM_WORLD_RANK",
+                  "PMI_RANK")
+
+
+def resolve_node_rank(node_rank: int, env=None) -> int:
+    """``--node_rank=-1`` means "ask the MPI environment": the openmpi /
+    mvapich runners broadcast ONE identical command to every node
+    (launcher/multinode_runner.py), so the per-node rank can only come
+    from the transport's own rank variable."""
+    if node_rank >= 0:
+        return node_rank
+    env = os.environ if env is None else env
+    for var in _MPI_RANK_VARS:
+        if var in env:
+            return int(env[var])
+    raise ValueError(
+        "--node_rank=-1 requires an MPI rank variable in the "
+        f"environment (one of {', '.join(_MPI_RANK_VARS)}); launch "
+        "through mpirun or pass an explicit --node_rank")
+
+
 def build_env(world_info: dict, node_rank: int, master_addr: str,
               master_port: int, base_env=None) -> dict:
     env = dict(base_env if base_env is not None else os.environ)
@@ -67,8 +89,10 @@ def build_env(world_info: dict, node_rank: int, master_addr: str,
 def main(args=None):
     args = parse_args(args)
     world_info = decode_world_info(args.world_info)
-    env = build_env(world_info, args.node_rank, args.master_addr,
+    node_rank = resolve_node_rank(args.node_rank)
+    env = build_env(world_info, node_rank, args.master_addr,
                     args.master_port)
+    args.node_rank = node_rank
     cmd = [sys.executable, args.user_script] + args.user_args
     logger.info("node %d/%d exec: %s", args.node_rank, len(world_info),
                 " ".join(cmd))

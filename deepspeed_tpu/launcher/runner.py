@@ -17,8 +17,6 @@ import base64
 import collections
 import json
 import os
-import shlex
-import shutil
 import subprocess
 import sys
 from copy import deepcopy
@@ -55,7 +53,8 @@ def parse_args(args=None):
                         "(default: first host)")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--launcher", type=str, default="pdsh",
-                        choices=("pdsh", "ssh", "local"),
+                        choices=("pdsh", "ssh", "local", "openmpi",
+                                 "mvapich"),
                         help="multi-node transport")
     parser.add_argument("--force_multi", action="store_true",
                         help="treat a single node as a multi-node launch")
@@ -214,19 +213,34 @@ def main(args=None):
     world_info = encode_world_info(active)
     exports = _export_env_lines()
 
-    launch_cmds = []
-    for proc_id, (host, slots) in enumerate(active.items()):
-        env_str = " ".join(f"{k}={shlex.quote(v)}"
-                           for k, v in sorted(exports.items()))
-        parts = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
-                 f"--world_info={world_info}",
-                 f"--node_rank={proc_id}",
-                 f"--master_addr={master_addr}",
-                 f"--master_port={args.master_port}",
-                 args.user_script] + list(args.user_args)
-        remote = (env_str + " " +
-                  " ".join(shlex.quote(p) for p in parts)).strip()
-        launch_cmds.append((host, remote))
+    if args.launcher in ("openmpi", "mvapich"):
+        # MPI flavor: ONE mpirun command covers every node (reference
+        # multinode_runner.py:78-189); ranks resolve node_rank from the
+        # MPI environment (launch.py --node_rank=-1)
+        from .multinode_runner import RUNNERS
+        args.master_addr = master_addr
+        runner = RUNNERS[args.launcher](args, world_info)
+        runner.validate_args()
+        if not runner.backend_exists():
+            raise RuntimeError(
+                f"launcher '{args.launcher}' selected but its binary "
+                "(mpirun) is not on PATH")
+        cmd = runner.get_cmd(exports, active)
+        logger.info("%s launch: %s", runner.name, " ".join(cmd))
+        env = os.environ.copy()
+        env.update(exports)
+        return subprocess.call(cmd, env=env)
+
+    # per-host fan-out: each node gets a distinct node_rank, so commands
+    # differ per host and pdsh's single-command broadcast doesn't apply —
+    # both transports dispatch one remote command per host, built by the
+    # shared runner classes (one copy of the launch-command grammar)
+    from .multinode_runner import PDSHRunner, SSHRunner
+    args.master_addr = master_addr
+    pdsh = PDSHRunner(args, world_info)
+    fan_out = (pdsh if args.launcher == "pdsh" and pdsh.backend_exists()
+               else SSHRunner(args, world_info))
+    launch_cmds = fan_out.get_cmd(exports, active)
 
     if args.launcher == "local" or (len(active) == 1
                                     and not args.force_multi):
@@ -234,11 +248,7 @@ def main(args=None):
         logger.info("local launch on %s", host)
         return subprocess.call(remote, shell=True)
 
-    # per-host fan-out: each node gets a distinct node_rank, so commands
-    # differ per host and pdsh's single-command broadcast doesn't apply —
-    # both transports dispatch one remote command per host
-    transport = (["pdsh", "-w"] if args.launcher == "pdsh"
-                 and shutil.which("pdsh") else ["ssh"])
+    transport = ["pdsh", "-w"] if fan_out.name == "pdsh" else ["ssh"]
     procs = [subprocess.Popen(transport + [host, remote])
              for host, remote in launch_cmds]
     return max(p.wait() for p in procs)

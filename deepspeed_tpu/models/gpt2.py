@@ -244,20 +244,23 @@ def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
                                          ulysses_attention)
         am = jax.sharding.get_abstract_mesh()
         sp = dict(getattr(am, "shape", {})).get(SEQ_AXIS, 1)
-        if sp > 1 and am.manual_axes:
-            # inside another manual computation (the pipeline engine's
-            # shard_map over 'pipe'; the 1-bit and CSR engine steps'
-            # shard_map over 'data') a nested shard_map over 'seq' is
-            # rejected by the partitioner — fail with the real story
-            # instead of an MLIR verification crash.  Direct attribute
-            # access on purpose: if jax renames manual_axes this guard
-            # must break loudly, not silently disable.
+        manual = set(getattr(am, "manual_axes", ()))
+        if sp > 1 and not manual <= {"pipe"}:
+            # Nesting under the pipeline's manual 'pipe' axis is
+            # supported: the inner shard_map closes over only 'seq' and
+            # the pipeline's uniform-stage body keeps the seq collectives
+            # identical on every pipe rank (pipe/engine.py:
+            # _uniform_stack_info).  Any OTHER manual context (the 1-bit
+            # and CSR engines' shard_map over 'data', or 'seq' itself
+            # already manual) has had no such hardening — fail with the
+            # real story instead of a partitioner crash or a divergent
+            # collective deadlock.
             raise NotImplementedError(
                 "sequence-parallel attention cannot run inside a manual "
-                f"SPMD program (nested shard_map over '{SEQ_AXIS}' under "
-                f"manual axes {am.manual_axes}); sp composes with the "
-                "plain dp/tp/ZeRO engine paths only — not the pipeline, "
-                "1-bit, or sparse-gradient engines")
+                f"SPMD program over axes {sorted(manual)}; sp composes "
+                "with the plain dp/tp/ZeRO engines and (via the uniform-"
+                "stage body) the pipeline engine — not the 1-bit or "
+                "sparse-gradient engines")
         seed = (jax.random.bits(r1, (), jnp.uint32) if drop > 0.0
                 else jnp.zeros((), jnp.uint32))
         if sp > 1:
@@ -268,13 +271,19 @@ def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
             # kernel's hash), so the seed is a replicated scalar and the
             # realization is identical for any seq-shard count (incl.
             # the sp==1 fallback below)
+            # the seq rank rides in as a P(seq)-sharded iota operand:
+            # axis_index inside this shard_map would lower to a manual
+            # computation over the complement axes, which re-binds 'pipe'
+            # when nested inside the pipeline engine's manual region
             fn = jax.shard_map(
-                lambda q, k, v, seed: impl(
+                lambda q, k, v, seed, rk: impl(
                     q, k, v, SEQ_AXIS, causal=True, dropout_rate=drop,
-                    dropout_seed=seed),
-                in_specs=(spec, spec, spec, P()), out_specs=spec,
+                    dropout_seed=seed, rank=rk),
+                in_specs=(spec, spec, spec, P(), P(SEQ_AXIS)),
+                out_specs=spec,
                 axis_names={SEQ_AXIS}, check_vma=False)
-            attn = fn(heads(q), heads(k), heads(v), seed)
+            attn = fn(heads(q), heads(k), heads(v), seed,
+                      jnp.arange(sp, dtype=jnp.int32))
         else:  # mesh has no seq shards: dense attention, same hash mask
             keep = None
             if drop > 0.0:

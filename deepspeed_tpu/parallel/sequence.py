@@ -191,10 +191,16 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                  + heads[None, :])                      # [B, H/n]
     if local_impl == "flash":
         from ..ops.pallas.flash_attention import flash_attention
+        # bh_global is affine in the flattened local grid row g:
+        # g = b*(H/n) + j  →  b*H + idx*(H/n) + j
+        #   = idx*(H/n) + (g // (H/n))*H + g % (H/n)
+        # so it ships as (traced base, static period, static stride) —
+        # the kernel's scalar-operand form (see _grid_bh there).
         og = flash_attention(qg, kg, vg, causal=causal, sm_scale=scale,
                              dropout_rate=dropout_rate,
                              dropout_seed=dropout_seed,
-                             bh_ids=bh_global.reshape(-1))
+                             bh_affine=(jnp.uint32(idx) *
+                                        jnp.uint32(H // n), H // n, H))
         return head2seq(og)
     s = _block_scores(qg.astype(jnp.float32), kg.astype(jnp.float32), scale)
     if causal:

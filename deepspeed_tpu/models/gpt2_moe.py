@@ -41,6 +41,7 @@ class GPT2MoEConfig(GPT2Config):
     aux_loss_weight: float = 1e-2
     router_z_loss_weight: float = 0.0
     router_jitter: float = 0.0
+    moe_dispatch_impl: str = "einsum"  # see MoEConfig.dispatch_impl
     # the dense/MoE block alternation makes the per-LAYER loop
     # heterogeneous, so GPT2Config's scan_layers is not supported; the
     # depth-scalable equivalent is scan_groups: lax.scan over homogeneous
@@ -89,7 +90,8 @@ class GPT2MoEConfig(GPT2Config):
             eval_capacity_factor=self.eval_capacity_factor,
             aux_loss_weight=self.aux_loss_weight,
             z_loss_weight=self.router_z_loss_weight,
-            router_jitter=self.router_jitter)
+            router_jitter=self.router_jitter,
+            dispatch_impl=self.moe_dispatch_impl)
 
     def is_moe_layer(self, i: int) -> bool:
         # MoE on the last block of each freq-group (layer 1, 3, ... for

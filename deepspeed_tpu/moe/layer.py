@@ -50,10 +50,15 @@ class MoEConfig:
     aux_loss_weight: float = 1e-2
     z_loss_weight: float = 0.0
     router_jitter: float = 0.0        # multiplicative input noise, train only
+    dispatch_impl: str = "einsum"     # "einsum" (one-hot, MXU) | "scatter"
 
     def __post_init__(self):
         if self.top_k not in (1, 2):
             raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
+        if self.dispatch_impl not in ("einsum", "scatter"):
+            raise ValueError(
+                f"dispatch_impl must be 'einsum' or 'scatter', got "
+                f"{self.dispatch_impl!r}")
         if self.n_experts < 1:
             raise ValueError(f"n_experts must be >= 1, got {self.n_experts}")
         if self.n_experts < self.top_k:
@@ -116,51 +121,103 @@ def _constrain(x, spec: P):
     return jax.lax.with_sharding_constraint(x, P(*spec))
 
 
-def _top1_dispatch(probs, capacity: int):
-    """probs [G,S,E] → (dispatch [G,S,E,C] {0,1}, combine [G,S,E,C])."""
-    E = probs.shape[-1]
-    idx = jnp.argmax(probs, axis=-1)
-    mask = jax.nn.one_hot(idx, E, dtype=probs.dtype)          # [G,S,E]
-    gate = jnp.sum(probs * mask, axis=-1)                     # [G,S]
-    pos = jnp.cumsum(mask, axis=1) * mask - 1.0               # [G,S,E]
-    keep = (pos >= 0) & (pos < capacity)
-    dispatch = jax.nn.one_hot(
-        pos.astype(jnp.int32), capacity, dtype=probs.dtype) \
-        * (mask * keep)[..., None]                            # [G,S,E,C]
-    combine = gate[..., None, None] * dispatch
-    return dispatch, combine, mask
-
-
-def _top2_dispatch(probs, capacity: int):
-    """GShard top-2: second expert's gate renormalized against the first;
-    its capacity positions come after all top-1 assignments."""
+def _route(probs, top_k: int):
+    """Routing choices from fp32 router probs [G,S,E]:
+    ``[(idx [G,S], gate [G,S], mask [G,S,E]), ...]`` per choice.  The
+    SINGLE source of the gate math for both dispatch implementations —
+    GShard top-2 renormalizes the two gates against each other."""
     E = probs.shape[-1]
     idx1 = jnp.argmax(probs, axis=-1)
     mask1 = jax.nn.one_hot(idx1, E, dtype=probs.dtype)
+    g1 = jnp.sum(probs * mask1, axis=-1)
+    if top_k == 1:
+        return [(idx1, g1, mask1)]
     probs2 = probs * (1.0 - mask1)
     idx2 = jnp.argmax(probs2, axis=-1)
     mask2 = jax.nn.one_hot(idx2, E, dtype=probs.dtype)
-    g1 = jnp.sum(probs * mask1, axis=-1)
     g2 = jnp.sum(probs * mask2, axis=-1)
     denom = jnp.maximum(g1 + g2, 1e-9)
-    g1, g2 = g1 / denom, g2 / denom
+    return [(idx1, g1 / denom, mask1), (idx2, g2 / denom, mask2)]
 
-    pos1 = jnp.cumsum(mask1, axis=1) * mask1 - 1.0
-    # second choices queue behind every first-choice assignment in the group
-    count1 = jnp.sum(mask1, axis=1, keepdims=True)            # [G,1,E]
-    pos2 = (jnp.cumsum(mask2, axis=1) + count1) * mask2 - 1.0
 
-    def one_hot_disp(pos, mask):
+def _choice_positions(mask, base):
+    """Per-(token, expert) arrival position [G,S,E] for one choice's
+    one-hot mask; ``base`` [G,1,E] queues this choice behind all earlier
+    choices' assignments (GShard order).  -1 at non-selected entries.
+    The single source of the queueing math for both dispatch impls."""
+    return (jnp.cumsum(mask, axis=1) + base) * mask - 1.0
+
+
+def _einsum_dispatch(choices, capacity: int):
+    """(dispatch [G,S,E,C] {0,1}, combine [G,S,E,C]) from the shared
+    routing choices — the dense one-hot formulation (every op tiles onto
+    the MXU; no scatter)."""
+    dispatch = combine = None
+    base = jnp.zeros_like(choices[0][2][:, :1, :])
+    for _idx, gate, mask in choices:
+        pos = _choice_positions(mask, base)
+        base = base + jnp.sum(mask, axis=1, keepdims=True)
         keep = (pos >= 0) & (pos < capacity)
-        return jax.nn.one_hot(
-            pos.astype(jnp.int32), capacity, dtype=probs.dtype) \
-            * (mask * keep)[..., None]
+        d = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                           dtype=mask.dtype) * (mask * keep)[..., None]
+        c = gate[..., None, None] * d
+        dispatch = d if dispatch is None else dispatch + d
+        combine = c if combine is None else combine + c
+    return dispatch, combine
 
-    d1 = one_hot_disp(pos1, mask1)
-    d2 = one_hot_disp(pos2, mask2)
-    dispatch = d1 + d2
-    combine = g1[..., None, None] * d1 + g2[..., None, None] * d2
-    return dispatch, combine, mask1
+
+def _token_slots(mask: jnp.ndarray, base: jnp.ndarray) -> jnp.ndarray:
+    """Per-token capacity slot from a one-hot choice mask [G,S,E]: the
+    token's position in its expert's arrival order (``base`` [G,1,E]
+    offsets second choices behind all first choices, GShard order).
+    Returns [G,S] fp32; the slot at the selected expert is >= 0, so a
+    max over E extracts it."""
+    return jnp.max(_choice_positions(mask, base), axis=-1)
+
+
+def _scatter_moe(cfg: MoEConfig, mp: Dict[str, Any], x: jnp.ndarray,
+                 probs: jnp.ndarray, capacity: int, choices) -> jnp.ndarray:
+    """Scatter/gather dispatch: O(S·d) data movement per token instead of
+    the one-hot einsum's O(S·C·E·d) = O(S²·cf·k·d) MXU work per group.
+    The einsum formulation's dispatch cost is independent of E (capacity
+    shrinks as 1/E) but quadratic in tokens-per-group — at long S the
+    dispatch einsum rivals the expert FFN itself (see bench_moe.py), which
+    is when this path wins.  Slots are unique by construction (disjoint
+    per-expert ranges; second choices queue behind all first choices), so
+    scatter-add never actually collides."""
+    G, S, d = x.shape
+    E, C = cfg.n_experts, capacity
+    dt = x.dtype
+    base = jnp.zeros((G, 1, E), probs.dtype)
+    slots = []
+    for (idx, gate, mask) in choices:
+        pos = _token_slots(mask, base)                       # [G,S]
+        base = base + jnp.sum(mask, axis=1, keepdims=True)
+        keep = pos < C
+        slot = idx * C + jnp.minimum(pos, C - 1.0).astype(jnp.int32)
+        slots.append((slot, keep, gate))
+
+    group_off = (jnp.arange(G, dtype=jnp.int32) * (E * C))[:, None]
+    xf = x.reshape(G * S, d)
+    buf = jnp.zeros((G * E * C, d), dt)
+    for slot, keep, _gate in slots:
+        flat = (slot + group_off).reshape(-1)
+        buf = buf.at[flat].add(xf * keep.reshape(-1, 1).astype(dt))
+
+    ein = buf.reshape(G, E, C, d).transpose(1, 0, 2, 3)      # [E,G,C,d]
+    ein = _constrain(ein, P(DATA_AXIS, None, None, None))
+    h = jnp.einsum("egcd,edf->egcf", ein, mp["wi"].astype(dt))
+    h = h + mp["bi"].astype(dt)[:, None, None, :]
+    h = jax.nn.gelu(h, approximate=True)
+    eo = jnp.einsum("egcf,efd->egcd", h, mp["wo"].astype(dt))
+    eo = eo + mp["bo"].astype(dt)[:, None, None, :]
+    eo = _constrain(eo, P(DATA_AXIS, None, None, None))
+    eo_g = eo.transpose(1, 0, 2, 3).reshape(G, E * C, d)     # [G,E*C,d]
+    y = jnp.zeros_like(x)
+    for slot, keep, gate in slots:
+        picked = jnp.take_along_axis(eo_g, slot[..., None], axis=1)
+        y = y + picked * (gate * keep).astype(dt)[..., None]
+    return _constrain(y, P(DATA_AXIS, None, None))
 
 
 def moe_ffn(cfg: MoEConfig, mp: Dict[str, Any], x: jnp.ndarray, rng,
@@ -182,10 +239,10 @@ def moe_ffn(cfg: MoEConfig, mp: Dict[str, Any], x: jnp.ndarray, rng,
     logits = x_gate @ mp["wg"]                                # [G,S,E] fp32
     probs = jax.nn.softmax(logits, axis=-1)
 
-    if cfg.top_k == 1:
-        dispatch, combine, mask1 = _top1_dispatch(probs, C)
-    else:
-        dispatch, combine, mask1 = _top2_dispatch(probs, C)
+    choices = _route(probs, cfg.top_k)
+    mask1 = choices[0][2]
+    if cfg.dispatch_impl == "einsum":
+        dispatch, combine = _einsum_dispatch(choices, C)
 
     # Switch load-balance loss: E · Σ_e (fraction of tokens routed to e) ·
     # (mean router prob of e); 1.0 at perfect balance.  The returned term
@@ -196,6 +253,9 @@ def moe_ffn(cfg: MoEConfig, mp: Dict[str, Any], x: jnp.ndarray, rng,
     if cfg.z_loss_weight > 0.0:
         z = jax.scipy.special.logsumexp(logits, axis=-1)
         aux = aux + cfg.z_loss_weight * jnp.mean(z * z)
+
+    if cfg.dispatch_impl == "scatter":
+        return _scatter_moe(cfg, mp, x, probs, C, choices), aux
 
     dt = x.dtype
     dispatch = dispatch.astype(dt)

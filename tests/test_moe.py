@@ -306,3 +306,52 @@ def test_moe_checkpoint_roundtrip(tmp_path):
     b = jax.tree.leaves(eng2.state.master_params)
     for x, y in zip(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_scatter_dispatch_matches_einsum(top_k):
+    """dispatch_impl='scatter' (O(S·d) scatter/gather) must be numerically
+    identical to the one-hot einsum formulation, including over-capacity
+    drops (cf small enough to force them) and top-2 queueing order."""
+    kw = dict(n_experts=4, d_model=16, d_ff=32, top_k=top_k,
+              capacity_factor=0.5)  # forces drops
+    mp = init_moe_params(jax.random.PRNGKey(0),
+                         MoEConfig(dispatch_impl="einsum", **kw))
+    x = _x(jax.random.PRNGKey(1), s=16)
+    outs = {}
+    for impl in ("einsum", "scatter"):
+        cfg = MoEConfig(dispatch_impl=impl, **kw)
+        y, aux = moe_ffn(cfg, mp, x, jax.random.PRNGKey(2), train=True)
+        outs[impl] = (np.asarray(y), float(aux))
+    np.testing.assert_allclose(outs["scatter"][0], outs["einsum"][0],
+                               rtol=1e-5, atol=1e-5)
+    assert outs["scatter"][1] == pytest.approx(outs["einsum"][1])
+
+
+def test_scatter_dispatch_grads_match_einsum():
+    """Backward equivalence: same loss gradients w.r.t. params and input
+    through either dispatch implementation."""
+    kw = dict(n_experts=4, d_model=16, d_ff=32, top_k=2,
+              capacity_factor=0.75)
+    mp = init_moe_params(jax.random.PRNGKey(0),
+                         MoEConfig(dispatch_impl="einsum", **kw))
+    x = _x(jax.random.PRNGKey(1), s=16)
+
+    def loss(params, xin, impl):
+        cfg = MoEConfig(dispatch_impl=impl, **kw)
+        y, aux = moe_ffn(cfg, params, xin, jax.random.PRNGKey(2),
+                         train=True)
+        return jnp.sum(y ** 2) + aux
+
+    for arg in (0, 1):
+        g_e = jax.grad(loss, argnums=arg)(mp, x, "einsum")
+        g_s = jax.grad(loss, argnums=arg)(mp, x, "scatter")
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            g_e, g_s)
+
+
+def test_scatter_dispatch_bad_impl_rejected():
+    with pytest.raises(ValueError, match="dispatch_impl"):
+        MoEConfig(n_experts=2, d_model=8, d_ff=16, dispatch_impl="sorted")

@@ -24,3 +24,98 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, (
     f"expected 8 virtual CPU devices, got {jax.devices()}")
+
+# ---------------------------------------------------------------------------
+# Test-tier guard.  pytest.ini defines two tiers (core = `-m "not slow"`,
+# full = everything); this guard keeps the core tier honest by failing any
+# test that builds a compile-bound mesh without carrying the ``slow`` marker,
+# and (opt-in, for CI) any unmarked test whose call phase overruns a wall
+# budget.  Mirrors the reference's CI split into per-PR unit jobs vs nightly
+# model tests (reference: azure-pipelines.yml runs tests/unit per PR and
+# gates tests/model behind a nightly trigger).
+# ---------------------------------------------------------------------------
+import pytest  # noqa: E402
+
+HEAVY_PIPE = 4  # pp>=4 programs compile multi-stage scans: always slow-tier
+
+_current_item = None
+_duration_offenders = []
+
+
+def heavy_mesh_violation(mesh_shape, has_slow_marker):
+    """Tier policy, pure so tests can exercise it: building a mesh with a
+    ``pipe`` axis >= HEAVY_PIPE means compiling a multi-stage pipeline scan
+    (the dominant compile cost in this suite — see pytest.ini's slow-tier
+    description); such a test must be in the slow tier."""
+    pipe = int(mesh_shape.get("pipe", 1))
+    if pipe >= HEAVY_PIPE and not has_slow_marker:
+        return (f"this test builds a pipe={pipe} mesh but is not marked "
+                "@pytest.mark.slow; pp>=4 programs are compile-bound and "
+                "belong in the slow tier (see pytest.ini / tests/README.md)")
+    return None
+
+
+def duration_violation(duration_s, has_slow_marker, budget_s):
+    """Opt-in (TIER_GUARD=1) wall-clock policy: an unmarked test whose call
+    phase overruns the budget must move to the slow tier."""
+    if not has_slow_marker and duration_s > budget_s:
+        return (f"call phase took {duration_s:.1f}s > TIER_GUARD_SECONDS="
+                f"{budget_s:.0f}s without @pytest.mark.slow")
+    return None
+
+
+@pytest.fixture(autouse=True)
+def _tier_guard_track_item(request):
+    global _current_item
+    _current_item = request.node
+    yield
+    _current_item = None
+
+
+# Mesh construction goes through __new__ (cached), not __init__.
+_orig_mesh_new = jax.sharding.Mesh.__new__
+
+
+def _guarded_mesh_new(cls, *args, **kwargs):
+    mesh = _orig_mesh_new(cls, *args, **kwargs)
+    item = _current_item
+    if item is None:
+        return mesh
+    try:
+        shape = dict(mesh.shape)
+    except Exception:
+        return mesh
+    msg = heavy_mesh_violation(
+        shape, item.get_closest_marker("slow") is not None)
+    if msg:
+        pytest.fail(msg, pytrace=False)
+    return mesh
+
+
+jax.sharding.Mesh.__new__ = _guarded_mesh_new
+
+
+def pytest_runtest_logreport(report):
+    if os.environ.get("TIER_GUARD") != "1":
+        return
+    if report.when != "call":
+        return
+    budget = float(os.environ.get("TIER_GUARD_SECONDS", "60"))
+    msg = duration_violation(
+        report.duration, "slow" in report.keywords, budget)
+    if msg:
+        _duration_offenders.append(f"{report.nodeid}: {msg}")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _duration_offenders:
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        lines = ["tier guard: unmarked tests overran the core-tier budget "
+                 "(mark them @pytest.mark.slow):"] + _duration_offenders
+        for line in lines:
+            if tr is not None:
+                tr.write_line(line, red=True)
+            else:
+                print(line)
+        if session.exitstatus == 0:
+            session.exitstatus = 1

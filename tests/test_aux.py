@@ -458,7 +458,12 @@ def test_multinode_runner_command_construction(tmp_path, monkeypatch):
 
     monkeypatch.setattr(R.subprocess, "Popen",
                         lambda argv: FakeProc(argv))
-    monkeypatch.setattr(R.shutil, "which", lambda name: None)  # force ssh
+    # shutil.which lives in multinode_runner since the runner refactor;
+    # ssh must look present (SSHRunner.backend_exists gates the launch)
+    from deepspeed_tpu.launcher import multinode_runner as MR
+    monkeypatch.setattr(
+        MR.shutil, "which",
+        lambda name: "/usr/bin/ssh" if name == "ssh" else None)
     monkeypatch.setenv("XLA_FLAGS", "--some_flag=1")
     rc = R.main(["--hostfile", str(hf), "--launcher", "ssh",
                  "--master_port", "29401", "train.py", "--foo", "1"])

@@ -1,0 +1,44 @@
+"""Tier-guard tests: the conftest guard that keeps the core tier
+(`pytest -m "not slow"`) fast by forcing compile-bound tests into the
+slow tier.  Mirrors the reference's CI split (per-PR unit jobs vs
+nightly model tests, reference: azure-pipelines.yml)."""
+import conftest
+import pytest
+
+from deepspeed_tpu.parallel import build_mesh
+
+
+def test_policy_pp4_unmarked_flagged():
+    msg = conftest.heavy_mesh_violation({"pipe": 4, "data": 2}, False)
+    assert msg is not None and "slow" in msg
+
+
+def test_policy_pp4_marked_ok():
+    assert conftest.heavy_mesh_violation({"pipe": 4, "data": 2}, True) is None
+
+
+def test_policy_small_meshes_ok():
+    assert conftest.heavy_mesh_violation({"pipe": 2, "data": 4}, False) is None
+    assert conftest.heavy_mesh_violation({"data": 8}, False) is None
+
+
+def test_policy_duration():
+    assert conftest.duration_violation(90.0, False, 60.0) is not None
+    assert conftest.duration_violation(90.0, True, 60.0) is None
+    assert conftest.duration_violation(10.0, False, 60.0) is None
+
+
+def test_unmarked_pp4_mesh_fails_at_construction():
+    """The live guard: this test carries no slow marker, so building a
+    pp=4 mesh must fail immediately (mesh construction is where the
+    guard hooks — before any compile cost is paid)."""
+    with pytest.raises(pytest.fail.Exception, match="pipe=4"):
+        build_mesh(pp=4, dp=2, tp=1)
+
+
+@pytest.mark.slow
+def test_marked_pp4_mesh_allowed():
+    """With the slow marker the same construction passes (construction
+    only — no program is compiled here, so this 'slow' test is cheap)."""
+    mesh = build_mesh(pp=4, dp=2, tp=1)
+    assert mesh.shape["pipe"] == 4

@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import shlex
 import shutil
+import subprocess
 import sys
 from abc import ABC, abstractmethod
 from typing import Dict, List
@@ -43,6 +44,11 @@ class MultiNodeRunner(ABC):
     def backend_exists(self) -> bool:
         """Is the transport binary available on this host?"""
         ...
+
+    def backend_missing_reason(self) -> str:
+        """Operator-facing reason when backend_exists() is False — must
+        name the ACTUAL failed requirement, not a generic PATH claim."""
+        return f"required binary for launcher {self.name!r} not on PATH"
 
     def validate_args(self):
         """Reference parity: MPI launchers reject per-host resource
@@ -98,6 +104,9 @@ class OpenMPIRunner(MultiNodeRunner):
     def backend_exists(self) -> bool:
         return shutil.which("mpirun") is not None
 
+    def backend_missing_reason(self) -> str:
+        return "mpirun is not on PATH"
+
     def validate_args(self):
         a = self.args
         if getattr(a, "include", "") or getattr(a, "exclude", ""):
@@ -140,22 +149,51 @@ class MVAPICHRunner(OpenMPIRunner):
     }
 
     def backend_exists(self) -> bool:
-        # reference checks `mpiname` reports MVAPICH (multinode_runner.py:
-        # 147-156); mpirun presence is the functional requirement here
-        return (shutil.which("mpiname") is not None
-                or shutil.which("mpirun") is not None)
+        """Require MVAPICH specifically, not any mpirun: the Hydra
+        dialect below (``-ppn``, ``-env K V``, plain hostfile) makes
+        OpenMPI's orterun die with a usage error, so accepting a generic
+        mpirun would swap a clear 'backend not found' for a cryptic
+        launch failure.  Like the reference (multinode_runner.py:147-156)
+        we identify the flavor via ``mpiname``."""
+        mpiname = shutil.which("mpiname")
+        if mpiname is None or shutil.which("mpirun") is None:
+            return False
+        try:
+            out = subprocess.run([mpiname], capture_output=True,
+                                 text=True, timeout=10).stdout
+        except (OSError, subprocess.SubprocessError):
+            return False
+        return "mvapich" in out.lower()
+
+    def backend_missing_reason(self) -> str:
+        if shutil.which("mpirun") is None:
+            return "mpirun is not on PATH"
+        if shutil.which("mpiname") is None:
+            return ("mpirun is on PATH but mpiname is not, so the "
+                    "MVAPICH flavor cannot be confirmed (this runner's "
+                    "Hydra dialect breaks other MPIs — for OpenMPI use "
+                    "--launcher openmpi)")
+        return ("mpirun is on PATH but mpiname does not report MVAPICH "
+                "— for OpenMPI clusters use --launcher openmpi")
 
     def get_cmd(self, environment: Dict[str, str],
                 active_resources: Dict[str, List[int]]) -> List[str]:
+        import atexit
         import tempfile
         n = len(active_resources)
-        # Hydra's hostfile is one host per line (no slots grammar)
-        hf = tempfile.NamedTemporaryFile(
-            "w", prefix="mvapich_hostfile_", suffix=".txt", delete=False)
-        hf.write("\n".join(active_resources) + "\n")
-        hf.close()
+        # Hydra's hostfile is one host per line (no slots grammar).
+        # mkstemp: unique per launch (concurrent launches cannot clobber
+        # each other's host lists) and O_EXCL|0600 (no symlink/pre-create
+        # games in the shared tmp dir); cleaned up when the launcher
+        # process exits — it outlives mpirun, so no accumulation.
+        fd, hf_path = tempfile.mkstemp(prefix="ds_mvapich_hostfile_",
+                                       suffix=".txt", text=True)
+        with os.fdopen(fd, "w") as hf:
+            hf.write("\n".join(active_resources) + "\n")
+        atexit.register(lambda p=hf_path: os.path.exists(p) and
+                        os.unlink(p))
         cmd = ["mpirun", "-n", str(n), "-ppn", "1",
-               "-hostfile", hf.name]
+               "-hostfile", hf_path]
         env = dict(self.MV2_DEFAULTS)
         env.update(environment)
         for k, v in sorted(env.items()):

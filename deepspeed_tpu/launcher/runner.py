@@ -223,8 +223,8 @@ def main(args=None):
         runner.validate_args()
         if not runner.backend_exists():
             raise RuntimeError(
-                f"launcher '{args.launcher}' selected but its binary "
-                "(mpirun) is not on PATH")
+                f"launcher '{args.launcher}' selected but unavailable: "
+                f"{runner.backend_missing_reason()}")
         cmd = runner.get_cmd(exports, active)
         logger.info("%s launch: %s", runner.name, " ".join(cmd))
         env = os.environ.copy()

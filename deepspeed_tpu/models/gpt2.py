@@ -244,7 +244,12 @@ def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
                                          ulysses_attention)
         am = jax.sharding.get_abstract_mesh()
         sp = dict(getattr(am, "shape", {})).get(SEQ_AXIS, 1)
-        manual = set(getattr(am, "manual_axes", ()))
+        # Direct attribute access on purpose: if jax renames manual_axes
+        # this guard must break loudly, not silently disable (a silent ()
+        # default would let sp>1 run inside the 1-bit/CSR engines' manual
+        # 'data' shard_map — exactly the partitioner crash / divergent-
+        # collective deadlock this guard pre-empts).
+        manual = set(am.manual_axes) if am is not None else set()
         if sp > 1 and not manual <= {"pipe"}:
             # Nesting under the pipeline's manual 'pipe' axis is
             # supported: the inner shard_map closes over only 'seq' and

@@ -57,6 +57,47 @@ def _from_storage(arr: np.ndarray, logical: str) -> np.ndarray:
     return arr
 
 
+def _split_merge_compatible(src: tuple, dst: tuple) -> bool:
+    """True iff ``dst`` is reachable from ``src`` by only SPLITTING a dim
+    into adjacent factors or MERGING adjacent dims — the reshapes that
+    preserve the logical row-major layout (qkv [d, 3d] <-> [d, 3, d]).
+    Greedy boundary alignment: walk both shapes accumulating products
+    until they agree; within each aligned group at least one side must be
+    a single dim (pure split or pure merge).  A permutation like
+    (768, 2304) -> (2304, 768) forms one multi-dim x multi-dim group and
+    is rejected even though the element counts match."""
+    if int(np.prod(src, dtype=np.int64)) != int(np.prod(dst,
+                                                        dtype=np.int64)):
+        return False
+    # Size-1 dims are layout-neutral in row-major order — drop them first
+    # so e.g. (1, 4) -> (2, 2) aligns as the pure split it is instead of
+    # the 1-dim getting absorbed into a multi x multi group.
+    src = tuple(d for d in src if d != 1)
+    dst = tuple(d for d in dst if d != 1)
+    i = j = 0
+    while i < len(src) and j < len(dst):
+        a, b = int(src[i]), int(dst[j])
+        ni, nj = 1, 1
+        while a != b:
+            if a < b:
+                i += 1
+                if i >= len(src):
+                    return False
+                a *= int(src[i])
+                ni += 1
+            else:
+                j += 1
+                if j >= len(dst):
+                    return False
+                b *= int(dst[j])
+                nj += 1
+        if ni > 1 and nj > 1:
+            return False
+        i += 1
+        j += 1
+    return all(d == 1 for d in src[i:]) and all(d == 1 for d in dst[j:])
+
+
 def _keystr(path) -> str:
     return jax.tree_util.keystr(path)
 
@@ -254,13 +295,14 @@ def load_tree(dirpath: str, target: Any, strict: bool = True) -> Any:
                     f"checkpoint leaf {key!r}: restacked "
                     f"{entry['shape']} -> {list(tshape)} (pipeline resize)",
                     ranks=[0])
-            elif int(np.prod(arr.shape)) == int(np.prod(tshape)):
-                # Size-preserving layout evolution: a leaf whose element
-                # count matches but whose dims were refactored (e.g. the
-                # qkv [.., d, 3d] -> [.., d, 3, d] re-layout — same
-                # values, row-major order unchanged) reshapes losslessly.
-                # Logged loudly so a REAL config mismatch that happens to
-                # preserve size is visible in the restore log.
+            elif _split_merge_compatible(tuple(arr.shape), tshape):
+                # Size-preserving layout evolution: dims purely split or
+                # merged (e.g. the qkv [.., d, 3d] -> [.., d, 3, d]
+                # re-layout — row-major order unchanged) reshape
+                # losslessly.  Equal element count alone is NOT enough: a
+                # permuted layout like [768, 2304] -> [2304, 768] would
+                # reshape into numeric garbage, so those still raise.
+                # Logged loudly so the restore log shows every re-layout.
                 arr = arr.reshape(tshape)
                 log_dist(
                     f"checkpoint leaf {key!r}: reshaped "

@@ -22,6 +22,7 @@ API surface preserved from the reference:
 from __future__ import annotations
 
 import collections
+import os
 import time
 from typing import Any, Callable, Dict, NamedTuple, Optional
 
@@ -253,7 +254,13 @@ class DeepSpeedEngine:
             # space and XLA rejects sharded pinned_host placements — the
             # tier still runs, just without a distinct host memory kind.
             platform = next(iter(self.mesh.devices.flat)).platform
-            self._offload_real_host = platform == "tpu"
+            # DS_OFFLOAD_PINNED_HOST=0 keeps master/moments in device
+            # memory (diagnosis knob: discriminates a pinned_host/
+            # compute_on platform stall from the program itself — only
+            # feasible where HBM fits the fp32 state, e.g. 124M probes).
+            self._offload_real_host = (
+                platform == "tpu"
+                and os.environ.get("DS_OFFLOAD_PINNED_HOST", "1") == "1")
             flat_host = (flat_dev.with_memory_kind("pinned_host")
                          if self._offload_real_host else flat_dev)
             self._flat_dev_sharding = flat_dev
@@ -1128,8 +1135,13 @@ class DeepSpeedEngine:
     def _host_section(self):
         """compute_on('device_host') on real TPUs; a no-op scope on CPU test
         meshes (same memory space, and the host-compute partitioner rejects
-        sharded host placements there)."""
-        if self._offload_real_host:
+        sharded host placements there).  DS_OFFLOAD_COMPUTE_ON=0 excises
+        the host-compute sections while keeping pinned_host residency —
+        XLA then runs the optimizer math on device with streamed transfers
+        (diagnosis knob for the compute_on stall candidate; also a valid
+        fallback configuration in its own right)."""
+        if (self._offload_real_host
+                and os.environ.get("DS_OFFLOAD_COMPUTE_ON", "1") == "1"):
             from jax.experimental import compute_on
             return compute_on.compute_on("device_host")
         import contextlib

@@ -1,0 +1,132 @@
+"""ZeRO-Offload XLA-tier stall diagnosis (round-2 verdict missing #2).
+
+The one healthy round-2 hardware window saw the 1.5B xla-tier attempt
+produce no output for 9.5 min.  Candidates: (a) slow remote compile of
+the 48-layer scan + host-section program, (b) a pinned_host /
+``compute_on('device_host')`` stall on the axon platform.  This driver
+discriminates them by running a matrix of variants lowest-risk-first,
+each in a fresh subprocess with timestamped phase markers on stderr and
+JAX_LOG_COMPILES=1 (so "compiling" vs "executing" is visible in the
+log).  A variant that hangs natively leaves its last marker as the
+verdict; later variants never run under a wedged tunnel, and nothing
+here SIGTERMs a TPU client (that wedges the tunnel — BENCH_NOTES.md).
+
+Engine knobs used (runtime/engine.py):
+  DS_OFFLOAD_PINNED_HOST=0  master/moments stay in device memory
+  DS_OFFLOAD_COMPUTE_ON=0   pinned_host residency, but no host compute
+
+Usage: python diag_offload.py [--full]   (--full includes the 1.5B legs)
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+CHILD = r"""
+import os, sys, time
+T0 = time.perf_counter()
+def mark(m):
+    print(f"[diag {time.perf_counter()-T0:7.1f}s] {m}", file=sys.stderr,
+          flush=True)
+
+import numpy as np
+mark("importing jax")
+import jax
+mark(f"devices: {[d.device_kind for d in jax.devices()]}")
+from deepspeed_tpu.config import DeepSpeedConfig
+from deepspeed_tpu.models import GPT2Config, GPT2Model
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+size = os.environ["DIAG_SIZE"]
+if size == "124M":
+    cfg_m = GPT2Config(vocab_size=50257, n_positions=1024, d_model=768,
+                       n_layer=12, n_head=12, remat="block",
+                       scan_layers=True)
+    micro, seq = 4, 1024
+else:
+    cfg_m = GPT2Config(vocab_size=50257, n_positions=1024, d_model=1600,
+                       n_layer=48, n_head=25, remat="block",
+                       scan_layers=True)
+    micro, seq = int(os.environ.get("DIAG_MICRO", "1")), 1024
+cfg = DeepSpeedConfig({
+    "train_micro_batch_size_per_gpu": micro,
+    "gradient_accumulation_steps": 1,
+    "steps_per_print": 10 ** 9,
+    "bf16": {"enabled": True},
+    "gradient_clipping": 1.0,
+    "zero_optimization": {"stage": 2, "cpu_offload": True,
+                          "offload_impl": "xla"},
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+}, world_size=1)
+mark(f"{size}: constructing engine")
+mesh = build_mesh(pp=1, dp=1, tp=1, devices=jax.devices()[:1])
+eng = DeepSpeedEngine(GPT2Model(cfg_m), cfg, mesh=mesh)
+mark(f"{size}: engine ready (real_host={eng._offload_real_host}); "
+     "first train_batch (trace+compile+step)")
+toks = np.random.default_rng(0).integers(0, 50257, (micro, seq),
+                                         dtype=np.int32)
+t1 = time.perf_counter()
+loss = float(eng.train_batch(toks))
+mark(f"{size}: first step done in {time.perf_counter()-t1:.1f}s "
+     f"loss={loss:.3f}")
+t2 = time.perf_counter()
+loss = float(eng.train_batch(toks))
+mark(f"{size}: steady step {time.perf_counter()-t2:.2f}s loss={loss:.3f}")
+print(json.dumps({"size": size, "ok": True}) if False else "OK")
+"""
+
+
+def run_variant(name, size, env_over, deadline):
+    env = dict(os.environ)
+    env.update(env_over)
+    env["DIAG_SIZE"] = size
+    env["JAX_LOG_COMPILES"] = "1"
+    print(f"=== variant {name} (size={size}, {env_over}, "
+          f"deadline={deadline}s) ===", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run([sys.executable, "-c", CHILD], env=env,
+                           timeout=deadline, capture_output=True, text=True)
+        rc, out = p.returncode, p.stderr[-3000:]
+        verdict = "OK" if rc == 0 else f"rc={rc}"
+    except subprocess.TimeoutExpired as e:
+        # TimeoutExpired kills the child (unavoidable here); run this
+        # variant LAST so a wedged tunnel cannot poison later variants.
+        rc, out = -1, ((e.stderr or b"")[-3000:].decode()
+                       if isinstance(e.stderr, bytes) else
+                       (e.stderr or "")[-3000:])
+        verdict = f"TIMEOUT after {deadline}s"
+    dt = time.time() - t0
+    print(out, flush=True)
+    rec = {"variant": name, "size": size, "env": env_over,
+           "verdict": verdict, "wall_s": round(dt, 1)}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    full = "--full" in sys.argv
+    results = []
+    # lowest-risk first; the known-stall candidate (1.5B full xla) LAST
+    results.append(run_variant(
+        "124M-no-host", "124M", {"DS_OFFLOAD_PINNED_HOST": "0"}, 1200))
+    results.append(run_variant(
+        "124M-pinned-no-computeon", "124M",
+        {"DS_OFFLOAD_COMPUTE_ON": "0"}, 1200))
+    results.append(run_variant("124M-full-xla", "124M", {}, 1200))
+    if full:
+        results.append(run_variant(
+            "1.5B-pinned-no-computeon", "1.5B",
+            {"DS_OFFLOAD_COMPUTE_ON": "0"}, 2400))
+        results.append(run_variant("1.5B-full-xla", "1.5B", {}, 2400))
+    with open("DIAG_offload.json", "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps({"diag": "done",
+                      "verdicts": {r["variant"]: r["verdict"]
+                                   for r in results}}))
+
+
+if __name__ == "__main__":
+    main()

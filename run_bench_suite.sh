@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-3 hardware bench suite, priority order per VERDICT.md "Next round" #1-2.
+# Each bench has internal watchdogs + subprocess device probes; never SIGTERM
+# TPU jobs externally (wedges the tunnel - BENCH_NOTES.md).
+cd /root/repo
+echo "=== suite start $(date -u +%H:%M:%S) ===" >> bench_suite.log
+run() {
+  name=$1; shift
+  echo "=== $name start $(date -u +%H:%M:%S) ===" >> bench_suite.log
+  "$@" > "BENCH_${name}_raw.json" 2>> bench_suite.log
+  echo "=== $name done rc=$? $(date -u +%H:%M:%S) ===" >> bench_suite.log
+}
+run r03 python bench.py
+run capacity python bench_capacity.py
+run sparse python bench_sparse.py
+run bert python bench_bert.py
+run flash python bench_flash.py
+echo "=== cpu_adam start $(date -u +%H:%M:%S) ===" >> bench_suite.log
+python bench_cpu_adam.py > BENCH_cpu_adam.txt 2>> bench_suite.log
+echo "=== suite done $(date -u +%H:%M:%S) ===" >> bench_suite.log

@@ -308,13 +308,19 @@ def main():
         # parse/validate ALL env knobs outside the fallback guard: a typo
         # must fail loudly, not silently demote the run to 124M
         _, _, _, deadline = _15b_knobs()
-        # host tier first: it is a plain jit step (no compute_on host
-        # sections), the same program shape as the known-good 124M path.
-        # The xla tier stalled natively for >9 min through the axon tunnel
-        # once (BENCH_NOTES.md) and a native stall is not watchdoggable —
-        # an un-produced artifact is worse than a slower one.
+        # xla tier first — root-caused round 3 (BENCH_NOTES.md): the
+        # round-2 "xla stall" was not tier-specific, it was (a) eager
+        # per-leaf init (~15 sequential remote compiles, now ONE jitted
+        # program) and (b) bulk device<->container transfers, which the
+        # websocket relay tunnel stalls on indefinitely.  The host tier
+        # pulls the 6.2 GB master through that tunnel at construction and
+        # again every step, so ON THIS TUNNELED PLATFORM it cannot work;
+        # the xla tier's pinned_host staging stays on the remote TPU VM
+        # (no bulk tunnel traffic at all).  The host tier now fast-fails
+        # on a bandwidth probe instead of stalling, so it is safe to keep
+        # as the second attempt (it IS the right tier on a real TPU VM).
         impls = [s.strip() for s in
-                 os.environ.get("BENCH_15B_IMPL", "host,xla").split(",")]
+                 os.environ.get("BENCH_15B_IMPL", "xla,host").split(",")]
         bad = [s for s in impls if s not in ("xla", "host")]
         if bad:
             raise ValueError(f"BENCH_15B_IMPL contains {bad}; valid: "

@@ -183,10 +183,32 @@ class DeepSpeedEngine:
 
         # ---- ZeRO placement plan ----
         init_rng, self._data_rng = jax.random.split(jax.random.PRNGKey(seed))
-        raw_params = params if params is not None else model.init(init_rng)
-        master = jax.tree.map(
-            lambda x: x.astype(jnp.float32)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x, raw_params)
+
+        def _cast_master(tree):
+            return jax.tree.map(
+                lambda x: x.astype(jnp.float32)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+        if params is not None:
+            master = _cast_master(params)
+        else:
+            # ONE compiled program for init+fp32-cast.  Eager init
+            # dispatches each leaf's random_normal/zeros as its own
+            # program — on a remote-compile platform (axon tunnel) that
+            # is ~15 sequential compile round-trips, observed as a
+            # multi-minute "constructing engine" stall at 1.5B (round-2
+            # BENCH_NOTES stall; the same wall hit both offload tiers).
+            # The TrainModule protocol does not REQUIRE a traceable init
+            # (a user init_fn may branch on concrete values or embed
+            # numpy weights), so fall back to eager on trace failure.
+            try:
+                master = jax.jit(
+                    lambda r: _cast_master(model.init(r)))(init_rng)
+            except jax.errors.JAXTypeError:
+                logger.warning(
+                    "model.init is not jit-traceable; initializing "
+                    "eagerly (slower on remote-compile platforms)")
+                master = _cast_master(model.init(init_rng))
         self.zero_plan = ZeroShardingPlan(
             stage=config.zero_optimization_stage, mesh=self.mesh,
             base_param_specs=model.param_partition_specs(master),

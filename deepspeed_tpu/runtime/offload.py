@@ -52,6 +52,7 @@ class HostOffloadOptimizer:
                 return np.array(arr, dtype=np.float32)
             return np.array(arr)
 
+        self._probe_transfer_path(master_params)
         self.master = jax.tree.map(to_host, master_params)
         self.opt = DeepSpeedCPUAdam(
             lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
@@ -61,6 +62,80 @@ class HostOffloadOptimizer:
         self._out_dtype = ("bfloat16" if compute_dtype == jnp.bfloat16
                            else "float16" if compute_dtype == jnp.float16
                            else None)
+
+    @staticmethod
+    def _probe_transfer_path(master_params, min_mbps: float = None,
+                             probe_timeout: float = None):
+        """Fail FAST if bulk device->host transfers are broken.
+
+        The host tier is single-controller: it pulls the full fp32 master
+        to this process and re-uploads compute params every step.  On a
+        tunneled dev platform (axon websocket relay) bulk transfers were
+        observed to stall *indefinitely* — un-interruptible by SIGALRM
+        because the wait is inside one native call (round-3 root cause,
+        BENCH_NOTES.md).  Probing a single ~4 MB pull in a worker thread
+        converts that forever-stall into a clean RuntimeError, letting
+        callers fall back (engine attempt chains, bench.py).  On a real
+        TPU VM the probe costs one microseconds-scale PCIe copy.
+
+        Knobs: DS_OFFLOAD_MIN_MBPS (default 8; 0 disables),
+        DS_OFFLOAD_PROBE_TIMEOUT seconds (default 60).
+        """
+        import os
+        import threading
+        import time
+
+        if min_mbps is None:
+            min_mbps = float(os.environ.get("DS_OFFLOAD_MIN_MBPS", "8"))
+        if probe_timeout is None:
+            probe_timeout = float(
+                os.environ.get("DS_OFFLOAD_PROBE_TIMEOUT", "60"))
+        if min_mbps <= 0:
+            return
+        leaves = [x for x in jax.tree.leaves(master_params)
+                  if hasattr(x, "nbytes")]
+        if not leaves:
+            return
+        # largest leaf capped to ~4 MB worth of leading rows
+        leaf = max(leaves, key=lambda x: x.nbytes)
+        if leaf.nbytes > 4 << 20 and leaf.ndim >= 1 and leaf.shape[0] > 1:
+            rows = max(1, int(leaf.shape[0] * (4 << 20) / leaf.nbytes))
+            leaf = leaf[:rows]
+        nbytes = leaf.nbytes
+        if nbytes < 1 << 20:  # tiny models: nothing worth probing
+            return
+        # Daemon thread, NOT ThreadPoolExecutor: the executor's interpreter
+        # exit hook join()s its (non-daemon) worker, so a probe thread
+        # wedged forever inside the native device_get would turn the
+        # intended fast-fail into a hang at process exit.  A daemon thread
+        # is simply abandoned.
+        done = threading.Event()
+
+        def pull():
+            try:
+                np.asarray(jax.device_get(leaf))
+            finally:
+                done.set()
+
+        t0 = time.perf_counter()
+        threading.Thread(target=pull, daemon=True).start()
+        if not done.wait(timeout=probe_timeout):
+            raise RuntimeError(
+                f"device->host transfer probe ({nbytes >> 20} MB) did not "
+                f"complete within {probe_timeout:.0f}s: bulk D2H appears "
+                "stalled on this platform (tunneled dev harness?). The "
+                "'host' offload tier needs working bulk transfers — use "
+                "offload_impl='xla' (remote-host pinned staging) here. "
+                "Override: DS_OFFLOAD_MIN_MBPS=0 disables this probe.")
+        dt = time.perf_counter() - t0
+        mbps = (nbytes / (1 << 20)) / max(dt, 1e-9)
+        if mbps < min_mbps:
+            raise RuntimeError(
+                f"device->host transfer probe measured {mbps:.1f} MB/s "
+                f"(< {min_mbps} MB/s): the host offload tier would take "
+                "minutes per step at this bandwidth. Use "
+                "offload_impl='xla', or set DS_OFFLOAD_MIN_MBPS=0 to "
+                "proceed anyway.")
 
     @property
     def is_native(self) -> bool:

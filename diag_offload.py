@@ -74,7 +74,7 @@ mark(f"{size}: first step done in {time.perf_counter()-t1:.1f}s "
 t2 = time.perf_counter()
 loss = float(eng.train_batch(toks))
 mark(f"{size}: steady step {time.perf_counter()-t2:.2f}s loss={loss:.3f}")
-print(json.dumps({"size": size, "ok": True}) if False else "OK")
+print(json.dumps({"size": size, "ok": True, "loss": loss}))
 """
 
 
@@ -90,7 +90,9 @@ def run_variant(name, size, env_over, deadline):
         p = subprocess.run([sys.executable, "-c", CHILD], env=env,
                            timeout=deadline, capture_output=True, text=True)
         rc, out = p.returncode, p.stderr[-3000:]
-        verdict = "OK" if rc == 0 else f"rc={rc}"
+        child_ok = any(l.startswith("{") and '"ok": true' in l
+                       for l in p.stdout.splitlines())
+        verdict = "OK" if rc == 0 and child_ok else f"rc={rc}"
     except subprocess.TimeoutExpired as e:
         # TimeoutExpired kills the child (unavoidable here); run this
         # variant LAST so a wedged tunnel cannot poison later variants.

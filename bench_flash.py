@@ -39,11 +39,12 @@ def main():
     seqs = [1024, 4096, 8192] if on_tpu else [128]
     blocks = ([256, 512, 1024] if on_tpu else [64])
 
-    rng = np.random.default_rng(0)
     best = {}
     for T in seqs:
-        q, k, v = (jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
-                   for _ in range(3))
+        # generate ON DEVICE: a host rng + upload is 50+ MB of H2D per
+        # tensor through the stall-prone tunnel (BENCH_NOTES.md round 3)
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (B, H, T, D),
+                                     jnp.bfloat16) for i in range(3))
         dense_fn = jax.jit(lambda q, k, v: causal_attention(q, k, v))
         try:
             t_dense = _time(lambda: dense_fn(q, k, v), iters)

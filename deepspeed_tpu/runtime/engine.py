@@ -1488,7 +1488,14 @@ class DeepSpeedEngine:
             spec[1] = DATA_AXIS
             sharding = NamedSharding(self.mesh, P(*spec))
             if nproc > 1:
-                # the multi-host assembly API wants process-local numpy
+                if isinstance(x, jax.Array):
+                    if x.sharding == sharding:
+                        return x  # already assembled for this mesh
+                    raise ValueError(
+                        "multi-process _shard_batch needs process-local "
+                        "numpy leaves (each process contributes its own "
+                        f"rows); got a jax.Array with sharding {x.sharding}"
+                        " — pass the local slice instead")
                 return jax.make_array_from_process_local_data(
                     sharding, np.asarray(x))
             return jax.device_put(x, sharding)

@@ -15,6 +15,7 @@ run capacity python bench_capacity.py
 run sparse python bench_sparse.py
 run bert python bench_bert.py
 run flash python bench_flash.py
+run moe python bench_moe.py
 echo "=== cpu_adam start $(date -u +%H:%M:%S) ===" >> bench_suite.log
 python bench_cpu_adam.py > BENCH_cpu_adam.txt 2>> bench_suite.log
 echo "=== suite done $(date -u +%H:%M:%S) ===" >> bench_suite.log

@@ -189,6 +189,19 @@ class DeepSpeedEngine:
                 lambda x: x.astype(jnp.float32)
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
 
+        # Offload-impl resolution must precede init: the xla tier stages
+        # the master leaf-by-leaf during init (below) so the full fp32
+        # tree never has to fit in device memory.
+        self._offload = bool(config.zero_config.cpu_offload)
+        self._offload_impl = None
+        if self._offload:
+            impl = config.zero_config.offload_impl
+            if impl == "auto":
+                platform = next(iter(self.mesh.devices.flat)).platform
+                impl = "xla" if platform == "tpu" else "host"
+            self._offload_impl = impl
+        self._offload_host = self._offload_impl == "host"
+
         if params is not None:
             master = _cast_master(params)
         else:
@@ -201,14 +214,44 @@ class DeepSpeedEngine:
             # The TrainModule protocol does not REQUIRE a traceable init
             # (a user init_fn may branch on concrete values or embed
             # numpy weights), so fall back to eager on trace failure.
+            #
+            # XLA-offload tier at large scale: init in COMPUTE dtype when
+            # the fp32 tree would exceed DS_OFFLOAD_FP32_INIT_LIMIT bytes
+            # (default 2 GiB) — the master is then the fp32 cast of
+            # bf16-rounded random draws (statistically identical; the
+            # reference also only ever trains on the half-precision view
+            # of its init).  Halves the device-resident peak during
+            # construction, which is what bounds trainable-params/chip
+            # with offload.
+            def _init_cast(r, dt):
+                tree = model.init(r)
+                if dt is None:
+                    return _cast_master(tree)
+                return jax.tree.map(
+                    lambda x: x.astype(dt)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
             try:
+                init_out_dtype = None
+                if self._offload and not self._offload_host:
+                    # eval_shape traces too — keep it under the fallback
+                    abstract = jax.eval_shape(model.init, init_rng)
+                    total = sum(
+                        4 * int(np.prod(l.shape)) if l.shape else 4
+                        for l in jax.tree.leaves(abstract)
+                        if jnp.issubdtype(l.dtype, jnp.floating))
+                    limit = int(float(os.environ.get(
+                        "DS_OFFLOAD_FP32_INIT_LIMIT", str(2 << 30))))
+                    if total > limit:
+                        init_out_dtype = self.compute_dtype
                 master = jax.jit(
-                    lambda r: _cast_master(model.init(r)))(init_rng)
+                    _init_cast, static_argnums=(1,))(init_rng,
+                                                     init_out_dtype)
             except jax.errors.JAXTypeError:
                 logger.warning(
                     "model.init is not jit-traceable; initializing "
                     "eagerly (slower on remote-compile platforms)")
-                master = _cast_master(model.init(init_rng))
+                master = _init_cast(init_rng, None)
         self.zero_plan = ZeroShardingPlan(
             stage=config.zero_optimization_stage, mesh=self.mesh,
             base_param_specs=model.param_partition_specs(master),
@@ -233,15 +276,6 @@ class DeepSpeedEngine:
                 "zero/utils.py:26-40): its compressed collective replaces "
                 "the data-parallel gradient reduction, which conflicts with "
                 "ZeRO's sharded gradients/state. Use zero stage 0.")
-        self._offload = bool(config.zero_config.cpu_offload)
-        self._offload_impl = None
-        if self._offload:
-            impl = config.zero_config.offload_impl
-            if impl == "auto":
-                platform = next(iter(self.mesh.devices.flat)).platform
-                impl = "xla" if platform == "tpu" else "host"
-            self._offload_impl = impl
-        self._offload_host = self._offload_impl == "host"
         if self._offload:
             name = config.optimizer_name or C.ADAM_OPTIMIZER
             if name != C.ADAM_OPTIMIZER or optimizer is not None:
@@ -250,16 +284,22 @@ class DeepSpeedEngine:
                     "(the reference's offload whitelist likewise admits "
                     "only Adam-family, zero/utils.py:26-40)")
         if self._offload and not self._offload_host:
-            # ZeRO-Offload, XLA-native tier: fp32 master + Adam moments live
-            # in the TPU host's memory (``pinned_host`` kind) as ONE flat
-            # padded vector each, sharded over ``data`` — each process's
-            # host stages only its own reduce-scattered partition, the flat
-            # analogue of the reference's per-rank fp32 partitions
-            # (reference: deepspeed/runtime/zero/stage2.py:262-269,743-900;
+            # ZeRO-Offload, XLA-native tier: fp32 master + Adam moments
+            # live in the TPU host's memory (``pinned_host`` kind) as one
+            # partition-major [dp, w_i] piece PER PARAMETER, sharded over
+            # ``data`` — each process's host stages only its own reduce-
+            # scattered partition, the piece-wise analogue of the
+            # reference's per-rank fp32 partitions (reference:
+            # deepspeed/runtime/zero/stage2.py:262-269,743-900;
             # pinned-tile streaming: csrc/adam/cpu_adam.cpp:64-113, here
-            # scheduled by XLA inside the one compiled step).  Flat staging
-            # matters: a per-leaf host tier costs ~8 ms dispatch per leaf
-            # per direction (measured ~2.5× the flat round-trip on a v5e).
+            # scheduled by XLA inside the one compiled step).  Pieces, not
+            # one concatenated vector: staging then proceeds leaf-at-a-
+            # time, so construction's device-resident peak is the init
+            # tree plus ONE piece rather than 2× the full fp32 state —
+            # this is what bounds peak trainable params/chip with offload
+            # (per-piece transfers inside the compiled step are scheduled
+            # and overlapped by XLA, unlike the eager per-leaf dispatches
+            # that motivated the old single-vector design).
             leaves, treedef = jax.tree.flatten(master)
             if not all(jnp.issubdtype(l.dtype, jnp.floating)
                        for l in leaves):
@@ -271,7 +311,7 @@ class DeepSpeedEngine:
             self._flat_sizes = [int(np.prod(s)) if s else 1
                                 for s in self._flat_shapes]
             dp = self.dp_world_size
-            flat_dev = NamedSharding(self.mesh, P(DATA_AXIS))
+            piece_dev = NamedSharding(self.mesh, P(DATA_AXIS, None))
             # Off-TPU (CPU test meshes) host and device memory are the same
             # space and XLA rejects sharded pinned_host placements — the
             # tier still runs, just without a distinct host memory kind.
@@ -283,28 +323,28 @@ class DeepSpeedEngine:
             self._offload_real_host = (
                 platform == "tpu"
                 and os.environ.get("DS_OFFLOAD_PINNED_HOST", "1") == "1")
-            flat_host = (flat_dev.with_memory_kind("pinned_host")
-                         if self._offload_real_host else flat_dev)
-            self._flat_dev_sharding = flat_dev
-            self._flat_host_sharding = flat_host
+            piece_host = (piece_dev.with_memory_kind("pinned_host")
+                          if self._offload_real_host else piece_dev)
+            self._piece_dev_sharding = piece_dev
+            self._piece_host_sharding = piece_host
             cspecs = self.zero_plan.compute_param_specs(master)
             self._compute_shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), cspecs,
                 is_leaf=lambda x: isinstance(x, P))
-            # Partition-major flat layout: the flat vector is logically
-            # (dp, W) with rank r's contiguous chunk holding the r-th
-            # data-shard of every leaf (the leaf's data-sharded dim moved
-            # to the front).  This makes every slice/reshape between the
-            # flat buffer and the per-leaf ZeRO shardings *sharding-
-            # natural*, so the SPMD partitioner emits zero collectives for
-            # the data-sharded legs — the naive offset-major layout forced
-            # an involuntary full rematerialization (replicate + re-
-            # partition) of every ZeRO-3 param on the cast-up path and of
-            # every reduce-scattered grad on the flatten path.  Layout dims
-            # come from grad_specs: identical to the stage-3 compute specs
-            # and additionally correct for stage-2's reduce-scattered
-            # grads (compute params are replicated there, so unflatten is
-            # local either way after the stage<3 all-gather).
+            # Partition-major piece layout: each piece is (dp, w_i) with
+            # row r holding rank r's data-shard of that leaf (the leaf's
+            # data-sharded dim moved to the front).  This makes every
+            # reshape between a piece and the leaf's ZeRO sharding
+            # *sharding-natural*, so the SPMD partitioner emits zero
+            # collectives for the data-sharded legs — the naive offset-
+            # major layout forced an involuntary full rematerialization
+            # (replicate + re-partition) of every ZeRO-3 param on the
+            # cast-up path and of every reduce-scattered grad on the
+            # flatten path.  Layout dims come from grad_specs: identical
+            # to the stage-3 compute specs and additionally correct for
+            # stage-2's reduce-scattered grads (compute params are
+            # replicated there, so unflatten is local either way after
+            # the stage<3 all-gather).
             gspec_leaves = jax.tree.leaves(
                 self.zero_plan.grad_specs(master),
                 is_leaf=lambda x: isinstance(x, P))
@@ -315,23 +355,34 @@ class DeepSpeedEngine:
             self._flat_w = sum(rec.w for rec in self._flat_layout)
             self._flat_pad = sum(rec.pad for rec in self._flat_layout)
             self._flat_n = dp * self._flat_w
-            # two-stage init staging: a plain jit flatten to device, then
-            # an eager device_put into host memory.  The init-time
-            # flatten-with-host-out_shardings compile was observed to
-            # stall on the axon platform (unconfirmed whether the step
-            # compile shares the trigger — it could not be re-tested while
-            # the TPU tunnel was down); init has a cheap workaround, so
-            # take it.
-            master = jax.device_put(
-                jax.jit(self._offload_flatten,
-                        out_shardings=flat_dev)(master), flat_host)
+            # Leaf-at-a-time staging: pack ONE leaf to its fp32 (dp, w)
+            # piece on device, move it to host memory, drop the leaf.
+            # Device peak = remaining init leaves + one piece, a strictly
+            # decreasing footprint; the old whole-tree flatten held tree
+            # AND flat vector simultaneously (2× fp32 state) and required
+            # a host-side concatenate.
+            master = None  # the tree would otherwise pin every leaf alive
+            # ONE jitted pack function: _FlatLeaf is hashable, so repeated
+            # leaf shapes (a transformer's dozens of same-shaped layers)
+            # hit the jit cache instead of compiling per leaf.
+            pack_piece = jax.jit(
+                lambda l, rec, dp: _pack_leaf(
+                    l.astype(jnp.float32), rec, dp, jnp),
+                static_argnums=(1, 2), out_shardings=piece_dev)
+            pieces = []
+            for i, rec in enumerate(self._flat_layout):
+                leaf, leaves[i] = leaves[i], None  # drop the last reference
+                piece = pack_piece(leaf, rec, dp)
+                del leaf
+                pieces.append(jax.device_put(piece, piece_host))
+                del piece
+            master = tuple(pieces)
+
             opt_state = FusedAdamState(
                 count=jax.device_put(jnp.zeros([], jnp.int32),
                                      NamedSharding(self.mesh, P())),
-                mu=jax.device_put(jnp.zeros((self._flat_n,), jnp.float32),
-                                  flat_host),
-                nu=jax.device_put(jnp.zeros((self._flat_n,), jnp.float32),
-                                  flat_host))
+                mu=self._zero_host_pieces(),
+                nu=self._zero_host_pieces())
         elif self._offload:
             # ZeRO-Offload, single-controller numpy tier: fp32 master +
             # moments live in THIS process's memory and are updated by the
@@ -1095,64 +1146,61 @@ class DeepSpeedEngine:
     # in pinned_host memory as flat padded vectors, cast + Adam run as XLA
     # host computations.
     # ------------------------------------------------------------------
+    def _zero_host_pieces(self):
+        """Zeroed (dp, w_i) host pieces — fresh Adam moments, shaped and
+        placed exactly like the master pieces (one definition for both
+        fresh init and checkpoint-load so they cannot drift)."""
+        dp = self.dp_world_size
+        return tuple(
+            jax.device_put(jnp.zeros((dp, rec.w), jnp.float32),
+                           self._piece_host_sharding)
+            for rec in self._flat_layout)
+
     def _offload_flatten(self, tree, dtype=jnp.float32):
-        """Param-shaped tree -> one flat partition-major vector
+        """Param-shaped tree -> tuple of partition-major (dp, w_i) pieces
         (traceable).  Each leaf's data-sharded dim is moved to the front
         and split into dp rows, so a leaf carrying its ZeRO reduce-scatter
-        / stage-3 sharding flattens into the P('data') flat buffer with
-        ZERO collectives — every reshape is sharding-natural (see
+        / stage-3 sharding packs into its P('data') piece with ZERO
+        collectives — every reshape is sharding-natural (see
         ``_FlatLeaf``)."""
         dp = self.dp_world_size
-        pieces = [_pack_leaf(leaf.astype(dtype), rec, dp, jnp)
-                  for leaf, rec in zip(jax.tree.leaves(tree),
-                                       self._flat_layout)]
-        flat2d = (pieces[0] if len(pieces) == 1
-                  else jnp.concatenate(pieces, axis=1))
-        return flat2d.reshape(-1)
+        return tuple(
+            _pack_leaf(leaf.astype(dtype), rec, dp, jnp)
+            for leaf, rec in zip(jax.tree.leaves(tree), self._flat_layout))
 
-    def _offload_unflatten(self, flat):
-        """Flat vector -> param-shaped tree with compute shardings
-        (traceable).  Stages ≤ 2: the cast-up path all-gathers the flat
-        vector first (the fused ZeRO param all-gather, reference
-        stage2.py:1438-1471), so slices are local and per-leaf constraints
-        only re-shard TP-split leaves.  Stage 3: the input stays
-        P('data')-sharded and, because the layout is partition-major,
-        each slice/reshape/moveaxis lands exactly on the leaf's
-        data-sharded compute spec — no resharding collectives (ZeRO-3
-        never materializes the replica)."""
-        dp = self.dp_world_size
+    def _offload_unflatten(self, pieces):
+        """Pieces -> param-shaped tree with compute shardings (traceable).
+        Stages ≤ 2: the cast-up path all-gathers each piece first (the
+        fused ZeRO param all-gather, reference stage2.py:1438-1471), so
+        unpacks are local and per-leaf constraints only re-shard TP-split
+        leaves.  Stage 3: pieces stay P('data')-sharded and, because the
+        layout is partition-major, each reshape/moveaxis lands exactly on
+        the leaf's data-sharded compute spec — no resharding collectives
+        (ZeRO-3 never materializes the replica).  Piece-wise state also
+        means NO slicing of one big vector here, removing the last SPMD
+        hazard of the old layout."""
         shard_leaves = jax.tree.leaves(
             self._compute_shardings,
             is_leaf=lambda x: isinstance(x, NamedSharding))
-        flat2d = flat.reshape(dp, self._flat_w)
-        out, off = [], 0
-        for rec, sh in zip(self._flat_layout, shard_leaves):
-            sl = jax.lax.slice_in_dim(flat2d, off, off + rec.w, axis=1)
-            out.append(jax.lax.with_sharding_constraint(
-                _unpack_leaf(sl, rec, jnp), sh))
-            off += rec.w
+        out = [
+            jax.lax.with_sharding_constraint(_unpack_leaf(p, rec, jnp), sh)
+            for p, rec, sh in zip(pieces, self._flat_layout, shard_leaves)]
         return jax.tree.unflatten(self._flat_treedef, out)
 
-    def _unflatten_numpy(self, flat):
+    def _unflatten_numpy(self, pieces):
         """Host-side unflatten for checkpointing (no device memory cost).
         Inverts the same partition-major layout as the traceable pair."""
-        dp = self.dp_world_size
-        arr2d = np.asarray(jax.device_get(flat)).reshape(dp, self._flat_w)
-        out, off = [], 0
-        for rec in self._flat_layout:
-            out.append(_unpack_leaf(arr2d[:, off:off + rec.w], rec, np))
-            off += rec.w
+        out = [
+            _unpack_leaf(np.asarray(jax.device_get(p)), rec, np)
+            for p, rec in zip(pieces, self._flat_layout)]
         return jax.tree.unflatten(self._flat_treedef, out)
 
     def _flatten_numpy(self, tree):
         dp = self.dp_world_size
-        pieces = [
+        return tuple(
             _pack_leaf(np.asarray(jax.device_get(l)).astype(np.float32),
                        rec, dp, np)
-            for l, rec in zip(jax.tree.leaves(tree), self._flat_layout)]
-        flat2d = (pieces[0] if len(pieces) == 1
-                  else np.concatenate(pieces, axis=1))
-        return flat2d.reshape(-1)
+            for l, rec in zip(jax.tree.leaves(tree), self._flat_layout))
 
     def _host_section(self):
         """compute_on('device_host') on real TPUs; a no-op scope on CPU test
@@ -1169,28 +1217,29 @@ class DeepSpeedEngine:
         import contextlib
         return contextlib.nullcontext()
 
-    def _xla_offload_cast_up(self, master_flat):
+    def _xla_offload_cast_up(self, master_pieces):
         """Host-side cast to compute dtype + PCIe upload (half the bytes of
         shipping fp32 and casting on device), then split into the tree.
 
-        Stages ≤ 2: the flat vector is all-gathered ONCE before the split —
-        per-leaf resharding of slices of a dp-sharded vector fragments into
-        hundreds of tiny collectives (SPMD "involuntary full
-        rematerialization"; this one constraint dropped the step's
-        collective count 370 → 235 on an 8-way mesh).  That is the ZeRO
-        param all-gather, fused, and peak-memory-neutral there because
+        Stages ≤ 2: each piece is all-gathered whole before its unpack —
+        the ZeRO param all-gather, one collective per parameter (NOT the
+        hundreds of tiny reshard collectives that slicing a dp-sharded
+        vector fragments into), and peak-memory-neutral there because
         stages ≤ 2 materialize replicated compute params anyway.
         Stage 3 skips the gather: compute params stay data-sharded."""
         with self._host_section():
-            lowp = master_flat.astype(self.compute_dtype)
-        lowp = jax.device_put(lowp, self._flat_dev_sharding)
+            lowp = tuple(p.astype(self.compute_dtype)
+                         for p in master_pieces)
+        lowp = tuple(jax.device_put(p, self._piece_dev_sharding)
+                     for p in lowp)
         if self.zero_plan.stage < 3:
-            # stages ≤ 2 compute on replicated params — gather once.
+            # stages ≤ 2 compute on replicated params — gather per piece.
             # Stage 3 (ZeRO-3 × offload, the 13B ladder rung) must NOT:
             # its compute params stay data-sharded and the per-leaf
-            # constraints below place each slice directly.
-            lowp = jax.lax.with_sharding_constraint(
-                lowp, NamedSharding(self.mesh, P()))
+            # constraints in the unflatten place each piece directly.
+            rep = NamedSharding(self.mesh, P())
+            lowp = tuple(jax.lax.with_sharding_constraint(p, rep)
+                         for p in lowp)
         return self._offload_unflatten(lowp)
 
     def _build_xla_offload_step(self):
@@ -1203,8 +1252,8 @@ class DeepSpeedEngine:
         wd = float(oparams.get("weight_decay", 0.0))
         adam_w_mode = bool(oparams.get("adam_w_mode", True))
         bias_correction = bool(oparams.get("bias_correction", True))
-        flat_dev = self._flat_dev_sharding
-        flat_host = self._flat_host_sharding
+        piece_dev = self._piece_dev_sharding
+        piece_host = self._piece_host_sharding
         host_scalar = NamedSharding(self.mesh, P())
         if self._offload_real_host:
             host_scalar = host_scalar.with_memory_kind("pinned_host")
@@ -1242,36 +1291,46 @@ class DeepSpeedEngine:
                 c1 = c2 = jnp.asarray(1.0, jnp.float32)
             step_lr = lr_at(count1)
 
-            # PCIe down: ONE flat compute-dtype grad buffer (the reference
-            # likewise stages fp16 gradients into flat pinned host buffers,
-            # stage2.py:793-816); the P('data') constraint makes the flatten
-            # consume each rank's reduce-scattered slice only.
-            gflat = jax.lax.with_sharding_constraint(
-                self._offload_flatten(grads, compute_dtype), flat_dev)
-            gh = jax.device_put(gflat, flat_host)
+            # PCIe down: per-parameter compute-dtype grad pieces (the
+            # reference likewise stages fp16 gradients into pinned host
+            # buffers, stage2.py:793-816); the P('data') constraint makes
+            # each pack consume its rank's reduce-scattered slice only,
+            # and XLA schedules/overlaps the piece transfers inside the
+            # one compiled step.
+            gpieces = tuple(
+                jax.device_put(
+                    jax.lax.with_sharding_constraint(p, piece_dev),
+                    piece_host)
+                for p in self._offload_flatten(grads, compute_dtype))
             finite_f = jax.device_put(
                 finite.astype(jnp.float32), host_scalar)
             c1_h = jax.device_put(c1, host_scalar)
             c2_h = jax.device_put(c2, host_scalar)
             lr_h = jax.device_put(step_lr, host_scalar)
 
-            master = state.master_params  # flat pinned_host f32
+            masters = state.master_params  # tuple of pinned_host f32 pieces
             with self._host_section():
-                g32 = gh.astype(jnp.float32)
-                if wd != 0.0 and not adam_w_mode:
-                    g32 = g32 + wd * master
-                mu2, nu2 = adam_moments(g32, opt.mu, opt.nu, b1, b2)
-                upd = adam_direction(mu2, nu2, c1_h, c2_h, eps)
-                if wd != 0.0 and adam_w_mode:
-                    upd = upd + wd * master
-                master2 = master - lr_h * upd
-                # overflow-skip as elementwise select (control flow stays
-                # out of the host section; the state write-back is masked —
-                # finite crosses as f32 to keep the section bool/int-free)
+                new_master, new_mu, new_nu = [], [], []
                 keep = finite_f > 0.5
-                new_master = jnp.where(keep, master2, master)
-                new_mu = jnp.where(keep, mu2, opt.mu)
-                new_nu = jnp.where(keep, nu2, opt.nu)
+                for gh, master, mu_p, nu_p in zip(
+                        gpieces, masters, opt.mu, opt.nu):
+                    g32 = gh.astype(jnp.float32)
+                    if wd != 0.0 and not adam_w_mode:
+                        g32 = g32 + wd * master
+                    mu2, nu2 = adam_moments(g32, mu_p, nu_p, b1, b2)
+                    upd = adam_direction(mu2, nu2, c1_h, c2_h, eps)
+                    if wd != 0.0 and adam_w_mode:
+                        upd = upd + wd * master
+                    master2 = master - lr_h * upd
+                    # overflow-skip as elementwise select (control flow
+                    # stays out of the host section; the state write-back
+                    # is masked — finite crosses as f32 to keep the
+                    # section bool/int-free)
+                    new_master.append(jnp.where(keep, master2, master))
+                    new_mu.append(jnp.where(keep, mu2, mu_p))
+                    new_nu.append(jnp.where(keep, nu2, nu_p))
+                new_master = tuple(new_master)
+                new_mu, new_nu = tuple(new_mu), tuple(new_nu)
 
             new_opt = FusedAdamState(
                 count=opt.count + finite.astype(jnp.int32),
@@ -1286,9 +1345,12 @@ class DeepSpeedEngine:
         # device memory, the next call sees different avals, and every step
         # retraces + recompiles (~40s/step observed on a v5e).
         dev = NamedSharding(self.mesh, P())
+        n_pieces = len(self._flat_layout)
+        host_tuple = (piece_host,) * n_pieces
         state_shardings = jax.tree.map(lambda _: dev, self.state)._replace(
-            master_params=flat_host,
-            opt_state=FusedAdamState(count=dev, mu=flat_host, nu=flat_host))
+            master_params=host_tuple,
+            opt_state=FusedAdamState(count=dev, mu=host_tuple,
+                                     nu=host_tuple))
         return jax.jit(train_step, donate_argnums=(0,),
                        out_shardings=(state_shardings, dev))
 
@@ -1383,25 +1445,22 @@ class DeepSpeedEngine:
         if not self._offload_xla:
             return master_tree, opt_tree
         dev = NamedSharding(self.mesh, P())
-        flat_master = jax.device_put(self._flatten_numpy(master_tree),
-                                     self._flat_host_sharding)
+
+        def put_pieces(tree):
+            return tuple(jax.device_put(p, self._piece_host_sharding)
+                         for p in self._flatten_numpy(tree))
+
+        flat_master = put_pieces(master_tree)
         if opt_tree is None:
             opt = FusedAdamState(
                 count=jax.device_put(jnp.zeros([], jnp.int32), dev),
-                mu=jax.device_put(
-                    jnp.zeros((self._flat_n,), jnp.float32),
-                    self._flat_host_sharding),
-                nu=jax.device_put(
-                    jnp.zeros((self._flat_n,), jnp.float32),
-                    self._flat_host_sharding))
+                mu=self._zero_host_pieces(), nu=self._zero_host_pieces())
         else:
             opt = FusedAdamState(
                 count=jax.device_put(
                     jnp.asarray(opt_tree.count, jnp.int32), dev),
-                mu=jax.device_put(self._flatten_numpy(opt_tree.mu),
-                                  self._flat_host_sharding),
-                nu=jax.device_put(self._flatten_numpy(opt_tree.nu),
-                                  self._flat_host_sharding))
+                mu=put_pieces(opt_tree.mu),
+                nu=put_pieces(opt_tree.nu))
         return flat_master, opt
 
     def _sync_offload_from_state(self):

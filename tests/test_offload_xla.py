@@ -1,12 +1,14 @@
-"""ZeRO-Offload, XLA tier (flat host staging) — correctness on the CPU mesh.
+"""ZeRO-Offload, XLA tier (piece-wise host staging) — correctness on the
+CPU mesh.
 
-The tier stores fp32 master + Adam moments as ONE flat padded vector each,
-sharded over ``data`` (the flat analogue of the reference's per-rank fp32
-partitions, deepspeed/runtime/zero/stage2.py:262-269,743-900).  On real TPUs
-the vectors live in ``pinned_host`` memory and the update runs as an XLA
-host computation; on the CPU test mesh the same program runs with a single
+The tier stores fp32 master + Adam moments as one partition-major
+(dp, w_i) piece per parameter, row-sharded over ``data`` (the piece-wise
+analogue of the reference's per-rank fp32 partitions,
+deepspeed/runtime/zero/stage2.py:262-269,743-900).  On real TPUs the
+pieces live in ``pinned_host`` memory and the update runs as an XLA host
+computation; on the CPU test mesh the same program runs with a single
 memory space (engine._offload_real_host gates the memory kind only), so
-everything here — flatten/unflatten, masking, checkpoint conversion — is the
+everything here — pack/unpack, masking, checkpoint conversion — is the
 code that runs on hardware.
 """
 import numpy as np
@@ -75,23 +77,27 @@ def test_weight_decay_paths(mesh):
 
 
 def test_flat_padding_and_sharding(mesh):
-    """Partition-major layout contract: the flat vector is (dp, W) row-
-    chunked, leaves without a leading data shard are padded per-leaf to a
-    multiple of dp, and the flatten/unflatten pair is an exact inverse."""
+    """Partition-major layout contract: the master is one (dp, w_i) piece
+    per parameter, row-sharded over data; leaves without a leading data
+    shard are padded per-leaf to a multiple of dp, and the pack/unpack
+    pair is an exact inverse."""
     eng = DeepSpeedEngine(SimpleModel(hidden_dim=32), _cfg(True), mesh=mesh)
     n_raw = sum(int(np.prod(s)) for s in eng._flat_shapes)
     assert eng._flat_n % 4 == 0              # dp rows of equal width
     assert eng._flat_n == 4 * eng._flat_w
     assert eng._flat_n - n_raw == eng._flat_pad  # per-leaf padding total
     assert all(rec.pad < 4 for rec in eng._flat_layout)
-    assert eng.state.master_params.shape == (eng._flat_n,)
-    spec = eng.state.master_params.sharding.spec
-    assert "data" in str(spec)               # per-rank host partitions
+    pieces = eng.state.master_params
+    assert isinstance(pieces, tuple)
+    assert len(pieces) == len(eng._flat_layout)
+    for p, rec in zip(pieces, eng._flat_layout):
+        assert p.shape == (4, rec.w)
+        assert "data" in str(p.sharding.spec)  # per-rank host partitions
     # exact numpy roundtrip through the layout
-    tree = eng._unflatten_numpy(eng.state.master_params)
+    tree = eng._unflatten_numpy(pieces)
     again = eng._flatten_numpy(tree)
-    np.testing.assert_array_equal(
-        again, np.asarray(jax.device_get(eng.state.master_params)))
+    for a, p in zip(again, pieces):
+        np.testing.assert_array_equal(a, np.asarray(jax.device_get(p)))
 
 
 def test_checkpoint_roundtrip_and_cross_load(mesh, tmp_path):
@@ -169,28 +175,39 @@ def test_zero3_offload_composition(mesh):
     # same math, different placement: both tiers converge identically
     assert abs(l3 - l2) < 2e-2
 
-    # the compiled step's HLO must not gather the full flat param vector
-    # when stage 3 is active (that replicate defeats ZeRO-3)
-    sharded = eng3._shard_batch(_batch(9))
-    hlo = eng3._train_step.lower(eng3.state, sharded).compile().as_text()
+    # ZeRO-3 gathers a param only AT USE, inside the grad-accum loop
+    # (while body), so the replica lives one layer at a time; stage 2
+    # gathers params whole once per step OUTSIDE the loop (the fused
+    # cast-up).  A param-sized gather at stage-3's top level would mean
+    # the cast-up replicated the master — the bug this guards against.
     import re
-    full_n = eng3._flat_n
-    def full_gathers(text):
-        out = []
+    piece_n = 4 * max(rec.w for rec in eng3._flat_layout)
+
+    def param_gathers(text):
+        """(inside_loop, outside_loop) param-sized all-gather lines."""
+        inside, outside = [], []
         for line in text.splitlines():
             if "all-gather" not in line:
                 continue
             m = re.search(
-                r"= *\(?[a-z0-9]*f\d+\[(\d+)\][^=]*all-gather\(", line)
-            if m and int(m.group(1)) >= full_n:
-                out.append(line)
-        return out
+                r"= *\(?[a-z0-9]*f\d+\[([0-9,]+)\][^=]*all-gather\(", line)
+            if not m:
+                continue
+            n = int(np.prod([int(d) for d in m.group(1).split(",")]))
+            if n >= piece_n:
+                (inside if "while/body" in line else outside).append(line)
+        return inside, outside
 
-    assert not full_gathers(hlo), "full flat-vector all-gather under zero3"
-    # regex sanity: the stage-2 engine DOES fuse the full param gather
+    sharded = eng3._shard_batch(_batch(9))
+    hlo = eng3._train_step.lower(eng3.state, sharded).compile().as_text()
+    in3, out3 = param_gathers(hlo)
+    assert not out3, f"stage-3 gathered params outside the loop: {out3[:1]}"
+    assert in3, "stage-3 should gather params at use inside the loop"
+    # control: the stage-2 engine's fused cast-up gather is at top level
     sharded2 = eng2._shard_batch(_batch(9))
     hlo2 = eng2._train_step.lower(eng2.state, sharded2).compile().as_text()
-    assert full_gathers(hlo2), "stage-2 control should show the gather"
+    _, out2 = param_gathers(hlo2)
+    assert out2, "stage-2 control should gather params outside the loop"
 
 
 def test_zero3_layout_roundtrip_is_collective_free(mesh):
@@ -212,19 +229,47 @@ def test_zero3_layout_roundtrip_is_collective_free(mesh):
     }, world_size=4)
     eng = DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg3, mesh=mesh)
 
-    def roundtrip(flat):
-        return eng._offload_flatten(eng._offload_unflatten(flat),
+    def roundtrip(pieces):
+        return eng._offload_flatten(eng._offload_unflatten(pieces),
                                     jnp.float32)
 
-    fn = jax.jit(roundtrip, in_shardings=eng._flat_dev_sharding,
-                 out_shardings=eng._flat_dev_sharding)
-    hlo = fn.lower(jax.ShapeDtypeStruct((eng._flat_n,),
-                                        jnp.float32)).compile().as_text()
+    n_pieces = len(eng._flat_layout)
+    in_sh = (eng._piece_dev_sharding,) * n_pieces
+    fn = jax.jit(roundtrip, in_shardings=(in_sh,), out_shardings=in_sh)
+    structs = tuple(jax.ShapeDtypeStruct((4, rec.w), jnp.float32)
+                    for rec in eng._flat_layout)
+    hlo = fn.lower(structs).compile().as_text()
     for op in ("all-gather", "all-reduce", "all-to-all",
                "collective-permute", "reduce-scatter"):
         assert op not in hlo, f"stage-3 layout roundtrip emits {op}"
     # and it is an exact identity on the data
-    x = np.arange(eng._flat_n, dtype=np.float32)
-    y = np.asarray(jax.device_get(fn(jax.device_put(
-        x, eng._flat_dev_sharding))))
-    np.testing.assert_array_equal(x, y)
+    xs = tuple(
+        np.arange(4 * rec.w, dtype=np.float32).reshape(4, rec.w) + i
+        for i, rec in enumerate(eng._flat_layout))
+    ys = fn(tuple(jax.device_put(x, eng._piece_dev_sharding) for x in xs))
+    for x, y in zip(xs, ys):
+        np.testing.assert_array_equal(x, np.asarray(jax.device_get(y)))
+
+
+def test_large_tree_inits_in_compute_dtype(mesh, monkeypatch):
+    """Above DS_OFFLOAD_FP32_INIT_LIMIT the init runs in compute dtype
+    (halving construction's device peak — what bounds params/chip); the
+    staged fp32 master is then the cast of bf16-rounded draws."""
+    import jax.numpy as jnp
+    monkeypatch.setenv("DS_OFFLOAD_FP32_INIT_LIMIT", "1")
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=32), _cfg(True), mesh=mesh,
+                          seed=3)
+    monkeypatch.delenv("DS_OFFLOAD_FP32_INIT_LIMIT")
+    ref = DeepSpeedEngine(SimpleModel(hidden_dim=32), _cfg(True), mesh=mesh,
+                          seed=3)
+    got = eng._unflatten_numpy(eng.state.master_params)
+    want = ref._unflatten_numpy(ref.state.master_params)
+    for k in want:
+        rounded = np.asarray(want[k]).astype(jnp.bfloat16).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(got[k]), rounded)
+    # and it still trains
+    x, y = _batch()
+    l0 = float(np.asarray(eng.train_batch((x, y))))
+    for _ in range(4):
+        l1 = float(np.asarray(eng.train_batch((x, y))))
+    assert np.isfinite(l1) and l1 < l0

@@ -602,12 +602,22 @@ class DeepSpeedEngine:
         return self.gradient_accumulation_steps
 
     def _scan_scaled_grads(self, params, batch, scaler, step_rng,
-                           cast: bool = True, constrain: bool = True):
+                           cast: bool = True, constrain: bool = True,
+                           keep_param_dtype: bool = False):
         """Shared grad-accumulation core of every step builder: scan the
         micro-batches, sum fp32 grads, unscale by loss_scale*grad_acc.
         Returns (grads, scaled_losses).  ``cast=False`` when ``params`` are
         already in compute dtype (offload tier casts on the host);
-        ``constrain=False`` on the 1-bit path (grads stay LOCAL there)."""
+        ``constrain=False`` on the 1-bit path (grads stay LOCAL there).
+
+        ``keep_param_dtype`` (offload tier only): at grad_acc == 1 there
+        is nothing to accumulate, so skip the scan and return grads in
+        the params' dtype — the fp32 loop carry would otherwise pin a 4N
+        buffer live through the whole backward, which is what bounds
+        trainable-params/chip in the capacity bench.  Numerically
+        identical to scan-then-cast: the unscale still happens in fp32
+        (elementwise, fused by XLA — never materialized), and the offload
+        step ships compute-dtype pieces either way."""
         module = self.module
         plan = self.zero_plan
         compute_dtype = self.compute_dtype
@@ -621,6 +631,16 @@ class DeepSpeedEngine:
             return precision.scale_loss(loss.astype(jnp.float32), scaler)
 
         grad_fn = jax.value_and_grad(micro_loss)
+
+        if keep_param_dtype and grad_acc == 1:
+            mb = jax.tree.map(lambda x: x[0], batch)
+            scaled_loss, g = grad_fn(params, mb,
+                                     jax.random.fold_in(step_rng, 0))
+            inv = (1.0 / scaler.loss_scale).astype(jnp.float32)
+            grads = con(jax.tree.map(
+                lambda x: (x.astype(jnp.float32) * inv).astype(x.dtype),
+                con(g)))
+            return grads, scaled_loss[None]
 
         def acc_body(carry, mb):
             gsum, i = carry
@@ -1271,7 +1291,8 @@ class DeepSpeedEngine:
             # point); on the dp=1 bench chip constraints are no-ops either
             # way.
             grads, scaled_losses = self._scan_scaled_grads(
-                params, batch, scaler, step_rng, cast=False)
+                params, batch, scaler, step_rng, cast=False,
+                keep_param_dtype=True)
             finite = precision.grads_finite(grads)
             grad_norm = global_norm(grads)
             if clip > 0:

@@ -10,12 +10,15 @@ run() {
   "$@" > "BENCH_${name}_raw.json" 2>> bench_suite.log
   echo "=== $name done rc=$? $(date -u +%H:%M:%S) ===" >> bench_suite.log
 }
+# capacity runs LAST: its probes are subprocesses killed on timeout,
+# and killing a TPU client mid-native-call can wedge the tunnel for
+# everything after it (BENCH_NOTES.md round 3)
 run r03 python bench.py
-run capacity python bench_capacity.py
-run sparse python bench_sparse.py
 run bert python bench_bert.py
+run sparse python bench_sparse.py
 run flash python bench_flash.py
 run moe python bench_moe.py
+run capacity python bench_capacity.py
 echo "=== cpu_adam start $(date -u +%H:%M:%S) ===" >> bench_suite.log
 python bench_cpu_adam.py > BENCH_cpu_adam.txt 2>> bench_suite.log
 echo "=== suite done $(date -u +%H:%M:%S) ===" >> bench_suite.log

@@ -273,3 +273,30 @@ def test_large_tree_inits_in_compute_dtype(mesh, monkeypatch):
     for _ in range(4):
         l1 = float(np.asarray(eng.train_batch((x, y))))
     assert np.isfinite(l1) and l1 < l0
+
+
+def test_ga1_scanless_grads_match(mesh):
+    """grad_acc=1 skips the fp32 accumulation scan (capacity: the fp32
+    loop carry would pin 4N live); trajectory must match ga=1 WITH the
+    scan-equivalent non-offload engine."""
+    def cfg(off):
+        zero = {"stage": 2}
+        if off:
+            zero.update({"cpu_offload": True, "offload_impl": "xla"})
+        return DeepSpeedConfig({
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 10 ** 9,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": zero,
+        }, world_size=4)
+    ex = DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg(True), mesh=mesh,
+                         seed=3)
+    ep = DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg(False), mesh=mesh,
+                         seed=3)
+    x, y = _batch()
+    for _ in range(5):
+        lx = float(np.asarray(ex.train_batch((x, y))))
+        lp = float(np.asarray(ep.train_batch((x, y))))
+        assert abs(lx - lp) < 1e-4, (lx, lp)

@@ -363,12 +363,53 @@ def main():
     cfg, seq, tps, name = result
 
     mfu = tps * _flops_per_token(cfg, seq) / peak
-    print(json.dumps({
+    rec = {
         "metric": f"{name}_seq{seq}_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
-    }))
+    }
+    # artifact BEFORE stdout: survives even if a later phase wedges
+    try:
+        with open("BENCH_north_star.json", "w") as f:
+            json.dump(rec, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(rec), flush=True)
+    _run_suite_benches()
+
+
+def _run_suite_benches():
+    """Opportunistic: after the north-star line is safely out, produce
+    the rest of the hardware evidence in the same healthy-tunnel window
+    (the driver only ever runs bench.py — if the tunnel is up only
+    during that run, these artifacts would otherwise never exist).
+    Each bench runs in a subprocess with stdout to a file (this
+    process's stdout stays exactly one JSON line) and writes its own
+    BENCH_*.json on TPU.  BENCH_SUITE=0 disables; each gets a bounded
+    timeout — by this point the main number is banked, so a worst-case
+    wedge costs only the driver's remaining slot."""
+    import subprocess
+    if os.environ.get("BENCH_SUITE", "1") == "0":
+        return
+    per_bench = int(os.environ.get("BENCH_SUITE_TIMEOUT", "1500"))
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name in ("bench_bert", "bench_sparse", "bench_flash",
+                 "bench_moe", "bench_capacity"):
+        _mark(f"suite: {name} (timeout {per_bench}s)")
+        out = os.path.join(here, f"BENCH_{name[6:]}_raw.json")
+        try:
+            with open(out, "w") as fh:
+                subprocess.run(
+                    [sys.executable, os.path.join(here, name + ".py")],
+                    stdout=fh, stderr=sys.stderr, timeout=per_bench,
+                    cwd=here)
+        except subprocess.TimeoutExpired:
+            _mark(f"suite: {name} timed out; stopping the suite (a "
+                  "killed TPU client can wedge the tunnel)")
+            break
+        except Exception as e:
+            _mark(f"suite: {name} failed: {e}")
 
 
 if __name__ == "__main__":

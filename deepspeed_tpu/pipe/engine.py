@@ -679,7 +679,15 @@ class PipelineEngine(DeepSpeedEngine):
             # 1F1B stages diverge per tick (F vs B parity), so seq-axis
             # collectives inside the stage bodies would execute on only
             # some pipe ranks — sequence parallelism rides the gpipe
-            # schedule's uniform tick body instead.
+            # schedule's uniform tick body instead.  Verified empirically
+            # (round 3): forcing 1F1B here deadlocks at runtime — the F
+            # and B cond branches lower to DISTINCT collective-permute
+            # instances, stage-0 devices join the F-branch's rendezvous
+            # while stage-1 devices join the B-branch's, and each waits
+            # forever for the full participant set (XLA rendezvous
+            # "expected 8 threads, only 4 arrived").  Not fixable at this
+            # layer: XLA scopes the rendezvous to the op instance, not to
+            # the seq subgroup.
             log_dist(
                 "pipeline: seq axis > 1 — using the gpipe schedule "
                 "(1F1B's F/B tick divergence cannot carry seq-axis "

@@ -1,0 +1,17 @@
+#!/bin/bash
+# One-shot hardware evidence run for a healthy-tunnel window.
+# Order: north-star bench (bench.py itself chains the remaining suite,
+# banking artifacts as it goes) -> offload stall diagnosis matrix ->
+# commit everything.  Never SIGTERM TPU jobs (BENCH_NOTES.md).
+cd /root/repo
+log=recovery_run.log
+echo "=== recovery run start $(date -u +%H:%M:%S) ===" >> "$log"
+python bench.py > BENCH_r03_raw.json 2>> "$log"
+echo "=== bench.py rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
+python bench_cpu_adam.py > BENCH_cpu_adam.txt 2>> "$log"
+echo "=== cpu_adam rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
+python diag_offload.py --full > DIAG_offload_run.log 2>&1
+echo "=== diag rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
+git add -A BENCH_*.json BENCH_*.txt DIAG_offload* recovery_run.log bench_suite.log 2>> "$log"
+git commit -q -m "Hardware bench artifacts: north star + suite + offload diagnosis" >> "$log" 2>&1
+echo "=== recovery run done $(date -u +%H:%M:%S) ===" >> "$log"

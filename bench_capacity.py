@@ -34,6 +34,7 @@ from deepspeed_tpu.parallel import build_mesh
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 
 n_layer, offload = int(sys.argv[1]), bool(int(sys.argv[2]))
+chunks = int(os.environ.get("CAPACITY_GRAD_CHUNKS", "0"))
 if len(sys.argv) > 3 and sys.argv[3] == "smoke":  # CPU plumbing check
     jax.config.update("jax_platforms", "cpu")
     cfg_model = GPT2Config(d_model=64, n_layer=n_layer, n_head=4,
@@ -44,6 +45,8 @@ else:
                            remat="block", scan_layers=True)
 zero = {{"stage": 2, "cpu_offload": True, "offload_impl": "xla"}} if offload \
     else {{"stage": 0}}
+if offload and chunks > 1:
+    zero["offload_grad_chunks"] = chunks
 ds_cfg = DeepSpeedConfig({{
     "train_micro_batch_size_per_gpu": 1,
     "gradient_accumulation_steps": 1,
@@ -62,16 +65,18 @@ print("PROBE_OK", cfg_model.num_params)
 
 
 def _probe(n_layer: int, offload: bool, timeout: int,
-           smoke: bool = False) -> int:
+           smoke: bool = False, chunks: int = 0) -> int:
     """Return param count if one step trains at this depth, else 0."""
     argv = [sys.executable, "-u", "-c",
             PROBE.format(repo=os.path.dirname(os.path.abspath(__file__))),
             str(n_layer), str(int(offload))]
     if smoke:
         argv.append("smoke")
+    env = dict(os.environ)
+    env["CAPACITY_GRAD_CHUNKS"] = str(chunks)
     try:
         proc = subprocess.run(argv, capture_output=True, text=True,
-                              timeout=timeout)
+                              timeout=timeout, env=env)
     except subprocess.TimeoutExpired:
         # a wedged probe near the OOM boundary counts as a failed size —
         # the bisection must continue, not abort
@@ -110,7 +115,7 @@ def _hbm_bytes(timeout: int) -> int:
     return 16 << 30  # v5e default
 
 
-def _predict_layers(offload: bool, hbm: int) -> int:
+def _predict_layers(offload: bool, hbm: int, chunks: int = 0) -> int:
     """Analytic seed for the search: device bytes/param at micro=1 ga=1.
 
     no-offload stage 0: fp32 master+mu+nu (12) + bf16 params (2) + fp32
@@ -120,13 +125,19 @@ def _predict_layers(offload: bool, hbm: int) -> int:
     for activations (seq 1024, micro 1, block remat + fp32 logits),
     workspace, and fragmentation."""
     margin = int(1.5 * (1 << 30))
-    per_param = 18.0 if not offload else 4.5
+    if not offload:
+        per_param = 18.0
+    elif chunks > 1:
+        # chunked: bf16 params (2) + largest grad group (~2/K) + slack
+        per_param = 2.0 + 2.0 / chunks + 0.6
+    else:
+        per_param = 4.5
     budget = max(hbm - margin, 1 << 30)
     return max(1, int((budget / per_param - EMB) / PER_LAYER))
 
 
 def _search_seeded(offload: bool, seed_layers: int, timeout: int,
-                   max_probes: int = 6):
+                   max_probes: int = 6, chunks: int = 0):
     """Largest working n_layer with a bounded probe budget: start at the
     analytic prediction, climb geometrically while passing (the model
     may be conservative), fall back geometrically while failing, then
@@ -137,7 +148,7 @@ def _search_seeded(offload: bool, seed_layers: int, timeout: int,
     def probe(n):
         nonlocal probes
         probes += 1
-        return _probe(n, offload, timeout)
+        return _probe(n, offload, timeout, chunks=chunks)
 
     n = max(1, seed_layers)
     params = probe(n)
@@ -190,22 +201,35 @@ def main():
                           "vs_baseline": float(bool(ok and ok_off))}))
         return
     hbm = _hbm_bytes(timeout=min(timeout, 300))
+    chunks = int(os.environ.get("CAPACITY_CHUNKS", "4"))
     p_plain = _predict_layers(False, hbm)
     p_off = _predict_layers(True, hbm)
+    p_ck = _predict_layers(True, hbm, chunks)
     max_probes = int(os.environ.get("CAPACITY_MAX_PROBES", "6"))
     print(f"  hbm={hbm / (1 << 30):.1f} GiB predict: plain={p_plain} "
-          f"offload={p_off} layers", file=sys.stderr)
+          f"offload={p_off} chunked(k={chunks})={p_ck} layers",
+          file=sys.stderr)
     plain_layers, plain_params = _search_seeded(False, p_plain, timeout,
                                                 max_probes)
     off_layers, off_params = _search_seeded(True, p_off, timeout,
                                             max_probes)
-    ratio = off_params / plain_params if plain_params else 0.0
+    ck_layers, ck_params = (0, 0)
+    if chunks > 1:
+        ck_layers, ck_params = _search_seeded(
+            True, max(p_ck, off_layers), timeout, max_probes,
+            chunks=chunks)
+    best_params = max(off_params, ck_params)
+    ratio = best_params / plain_params if plain_params else 0.0
     out = {
         "metric": "offload_peak_trainable_params_per_chip",
-        "value": round(off_params / 1e9, 3),
+        "value": round(best_params / 1e9, 3),
         "unit": "B params",
         "no_offload_params_b": round(plain_params / 1e9, 3),
+        "offload_params_b": round(off_params / 1e9, 3),
+        "offload_chunked_params_b": round(ck_params / 1e9, 3),
+        "grad_chunks": chunks,
         "offload_layers": off_layers,
+        "offload_chunked_layers": ck_layers,
         "no_offload_layers": plain_layers,
         "capacity_ratio": round(ratio, 2),
         # reference: 10x larger models via offload (BASELINE.md:16)

@@ -72,6 +72,14 @@ class DeepSpeedZeroConfig:
             zero, C.ZERO_CPU_OFFLOAD, C.ZERO_CPU_OFFLOAD_DEFAULT)
         self.offload_impl = get_scalar_param(
             zero, C.ZERO_OFFLOAD_IMPL, C.ZERO_OFFLOAD_IMPL_DEFAULT)
+        self.offload_grad_chunks = get_scalar_param(
+            zero, C.ZERO_OFFLOAD_GRAD_CHUNKS,
+            C.ZERO_OFFLOAD_GRAD_CHUNKS_DEFAULT)
+        if (not isinstance(self.offload_grad_chunks, int)
+                or self.offload_grad_chunks < 1):
+            raise DeepSpeedConfigError(
+                f"{C.ZERO_OFFLOAD_GRAD_CHUNKS} must be an int >= 1, "
+                f"got {self.offload_grad_chunks!r}")
         self.elastic_checkpoint = get_scalar_param(
             zero, C.ZERO_ELASTIC_CHECKPOINT, C.ZERO_ELASTIC_CHECKPOINT_DEFAULT)
         self.pg_correctness_test = get_scalar_param(
@@ -409,6 +417,14 @@ class DeepSpeedConfig:
         if self.zero_config.cpu_offload and self.zero_config.stage < 2:
             raise DeepSpeedConfigError(
                 "cpu_offload requires ZeRO stage >= 2")
+        if self.zero_config.offload_grad_chunks > 1:
+            if not self.zero_config.cpu_offload:
+                raise DeepSpeedConfigError(
+                    "offload_grad_chunks > 1 requires cpu_offload")
+            if self.zero_config.offload_impl == "host":
+                raise DeepSpeedConfigError(
+                    "offload_grad_chunks > 1 is an xla-tier capacity mode "
+                    "(offload_impl 'xla' or 'auto')")
         if self.optimizer_name is not None and self.optimizer_name in (
                 C.ONEBIT_ADAM_OPTIMIZER,) and not (self.fp16_enabled or self.bf16_enabled):
             raise DeepSpeedConfigError("onebitadam requires fp16 or bf16")

@@ -132,6 +132,15 @@ ZERO_CPU_OFFLOAD_DEFAULT = False
 #   'auto' — 'xla' on TPU meshes, 'host' elsewhere.
 ZERO_OFFLOAD_IMPL = "offload_impl"
 ZERO_OFFLOAD_IMPL_DEFAULT = "auto"
+# TPU extension (capacity mode): compute parameter gradients in K
+# balanced groups, one compiled program per group, so device-resident
+# gradient bytes are bounded by the largest group instead of the full
+# model (the program boundary guarantees the liveness bound).  Each
+# group re-runs the forward — a deliberate K× compute trade for
+# capacity, the in-XLA analogue of the reference streaming grads into
+# pinned host buffers during backward (stage2.py:743-816).  1 = off.
+ZERO_OFFLOAD_GRAD_CHUNKS = "offload_grad_chunks"
+ZERO_OFFLOAD_GRAD_CHUNKS_DEFAULT = 1
 ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_ELASTIC_CHECKPOINT_DEFAULT = True
 ZERO_MAX_ELEMENTS_PER_COMM = "max_elements_per_comm"

@@ -395,6 +395,14 @@ class DeepSpeedEngine:
                     "and cannot address multi-process arrays. Use "
                     "offload_impl='xla' (per-device pinned_host staging) "
                     "for multi-host runs.")
+            if int(getattr(config.zero_config,
+                           "offload_grad_chunks", 1) or 1) > 1:
+                # config-level sanity rejects impl='host' explicitly, but
+                # 'auto' resolves per-platform — never ignore the knob
+                raise ValueError(
+                    "offload_grad_chunks > 1 is an xla-tier capacity "
+                    "mode; offload_impl resolved to 'host' on this "
+                    "platform. Set offload_impl='xla' explicitly.")
             if config.zero_optimization_stage >= 3:
                 raise ValueError(
                     "ZeRO-3 × cpu_offload requires offload_impl='xla' "
@@ -449,7 +457,14 @@ class DeepSpeedEngine:
             self._grad_step = self._build_offload_grad_step()
             self._offload_eval_step = self._build_offload_eval_step()
         elif self._offload:
-            self._train_step = self._build_xla_offload_step()
+            chunks = int(getattr(config.zero_config,
+                                 "offload_grad_chunks", 1) or 1)
+            chunks = min(chunks, len(self._flat_sizes))
+            if chunks > 1:
+                self._train_step = self._build_chunked_offload_steps(
+                    self._grad_group_indices(chunks))
+            else:
+                self._train_step = self._build_xla_offload_step()
             self._eval_step = self._build_xla_offload_eval_step()
         elif self._onebit_path and self.dp_world_size > 1:
             # two compiled programs selected host-side at the freeze
@@ -603,7 +618,8 @@ class DeepSpeedEngine:
 
     def _scan_scaled_grads(self, params, batch, scaler, step_rng,
                            cast: bool = True, constrain: bool = True,
-                           keep_param_dtype: bool = False):
+                           keep_param_dtype: bool = False,
+                           loss_fn=None, constrain_fn=None):
         """Shared grad-accumulation core of every step builder: scan the
         micro-batches, sum fp32 grads, unscale by loss_scale*grad_acc.
         Returns (grads, scaled_losses).  ``cast=False`` when ``params`` are
@@ -618,16 +634,21 @@ class DeepSpeedEngine:
         identical to scan-then-cast: the unscale still happens in fp32
         (elementwise, fused by XLA — never materialized), and the offload
         step ships compute-dtype pieces either way."""
-        module = self.module
         plan = self.zero_plan
         compute_dtype = self.compute_dtype
         grad_acc = self._scan_grad_acc
-        con = (lambda g: constrain_grads(g, plan)) if constrain \
-            else (lambda g: g)
+        if loss_fn is None:
+            loss_fn = self.module.loss_fn
+        if constrain_fn is not None:
+            con = constrain_fn  # caller-supplied (subset trees)
+        elif constrain:
+            con = lambda g: constrain_grads(g, plan)  # noqa: E731
+        else:
+            con = lambda g: g  # noqa: E731
 
         def micro_loss(p, mb, rng):
             pp = precision.cast_to_compute(p, compute_dtype) if cast else p
-            loss = module.loss_fn(pp, mb, rng, train=True)
+            loss = loss_fn(pp, mb, rng, train=True)
             return precision.scale_loss(loss.astype(jnp.float32), scaler)
 
         grad_fn = jax.value_and_grad(micro_loss)
@@ -1330,28 +1351,9 @@ class DeepSpeedEngine:
             lr_h = jax.device_put(step_lr, host_scalar)
 
             masters = state.master_params  # tuple of pinned_host f32 pieces
-            with self._host_section():
-                new_master, new_mu, new_nu = [], [], []
-                keep = finite_f > 0.5
-                for gh, master, mu_p, nu_p in zip(
-                        gpieces, masters, opt.mu, opt.nu):
-                    g32 = gh.astype(jnp.float32)
-                    if wd != 0.0 and not adam_w_mode:
-                        g32 = g32 + wd * master
-                    mu2, nu2 = adam_moments(g32, mu_p, nu_p, b1, b2)
-                    upd = adam_direction(mu2, nu2, c1_h, c2_h, eps)
-                    if wd != 0.0 and adam_w_mode:
-                        upd = upd + wd * master
-                    master2 = master - lr_h * upd
-                    # overflow-skip as elementwise select (control flow
-                    # stays out of the host section; the state write-back
-                    # is masked — finite crosses as f32 to keep the
-                    # section bool/int-free)
-                    new_master.append(jnp.where(keep, master2, master))
-                    new_mu.append(jnp.where(keep, mu2, mu_p))
-                    new_nu.append(jnp.where(keep, nu2, nu_p))
-                new_master = tuple(new_master)
-                new_mu, new_nu = tuple(new_mu), tuple(new_nu)
+            new_master, new_mu, new_nu = self._host_adam_pieces(
+                gpieces, masters, opt, finite_f, c1_h, c2_h, lr_h,
+                b1=b1, b2=b2, eps=eps, wd=wd, adam_w_mode=adam_w_mode)
 
             new_opt = FusedAdamState(
                 count=opt.count + finite.astype(jnp.int32),
@@ -1375,6 +1377,35 @@ class DeepSpeedEngine:
         return jax.jit(train_step, donate_argnums=(0,),
                        out_shardings=(state_shardings, dev))
 
+    def _host_adam_pieces(self, gpieces, masters, opt, finite_f,
+                          c1_h, c2_h, lr_h, *, b1, b2, eps, wd,
+                          adam_w_mode, clip_scale_h=None):
+        """The piece-wise Adam update in one host-compute section — the
+        ONE definition of overflow-skip masking and weight-decay
+        semantics for both the single-program and chunked offload steps.
+        All operands are floats (an s32 in pinned_host space trips XLA's
+        host-compute alias assigner; control flow stays outside — the
+        write-back is an elementwise select on ``finite_f``)."""
+        with self._host_section():
+            new_master, new_mu, new_nu = [], [], []
+            keep = finite_f > 0.5
+            for gh, master, mu_p, nu_p in zip(
+                    gpieces, masters, opt.mu, opt.nu):
+                g32 = gh.astype(jnp.float32)
+                if clip_scale_h is not None:
+                    g32 = g32 * clip_scale_h
+                if wd != 0.0 and not adam_w_mode:
+                    g32 = g32 + wd * master
+                mu2, nu2 = adam_moments(g32, mu_p, nu_p, b1, b2)
+                upd = adam_direction(mu2, nu2, c1_h, c2_h, eps)
+                if wd != 0.0 and adam_w_mode:
+                    upd = upd + wd * master
+                master2 = master - lr_h * upd
+                new_master.append(jnp.where(keep, master2, master))
+                new_mu.append(jnp.where(keep, mu2, mu_p))
+                new_nu.append(jnp.where(keep, nu2, nu_p))
+            return (tuple(new_master), tuple(new_mu), tuple(new_nu))
+
     def _build_xla_offload_eval_step(self):
         module = self.module
 
@@ -1383,6 +1414,176 @@ class DeepSpeedEngine:
             return module.loss_fn(params, batch, rng, train=False)
 
         return jax.jit(eval_step)
+
+    # ------------------------------------------------------------------
+    # Chunked-gradient capacity mode (zero_optimization.offload_grad_chunks
+    # > 1): K compiled grad programs, each computing one balanced group of
+    # parameter gradients and staging them to host, then one compiled
+    # host-Adam update over all pieces.  The program boundaries GUARANTEE
+    # device-resident gradient bytes <= the largest group (XLA cannot
+    # extend liveness across programs) — the in-XLA analogue of the
+    # reference streaming gradients into pinned host buffers during
+    # backward (stage2.py:743-816), trading K forward recomputations for
+    # capacity.
+    # ------------------------------------------------------------------
+    def _grad_group_indices(self, k: int):
+        """Balanced greedy partition of leaf indices into k groups."""
+        order = sorted(range(len(self._flat_sizes)),
+                       key=lambda i: -self._flat_sizes[i])
+        groups = [[] for _ in range(k)]
+        loads = [0] * k
+        for i in order:
+            g = loads.index(min(loads))
+            groups[g].append(i)
+            loads[g] += self._flat_sizes[i]
+        return [sorted(g) for g in groups if g]
+
+    def _build_chunked_offload_steps(self, groups):
+        compute_dtype = self.compute_dtype
+        clip = self.gradient_clipping
+        scale_config = self.loss_scale_config
+        oparams = dict(self.config.optimizer_params)
+        b1, b2 = (float(b) for b in oparams.get("betas", (0.9, 0.999)))
+        eps = float(oparams.get("eps", 1e-8))
+        wd = float(oparams.get("weight_decay", 0.0))
+        adam_w_mode = bool(oparams.get("adam_w_mode", True))
+        bias_correction = bool(oparams.get("bias_correction", True))
+        piece_dev = self._piece_dev_sharding
+        piece_host = self._piece_host_sharding
+        host_scalar = NamedSharding(self.mesh, P())
+        if self._offload_real_host:
+            host_scalar = host_scalar.with_memory_kind("pinned_host")
+        lr_at = self._lr_at_fn()
+        module = self.module
+        treedef = self._flat_treedef
+        n_leaves = len(self._flat_sizes)
+        dp = self.dp_world_size
+        # full-tree grad placement, selected by leaf index (grad_specs on
+        # a subset tree would misalign with the base specs; the dummy
+        # tree must carry real shapes — int leaves' () shapes would make
+        # every spec replicated and defeat the memory bound)
+        shape_tree = jax.tree.unflatten(treedef, [
+            jax.ShapeDtypeStruct(s, jnp.float32)
+            for s in self._flat_shapes])
+        gspecs = jax.tree.leaves(
+            self.zero_plan.grad_specs(shape_tree),
+            is_leaf=lambda x: isinstance(x, P))
+
+        def make_grad_fn(gidx, first):
+            gset = list(gidx)
+            group_shardings = [NamedSharding(self.mesh, gspecs[i])
+                               for i in gset]
+
+            def con_subset(tree):
+                # subset-aware ZeRO grad constraint: applied INSIDE the
+                # accumulation scan too, so the fp32 carry stays sharded
+                # over data (the single-program path's constrain=True)
+                return [jax.lax.with_sharding_constraint(g, sh)
+                        for g, sh in zip(tree, group_shardings)]
+
+            def grad_fn(master_pieces, batch, scaler, rng, global_steps):
+                step_rng = jax.random.fold_in(rng, global_steps)
+                params = self._xla_offload_cast_up(master_pieces)
+                leaves = jax.tree.leaves(params)
+                active = [leaves[i] for i in gset]
+
+                def subset_loss(act, mb, mrng, train=True):
+                    merged = list(leaves)
+                    for j, i in enumerate(gset):
+                        merged[i] = act[j]
+                    return module.loss_fn(
+                        jax.tree.unflatten(treedef, merged), mb, mrng,
+                        train=train)
+
+                grads, scaled_losses = self._scan_scaled_grads(
+                    active, batch, scaler, step_rng, cast=False,
+                    constrain=False, keep_param_dtype=True,
+                    loss_fn=subset_loss, constrain_fn=con_subset)
+                finite = precision.grads_finite(grads)
+                sumsq = sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in grads)
+                pieces = []
+                for j, i in enumerate(gset):
+                    p = _pack_leaf(grads[j].astype(compute_dtype),
+                                   self._flat_layout[i], dp, jnp)
+                    p = jax.lax.with_sharding_constraint(p, piece_dev)
+                    pieces.append(jax.device_put(p, piece_host))
+                out = (tuple(pieces), finite, sumsq)
+                return out + ((scaled_losses,) if first else ())
+
+            return jax.jit(grad_fn)
+
+        grad_fns = [make_grad_fn(g, first=(k == 0))
+                    for k, g in enumerate(groups)]
+
+        def update_fn(state: TrainState, gpieces, finites, sumsqs,
+                      losses):
+            # per-group stats combine INSIDE the one compiled program —
+            # eager op-by-op combination would dispatch ~2K tiny programs
+            # per step (the class of overhead prior rounds removed)
+            finite = finites[0]
+            for f in finites[1:]:
+                finite = jnp.logical_and(finite, f)
+            grad_norm = jnp.sqrt(sum(sumsqs))
+            mean_loss = jnp.mean(losses) / state.scaler.loss_scale
+            opt = state.opt_state
+            count1 = opt.count + 1
+            count_f = count1.astype(jnp.float32)
+            if bias_correction:
+                c1 = 1 - b1 ** count_f
+                c2 = 1 - b2 ** count_f
+            else:
+                c1 = c2 = jnp.asarray(1.0, jnp.float32)
+            step_lr = lr_at(count1)
+            # clip factor from the cross-group global norm, applied in
+            # fp32 on the host (the single-program path clips on device
+            # pre-pack; same linear scaling, fp32 here)
+            cscale = (jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                      if clip > 0 else jnp.asarray(1.0, jnp.float32))
+            finite_f = jax.device_put(
+                finite.astype(jnp.float32), host_scalar)
+            c1_h = jax.device_put(c1, host_scalar)
+            c2_h = jax.device_put(c2, host_scalar)
+            lr_h = jax.device_put(step_lr, host_scalar)
+            cs_h = jax.device_put(cscale, host_scalar)
+            new_master, new_mu, new_nu = self._host_adam_pieces(
+                gpieces, state.master_params, opt, finite_f, c1_h, c2_h,
+                lr_h, b1=b1, b2=b2, eps=eps, wd=wd,
+                adam_w_mode=adam_w_mode, clip_scale_h=cs_h)
+            new_opt = FusedAdamState(
+                count=opt.count + finite.astype(jnp.int32),
+                mu=new_mu, nu=new_nu)
+            return self._step_epilogue(state, new_master, new_opt, finite,
+                                       mean_loss, grad_norm, lr_at,
+                                       scale_config)
+
+        dev = NamedSharding(self.mesh, P())
+        host_tuple = (piece_host,) * n_leaves
+        state_shardings = jax.tree.map(lambda _: dev, self.state)._replace(
+            master_params=host_tuple,
+            opt_state=FusedAdamState(count=dev, mu=host_tuple,
+                                     nu=host_tuple))
+        update_jit = jax.jit(update_fn, donate_argnums=(0,),
+                             out_shardings=(state_shardings, dev))
+
+        def train_step(state: TrainState, batch):
+            pieces_by_leaf = [None] * n_leaves
+            finites, sumsqs, losses = [], [], None
+            for k, (gidx, fn) in enumerate(zip(groups, grad_fns)):
+                out = fn(state.master_params, batch, state.scaler,
+                         state.rng, state.global_steps)
+                pieces, fin, sumsq = out[:3]
+                if k == 0:
+                    losses = out[3]
+                for j, i in enumerate(gidx):
+                    pieces_by_leaf[i] = pieces[j]
+                finites.append(fin)
+                sumsqs.append(sumsq)
+            return update_jit(state, tuple(pieces_by_leaf),
+                              tuple(finites), tuple(sumsqs), losses)
+
+        return train_step
 
     def _train_batch_offload(self, batch):
         scaler = self.state.scaler

@@ -12,6 +12,8 @@ python bench_cpu_adam.py > BENCH_cpu_adam.txt 2>> "$log"
 echo "=== cpu_adam rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
 python diag_offload.py --full > DIAG_offload_run.log 2>&1
 echo "=== diag rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
-git add -A BENCH_*.json BENCH_*.txt DIAG_offload* recovery_run.log bench_suite.log 2>> "$log"
+# add the whole tree: a pathspec list aborts (staging NOTHING) if any
+# one artifact is missing, which is exactly the degraded case
+git add -A >> "$log" 2>&1
 git commit -q -m "Hardware bench artifacts: north star + suite + offload diagnosis" >> "$log" 2>&1
 echo "=== recovery run done $(date -u +%H:%M:%S) ===" >> "$log"

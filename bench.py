@@ -158,6 +158,10 @@ def _bench_15b(jax, impl: str = "xla"):
                            vocab_size=50257, n_positions=1024,
                            remat="block", scan_layers=True)
     micro, ga, steps, _ = _15b_knobs()
+    # OOM insurance: BENCH_15B_CHUNKS=K bounds device grad bytes to the
+    # largest of K groups (offload_grad_chunks capacity mode) at K
+    # forward recomputes — a fallback knob, not the default
+    chunks = int(os.environ.get("BENCH_15B_CHUNKS", "0"))
     seq = 1024
     mesh = build_mesh(devices=jax.devices()[:1])
     ds_cfg = DeepSpeedConfig({
@@ -166,8 +170,10 @@ def _bench_15b(jax, impl: str = "xla"):
         "steps_per_print": 10 ** 9,
         "bf16": {"enabled": True},
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-        "zero_optimization": {"stage": 2, "cpu_offload": True,
-                              "offload_impl": impl},
+        "zero_optimization": dict(
+            {"stage": 2, "cpu_offload": True, "offload_impl": impl},
+            **({"offload_grad_chunks": chunks}
+               if impl == "xla" and chunks > 1 else {})),
     }, world_size=1)
     _mark(f"1.5B[{impl}]: constructing engine (param init + host staging)")
     engine = DeepSpeedEngine(GPT2Model(cfg_model), ds_cfg, mesh=mesh)

@@ -8,7 +8,6 @@ deepspeed/ops/sparse_attention/sparse_self_attention.py:83-142).
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

@@ -50,7 +50,6 @@ all-gather.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Optional
 
 import jax

@@ -435,3 +435,36 @@ def test_pipeline_sp_rejects_non_uniform_partition():
         0, 128, (cfg.train_batch_size, 33), dtype=np.int32)
     with pytest.raises(NotImplementedError, match="uniform"):
         eng.train_batch(split_gpt2_batch(toks))
+
+
+@pytest.mark.slow
+def test_pipeline_sequence_parallel_ulysses():
+    """PP × SP with the Ulysses (all-to-all head-scatter) implementation
+    inside the pipeline's uniform-stage body — same composition slot as
+    ring, differential against ring on the identical mesh/batch."""
+    from deepspeed_tpu.models import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import (build_gpt2_pipe,
+                                                split_gpt2_batch)
+
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 4,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }, world_size=2)
+    mesh = build_mesh(pp=2, dp=2, sp=2, tp=1)
+    toks = np.random.default_rng(9).integers(
+        0, 128, (cfg.train_batch_size, 33), dtype=np.int32)
+    losses = {}
+    for impl in ("ring", "ulysses"):
+        cm = GPT2Config(vocab_size=128, n_positions=64, d_model=32,
+                        n_layer=2, n_head=4, remat=None, attn_impl=impl,
+                        dropout=0.0, embd_dropout=0.0)
+        eng = PipelineEngine(build_gpt2_pipe(cm, num_stages=2), cfg, mesh)
+        losses[impl] = [
+            float(np.asarray(eng.train_batch(split_gpt2_batch(toks))))
+            for _ in range(3)]
+    diffs = [abs(a - b) for a, b in zip(losses["ring"], losses["ulysses"])]
+    assert max(diffs) < 2e-3, (losses, diffs)

@@ -119,6 +119,18 @@ def _run(engine, tokens, steps, warmup=1):
     loss = float(np.asarray(loss))
     dt = (time.perf_counter() - t0) / steps
     assert np.isfinite(loss), f"non-finite loss {loss}"
+    if os.environ.get("BENCH_PROFILE") == "1":
+        # one traced step AFTER measurement (tracing skews timing):
+        # the xplane shows host-section vs device vs transfer time —
+        # the data that decides whether delayed-param-update is needed
+        try:
+            import jax
+            _mark("profiling one step -> bench_trace/")
+            with jax.profiler.trace("bench_trace"):
+                np.asarray(engine.train_batch(tokens))
+            _mark("profile captured")
+        except Exception as e:  # profiling must never kill the bench
+            _mark(f"profile failed: {e}")
     return dt, loss
 
 

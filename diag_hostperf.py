@@ -1,0 +1,139 @@
+"""Hardware probes for the two open offload design questions (round 3).
+
+1. host-section bandwidth: how fast does an XLA ``compute_on
+   ('device_host')`` elementwise pass run over pinned_host data on THIS
+   platform?  The 1.5B step's host Adam touches ~37 GB of pinned_host
+   state per optimizer step; at the measured GB/s this either vanishes
+   behind ga=32 amortization or dominates the step — the direct signal
+   for whether a delayed-parameter-update overlap is worth building.
+
+2. param streaming: can a lax.scan consume a HOST-resident stacked
+   array one slice per iteration without materializing the whole array
+   in device memory (checked via memory_stats peak deltas)?  If yes,
+   ZeRO-Infinity-style param streaming (device param bytes ~ one layer)
+   is expressible directly in XLA — the capacity path past the 2 bytes/
+   param floor that bounds offload_grad_chunks.
+
+Run on a healthy tunnel: ``python diag_hostperf.py``.  Writes
+DIAG_hostperf.json.  CPU smoke: pinned_host degrades to device memory,
+numbers are meaningless but the program shapes are validated.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _mark(m):
+    print(f"[hostperf] {m}", file=sys.stderr, flush=True)
+
+
+def bench_host_section(jax, jnp, real_host: bool, gb: float = 1.0):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = None
+    from deepspeed_tpu.parallel import build_mesh
+    mesh = build_mesh(devices=jax.devices()[:1])
+    sh = NamedSharding(mesh, P())
+    host_sh = sh.with_memory_kind("pinned_host") if real_host else sh
+    n = int(gb * (1 << 30) / 4)
+    _mark(f"allocating {gb} GiB in {'pinned_host' if real_host else 'device'}")
+    x = jax.device_put(jnp.zeros((n,), jnp.float32), host_sh)
+    y = jax.device_put(jnp.ones((n,), jnp.float32), host_sh)
+
+    def host_fma(x, y):
+        if real_host:
+            from jax.experimental import compute_on
+            with compute_on.compute_on("device_host"):
+                out = x * 0.999 + y * 1e-3
+        else:
+            out = x * 0.999 + y * 1e-3
+        return out
+
+    f = jax.jit(host_fma, out_shardings=host_sh, donate_argnums=(0,))
+    x = f(x, y)
+    jax.block_until_ready(x)
+    t0 = time.perf_counter()
+    iters = 5
+    for _ in range(iters):
+        x = f(x, y)
+    jax.block_until_ready(x)
+    dt = (time.perf_counter() - t0) / iters
+    gbs = 3 * gb / dt  # 2 reads + 1 write per element
+    _mark(f"host-section fma: {dt*1e3:.1f} ms/pass -> {gbs:.1f} GB/s")
+    return {"host_fma_ms": round(dt * 1e3, 2),
+            "host_fma_gbps": round(gbs, 2)}
+
+
+def bench_param_stream(jax, jnp, real_host: bool, layers=16, mb=64):
+    """Scan over a host-resident [L, n] stack, one slice used per
+    iteration; compare device peak_bytes delta to full-stack size."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.parallel import build_mesh
+    mesh = build_mesh(devices=jax.devices()[:1])
+    sh = NamedSharding(mesh, P())
+    host_sh = sh.with_memory_kind("pinned_host") if real_host else sh
+    n = int(mb * (1 << 20) / 4)
+    stack_bytes = layers * n * 4
+    _mark(f"staging [{layers}, {n}] ({stack_bytes >> 20} MiB) on host")
+    stack = jax.device_put(jnp.ones((layers, n), jnp.float32), host_sh)
+    d = jax.local_devices()[0]
+
+    def stats():
+        try:
+            return dict(d.memory_stats() or {})
+        except Exception:
+            return {}
+
+    def step(stack, x):
+        def body(carry, i):
+            w = jax.lax.dynamic_index_in_dim(stack, i, 0, keepdims=False)
+            w = jax.device_put(w, sh)  # host -> device, one layer
+            return carry * 0.5 + jnp.dot(w[:8], carry[:8]) * 0.01, None
+
+        out, _ = jax.lax.scan(body, x, jnp.arange(layers))
+        return out
+
+    f = jax.jit(step)
+    x = jnp.ones((n,), jnp.float32)
+    before = stats().get("peak_bytes_in_use", 0)
+    out = f(stack, x)
+    jax.block_until_ready(out)
+    after = stats().get("peak_bytes_in_use", 0)
+    delta = after - before
+    streamed = bool(after and delta < stack_bytes * 0.6)
+    _mark(f"peak_bytes delta {delta >> 20} MiB vs stack "
+          f"{stack_bytes >> 20} MiB -> "
+          f"{'STREAMED' if streamed else 'materialized/unknown'}")
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = f(stack, out)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / 3
+    return {"stack_mb": stack_bytes >> 20,
+            "peak_delta_mb": int(delta) >> 20 if after else None,
+            "streamed": streamed if after else None,
+            "scan_ms": round(dt * 1e3, 2),
+            "stream_gbps": round(stack_bytes / (1 << 30) / dt, 2)}
+
+
+def main():
+    sys.path.insert(0, ".")
+    from bench import guarded_devices
+    devices = guarded_devices()
+    on_tpu = devices[0].platform != "cpu"
+    import jax
+    import jax.numpy as jnp
+    rec = {"device": str(devices[0]), "real_host": on_tpu}
+    gb = 1.0 if on_tpu else 0.02
+    rec["host_section"] = bench_host_section(jax, jnp, on_tpu, gb=gb)
+    rec["param_stream"] = bench_param_stream(
+        jax, jnp, on_tpu, layers=16, mb=256 if on_tpu else 4)
+    print(json.dumps(rec))
+    if on_tpu:
+        with open("DIAG_hostperf.json", "w") as f:
+            json.dump(rec, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

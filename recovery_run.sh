@@ -10,6 +10,8 @@ python bench.py > BENCH_r03_raw.json 2>> "$log"
 echo "=== bench.py rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
 python bench_cpu_adam.py > BENCH_cpu_adam.txt 2>> "$log"
 echo "=== cpu_adam rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
+python diag_hostperf.py > DIAG_hostperf_run.log 2>&1
+echo "=== hostperf rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
 python diag_offload.py --full > DIAG_offload_run.log 2>&1
 echo "=== diag rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
 # add the whole tree: a pathspec list aborts (staging NOTHING) if any

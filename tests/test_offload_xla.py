@@ -350,3 +350,17 @@ def test_chunked_grads_config_sanity():
             "zero_optimization": {"stage": 2,
                                   "offload_grad_chunks": 2},
         }, world_size=4)
+
+
+def test_grad_group_partition_is_balanced(mesh):
+    """Greedy size-balanced partition: every leaf appears exactly once
+    and the heaviest group is within 2x of the ideal share."""
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=32, nlayers=6),
+                          _cfg(True), mesh=mesh)
+    for k in (2, 3, 5):
+        groups = eng._grad_group_indices(k)
+        flat = sorted(i for g in groups for i in g)
+        assert flat == list(range(len(eng._flat_sizes)))
+        loads = [sum(eng._flat_sizes[i] for i in g) for g in groups]
+        ideal = sum(eng._flat_sizes) / len(groups)
+        assert max(loads) <= 2 * ideal + max(eng._flat_sizes)

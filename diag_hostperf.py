@@ -85,7 +85,17 @@ def bench_param_stream(jax, jnp, real_host: bool, layers=16, mb=64):
         except Exception:
             return {}
 
-    def step(stack, x):
+    # two access patterns — GPT-2's scan consumes stacked params as scan
+    # XS (models/gpt2.py:171); the closure+dynamic_index form is the
+    # fallback shape a streaming redesign would use if xs don't stream
+    def step_xs(stack, x):
+        def body(carry, w):
+            return carry * 0.5 + jnp.dot(w[:8], carry[:8]) * 0.01, None
+
+        out, _ = jax.lax.scan(body, x, stack)
+        return out
+
+    def step_index(stack, x):
         def body(carry, i):
             w = jax.lax.dynamic_index_in_dim(stack, i, 0, keepdims=False)
             w = jax.device_put(w, sh)  # host -> device, one layer
@@ -94,27 +104,35 @@ def bench_param_stream(jax, jnp, real_host: bool, layers=16, mb=64):
         out, _ = jax.lax.scan(body, x, jnp.arange(layers))
         return out
 
-    f = jax.jit(step)
+    rec = {"stack_mb": stack_bytes >> 20}
     x = jnp.ones((n,), jnp.float32)
-    before = stats().get("peak_bytes_in_use", 0)
-    out = f(stack, x)
-    jax.block_until_ready(out)
-    after = stats().get("peak_bytes_in_use", 0)
-    delta = after - before
-    streamed = bool(after and delta < stack_bytes * 0.6)
-    _mark(f"peak_bytes delta {delta >> 20} MiB vs stack "
-          f"{stack_bytes >> 20} MiB -> "
-          f"{'STREAMED' if streamed else 'materialized/unknown'}")
-    t0 = time.perf_counter()
-    for _ in range(3):
-        out = f(stack, out)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / 3
-    return {"stack_mb": stack_bytes >> 20,
+    for name, fn in (("xs", step_xs), ("index", step_index)):
+        f = jax.jit(fn)
+        before = stats().get("peak_bytes_in_use", 0)
+        try:
+            out = f(stack, x)
+            jax.block_until_ready(out)
+        except Exception as e:
+            _mark(f"{name}: FAILED {type(e).__name__}: {e}")
+            rec[name] = {"error": str(e)[:200]}
+            continue
+        after = stats().get("peak_bytes_in_use", 0)
+        delta = after - before
+        streamed = bool(after and delta < stack_bytes * 0.6)
+        _mark(f"{name}: peak delta {delta >> 20} MiB vs stack "
+              f"{stack_bytes >> 20} MiB -> "
+              f"{'STREAMED' if streamed else 'materialized/unknown'}")
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = f(stack, out)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / 3
+        rec[name] = {
             "peak_delta_mb": int(delta) >> 20 if after else None,
             "streamed": streamed if after else None,
             "scan_ms": round(dt * 1e3, 2),
             "stream_gbps": round(stack_bytes / (1 << 30) / dt, 2)}
+    return rec
 
 
 def main():

@@ -75,6 +75,9 @@ class DeepSpeedZeroConfig:
         self.offload_grad_chunks = get_scalar_param(
             zero, C.ZERO_OFFLOAD_GRAD_CHUNKS,
             C.ZERO_OFFLOAD_GRAD_CHUNKS_DEFAULT)
+        self.delayed_param_update = get_scalar_param(
+            zero, C.ZERO_DELAYED_PARAM_UPDATE,
+            C.ZERO_DELAYED_PARAM_UPDATE_DEFAULT)
         if (not isinstance(self.offload_grad_chunks, int)
                 or self.offload_grad_chunks < 1):
             raise DeepSpeedConfigError(
@@ -425,6 +428,18 @@ class DeepSpeedConfig:
                 raise DeepSpeedConfigError(
                     "offload_grad_chunks > 1 is an xla-tier capacity mode "
                     "(offload_impl 'xla' or 'auto')")
+        if self.zero_config.delayed_param_update:
+            if not self.zero_config.cpu_offload:
+                raise DeepSpeedConfigError(
+                    "delayed_param_update requires cpu_offload")
+            if self.zero_config.offload_impl == "xla":
+                raise DeepSpeedConfigError(
+                    "delayed_param_update is a host-tier overlap (the C++ "
+                    "Adam runs concurrently with the next device step); "
+                    "the xla tier's update is already inside the compiled "
+                    "step. Set offload_impl 'host' explicitly ('auto' "
+                    "resolves to xla on TPU and the engine will reject "
+                    "the combination there).")
         if self.optimizer_name is not None and self.optimizer_name in (
                 C.ONEBIT_ADAM_OPTIMIZER,) and not (self.fp16_enabled or self.bf16_enabled):
             raise DeepSpeedConfigError("onebitadam requires fp16 or bf16")

@@ -141,6 +141,14 @@ ZERO_OFFLOAD_IMPL_DEFAULT = "auto"
 # pinned host buffers during backward (stage2.py:743-816).  1 = off.
 ZERO_OFFLOAD_GRAD_CHUNKS = "offload_grad_chunks"
 ZERO_OFFLOAD_GRAD_CHUNKS_DEFAULT = 1
+# TPU extension (host tier): delayed parameter update — the host Adam
+# for step t runs concurrently with the device forward/backward of step
+# t+1, which therefore uses one-step-stale parameters (the ZeRO-Offload
+# paper's DPU; the reference repo gained it after v0.3.2).  Off by
+# default: staleness changes numerics slightly, so it is opt-in like
+# the paper describes (enable after convergence stabilizes).
+ZERO_DELAYED_PARAM_UPDATE = "delayed_param_update"
+ZERO_DELAYED_PARAM_UPDATE_DEFAULT = False
 ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_ELASTIC_CHECKPOINT_DEFAULT = True
 ZERO_MAX_ELEMENTS_PER_COMM = "max_elements_per_comm"

@@ -428,6 +428,8 @@ class DeepSpeedEngine:
                 is_leaf=lambda x: isinstance(x, P))
             self._compute_params = _device_put_tree(
                 self._host_opt.compute_params(), self._compute_shardings)
+            self._dpu = bool(config.zero_config.delayed_param_update)
+            self._dpu_pending = None
             master = self._host_opt.master       # host numpy identity
             opt_state = self._host_opt.state_tree()
         elif self._onebit_path and self.dp_world_size > 1:
@@ -457,6 +459,13 @@ class DeepSpeedEngine:
             self._grad_step = self._build_offload_grad_step()
             self._offload_eval_step = self._build_offload_eval_step()
         elif self._offload:
+            if bool(config.zero_config.delayed_param_update):
+                # 'auto' resolves per-platform — never ignore the knob
+                raise ValueError(
+                    "delayed_param_update is a host-tier overlap; "
+                    "offload_impl resolved to 'xla' on this platform "
+                    "(its update is already inside the compiled step). "
+                    "Set offload_impl='host' explicitly.")
             chunks = int(getattr(config.zero_config,
                                  "offload_grad_chunks", 1) or 1)
             chunks = min(chunks, len(self._flat_sizes))
@@ -1585,6 +1594,20 @@ class DeepSpeedEngine:
 
         return train_step
 
+    def _apply_host_update(self, grads):
+        """C++ Adam over host grads + async re-upload of compute params."""
+        lowp = self._host_opt.step(grads)
+        self._compute_params = _device_put_tree(
+            lowp, self._compute_shardings)
+
+    def _dpu_flush(self):
+        """Apply a pending delayed update (checkpoint save, eval, and
+        state sync must see the fully-applied master)."""
+        pending = getattr(self, "_dpu_pending", None)
+        if pending is not None:
+            self._dpu_pending = None
+            self._apply_host_update(pending)
+
     def _train_batch_offload(self, batch):
         scaler = self.state.scaler
         step_rng = jax.random.fold_in(self.state.rng,
@@ -1592,23 +1615,44 @@ class DeepSpeedEngine:
         with self._pallas_scope():
             grads, loss, finite, grad_norm = self._grad_step(
                 self._compute_params, batch, scaler.loss_scale, step_rng)
-        finite_b = bool(finite)
-        if finite_b:
-            # Device → host staging overlapped with the host Adam: start
-            # EVERY leaf's D2H transfer asynchronously, then hand the jax
-            # arrays straight to the optimizer — its per-leaf np.asarray
-            # blocks only for that leaf while later leaves stream behind
-            # the C++ Adam of earlier ones (the reference's pinned-tile
-            # double buffering, csrc/adam/cpu_adam.cpp:64-113, done by the
-            # transfer engine instead of hand-rolled buffers).
-            # Single-controller: this host assembles the FULL gradient and
-            # owns the full master (host RAM is the resource offload
-            # spends; HBM is what it frees).
-            for g in jax.tree.leaves(grads):
-                g.copy_to_host_async()
-            lowp = self._host_opt.step(grads)
-            self._compute_params = _device_put_tree(
-                lowp, self._compute_shardings)
+        if self._dpu:
+            # Delayed parameter update (ZeRO-Offload paper's DPU; the
+            # reference repo gained it after v0.3.2): step t's device
+            # fwd/bwd is ALREADY dispatched above on one-step-stale
+            # params — running step t-1's C++ Adam now overlaps it for
+            # real (device crunches in the background while this Python
+            # thread drives the OpenMP kernel).  finite(t-1) was
+            # resolved at the end of the previous call, so loss-scale
+            # semantics are exact; only the weight application lags one
+            # step.
+            self._dpu_flush()
+            finite_b = bool(finite)  # syncs: step t's compute done
+            if finite_b:
+                for g in jax.tree.leaves(grads):
+                    g.copy_to_host_async()
+                # stash HOST copies: keeping the jax arrays would pin a
+                # full device gradient tree alive across the next step
+                # (one extra grad tree of peak HBM — the opposite of
+                # offload's point).  The async D2H is in flight, so these
+                # np.asarray calls barely block.
+                self._dpu_pending = jax.tree.map(np.asarray, grads)
+        else:
+            finite_b = bool(finite)
+            if finite_b:
+                # Device → host staging overlapped with the host Adam:
+                # start EVERY leaf's D2H transfer asynchronously, then
+                # hand the jax arrays straight to the optimizer — its
+                # per-leaf np.asarray blocks only for that leaf while
+                # later leaves stream behind the C++ Adam of earlier ones
+                # (the reference's pinned-tile double buffering,
+                # csrc/adam/cpu_adam.cpp:64-113, done by the transfer
+                # engine instead of hand-rolled buffers).
+                # Single-controller: this host assembles the FULL gradient
+                # and owns the full master (host RAM is the resource
+                # offload spends; HBM is what it frees).
+                for g in jax.tree.leaves(grads):
+                    g.copy_to_host_async()
+                self._apply_host_update(grads)
         new_scaler = precision.update_scale(
             scaler, jnp.asarray(finite_b), self.loss_scale_config)
         self.state = TrainState(
@@ -1689,6 +1733,7 @@ class DeepSpeedEngine:
         """After a checkpoint load replaced engine.state with device/loaded
         arrays: copy them back into the host buffers (identity-preserving)
         and refresh the device compute params."""
+        self._dpu_pending = None  # loaded state supersedes any pending
         opt_tree = self.state.opt_state
         if not (isinstance(opt_tree, dict) and "mu" in opt_tree):
             # module-only restore path: fresh moments (the loader built a
@@ -1904,6 +1949,7 @@ class DeepSpeedEngine:
         rng = jax.random.fold_in(self._data_rng, self.micro_steps)
         with self._pallas_scope():
             if self._offload_host:
+                self._dpu_flush()  # eval on fully-applied params
                 return self._offload_eval_step(self._compute_params,
                                                micro, rng)
             return self._eval_step(self.state, micro, rng)
@@ -1916,6 +1962,7 @@ class DeepSpeedEngine:
         micro = jax.tree.map(np.asarray, batch)
         with self._pallas_scope():
             if self._offload_host:
+                self._dpu_flush()  # same view as eval_batch
                 loss = self._offload_eval_step(self._compute_params,
                                                micro, rng)
             else:
@@ -1950,6 +1997,8 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
+        if self._offload_host:
+            self._dpu_flush()  # the saved master must be fully applied
         from .checkpointing import save_checkpoint
         return save_checkpoint(self, save_dir, tag=tag,
                                client_state=client_state,

@@ -432,14 +432,6 @@ class DeepSpeedConfig:
             if not self.zero_config.cpu_offload:
                 raise DeepSpeedConfigError(
                     "delayed_param_update requires cpu_offload")
-            if self.zero_config.offload_impl == "xla":
-                raise DeepSpeedConfigError(
-                    "delayed_param_update is a host-tier overlap (the C++ "
-                    "Adam runs concurrently with the next device step); "
-                    "the xla tier's update is already inside the compiled "
-                    "step. Set offload_impl 'host' explicitly ('auto' "
-                    "resolves to xla on TPU and the engine will reject "
-                    "the combination there).")
         if self.optimizer_name is not None and self.optimizer_name in (
                 C.ONEBIT_ADAM_OPTIMIZER,) and not (self.fp16_enabled or self.bf16_enabled):
             raise DeepSpeedConfigError("onebitadam requires fp16 or bf16")

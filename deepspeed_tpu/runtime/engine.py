@@ -459,19 +459,17 @@ class DeepSpeedEngine:
             self._grad_step = self._build_offload_grad_step()
             self._offload_eval_step = self._build_offload_eval_step()
         elif self._offload:
-            if bool(config.zero_config.delayed_param_update):
-                # 'auto' resolves per-platform — never ignore the knob
-                raise ValueError(
-                    "delayed_param_update is a host-tier overlap; "
-                    "offload_impl resolved to 'xla' on this platform "
-                    "(its update is already inside the compiled step). "
-                    "Set offload_impl='host' explicitly.")
             chunks = int(getattr(config.zero_config,
                                  "offload_grad_chunks", 1) or 1)
             chunks = min(chunks, len(self._flat_sizes))
-            if chunks > 1:
+            dpu_xla = bool(config.zero_config.delayed_param_update)
+            self._xla_dpu_pending = None
+            self._xla_dpu_update = None
+            self._xla_dpu_dispatch = 0
+            if chunks > 1 or dpu_xla:
                 self._train_step = self._build_chunked_offload_steps(
-                    self._grad_group_indices(chunks))
+                    self._grad_group_indices(max(chunks, 1)),
+                    delayed=dpu_xla)
             else:
                 self._train_step = self._build_xla_offload_step()
             self._eval_step = self._build_xla_offload_eval_step()
@@ -1447,7 +1445,7 @@ class DeepSpeedEngine:
             loads[g] += self._flat_sizes[i]
         return [sorted(g) for g in groups if g]
 
-    def _build_chunked_offload_steps(self, groups):
+    def _build_chunked_offload_steps(self, groups, delayed: bool = False):
         compute_dtype = self.compute_dtype
         clip = self.gradient_clipping
         scale_config = self.loss_scale_config
@@ -1519,7 +1517,11 @@ class DeepSpeedEngine:
                     p = jax.lax.with_sharding_constraint(p, piece_dev)
                     pieces.append(jax.device_put(p, piece_host))
                 out = (tuple(pieces), finite, sumsq)
-                return out + ((scaled_losses,) if first else ())
+                if first:
+                    mean_loss = (jnp.mean(scaled_losses)
+                                 / scaler.loss_scale)
+                    out = out + (mean_loss,)
+                return out
 
             return jax.jit(grad_fn)
 
@@ -1527,7 +1529,7 @@ class DeepSpeedEngine:
                     for k, g in enumerate(groups)]
 
         def update_fn(state: TrainState, gpieces, finites, sumsqs,
-                      losses):
+                      mean_loss):
             # per-group stats combine INSIDE the one compiled program —
             # eager op-by-op combination would dispatch ~2K tiny programs
             # per step (the class of overhead prior rounds removed)
@@ -1535,7 +1537,6 @@ class DeepSpeedEngine:
             for f in finites[1:]:
                 finite = jnp.logical_and(finite, f)
             grad_norm = jnp.sqrt(sum(sumsqs))
-            mean_loss = jnp.mean(losses) / state.scaler.loss_scale
             opt = state.opt_state
             count1 = opt.count + 1
             count_f = count1.astype(jnp.float32)
@@ -1573,24 +1574,83 @@ class DeepSpeedEngine:
             master_params=host_tuple,
             opt_state=FusedAdamState(count=dev, mu=host_tuple,
                                      nu=host_tuple))
-        update_jit = jax.jit(update_fn, donate_argnums=(0,),
-                             out_shardings=(state_shardings, dev))
+        # DPU: no donation — the update for step t-1 runs while the
+        # already-dispatched grad program for step t still READS the same
+        # master pieces, so aliasing would be refused anyway (ping-pong
+        # buffers; transient 2× host state is the price of the overlap)
+        update_jit = jax.jit(
+            update_fn, donate_argnums=(() if delayed else (0,)),
+            out_shardings=(state_shardings, dev))
+        self._xla_dpu_update = update_jit if delayed else None
 
-        def train_step(state: TrainState, batch):
+        def run_grads(state, batch, step_seed):
             pieces_by_leaf = [None] * n_leaves
-            finites, sumsqs, losses = [], [], None
+            finites, sumsqs, mean_loss = [], [], None
             for k, (gidx, fn) in enumerate(zip(groups, grad_fns)):
                 out = fn(state.master_params, batch, state.scaler,
-                         state.rng, state.global_steps)
+                         state.rng, step_seed)
                 pieces, fin, sumsq = out[:3]
                 if k == 0:
-                    losses = out[3]
+                    mean_loss = out[3]
                 for j, i in enumerate(gidx):
                     pieces_by_leaf[i] = pieces[j]
                 finites.append(fin)
                 sumsqs.append(sumsq)
-            return update_jit(state, tuple(pieces_by_leaf),
-                              tuple(finites), tuple(sumsqs), losses)
+            return (tuple(pieces_by_leaf), tuple(finites), tuple(sumsqs),
+                    mean_loss)
+
+        if not delayed:
+            def train_step(state: TrainState, batch):
+                gp, fins, ssqs, mean_loss = run_grads(
+                    state, batch, state.global_steps)
+                return update_jit(state, gp, fins, ssqs, mean_loss)
+
+            return train_step
+
+        # ---- delayed parameter update (xla tier) ----
+        # Dispatch step t's grad program(s) on the CURRENT (one-step-
+        # stale) master FIRST, then apply step t-1's pending update: the
+        # device crunches t's fwd/bwd while the update's host section
+        # runs — the overlap the single-program step structurally cannot
+        # have (its host Adam sits between the grads and the next cast-
+        # up of the SAME step).  Returned packed metrics carry step t's
+        # loss with step t-1's grad_norm/scale/lr (one tiny .at[].set
+        # per step, DPU mode only).
+        #
+        # Loss-scale exactness: finite(t-1) is synced BEFORE dispatching
+        # step t.  On the (rare) overflow, the pending update is applied
+        # FIRST — forgoing one step's overlap — so step t's grads run at
+        # the reacted scale and one overflow costs exactly one skip, not
+        # two (the host-tier DPU has the same ordering guarantee).
+        #
+        # rng: a host-side dispatch counter seeds the per-step rng fold —
+        # state.global_steps lags behind dispatches by one (and stalls
+        # across flushes), which would hand consecutive steps identical
+        # dropout masks.
+        def train_step(state: TrainState, batch):
+            prev = self._xla_dpu_pending
+            if prev is not None:
+                prev_finite = all(bool(f) for f in prev[1])
+                if not prev_finite:
+                    # react to the overflow before dispatching new grads
+                    self._xla_dpu_pending = None
+                    state, _ = update_jit(state, *prev)
+                    prev = None
+            seed = jnp.asarray(self._xla_dpu_dispatch, jnp.int32)
+            self._xla_dpu_dispatch += 1
+            gp, fins, ssqs, mean_loss = run_grads(state, batch, seed)
+            self._xla_dpu_pending = (gp, fins, ssqs, mean_loss)
+            if prev is not None:
+                new_state, packed = update_jit(state, *prev)
+            else:
+                new_state = state
+                applied = state.global_steps - state.skipped_steps
+                packed = self._packed_metrics(
+                    jnp.asarray(0.0, jnp.float32),
+                    jnp.asarray(0.0, jnp.float32), state.scaler,
+                    jnp.asarray(True), self._lr_at_fn()(applied))
+            packed = packed.at[0].set(mean_loss.astype(jnp.float32))
+            return new_state, packed
 
         return train_step
 
@@ -1607,6 +1667,14 @@ class DeepSpeedEngine:
         if pending is not None:
             self._dpu_pending = None
             self._apply_host_update(pending)
+
+    def _xla_dpu_flush(self):
+        """xla-tier analogue: run the deferred update program so
+        engine.state reflects every gradient computed so far."""
+        pending = getattr(self, "_xla_dpu_pending", None)
+        if pending is not None and self._xla_dpu_update is not None:
+            self._xla_dpu_pending = None
+            self.state, _ = self._xla_dpu_update(self.state, *pending)
 
     def _train_batch_offload(self, batch):
         scaler = self.state.scaler
@@ -1710,6 +1778,12 @@ class DeepSpeedEngine:
         """Convert loaded canonical trees to the engine's internal form."""
         if not self._offload_xla:
             return master_tree, opt_tree
+        self._xla_dpu_pending = None  # loaded state supersedes pending
+        if opt_tree is not None:
+            # continue the DPU rng stream past the restored step count
+            # instead of replaying seeds 0..t's dropout masks
+            self._xla_dpu_dispatch = int(
+                np.asarray(jax.device_get(opt_tree.count)))
         dev = NamedSharding(self.mesh, P())
 
         def put_pieces(tree):
@@ -1952,6 +2026,8 @@ class DeepSpeedEngine:
                 self._dpu_flush()  # eval on fully-applied params
                 return self._offload_eval_step(self._compute_params,
                                                micro, rng)
+            if self._offload_xla:
+                self._xla_dpu_flush()
             return self._eval_step(self.state, micro, rng)
 
     # --- reference-style imperative facade -----------------------------
@@ -1966,6 +2042,8 @@ class DeepSpeedEngine:
                 loss = self._offload_eval_step(self._compute_params,
                                                micro, rng)
             else:
+                if self._offload_xla:
+                    self._xla_dpu_flush()
                 loss = self._eval_step(self.state, micro, rng)
         self._pending_micros.append(batch)
         return loss
@@ -1999,6 +2077,8 @@ class DeepSpeedEngine:
                         save_latest=True):
         if self._offload_host:
             self._dpu_flush()  # the saved master must be fully applied
+        elif self._offload_xla:
+            self._xla_dpu_flush()
         from .checkpointing import save_checkpoint
         return save_checkpoint(self, save_dir, tag=tag,
                                client_state=client_state,

@@ -364,3 +364,77 @@ def test_grad_group_partition_is_balanced(mesh):
         loads = [sum(eng._flat_sizes[i] for i in g) for g in groups]
         ideal = sum(eng._flat_sizes) / len(groups)
         assert max(loads) <= 2 * ideal + max(eng._flat_sizes)
+
+
+def test_xla_dpu_staleness_and_flush(mesh, tmp_path):
+    """xla-tier delayed parameter update: steps 0/1 compute at the same
+    (initial) master with a fixed batch; save_checkpoint flushes the
+    pending update and the loaded engine continues identically."""
+    def cfg(dpu):
+        return DeepSpeedConfig({
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 10 ** 9,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2, "cpu_offload": True,
+                                  "offload_impl": "xla",
+                                  "delayed_param_update": dpu},
+        }, world_size=4)
+    x, y = _batch()
+    ed = DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg(True), mesh=mesh,
+                         seed=3)
+    l0 = float(np.asarray(ed.train_batch((x, y))))
+    l1 = float(np.asarray(ed.train_batch((x, y))))
+    assert l0 == pytest.approx(l1, abs=1e-7), "DPU steps 0/1 share params"
+    en = DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg(False), mesh=mesh,
+                         seed=3)
+    n0 = float(np.asarray(en.train_batch((x, y))))
+    n1 = float(np.asarray(en.train_batch((x, y))))
+    assert n0 == pytest.approx(l0, abs=1e-6)
+    assert abs(n1 - n0) > 1e-6
+
+    losses = [float(np.asarray(ed.train_batch((x, y)))) for _ in range(20)]
+    assert losses[-1] < l0 * 0.95, (l0, losses[-3:])
+
+    ed.save_checkpoint(str(tmp_path), tag="t")
+    assert ed._xla_dpu_pending is None  # flushed
+    ref = float(np.asarray(ed.train_batch((x, y))))
+    e2 = DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg(True), mesh=mesh,
+                         seed=9)
+    path, _ = e2.load_checkpoint(str(tmp_path), tag="t")
+    assert path is not None
+    got = float(np.asarray(e2.train_batch((x, y))))
+    assert got == pytest.approx(ref, abs=1e-6)
+
+
+def test_xla_dpu_overflow_costs_one_skip(mesh):
+    """fp16 + dynamic scale under DPU: finite(t-1) is synced before
+    dispatching step t, so one overflow event produces exactly one
+    skipped step and one halving — not the double penalty of grads
+    dispatched at a stale scale."""
+
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "fp16": {"enabled": True, "initial_scale_power": 8,
+                 "hysteresis": 1, "loss_scale_window": 1000},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "offload_impl": "xla",
+                              "delayed_param_update": True},
+    }, world_size=4)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg, mesh=mesh,
+                          seed=3)
+    x, y = _batch()
+    bad_x = x.copy()
+    bad_x[0, 0] = np.float32(3e38)  # inf in fp16 compute -> inf grads
+    eng.train_batch((bad_x, y))     # step 0: overflowing grads (pending)
+    eng.train_batch((x, y))         # step 1: syncs finite(0) -> skip+halve
+    eng.train_batch((x, y))         # step 2: applies step 1's good grads
+    eng._xla_dpu_flush()            # apply the last pending
+    assert eng.get_skipped_steps() == 1, eng.get_skipped_steps()
+    assert float(eng.state.scaler.loss_scale) == 2 ** 7
+    # applied steps: 2 good updates landed (steps 1 and 2)
+    assert int(eng.state.opt_state.count) == 2

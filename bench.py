@@ -174,6 +174,10 @@ def _bench_15b(jax, impl: str = "xla"):
     # largest of K groups (offload_grad_chunks capacity mode) at K
     # forward recomputes — a fallback knob, not the default
     chunks = int(os.environ.get("BENCH_15B_CHUNKS", "0"))
+    # BENCH_15B_DPU=1 overlaps the host Adam with the next step's
+    # compute (one-step param staleness) — flip on if the measured gap
+    # to 45% MFU matches the host-section time
+    dpu = os.environ.get("BENCH_15B_DPU", "0") == "1"
     seq = 1024
     mesh = build_mesh(devices=jax.devices()[:1])
     ds_cfg = DeepSpeedConfig({
@@ -185,7 +189,8 @@ def _bench_15b(jax, impl: str = "xla"):
         "zero_optimization": dict(
             {"stage": 2, "cpu_offload": True, "offload_impl": impl},
             **({"offload_grad_chunks": chunks}
-               if impl == "xla" and chunks > 1 else {})),
+               if impl == "xla" and chunks > 1 else {}),
+            **({"delayed_param_update": True} if dpu else {})),
     }, world_size=1)
     _mark(f"1.5B[{impl}]: constructing engine (param init + host staging)")
     engine = DeepSpeedEngine(GPT2Model(cfg_model), ds_cfg, mesh=mesh)

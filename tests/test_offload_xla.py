@@ -438,3 +438,27 @@ def test_xla_dpu_overflow_costs_one_skip(mesh):
     assert float(eng.state.scaler.loss_scale) == 2 ** 7
     # applied steps: 2 good updates landed (steps 1 and 2)
     assert int(eng.state.opt_state.count) == 2
+
+
+def test_chunked_plus_dpu_compose(mesh):
+    """offload_grad_chunks > 1 and delayed_param_update share one
+    builder; together they keep the staleness signature and converge."""
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "offload_impl": "xla",
+                              "offload_grad_chunks": 2,
+                              "delayed_param_update": True},
+    }, world_size=4)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=32, nlayers=4), cfg,
+                          mesh=mesh, seed=3)
+    x, y = _batch()
+    l0 = float(np.asarray(eng.train_batch((x, y))))
+    l1 = float(np.asarray(eng.train_batch((x, y))))
+    assert l0 == pytest.approx(l1, abs=1e-7)  # staleness signature
+    losses = [float(np.asarray(eng.train_batch((x, y)))) for _ in range(20)]
+    assert losses[-1] < l0 * 0.95

@@ -192,6 +192,11 @@ def _bench_15b(jax, impl: str = "xla"):
                if impl == "xla" and chunks > 1 else {}),
             **({"delayed_param_update": True} if dpu else {})),
     }, world_size=1)
+    if impl == "host":
+        # strict probe semantics for the bench: a slow-but-working link
+        # must fall through to the next tier, not eat the measurement
+        # window at minutes/step (library default is warn-and-proceed)
+        os.environ.setdefault("DS_OFFLOAD_SLOW_LINK", "error")
     _mark(f"1.5B[{impl}]: constructing engine (param init + host staging)")
     engine = DeepSpeedEngine(GPT2Model(cfg_model), ds_cfg, mesh=mesh)
     _mark(f"1.5B[{impl}]: engine ready; compiling + first step")

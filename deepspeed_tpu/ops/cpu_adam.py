@@ -88,15 +88,21 @@ class DeepSpeedCPUAdam:
             return float(self.lr(self.step_count))
         return float(self.lr)
 
-    def step(self, params, grads, out_dtype=None):
+    def step(self, params, grads, out_dtype=None, leaf_get=None):
         """params: pytree of numpy fp32 leaves (updated IN PLACE).
         grads: matching pytree whose leaves may be numpy OR jax Arrays —
-        each leaf goes through np.asarray inside the loop, so callers can
-        start async D2H copies for all leaves and have later transfers
-        overlap earlier leaves' Adam compute.  out_dtype: None |
-        'bfloat16' | 'float16' — fused low-precision copies returned as a
-        matching pytree of reinterpreted uint16 views."""
+        each leaf goes through ``leaf_get`` inside the loop, so callers
+        can start async D2H copies for all leaves and have later
+        transfers overlap earlier leaves' Adam compute.  ``leaf_get``
+        (default np.asarray to fp32) lets the offload tier substitute a
+        watchdogged pull that converts a mid-training link stall into a
+        clean error instead of an un-interruptible native hang.
+        out_dtype: None | 'bfloat16' | 'float16' — fused low-precision
+        copies returned as a matching pytree of reinterpreted uint16
+        views."""
         import jax
+        if leaf_get is None:
+            leaf_get = lambda a: np.asarray(a, dtype=np.float32)  # noqa: E731
         self.step_count += 1
         lr = self._lr_now()
         p_leaves, treedef = jax.tree.flatten(params)
@@ -117,7 +123,7 @@ class DeepSpeedCPUAdam:
             m, v = self._moments(i, p)
             flat_p = p.reshape(-1)
             flat_g = np.ascontiguousarray(
-                np.asarray(g, dtype=np.float32).reshape(-1))
+                np.asarray(leaf_get(g), dtype=np.float32).reshape(-1))
             out = (np.empty(flat_p.shape, np.uint16)
                    if lowp_kind else np.empty(0, np.uint16))
             if self._lib is not None:

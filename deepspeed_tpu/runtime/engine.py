@@ -1654,6 +1654,19 @@ class DeepSpeedEngine:
 
         return train_step
 
+    @staticmethod
+    def _start_small_leaf_d2h(grads):
+        """Kick off async D2H for leaves the guarded pull will fetch in
+        ONE native call (<= one chunk) — their later device_get just
+        syncs the in-flight copy.  Leaves ABOVE the chunk size are pulled
+        piece-wise by chunked_device_get; a full-leaf async copy for
+        those would move the same bytes over the wire twice."""
+        from .offload import pull_chunk_bytes
+        cb = pull_chunk_bytes()
+        for g in jax.tree.leaves(grads):
+            if cb <= 0 or getattr(g, "nbytes", 0) <= cb:
+                g.copy_to_host_async()
+
     def _apply_host_update(self, grads):
         """C++ Adam over host grads + async re-upload of compute params."""
         lowp = self._host_opt.step(grads)
@@ -1696,14 +1709,17 @@ class DeepSpeedEngine:
             self._dpu_flush()
             finite_b = bool(finite)  # syncs: step t's compute done
             if finite_b:
-                for g in jax.tree.leaves(grads):
-                    g.copy_to_host_async()
+                self._start_small_leaf_d2h(grads)
                 # stash HOST copies: keeping the jax arrays would pin a
                 # full device gradient tree alive across the next step
                 # (one extra grad tree of peak HBM — the opposite of
-                # offload's point).  The async D2H is in flight, so these
-                # np.asarray calls barely block.
-                self._dpu_pending = jax.tree.map(np.asarray, grads)
+                # offload's point).  Small leaves' async D2H is in
+                # flight, large leaves stream piece-wise — and every pull
+                # is watchdogged (dtype-preserving, so the stash stays at
+                # 1x the grads' bytes) so a link that degrades
+                # mid-training fails cleanly.
+                from .offload import guarded_tree_pull
+                self._dpu_pending = guarded_tree_pull(grads)
         else:
             finite_b = bool(finite)
             if finite_b:
@@ -1718,8 +1734,7 @@ class DeepSpeedEngine:
                 # Single-controller: this host assembles the FULL gradient
                 # and owns the full master (host RAM is the resource
                 # offload spends; HBM is what it frees).
-                for g in jax.tree.leaves(grads):
-                    g.copy_to_host_async()
+                self._start_small_leaf_d2h(grads)
                 self._apply_host_update(grads)
         new_scaler = precision.update_scale(
             scaler, jnp.asarray(finite_b), self.loss_scale_config)

@@ -25,6 +25,9 @@ flow, exactly what the reference does in Python, stage2.py:1341-1362).
 """
 from __future__ import annotations
 
+import os
+import threading
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -32,6 +35,225 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.cpu_adam import DeepSpeedCPUAdam
+from ..utils.logging import logger
+
+
+def _watchdog_get(x, timeout_s: float, what: str = "D2H transfer"):
+    """jax.device_get guarded by a daemon-thread watchdog.
+
+    Bulk transfers on a tunneled dev platform can stall *inside one
+    native call* — un-interruptible by signals (round-3 root cause,
+    BENCH_NOTES.md).  Running the pull in a daemon thread converts the
+    forever-stall into a RuntimeError after ``timeout_s``; the wedged
+    native call is abandoned (the thread never joins), which costs this
+    process its device handle but keeps the failure clean and lets the
+    caller fall back to another tier instead of hanging the session.
+    """
+    out: dict = {}
+    done = threading.Event()
+
+    def pull():
+        try:
+            out["v"] = np.asarray(jax.device_get(x))
+        except BaseException as e:  # surfaced to the caller below
+            out["e"] = e
+        finally:
+            done.set()
+
+    threading.Thread(target=pull, daemon=True).start()
+    if not done.wait(timeout=timeout_s):
+        nbytes = getattr(x, "nbytes", 0)
+        raise RuntimeError(
+            f"{what} ({nbytes >> 20} MB) did not complete within "
+            f"{timeout_s:.0f}s: bulk D2H appears stalled on this platform "
+            "(tunneled dev harness?). Aborting the pull piece-wise instead "
+            "of wedging the session; use offload_impl='xla' here.")
+    if "e" in out:
+        raise out["e"]
+    return out["v"]
+
+
+def pull_chunk_bytes() -> int:
+    """Piece size for guarded device->host pulls (DS_OFFLOAD_PULL_CHUNK_MB,
+    default 64 MB; <=0 disables chunking).  Exposed so the engine can
+    skip ``copy_to_host_async`` for leaves that will be pulled piece-wise
+    anyway — a full-leaf async copy alongside the slice pulls would move
+    every large leaf over the wire twice."""
+    return int(float(os.environ.get("DS_OFFLOAD_PULL_CHUNK_MB", "64"))
+               * (1 << 20))
+
+
+def chunked_device_get(x, chunk_mb: Optional[float] = None,
+                       piece_timeout: Optional[float] = None,
+                       what: str = "master pull", out=None):
+    """Piece-wise device->host pull with a per-piece watchdog.
+
+    The reference's offload path never moves its state in one shot — it
+    staggers pinned-buffer copies tile by tile (reference:
+    csrc/adam/cpu_adam.cpp:64-113).  Here the motivation is robustness as
+    much as overlap: one monolithic ``device_get`` of a multi-GB stacked
+    scan leaf is a single native call that can stall forever on a sick
+    link, and nothing can interrupt it.  Pulling ~chunk_mb slices along
+    the leading axis bounds each native call, so a sick tunnel costs a
+    clean per-tier RuntimeError within one piece-timeout instead of a
+    wedged device.
+
+    Pieces are FLAT element ranges (the leaf is viewed 1-D on device, a
+    free row-major rebitcast), so every piece is <= chunk_mb regardless
+    of the leaf's shape — a (2, huge) or (1, huge) leaf must not sneak a
+    multi-GB native call under the per-piece timeout, or slow links
+    misclassify as stalled on exactly the leaves that matter.
+
+    ``out``: optional preallocated destination (any assignment-compatible
+    dtype) — pieces are written straight into its slices, keeping peak
+    host memory at 1x the leaf (the offload host is RAM-pressured by
+    design; a transient second copy is exactly what it cannot afford).
+
+    Knobs: DS_OFFLOAD_PULL_CHUNK_MB (default 64, 0 disables chunking),
+    DS_OFFLOAD_PULL_TIMEOUT seconds per piece (default 120, 0 disables
+    the watchdog too).
+    """
+    if chunk_mb is not None:
+        chunk_bytes = int(chunk_mb * (1 << 20))
+    else:
+        chunk_bytes = pull_chunk_bytes()
+    if piece_timeout is None:
+        piece_timeout = float(
+            os.environ.get("DS_OFFLOAD_PULL_TIMEOUT", "120"))
+
+    def _deliver(arr):
+        if out is None:
+            return arr
+        out[...] = arr
+        return out
+
+    if not isinstance(x, jax.Array):
+        return _deliver(np.asarray(x))
+    if piece_timeout <= 0:
+        return _deliver(np.asarray(jax.device_get(x)))
+    if chunk_bytes <= 0 or x.nbytes <= chunk_bytes or x.ndim == 0:
+        return _deliver(_watchdog_get(x, piece_timeout, what))
+    dt = np.dtype(x.dtype)
+    elems_per = max(1, chunk_bytes // dt.itemsize)
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if out is None:
+        out = np.empty(x.shape, dt)
+    if out.flags.c_contiguous and out.size == n:
+        out_flat = out.reshape(-1)
+    else:  # exotic destination: pull to a temp flat, assign once
+        out_flat = np.empty(n, out.dtype)
+    for start in range(0, n, elems_per):
+        out_flat[start:start + elems_per] = _watchdog_get(
+            flat[start:start + elems_per], piece_timeout,
+            f"{what} piece [{start}:{start + elems_per}]")
+    if out_flat.base is not out and out_flat is not out:
+        out[...] = out_flat.reshape(out.shape)
+    return out
+
+
+class _PrefetchPuller:
+    """Chunked, watchdogged, bounded-lookahead grad pull — ONE worker
+    thread per step.
+
+    The construction-time probe certifies the link ONCE; this guard holds
+    for every step after.  Each leaf goes through ``chunked_device_get``,
+    so stall detection is PROGRESS-based (per ~64 MB piece): a slow but
+    working link keeps completing pieces and never misfires the watchdog,
+    while a genuine stall raises within one piece-timeout — the
+    distinction a whole-leaf deadline cannot make on multi-GB stacked
+    scan leaves.
+
+    The single daemon worker pulls leaves in flatten order AHEAD of the
+    consumer (the C++ Adam loop), so later transfers overlap earlier
+    leaves' compute without a thread spawn per leaf.  Lookahead is
+    bounded: the worker stays at most LOOKAHEAD leaves past the highest
+    index the consumer has asked for, keeping the prefetch buffer at a
+    few leaves — not a full extra gradient tree on the RAM-pressured
+    offload host.  Dtypes are preserved (casting is the consumer's
+    business).  A pull failure poisons all remaining slots with the same
+    error and surfaces to the engine's attempt chain.
+    """
+
+    LOOKAHEAD = 2
+
+    def __init__(self, tree, what: str = "grad pull"):
+        self._cond = threading.Condition()
+        self._want = -1
+        self._closed = False
+        order = []
+        self._slots: dict = {}
+        for idx, g in enumerate(jax.tree.leaves(tree)):
+            ev, box = threading.Event(), {}
+            self._slots.setdefault(id(g), []).append((idx, ev, box))
+            order.append((idx, g, ev, box))
+
+        def work():
+            for pos, (idx, g, ev, box) in enumerate(order):
+                with self._cond:
+                    self._cond.wait_for(
+                        lambda: self._closed
+                        or self._want + self.LOOKAHEAD >= idx)
+                    if self._closed:
+                        return  # consumer is done; drop the tree refs
+                try:
+                    box["v"] = chunked_device_get(g, what=what)
+                except BaseException as e:
+                    box["e"] = e
+                    ev.set()
+                    # the link is sick: fail every later slot immediately
+                    # rather than burning one piece-timeout per leaf
+                    for _, _, ev2, box2 in order[pos + 1:]:
+                        box2["e"] = e
+                        ev2.set()
+                    return
+                ev.set()
+
+        threading.Thread(target=work, daemon=True).start()
+
+    def __call__(self, g):
+        idx, ev, box = self._slots[id(g)].pop(0)
+        with self._cond:
+            if idx > self._want:
+                self._want = idx
+                self._cond.notify_all()
+        # no outer deadline needed: the worker cannot wedge (every native
+        # pull inside it is piece-watchdogged) — it always sets the event
+        ev.wait()
+        if "e" in box:
+            raise box["e"]
+        return box["v"]
+
+    def close(self):
+        """Release the worker.  The consumer may legitimately skip
+        trailing leaves (the Adam loop never requests non-fp32 ones), and
+        a parked worker would otherwise wait forever holding a reference
+        to every grad Array — one leaked thread plus one pinned gradient
+        tree PER STEP.  Call from a finally block once consumption is
+        done; un-pulled slots are failed so a late (buggy) request raises
+        instead of hanging."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        for lst in self._slots.values():
+            for _idx, ev, box in lst:
+                if not ev.is_set():
+                    box.setdefault("e", RuntimeError(
+                        "_PrefetchPuller closed before this leaf was "
+                        "requested"))
+                    ev.set()
+
+
+def guarded_tree_pull(tree):
+    """Dtype-preserving watchdogged pull of every leaf in ``tree``.
+    Used for the DPU pending-grad stash (engine keeps HOST copies so the
+    device grad tree can be freed) — preserving dtype keeps the stash at
+    1x the grads' bytes."""
+    puller = _PrefetchPuller(tree)
+    try:
+        return jax.tree.map(puller, tree)
+    finally:
+        puller.close()
 
 
 class HostOffloadOptimizer:
@@ -41,18 +263,24 @@ class HostOffloadOptimizer:
                  adamw_mode: bool = True, bias_correction: bool = True,
                  compute_dtype=jnp.bfloat16,
                  use_native: Optional[bool] = None):
-        # pull master to host numpy once; it never goes back whole.
+        # pull master to host numpy once; it never goes back whole.  The
+        # pull is piece-wise with a per-piece watchdog (chunked_device_get)
+        # so a sick link fails this tier cleanly instead of wedging the
+        # device inside one un-interruptible multi-GB native call.
         # fp32-promote only floating leaves — integer/bool buffers keep
         # their dtype and are never touched by Adam (same rule the engine
         # applies building the master, engine.py master cast).
         def to_host(x):
-            arr = np.asarray(jax.device_get(x))
-            if np.issubdtype(arr.dtype, np.floating) or \
-                    arr.dtype.name == "bfloat16":
-                return np.array(arr, dtype=np.float32)
-            return np.array(arr)
+            dt = np.dtype(x.dtype)
+            if np.issubdtype(dt, np.floating) or dt.name == "bfloat16":
+                # pull pieces straight into the fp32 master buffer —
+                # cast-on-assign, no transient full-leaf copy
+                out = np.empty(np.shape(x), np.float32)
+                return chunked_device_get(x, what="master pull", out=out)
+            return np.array(chunked_device_get(x, what="master pull"))
 
         self._probe_transfer_path(master_params)
+        self._poisoned: Optional[BaseException] = None
         self.master = jax.tree.map(to_host, master_params)
         self.opt = DeepSpeedCPUAdam(
             lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
@@ -78,13 +306,18 @@ class HostOffloadOptimizer:
         callers fall back (engine attempt chains, bench.py).  On a real
         TPU VM the probe costs one microseconds-scale PCIe copy.
 
-        Knobs: DS_OFFLOAD_MIN_MBPS (default 8; 0 disables),
-        DS_OFFLOAD_PROBE_TIMEOUT seconds (default 60).
-        """
-        import os
-        import threading
-        import time
+        A probe that COMPLETES but measures under min_mbps is a working
+        (just slow) link: that case logs a loud warning and proceeds —
+        each subsequent bulk pull is chunked + watchdogged, so a link
+        that later degrades into a stall still fails cleanly.  Set
+        DS_OFFLOAD_SLOW_LINK=error to restore the hard failure (the
+        bench chain does: a slow link there should fall through to the
+        xla tier, not eat the measurement window).
 
+        Knobs: DS_OFFLOAD_MIN_MBPS (default 8; 0 disables),
+        DS_OFFLOAD_PROBE_TIMEOUT seconds (default 60),
+        DS_OFFLOAD_SLOW_LINK = warn|error (default warn).
+        """
         if min_mbps is None:
             min_mbps = float(os.environ.get("DS_OFFLOAD_MIN_MBPS", "8"))
         if probe_timeout is None:
@@ -104,38 +337,31 @@ class HostOffloadOptimizer:
         nbytes = leaf.nbytes
         if nbytes < 1 << 20:  # tiny models: nothing worth probing
             return
-        # Daemon thread, NOT ThreadPoolExecutor: the executor's interpreter
-        # exit hook join()s its (non-daemon) worker, so a probe thread
-        # wedged forever inside the native device_get would turn the
-        # intended fast-fail into a hang at process exit.  A daemon thread
-        # is simply abandoned.
-        done = threading.Event()
-
-        def pull():
-            try:
-                np.asarray(jax.device_get(leaf))
-            finally:
-                done.set()
-
+        # _watchdog_get runs the pull in an abandoned-on-timeout daemon
+        # thread (see its docstring) AND propagates device_get exceptions
+        # — a dead-tunnel XlaRuntimeError must fail the probe, not be
+        # swallowed into a fast-looking measurement.
         t0 = time.perf_counter()
-        threading.Thread(target=pull, daemon=True).start()
-        if not done.wait(timeout=probe_timeout):
-            raise RuntimeError(
-                f"device->host transfer probe ({nbytes >> 20} MB) did not "
-                f"complete within {probe_timeout:.0f}s: bulk D2H appears "
-                "stalled on this platform (tunneled dev harness?). The "
-                "'host' offload tier needs working bulk transfers — use "
-                "offload_impl='xla' (remote-host pinned staging) here. "
-                "Override: DS_OFFLOAD_MIN_MBPS=0 disables this probe.")
+        _watchdog_get(leaf, probe_timeout, "device->host transfer probe")
         dt = time.perf_counter() - t0
         mbps = (nbytes / (1 << 20)) / max(dt, 1e-9)
         if mbps < min_mbps:
-            raise RuntimeError(
+            msg = (
                 f"device->host transfer probe measured {mbps:.1f} MB/s "
                 f"(< {min_mbps} MB/s): the host offload tier would take "
                 "minutes per step at this bandwidth. Use "
                 "offload_impl='xla', or set DS_OFFLOAD_MIN_MBPS=0 to "
-                "proceed anyway.")
+                "skip this probe.")
+            if os.environ.get("DS_OFFLOAD_SLOW_LINK", "warn") == "error":
+                raise RuntimeError(msg)
+            logger.warning(
+                "%s Proceeding anyway (DS_OFFLOAD_SLOW_LINK=warn); every "
+                "device->host pull (bulk + per-step grads) is chunked "
+                "with a per-piece progress watchdog, so slow links keep "
+                "working and only a genuine pull-side stall fails "
+                "cleanly. The per-step param re-UPLOAD is not guarded — "
+                "if the upload direction stalls, the process hangs; set "
+                "DS_OFFLOAD_SLOW_LINK=error to hard-fail instead.", msg)
 
     @property
     def is_native(self) -> bool:
@@ -158,10 +384,35 @@ class HostOffloadOptimizer:
         """Update master/moments in place; return upload copies in the
         configured compute dtype (fp32 configs get fp32 copies — no silent
         bf16 downgrade).  Grad leaves may be numpy OR jax Arrays — the
-        inner optimizer converts per leaf via np.asarray, which lets the
-        engine overlap D2H transfers with the C++ Adam compute."""
-        out = self.opt.step(self.master, host_grads,
-                            out_dtype=self._out_dtype)
+        inner optimizer converts per leaf via a watchdogged pull, which
+        lets the engine overlap D2H transfers with the C++ Adam compute
+        while a link that degrades into a stall MID-TRAINING still fails
+        cleanly (the construction-time probe only certifies the link once;
+        this guard holds for every step after; see _PrefetchPuller).
+
+        A mid-step pull failure leaves master/moments PARTIALLY updated
+        (leaves before the failing one carry step t, later ones do not,
+        and the inner step counter advanced) — an inconsistency the
+        old always-hang behavior could not produce.  The optimizer
+        therefore POISONS itself: further step()/state_tree() calls
+        refuse with a clear error so the inconsistent state can neither
+        keep training nor be serialized; load_state_tree (checkpoint
+        restore) clears the poison."""
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "HostOffloadOptimizer is poisoned: a previous step failed "
+                "mid-update, leaving master/moments inconsistent. Restore "
+                f"from a checkpoint. Original error: {self._poisoned!r}")
+        leaf_get = _PrefetchPuller(host_grads)
+        try:
+            out = self.opt.step(self.master, host_grads,
+                                out_dtype=self._out_dtype,
+                                leaf_get=leaf_get)
+        except BaseException as e:
+            self._poisoned = e
+            raise
+        finally:
+            leaf_get.close()
         if self._out_dtype is None:
             return jax.tree.map(lambda x: x.copy(), self.master)
         return out
@@ -170,7 +421,14 @@ class HostOffloadOptimizer:
     def state_tree(self):
         """Optimizer state as a pytree aligned with the master params
         (what the engine stores in TrainState.opt_state and the
-        checkpointer serializes)."""
+        checkpointer serializes).  Refuses while poisoned — serializing a
+        partially-updated master/moment set would turn a clean failure
+        into silent divergence on restore."""
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "refusing to serialize inconsistent optimizer state (a "
+                "step failed mid-update). Restore from an earlier "
+                f"checkpoint. Original error: {self._poisoned!r}")
         leaves, treedef = jax.tree.flatten(self.master)
         mu, nu = [], []
         for i, leaf in enumerate(leaves):
@@ -184,8 +442,9 @@ class HostOffloadOptimizer:
     def load_state_tree(self, master_tree, opt_tree):
         """In-place restore (buffer identity preserved so the numpy views
         the native kernel updates stay the engine's state)."""
+        self._poisoned = None  # restore re-establishes a consistent state
         def copy_into(dst, src):
-            dst[...] = np.asarray(jax.device_get(src), dtype=np.float32)
+            chunked_device_get(src, what="restore pull", out=dst)
         jax.tree.map(copy_into, self.master, master_tree)
         self.opt.step_count = int(np.asarray(
             jax.device_get(opt_tree["step"])))
@@ -194,5 +453,5 @@ class HostOffloadOptimizer:
         nu = jax.tree.leaves(opt_tree["nu"])
         for i, leaf in enumerate(leaves):
             m, v = self.opt._moments(i, leaf)
-            m[...] = np.asarray(jax.device_get(mu[i]), np.float32)
-            v[...] = np.asarray(jax.device_get(nu[i]), np.float32)
+            chunked_device_get(mu[i], what="restore pull", out=m)
+            chunked_device_get(nu[i], what="restore pull", out=v)

@@ -6,7 +6,7 @@
 cd /root/repo
 log=recovery_run.log
 echo "=== recovery run start $(date -u +%H:%M:%S) ===" >> "$log"
-python bench.py > BENCH_r03_raw.json 2>> "$log"
+python bench.py > BENCH_r04_raw.json 2>> "$log"
 echo "=== bench.py rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
 python bench_cpu_adam.py > BENCH_cpu_adam.txt 2>> "$log"
 echo "=== cpu_adam rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
@@ -14,8 +14,15 @@ python diag_hostperf.py > DIAG_hostperf_run.log 2>&1
 echo "=== hostperf rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
 python diag_offload.py --full > DIAG_offload_run.log 2>&1
 echo "=== diag rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
-# add the whole tree: a pathspec list aborts (staging NOTHING) if any
-# one artifact is missing, which is exactly the degraded case
-git add -A >> "$log" 2>&1
+# Stage only bench/diag artifacts (tolerating missing ones) so a failed
+# bench never sweeps unrelated working-tree changes into the commit.
+# Globs cover every artifact the chain can write: BENCH_north_star.json,
+# BENCH_r04_raw.json, the suite's BENCH_*{,_raw}.json, BENCH_cpu_adam.txt,
+# DIAG_*.json and run logs.
+for f in BENCH_*.json BENCH_*.txt DIAG_*.json DIAG_*.log \
+         DIAG_hostperf_run.log DIAG_offload_run.log MULTICHIP_*.json \
+         bench_suite.log recovery_run.log; do
+  [ -e "$f" ] && git add "$f" >> "$log" 2>&1
+done
 git commit -q -m "Hardware bench artifacts: north star + suite + offload diagnosis" >> "$log" 2>&1
 echo "=== recovery run done $(date -u +%H:%M:%S) ===" >> "$log"

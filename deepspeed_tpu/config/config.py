@@ -78,6 +78,8 @@ class DeepSpeedZeroConfig:
         self.delayed_param_update = get_scalar_param(
             zero, C.ZERO_DELAYED_PARAM_UPDATE,
             C.ZERO_DELAYED_PARAM_UPDATE_DEFAULT)
+        self.param_streaming = get_scalar_param(
+            zero, C.ZERO_PARAM_STREAMING, C.ZERO_PARAM_STREAMING_DEFAULT)
         if (not isinstance(self.offload_grad_chunks, int)
                 or self.offload_grad_chunks < 1):
             raise DeepSpeedConfigError(
@@ -432,6 +434,14 @@ class DeepSpeedConfig:
             if not self.zero_config.cpu_offload:
                 raise DeepSpeedConfigError(
                     "delayed_param_update requires cpu_offload")
+        if self.zero_config.param_streaming:
+            if not self.zero_config.cpu_offload:
+                raise DeepSpeedConfigError(
+                    "param_streaming requires cpu_offload")
+            if self.zero_config.offload_impl == "host":
+                raise DeepSpeedConfigError(
+                    "param_streaming is an xla-tier capacity mode "
+                    "(offload_impl 'xla' or 'auto')")
         if self.optimizer_name is not None and self.optimizer_name in (
                 C.ONEBIT_ADAM_OPTIMIZER,) and not (self.fp16_enabled or self.bf16_enabled):
             raise DeepSpeedConfigError("onebitadam requires fp16 or bf16")

@@ -149,6 +149,18 @@ ZERO_OFFLOAD_GRAD_CHUNKS_DEFAULT = 1
 # the paper describes (enable after convergence stabilizes).
 ZERO_DELAYED_PARAM_UPDATE = "delayed_param_update"
 ZERO_DELAYED_PARAM_UPDATE_DEFAULT = False
+# TPU extension (capacity mode, xla tier): ZeRO-Infinity-style parameter
+# streaming (reference: deepspeed/runtime/zero/partition_parameters.py +
+# the ZeRO-Infinity paper's NVMe/CPU param offload).  Compute copies of
+# the leaves the model marks via ``TrainModule.streaming_param_spec``
+# (its stacked-over-layers scan leaves) STAY in host memory; the model
+# fetches one layer's slice per scan tick, so device-resident parameter
+# bytes ~ one layer instead of 2 bytes/param for the whole model — the
+# floor that bounds offload_grad_chunks capacity.  Composes with dp=1
+# (any ZeRO stage >= 2) or ZeRO-3 (host leaves stay data-sharded; no
+# host-side collectives are ever needed).
+ZERO_PARAM_STREAMING = "param_streaming"
+ZERO_PARAM_STREAMING_DEFAULT = False
 ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_ELASTIC_CHECKPOINT_DEFAULT = True
 ZERO_MAX_ELEMENTS_PER_COMM = "max_elements_per_comm"

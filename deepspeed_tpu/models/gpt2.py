@@ -46,6 +46,12 @@ class GPT2Config:
     scan_layers: bool = True         # False: unroll (≈25% faster on TPU —
                                      # XLA optimizes across layer bounds —
                                      # at the cost of depth-linear compile)
+    stream_scan: bool = False        # fetch ONE layer's params per scan
+                                     # tick with an explicit memory-space
+                                     # transfer — pair with the engine's
+                                     # zero_optimization.param_streaming
+                                     # (host-resident block params) so
+                                     # device param bytes ~ one layer
 
     @property
     def d_head(self) -> int:
@@ -166,7 +172,26 @@ class GPT2Model(TrainModule):
         if cfg.remat == "block":
             body_fn = jax.checkpoint(body)
 
-        if cfg.scan_layers:
+        if cfg.scan_layers and cfg.stream_scan:
+            # Param-streaming form: block params stay a scan CONSTANT
+            # (host-resident under zero_optimization.param_streaming) and
+            # the body fetches layer i's slice with an explicit transfer
+            # to device memory.  The fetch sits INSIDE the remat'd body,
+            # so the backward pass re-fetches each layer instead of
+            # keeping the stack alive — device param bytes ~ one layer in
+            # both directions.  The transfer's transpose moves the layer
+            # grads back toward the stack's (host) memory space, so the
+            # accumulated grad stack does not claim HBM either.
+            fetch = _layer_fetcher(
+                self.param_partition_specs(params)["blocks"])
+
+            def body_stream(carry, i):
+                return body(carry, (fetch(block_params, i), i))
+
+            if cfg.remat == "block":
+                body_stream = jax.checkpoint(body_stream)
+            x, _ = jax.lax.scan(body_stream, x, jnp.arange(cfg.n_layer))
+        elif cfg.scan_layers:
             layer_idx = jnp.arange(cfg.n_layer)
             x, _ = jax.lax.scan(body_fn, x, (block_params, layer_idx))
         else:
@@ -186,6 +211,58 @@ class GPT2Model(TrainModule):
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
         return jnp.mean(nll)
+
+    # ---------------- param-streaming declaration ----------------
+    def streaming_param_spec(self, params):
+        """The stacked block leaves stream (one layer per scan tick);
+        embeddings/final LN stay device-resident.  Requires the scan form
+        with explicit per-layer fetch (``stream_scan``) so the engine's
+        host placement actually bounds device bytes."""
+        if not (self.config.scan_layers and self.config.stream_scan):
+            return None
+        return {
+            k: jax.tree.map(lambda _: k == "blocks", v)
+            for k, v in params.items()
+        }
+
+
+_DEVICE_MEMORY_KIND: Optional[str] = None
+
+
+def _device_memory_kind() -> str:
+    """The backend's default (device/HBM) memory kind — the fetch target
+    for streamed layer slices.  'device' on TPU and on the CPU test
+    backend; resolved once, outside any trace."""
+    global _DEVICE_MEMORY_KIND
+    if _DEVICE_MEMORY_KIND is None:
+        try:
+            _DEVICE_MEMORY_KIND = jax.local_devices()[0].default_memory().kind
+        except Exception:
+            _DEVICE_MEMORY_KIND = "device"
+    return _DEVICE_MEMORY_KIND
+
+
+def _layer_fetcher(block_specs):
+    """Build the per-layer fetch for the streaming scan: dynamic-index
+    the leading layer axis of every block leaf and move the slice into
+    device memory with the leaf's own TP sharding (leading layer dim
+    dropped).  Uses the engine's ambient mesh (``jax.set_mesh``); with no
+    mesh set (eager unit use) the fetch degrades to a plain index."""
+    def fetch(block_params, i):
+        am = jax.sharding.get_abstract_mesh()
+        has_mesh = am is not None and bool(dict(getattr(am, "shape", {})))
+        kind = _device_memory_kind() if has_mesh else None
+
+        def one(a, spec):
+            w = jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+            if not has_mesh:
+                return w
+            sh = jax.sharding.NamedSharding(
+                am, P(*tuple(spec)[1:]), memory_kind=kind)
+            return jax.device_put(w, sh)
+
+        return jax.tree.map(one, block_params, block_specs)
+    return fetch
 
 
 def gpt2_block_forward(cfg: GPT2Config, bp, x, rng, train: bool):

@@ -535,6 +535,12 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.global_steps = meta["global_steps"]
     engine.micro_steps = meta["micro_steps"]
     engine.skipped_steps = meta["skipped_steps"]
+    if getattr(engine, "_offload_xla", False):
+        # continue the DPU rng stream past the restored run: global_steps
+        # is the total dispatch count after a flush INCLUDING overflow-
+        # skipped steps — seeding from opt_state.count (applied steps
+        # only) would replay dropout seeds consumed before the save
+        engine._xla_dpu_dispatch = int(meta["global_steps"])
     if getattr(engine, "_offload_host", False):
         # host tier: copy the loaded arrays back into the native host-Adam
         # buffers here (not in the engine wrapper) so calling this public

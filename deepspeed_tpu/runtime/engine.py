@@ -155,9 +155,26 @@ class DeepSpeedEngine:
         # the computation actually runs (see ops/pallas/runtime.py).  The
         # scope is entered around compiled-step calls (_pallas_scope) so
         # engines on different meshes don't fight over a global.
+        #
+        # The scope ALSO establishes the ambient mesh (jax.set_mesh):
+        # model-side code reads jax.sharding.get_abstract_mesh() during
+        # trace — sequence-parallel attention discovers the 'seq' axis,
+        # MoE binds its expert constraint, and the param-streaming fetch
+        # builds its device placement from it.  Without the ambient mesh
+        # those reads see an EMPTY AbstractMesh inside jit (argument
+        # shardings do not populate it) and every one of those features
+        # silently degrades.
         from ..ops.pallas.runtime import interpret_scope, mesh_wants_interpret
         self._pallas_interpret = mesh_wants_interpret(self.mesh)
-        self._pallas_scope = lambda: interpret_scope(self._pallas_interpret)
+
+        def _step_scope():
+            import contextlib
+            stack = contextlib.ExitStack()
+            stack.enter_context(interpret_scope(self._pallas_interpret))
+            stack.enter_context(jax.set_mesh(self.mesh))
+            return stack
+
+        self._pallas_scope = _step_scope
 
         self.compute_dtype = precision.select_compute_dtype(
             config.fp16_enabled, config.bf16_enabled)
@@ -355,6 +372,36 @@ class DeepSpeedEngine:
             self._flat_w = sum(rec.w for rec in self._flat_layout)
             self._flat_pad = sum(rec.pad for rec in self._flat_layout)
             self._flat_n = dp * self._flat_w
+            # ZeRO-Infinity-style param streaming: leaves the model marks
+            # keep their compute copies in HOST memory; the model fetches
+            # one layer per scan tick (streaming_param_spec contract).
+            self._stream_mask = [False] * len(self._flat_sizes)
+            if config.zero_config.param_streaming:
+                if dp > 1 and config.zero_optimization_stage < 3:
+                    raise ValueError(
+                        "param_streaming with dp > 1 requires ZeRO-3 "
+                        "(stage <= 2 would need host-side all-gathers of "
+                        "the streamed leaves; stage 3 keeps them data-"
+                        "sharded end to end)")
+                spec = self.module.streaming_param_spec(
+                    jax.tree.unflatten(treedef, leaves))
+                if spec is None:
+                    raise ValueError(
+                        "param_streaming is enabled but the model's "
+                        "streaming_param_spec returned None — the model "
+                        "must mark its stacked scan leaves (for GPT2Model "
+                        "set scan_layers=True and stream_scan=True)")
+                mask_leaves = jax.tree.leaves(spec)
+                if len(mask_leaves) != len(leaves):
+                    raise ValueError(
+                        "streaming_param_spec structure does not match "
+                        f"the parameter tree ({len(mask_leaves)} vs "
+                        f"{len(leaves)} leaves)")
+                self._stream_mask = [bool(b) for b in mask_leaves]
+                if not any(self._stream_mask):
+                    raise ValueError(
+                        "param_streaming is enabled but the model marked "
+                        "no leaves as streamable")
             # Leaf-at-a-time staging: pack ONE leaf to its fp32 (dp, w)
             # piece on device, move it to host memory, drop the leaf.
             # Device peak = remaining init leaves + one piece, a strictly
@@ -384,17 +431,13 @@ class DeepSpeedEngine:
                 mu=self._zero_host_pieces(),
                 nu=self._zero_host_pieces())
         elif self._offload:
-            # ZeRO-Offload, single-controller numpy tier: fp32 master +
-            # moments live in THIS process's memory and are updated by the
-            # native C++ CPU Adam (runtime/offload.py); the device keeps
-            # only compute-dtype params.
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "cpu_offload with offload_impl='host' is single-"
-                    "controller: it stages the FULL gradient on one host "
-                    "and cannot address multi-process arrays. Use "
-                    "offload_impl='xla' (per-device pinned_host staging) "
-                    "for multi-host runs.")
+            # ZeRO-Offload host tier: fp32 master + moments live in host
+            # numpy and are updated by the native C++ CPU Adam
+            # (runtime/offload.py); the device keeps only compute-dtype
+            # params.  Single-process: one host owns the full master.
+            # Multi-process: each host owns ONLY its dp-shard (the
+            # reference's per-DP-rank fp32 partitions, stage2.py:743-900)
+            # — see ShardedHostOffloadOptimizer.
             if int(getattr(config.zero_config,
                            "offload_grad_chunks", 1) or 1) > 1:
                 # config-level sanity rejects impl='host' explicitly, but
@@ -403,17 +446,22 @@ class DeepSpeedEngine:
                     "offload_grad_chunks > 1 is an xla-tier capacity "
                     "mode; offload_impl resolved to 'host' on this "
                     "platform. Set offload_impl='xla' explicitly.")
+            if config.zero_config.param_streaming:
+                raise ValueError(
+                    "param_streaming is an xla-tier capacity mode; "
+                    "offload_impl resolved to 'host' on this platform. "
+                    "Set offload_impl='xla' explicitly.")
             if config.zero_optimization_stage >= 3:
                 raise ValueError(
                     "ZeRO-3 × cpu_offload requires offload_impl='xla' "
                     "(data-sharded compute params); the host tier places "
                     "replicated compute params and would silently lose "
                     "stage 3's memory savings.")
-            from .offload import HostOffloadOptimizer
+            from .offload import (HostOffloadOptimizer,
+                                  ShardedHostOffloadOptimizer)
             oparams = dict(config.optimizer_params)
             lr = self._lr_schedule or float(oparams.get("lr", 1e-3))
-            self._host_opt = HostOffloadOptimizer(
-                master,
+            opt_kwargs = dict(
                 lr=lr,
                 betas=tuple(oparams.get("betas", (0.9, 0.999))),
                 eps=oparams.get("eps", 1e-8),
@@ -426,8 +474,32 @@ class DeepSpeedEngine:
             self._compute_shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), specs,
                 is_leaf=lambda x: isinstance(x, P))
-            self._compute_params = _device_put_tree(
-                self._host_opt.compute_params(), self._compute_shardings)
+            self._offload_sharded = jax.process_count() > 1
+            if self._offload_sharded:
+                # multi-host: dp-shard the fp32 master on device, let each
+                # process pull only ITS shards to host; compute params
+                # come back via one jitted all-gather over ICI
+                if config.zero_config.delayed_param_update:
+                    raise ValueError(
+                        "delayed_param_update × the multi-host host tier "
+                        "is not supported; use offload_impl='xla' for "
+                        "DPU at multi-host scale")
+                master_shardings = self.zero_plan.master_shardings(master)
+                master_dev = _device_put_tree(master, master_shardings)
+                self._host_opt = ShardedHostOffloadOptimizer(
+                    master_dev, **opt_kwargs)
+                del master_dev  # host blocks pulled; free the device fp32
+                self._sharded_gather = jax.jit(
+                    lambda t: t, out_shardings=self._compute_shardings)
+                self._reshard_to_master = jax.jit(
+                    lambda t: t, out_shardings=master_shardings)
+                self._compute_params = self._sharded_gather(
+                    self._host_opt.compute_params())
+            else:
+                self._host_opt = HostOffloadOptimizer(master, **opt_kwargs)
+                self._compute_params = _device_put_tree(
+                    self._host_opt.compute_params(),
+                    self._compute_shardings)
             self._dpu = bool(config.zero_config.delayed_param_update)
             self._dpu_pending = None
             master = self._host_opt.master       # host numpy identity
@@ -667,7 +739,7 @@ class DeepSpeedEngine:
             inv = (1.0 / scaler.loss_scale).astype(jnp.float32)
             grads = con(jax.tree.map(
                 lambda x: (x.astype(jnp.float32) * inv).astype(x.dtype),
-                con(g)))
+                g))
             return grads, scaled_loss[None]
 
         def acc_body(carry, mb):
@@ -1218,20 +1290,15 @@ class DeepSpeedEngine:
 
     def _offload_unflatten(self, pieces):
         """Pieces -> param-shaped tree with compute shardings (traceable).
-        Stages ≤ 2: the cast-up path all-gathers each piece first (the
-        fused ZeRO param all-gather, reference stage2.py:1438-1471), so
-        unpacks are local and per-leaf constraints only re-shard TP-split
-        leaves.  Stage 3: pieces stay P('data')-sharded and, because the
-        layout is partition-major, each reshape/moveaxis lands exactly on
-        the leaf's data-sharded compute spec — no resharding collectives
-        (ZeRO-3 never materializes the replica).  Piece-wise state also
+        Delegates per leaf to ``_unpack_device_piece`` — the ONE
+        definition of the gather/unpack contract.  Piece-wise state also
         means NO slicing of one big vector here, removing the last SPMD
         hazard of the old layout."""
         shard_leaves = jax.tree.leaves(
             self._compute_shardings,
             is_leaf=lambda x: isinstance(x, NamedSharding))
         out = [
-            jax.lax.with_sharding_constraint(_unpack_leaf(p, rec, jnp), sh)
+            self._unpack_device_piece(p, rec, sh)
             for p, rec, sh in zip(pieces, self._flat_layout, shard_leaves)]
         return jax.tree.unflatten(self._flat_treedef, out)
 
@@ -1274,21 +1341,58 @@ class DeepSpeedEngine:
         hundreds of tiny reshard collectives that slicing a dp-sharded
         vector fragments into), and peak-memory-neutral there because
         stages ≤ 2 materialize replicated compute params anyway.
-        Stage 3 skips the gather: compute params stay data-sharded."""
+        Stage 3 skips the gather: compute params stay data-sharded.
+
+        param_streaming: masked leaves are cast AND unpacked inside the
+        host section and constrained to a pinned_host placement — their
+        compute copies never claim HBM.  The model fetches one layer's
+        slice per scan tick (streaming_param_spec contract), so device-
+        resident parameter bytes ~ one layer + the non-streamed leaves
+        (embeddings, final LN) — ZeRO-Infinity's param offload re-expressed
+        as XLA memory placement.  Streaming leaves never need the stage<3
+        gather: the mode requires dp == 1 below stage 3."""
+        mask = getattr(self, "_stream_mask", None) or \
+            [False] * len(self._flat_layout)
         with self._host_section():
-            lowp = tuple(p.astype(self.compute_dtype)
-                         for p in master_pieces)
-        lowp = tuple(jax.device_put(p, self._piece_dev_sharding)
-                     for p in lowp)
+            lowp = [p.astype(self.compute_dtype) for p in master_pieces]
+            stream_leaves = {
+                i: _unpack_leaf(lowp[i], rec, jnp)
+                for i, rec in enumerate(self._flat_layout) if mask[i]}
+        shard_leaves = jax.tree.leaves(
+            self._compute_shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+        out = []
+        for i, rec in enumerate(self._flat_layout):
+            if mask[i]:
+                sh = shard_leaves[i]
+                if self._offload_real_host:
+                    sh = sh.with_memory_kind("pinned_host")
+                out.append(jax.lax.with_sharding_constraint(
+                    stream_leaves[i], sh))
+            else:
+                out.append(self._unpack_device_piece(
+                    lowp[i], rec, shard_leaves[i]))
+        return jax.tree.unflatten(self._flat_treedef, out)
+
+    def _unpack_device_piece(self, piece, rec: _FlatLeaf, leaf_sharding):
+        """ONE definition of piece -> device compute leaf, shared by the
+        streamed and unstreamed cast-up paths so the partition-major
+        unpack and the stage<3 gather cannot drift apart.
+
+        Stages ≤ 2: the piece is all-gathered whole before its unpack —
+        the fused ZeRO param all-gather (reference stage2.py:1438-1471),
+        one collective per parameter, peak-memory-neutral because stages
+        ≤ 2 materialize replicated compute params anyway.  Stage 3 skips
+        the gather: pieces stay P('data')-sharded and, because the layout
+        is partition-major, the reshape lands exactly on the leaf's
+        data-sharded compute spec — no resharding collectives (ZeRO-3
+        never materializes the replica)."""
+        p = jax.device_put(piece, self._piece_dev_sharding)
         if self.zero_plan.stage < 3:
-            # stages ≤ 2 compute on replicated params — gather per piece.
-            # Stage 3 (ZeRO-3 × offload, the 13B ladder rung) must NOT:
-            # its compute params stay data-sharded and the per-leaf
-            # constraints in the unflatten place each piece directly.
-            rep = NamedSharding(self.mesh, P())
-            lowp = tuple(jax.lax.with_sharding_constraint(p, rep)
-                         for p in lowp)
-        return self._offload_unflatten(lowp)
+            p = jax.lax.with_sharding_constraint(
+                p, NamedSharding(self.mesh, P()))
+        return jax.lax.with_sharding_constraint(
+            _unpack_leaf(p, rec, jnp), leaf_sharding)
 
     def _build_xla_offload_step(self):
         compute_dtype = self.compute_dtype
@@ -1654,13 +1758,15 @@ class DeepSpeedEngine:
 
         return train_step
 
-    @staticmethod
-    def _start_small_leaf_d2h(grads):
+    def _start_small_leaf_d2h(self, grads):
         """Kick off async D2H for leaves the guarded pull will fetch in
         ONE native call (<= one chunk) — their later device_get just
         syncs the in-flight copy.  Leaves ABOVE the chunk size are pulled
         piece-wise by chunked_device_get; a full-leaf async copy for
-        those would move the same bytes over the wire twice."""
+        those would move the same bytes over the wire twice.  Sharded
+        tier: no-op — the optimizer async-copies per addressable shard."""
+        if getattr(self, "_offload_sharded", False):
+            return
         from .offload import pull_chunk_bytes
         cb = pull_chunk_bytes()
         for g in jax.tree.leaves(grads):
@@ -1668,7 +1774,15 @@ class DeepSpeedEngine:
                 g.copy_to_host_async()
 
     def _apply_host_update(self, grads):
-        """C++ Adam over host grads + async re-upload of compute params."""
+        """C++ Adam over host grads + async re-upload of compute params.
+        Sharded (multi-host) tier: grads are first pinned to the master's
+        dp-sharding (a no-op when the ZeRO plan already placed them
+        there), each host Adams only its shards, and the updated lowp
+        shards all-gather to the compute sharding on device."""
+        if getattr(self, "_offload_sharded", False):
+            lowp = self._host_opt.step(self._reshard_to_master(grads))
+            self._compute_params = self._sharded_gather(lowp)
+            return
         lowp = self._host_opt.step(grads)
         self._compute_params = _device_put_tree(
             lowp, self._compute_shardings)
@@ -1687,7 +1801,11 @@ class DeepSpeedEngine:
         pending = getattr(self, "_xla_dpu_pending", None)
         if pending is not None and self._xla_dpu_update is not None:
             self._xla_dpu_pending = None
-            self.state, _ = self._xla_dpu_update(self.state, *pending)
+            # scope: a flush can be the FIRST dispatch of the update
+            # program (save right after a DPU step) — tracing needs the
+            # ambient mesh like every other compiled step
+            with self._pallas_scope():
+                self.state, _ = self._xla_dpu_update(self.state, *pending)
 
     def _train_batch_offload(self, batch):
         scaler = self.state.scaler
@@ -1775,6 +1893,10 @@ class DeepSpeedEngine:
                     FusedAdamState(count=opt.count,
                                    mu=self._unflatten_numpy(opt.mu),
                                    nu=self._unflatten_numpy(opt.nu)))
+        if getattr(self, "_offload_sharded", False):
+            # global (non-fully-addressable) fp32 arrays: the saver
+            # writes per-process shard files and merges on load
+            return self._host_opt.canonical_state()
         return self.state.master_params, self.state.opt_state
 
     def _canonical_templates(self):
@@ -1787,6 +1909,8 @@ class DeepSpeedEngine:
                 return jax.tree.unflatten(self._flat_treedef, leaves)
             return tmpl(), FusedAdamState(
                 count=self.state.opt_state.count, mu=tmpl(), nu=tmpl())
+        if getattr(self, "_offload_sharded", False):
+            return self._host_opt.canonical_templates()
         return self.state.master_params, self.state.opt_state
 
     def _adopt_loaded(self, master_tree, opt_tree):
@@ -1794,11 +1918,11 @@ class DeepSpeedEngine:
         if not self._offload_xla:
             return master_tree, opt_tree
         self._xla_dpu_pending = None  # loaded state supersedes pending
-        if opt_tree is not None:
-            # continue the DPU rng stream past the restored step count
-            # instead of replaying seeds 0..t's dropout masks
-            self._xla_dpu_dispatch = int(
-                np.asarray(jax.device_get(opt_tree.count)))
+        # NOTE: the DPU dispatch counter is NOT seeded here — opt.count
+        # counts only applied (finite) steps, and seeding from it would
+        # replay the dropout seeds consumed by overflow-skipped steps
+        # before the save.  load_checkpoint seeds it from global_steps
+        # (total dispatches after a flush, including skips).
         dev = NamedSharding(self.mesh, P())
 
         def put_pieces(tree):
@@ -1828,6 +1952,17 @@ class DeepSpeedEngine:
             # module-only restore path: fresh moments (the loader built a
             # device optimizer state that doesn't apply to the host tier)
             opt_tree = None
+        if getattr(self, "_offload_sharded", False):
+            # each process scatters only its addressable shards back into
+            # its host blocks; compute params re-gather on device
+            self._host_opt.load_state_tree(self.state.master_params,
+                                           opt_tree)
+            self._compute_params = self._sharded_gather(
+                self._host_opt.compute_params())
+            self.state = self.state._replace(
+                master_params=self._host_opt.master,
+                opt_state=self._host_opt.state_tree())
+            return
         if opt_tree is None:
             def copy_into(dst, src):
                 arr = np.asarray(jax.device_get(src))
@@ -2049,6 +2184,14 @@ class DeepSpeedEngine:
     def forward(self, batch):
         """Compat shim for the reference trio (engine.py:779): computes the
         micro-batch loss and queues the batch for the fused step."""
+        if not getattr(self, "_facade_warned", False):
+            self._facade_warned = True
+            log_dist(
+                "forward/backward/step facade in use: each micro-batch "
+                "pays one EXTRA forward (the loss returned here is an "
+                "eval pass; gradients run inside the fused step). Port "
+                "the loop to engine.train_batch(batch) for full "
+                "throughput.", ranks=[0])
         rng = jax.random.fold_in(self._data_rng, self.micro_steps)
         micro = jax.tree.map(np.asarray, batch)
         with self._pallas_scope():

@@ -31,6 +31,20 @@ class TrainModule:
     def param_partition_specs(self, params) -> Optional[Any]:
         return None
 
+    def streaming_param_spec(self, params) -> Optional[Any]:
+        """Optional: a pytree of bools aligned with ``params`` marking
+        stacked-over-layers leaves the model consumes one layer per scan
+        tick (True = streamable).  With
+        ``zero_optimization.param_streaming`` the engine keeps those
+        leaves' compute copies in HOST memory, so device-resident
+        parameter bytes ~ one layer — ZeRO-Infinity-style parameter
+        offload (the capacity feature the reference implements as CPU/
+        NVMe param partitions, deepspeed/runtime/zero/stage2.py's fp16
+        partition machinery generalized by the ZeRO-Infinity paper).
+        Return None when nothing is streamable (streaming becomes a
+        config error rather than a silent no-op)."""
+        return None
+
     def sparse_grad_tokens(self, batch) -> dict:
         """Optional: declare embedding-style params whose gradient rows are
         only the batch's token rows.  Returns {param keystr: token-id

@@ -15,10 +15,15 @@ The TPU shape of the same idea, given XLA's execution model:
       in the same pass, which are device_put back as the next step's
       compute params.
 
-Scope note: this is the single-controller tier — the host stages the FULL
-gradient and owns the full master.  Multi-host offload (each process
-pulling only its reduce-scattered shard, the reference's per-DP-rank
-partitions) is future work and is called out where it matters.
+Two controllers' worth of scope:
+
+  HostOffloadOptimizer — single-controller: the one host stages the FULL
+      gradient and owns the full master (the dp=1 / single-process case).
+  ShardedHostOffloadOptimizer — multi-host: each process stages ONLY its
+      addressable shards of the dp-sharded master/gradients (the
+      reference's per-DP-rank fp32 partitions, stage2.py:743-900) and
+      C++-Adams them; compute params are reassembled ON DEVICE by one
+      jitted all-gather, so no host ever touches another rank's bytes.
 
 Loss-scale skip/update bookkeeping runs on host (it is per-step control
 flow, exactly what the reference does in Python, stage2.py:1341-1362).
@@ -34,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.cpu_adam import DeepSpeedCPUAdam
+from ..ops.cpu_adam import DeepSpeedCPUAdam, lowp_np_dtype
 from ..utils.logging import logger
 
 
@@ -455,3 +460,275 @@ class HostOffloadOptimizer:
             m, v = self.opt._moments(i, leaf)
             chunked_device_get(mu[i], what="restore pull", out=m)
             chunked_device_get(nu[i], what="restore pull", out=v)
+
+
+def _index_key(index) -> tuple:
+    """Hashable key for a shard's global index (a tuple of slices)."""
+    return tuple((s.start, s.stop, s.step) for s in index)
+
+
+class ShardedHostOffloadOptimizer:
+    """Multi-host ZeRO-Offload host tier.
+
+    Each process pulls ONLY its addressable shards of the dp-sharded fp32
+    master into host numpy — the reference's per-DP-rank fp32 partitions
+    (reference: deepspeed/runtime/zero/stage2.py:743-900, where each rank
+    stages its own ``get_grad_position`` ranges into pinned buffers) —
+    and the native C++ Adam updates them in place.  Per step, each
+    process stages only its shard of the reduce-scattered gradients
+    (staged bytes per host ~ total/dp), and the updated low-precision
+    shards are re-assembled into a global array whose all-gather to the
+    compute sharding runs ON DEVICE over ICI (one jitted identity in the
+    engine) — no host ever handles another rank's bytes, removing the
+    single-controller tier's process-0 staging and master bottleneck.
+
+    Replicated leaves (biases, norms) are deduplicated by shard index:
+    one host block + one set of moments per UNIQUE slice, shared across
+    the local devices that hold a replica.
+    """
+
+    def __init__(self, master_global, lr, betas, eps, weight_decay,
+                 adamw_mode: bool = True, bias_correction: bool = True,
+                 compute_dtype=jnp.bfloat16,
+                 use_native: Optional[bool] = None):
+        leaves = jax.tree.leaves(master_global)
+        self._treedef = jax.tree.structure(master_global)
+        self._shardings = [l.sharding for l in leaves]
+        self._shapes = [tuple(l.shape) for l in leaves]
+        self._poisoned: Optional[BaseException] = None
+        self.opt = DeepSpeedCPUAdam(
+            lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+            adamw_mode=adamw_mode, bias_correction=bias_correction,
+            use_native=use_native)
+        self.compute_dtype = compute_dtype
+        self._out_dtype = ("bfloat16" if compute_dtype == jnp.bfloat16
+                           else "float16" if compute_dtype == jnp.float16
+                           else None)
+        # per leaf: ordered unique-index groups
+        #   {"index": shard index, "devices": [Device], "block": fp32 np}
+        self._local = []
+        for leaf in leaves:
+            groups: dict = {}
+            order = []
+            for s in leaf.addressable_shards:
+                k = _index_key(s.index)
+                if k not in groups:
+                    blk = np.array(
+                        chunked_device_get(s.data,
+                                           what="master shard pull"),
+                        dtype=np.float32)
+                    groups[k] = {"index": s.index, "devices": [],
+                                 "block": blk}
+                    order.append(k)
+                groups[k]["devices"].append(s.device)
+            self._local.append([groups[k] for k in order])
+
+    # -- introspection --------------------------------------------------
+    def staged_bytes(self) -> int:
+        """Host bytes this process stages for the master (the per-host
+        partition size the multi-host design bounds to ~ total/dp)."""
+        return sum(g["block"].nbytes
+                   for leaf in self._local for g in leaf)
+
+    @property
+    def is_native(self) -> bool:
+        return self.opt.is_native
+
+    @property
+    def master(self):
+        """Local-blocks pytree (leaves = lists of fp32 numpy blocks) —
+        the engine's TrainState view between checkpoints.  Canonical
+        (global-array) form comes from ``canonical_master()``."""
+        return jax.tree.unflatten(
+            self._treedef,
+            [[g["block"] for g in leaf] for leaf in self._local])
+
+    # -- assembly -------------------------------------------------------
+    def _assemble(self, block_fn, np_dtype):
+        """Global jax arrays from per-group host blocks.  ``block_fn(li,
+        gi, g)`` returns the host block to place for group ``g`` (index
+        ``gi`` within leaf ``li``); each local device holding that index
+        receives a copy and ``make_array_from_single_device_arrays``
+        stitches the global view (non-addressable shards belong to the
+        other processes)."""
+        out = []
+        for li, (leaf_groups, sharding, shape) in enumerate(
+                zip(self._local, self._shardings, self._shapes)):
+            arrays = []
+            for gi, g in enumerate(leaf_groups):
+                blk = np.asarray(block_fn(li, gi, g), dtype=np_dtype)
+                for d in g["devices"]:
+                    arrays.append(jax.device_put(blk, d))
+            out.append(jax.make_array_from_single_device_arrays(
+                shape, sharding, arrays))
+        return jax.tree.unflatten(self._treedef, out)
+
+    def compute_params(self):
+        """Initial compute-dtype global params (dp-sharded like the
+        master; the engine's jitted gather reshard them to the compute
+        sharding — the fused ZeRO param all-gather on ICI)."""
+        dt = lowp_np_dtype(self._out_dtype)
+        np_dt = dt if dt is not None else np.float32
+        return self._assemble(
+            lambda li, gi, g: g["block"].astype(np_dt)
+            if dt is not None else g["block"].copy(), np_dt)
+
+    # -- the step -------------------------------------------------------
+    def step(self, grads):
+        """C++ Adam over THIS process's shards only.  ``grads``: global
+        jax arrays whose sharding must match the master's (the engine
+        constrains them with the ZeRO plan).  Returns global
+        compute-dtype params (master-sharded; gather happens in the
+        engine's jitted identity).  Poisons on mid-step failure exactly
+        like the single-controller tier."""
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "ShardedHostOffloadOptimizer is poisoned: a previous "
+                "step failed mid-update. Restore from a checkpoint. "
+                f"Original error: {self._poisoned!r}")
+        g_leaves = jax.tree.leaves(grads)
+        flat_p, flat_g = [], []
+        for leaf_groups, gleaf in zip(self._local, g_leaves):
+            by_key = {}
+            for s in gleaf.addressable_shards:
+                by_key.setdefault(_index_key(s.index), s)
+            for g in leaf_groups:
+                k = _index_key(g["index"])
+                if k not in by_key:
+                    raise ValueError(
+                        "gradient sharding does not match the master "
+                        "sharding — the sharded host tier requires the "
+                        "ZeRO plan's grad placement (engine constrains "
+                        "this; custom grad trees must match)")
+                flat_p.append(g["block"])
+                flat_g.append(by_key[k].data)
+        # async D2H only for shards the puller fetches in ONE native call
+        # — larger shards stream piece-wise (chunked_device_get); a full-
+        # shard async copy alongside the slice pulls would move the same
+        # bytes over the wire twice (the _start_small_leaf_d2h rule)
+        cb = pull_chunk_bytes()
+        for a in flat_g:
+            if hasattr(a, "copy_to_host_async") and (
+                    cb <= 0 or getattr(a, "nbytes", 0) <= cb):
+                a.copy_to_host_async()
+        puller = _PrefetchPuller(flat_g)
+        try:
+            outs = self.opt.step(flat_p, flat_g,
+                                 out_dtype=self._out_dtype,
+                                 leaf_get=puller)
+        except BaseException as e:
+            self._poisoned = e
+            raise
+        finally:
+            puller.close()
+        dt = lowp_np_dtype(self._out_dtype)
+        np_dt = dt if dt is not None else np.float32
+        if outs is None:
+            return self._assemble(
+                lambda li, gi, g: g["block"].copy(), np_dt)
+        it = iter(outs)
+        lowp = [[next(it) for _ in leaf] for leaf in self._local]
+        return self._assemble(
+            lambda li, gi, g, _l=lowp: _l[li][gi], np_dt)
+
+    # -- checkpoint plumbing --------------------------------------------
+    def state_tree(self):
+        """Cheap per-step view (local moment blocks); the canonical
+        global-array form for saving comes from canonical_state()."""
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "refusing to serialize inconsistent optimizer state (a "
+                "step failed mid-update). Restore from an earlier "
+                f"checkpoint. Original error: {self._poisoned!r}")
+        flat = [g["block"] for leaf in self._local for g in leaf]
+        mu, nu = [], []
+        for i, blk in enumerate(flat):
+            m, v = self.opt._moments(i, blk)
+            mu.append(m)
+            nu.append(v)
+        it_m, it_v = iter(mu), iter(nu)
+        return {"step": np.asarray(self.opt.step_count, np.int64),
+                "mu": jax.tree.unflatten(
+                    self._treedef,
+                    [[next(it_m) for _ in leaf] for leaf in self._local]),
+                "nu": jax.tree.unflatten(
+                    self._treedef,
+                    [[next(it_v) for _ in leaf] for leaf in self._local])}
+
+    def canonical_state(self):
+        """(master, {step, mu, nu}) as GLOBAL fp32 jax arrays (master-
+        sharded, non-fully-addressable) — the save-time form: the
+        checkpointer writes per-process shard files and merges on load.
+        Costs one device round-trip per leaf, paid only at save."""
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "refusing to serialize inconsistent optimizer state; "
+                f"original error: {self._poisoned!r}")
+        master = self._assemble(lambda li, gi, g: g["block"], np.float32)
+        flat = [g["block"] for leaf in self._local for g in leaf]
+        moments = [self.opt._moments(i, b) for i, b in enumerate(flat)]
+        it = iter(moments)
+        per_leaf = [[next(it) for _ in leaf] for leaf in self._local]
+
+        def pick(which):
+            return lambda li, gi, g, _p=per_leaf: _p[li][gi][which]
+        mu = self._assemble(pick(0), np.float32)
+        nu = self._assemble(pick(1), np.float32)
+        return master, {"step": np.asarray(self.opt.step_count, np.int64),
+                        "mu": mu, "nu": nu}
+
+    def load_state_tree(self, master_tree, opt_tree):
+        """In-place restore from canonical global arrays (or full numpy):
+        each process scatters ONLY its local shards back into its blocks."""
+        self._poisoned = None
+
+        def scatter(tree, which=None, moments=False):
+            leaves = jax.tree.leaves(tree)
+            flat_i = 0
+            for li, leaf_groups in enumerate(self._local):
+                src = leaves[li]
+                for g in leaf_groups:
+                    if isinstance(src, jax.Array) and not getattr(
+                            src, "is_fully_addressable", True):
+                        by_key = {_index_key(s.index): s
+                                  for s in src.addressable_shards}
+                        blk = chunked_device_get(
+                            by_key[_index_key(g["index"])].data,
+                            what="restore shard pull")
+                    else:
+                        arr = (np.asarray(src) if not isinstance(
+                            src, jax.Array) else chunked_device_get(
+                                src, what="restore pull"))
+                        blk = arr[g["index"]]
+                    if moments:
+                        m, v = self.opt._moments(flat_i, g["block"])
+                        dst = m if which == 0 else v
+                        dst[...] = np.asarray(blk, np.float32)
+                    else:
+                        g["block"][...] = np.asarray(blk, np.float32)
+                    flat_i += 1
+
+        scatter(master_tree)
+        if opt_tree is None:
+            for m, v in self.opt._state.values():
+                m[...] = 0.0
+                v[...] = 0.0
+            self.opt.step_count = 0
+            return
+        self.opt.step_count = int(np.asarray(
+            jax.device_get(opt_tree["step"])))
+        scatter(opt_tree["mu"], which=0, moments=True)
+        scatter(opt_tree["nu"], which=1, moments=True)
+
+    def canonical_templates(self):
+        """Zero-filled global arrays shaped/sharded like canonical_state()
+        — the load targets: the checkpoint loader reads only each
+        process's addressable ranges into them (per-process shard files,
+        merge-on-load).  Block-size transients only."""
+        def zeros(li, gi, g):
+            return np.zeros(np.shape(g["block"]), np.float32)
+        master = self._assemble(zeros, np.float32)
+        mu = self._assemble(zeros, np.float32)
+        nu = self._assemble(zeros, np.float32)
+        return master, {"step": np.asarray(self.opt.step_count, np.int64),
+                        "mu": mu, "nu": nu}

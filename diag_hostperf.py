@@ -135,6 +135,44 @@ def bench_param_stream(jax, jnp, real_host: bool, layers=16, mb=64):
     return rec
 
 
+def bench_remat_offload(jax, jnp, real_host: bool, n=2048, depth=4):
+    """cpu_checkpointing's remat-offload policy ON HARDWARE: does the
+    lowered grad program actually annotate saved dot residuals into host
+    memory (the thing the CPU test suite cannot see — CPU lowering
+    erases memory kinds), and what does the offload cost per pass?"""
+    pol = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+        "device", "pinned_host")
+
+    def block(x, w):
+        for _ in range(depth):
+            x = jnp.tanh(x @ w)
+        return x.sum()
+
+    w = jnp.ones((n, n), jnp.bfloat16)
+    x = jnp.ones((8, n), jnp.bfloat16)
+    rec = {}
+    for name, p in (("offload", pol), ("full_remat", None)):
+        g = jax.jit(jax.grad(jax.checkpoint(block, policy=p), argnums=1))
+        try:
+            txt = g.lower(x, w).as_text()
+            annotated = ("pinned_host" in txt
+                         or "annotate_device_placement" in txt)
+            out = g(x, w)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                out = g(x, w)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / 5
+            rec[name] = {"host_annotated": bool(annotated),
+                         "grad_ms": round(dt * 1e3, 2)}
+        except Exception as e:
+            _mark(f"remat_offload[{name}]: FAILED {type(e).__name__}: {e}")
+            rec[name] = {"error": str(e)[:200]}
+    _mark(f"remat_offload: {rec}")
+    return rec
+
+
 def main():
     sys.path.insert(0, ".")
     from bench import guarded_devices
@@ -147,6 +185,8 @@ def main():
     rec["host_section"] = bench_host_section(jax, jnp, on_tpu, gb=gb)
     rec["param_stream"] = bench_param_stream(
         jax, jnp, on_tpu, layers=16, mb=256 if on_tpu else 4)
+    rec["remat_offload"] = bench_remat_offload(
+        jax, jnp, on_tpu, n=2048 if on_tpu else 64)
     print(json.dumps(rec))
     if on_tpu:
         with open("DIAG_hostperf.json", "w") as f:

@@ -1,0 +1,90 @@
+"""Worker body for the multi-host ZeRO-Offload host-tier test.
+
+Each of the two processes must stage ONLY its dp-shard of the fp32
+master and gradients (the reference's per-DP-rank fp32 partitions,
+reference: deepspeed/runtime/zero/stage2.py:743-900) — asserted from
+the optimizer's actual host bytes — and the loss trajectory must match
+the single-controller tier run by the parent test process on the same
+global batch (same global semantics, different staging topology).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.parallel import build_mesh  # noqa: E402
+from simple_model import SimpleModel  # noqa: E402
+
+HIDDEN = 32
+
+
+def main():
+    out_dir = sys.argv[1]
+    deepspeed_tpu.init_distributed()
+    assert jax.process_count() == 2, jax.process_count()
+    pid = jax.process_index()
+
+    mesh = build_mesh(dp=8, devices=jax.devices())
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "offload_impl": "host"},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN), config=cfg, mesh=mesh)
+    assert getattr(engine, "_offload_sharded", False), \
+        "multi-process host tier must use the sharded optimizer"
+
+    # --- per-host staged bytes ~ total/nproc -------------------------
+    params = SimpleModel(hidden_dim=HIDDEN).init(jax.random.PRNGKey(0))
+    total_fp32 = sum(int(np.prod(l.shape)) * 4
+                     for l in jax.tree.leaves(params))
+    staged = engine._host_opt.staged_bytes()
+    # each process addresses 4 of the 8 dp shards; leaves that don't
+    # shard stay replicated but deduplicate to ONE block per process
+    assert staged <= total_fp32 * 0.75, (staged, total_fp32)
+    assert staged >= total_fp32 * 0.25, (staged, total_fp32)
+
+    # --- step parity with the single-controller tier -----------------
+    rng = np.random.default_rng(0)
+    gx = rng.normal(size=(32, HIDDEN)).astype(np.float32)
+    gy = (0.5 * gx).astype(np.float32)
+    lo, hi = (0, 16) if pid == 0 else (16, 32)
+    losses = []
+    for _ in range(5):
+        loss = engine.train_batch((gx[lo:hi], gy[lo:hi]))
+        losses.append(float(np.asarray(loss)))
+    ref = json.load(open(os.path.join(out_dir, "ref_losses.json")))
+    np.testing.assert_allclose(losses, ref, rtol=2e-3, atol=2e-3)
+
+    # --- checkpoint roundtrip (per-process shard files) ---------------
+    engine.save_checkpoint(out_dir, tag="mpoff")
+    cont = float(np.asarray(engine.train_batch((gx[lo:hi], gy[lo:hi]))))
+
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN), config=cfg, mesh=mesh,
+        seed=9)
+    path, _ = engine2.load_checkpoint(out_dir, tag="mpoff")
+    assert path is not None
+    got = float(np.asarray(engine2.train_batch((gx[lo:hi], gy[lo:hi]))))
+    assert abs(got - cont) < 1e-5, (got, cont)
+
+    print(f"WORKER_{pid}_OK staged={staged} total={total_fp32} "
+          f"loss={losses[-1]:.6f} resume={got:.6f}")
+
+
+if __name__ == "__main__":
+    main()

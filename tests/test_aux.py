@@ -244,6 +244,49 @@ def test_checkpoint_preserves_values_and_grads():
     assert not ac.is_configured()
 
 
+def test_cpu_checkpointing_selects_offload_policy():
+    """``cpu_checkpointing`` must wire the HOST-OFFLOAD remat policy on
+    this jax (reference moves saved activations to CPU,
+    checkpointing.py:382-408 there) — not silently fall back to full
+    remat.  The policy is asserted behaviorally: for a no-batch-dim dot
+    it must answer Offloadable(device -> pinned_host).  (The on-TPU HLO
+    check — residuals annotated into host memory space — lives in
+    diag_hostperf.py's remat_offload probe; CPU lowering erases memory
+    kinds, so it cannot be asserted here.)"""
+    ac.reset()
+    ac.configure(deepspeed_config={"activation_checkpointing": {
+        "cpu_checkpointing": True}})
+    assert ac._policy is not None, (
+        "cpu_checkpointing fell back to full remat on a jax that "
+        "provides the offload policy")
+
+    def f(w, x):
+        return x @ w
+
+    jaxpr = jax.make_jaxpr(f)(jnp.ones((8, 8)), jnp.ones((4, 8)))
+    eqn = jaxpr.jaxpr.eqns[0]
+    verdict = ac._policy(eqn.primitive,
+                         *[v.aval for v in eqn.invars], **eqn.params)
+    assert type(verdict).__name__ == "Offloadable", verdict
+    assert verdict.src == "device" and verdict.dst == "pinned_host", verdict
+
+    # and grads through the offload policy match the plain function
+    def block(x, w):
+        for _ in range(3):
+            x = jnp.tanh(x @ w)
+        return x
+
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((4, 8)),
+                    jnp.float32)
+    w = jnp.asarray(np.random.default_rng(5).standard_normal((8, 8)),
+                    jnp.float32)
+    g_off = jax.grad(lambda w: jnp.sum(ac.checkpoint(block, x, w) ** 2))(w)
+    g = jax.grad(lambda w: jnp.sum(block(x, w) ** 2))(w)
+    np.testing.assert_allclose(np.asarray(g_off), np.asarray(g),
+                               rtol=1e-5, atol=1e-6)
+    ac.reset()
+
+
 def test_rng_tracker_fork_advances():
     tracker = ac.RNGStatesTracker()
     tracker.add("mp", 17)

@@ -268,3 +268,33 @@ def test_size_preserving_layout_reshape_on_load(tmp_path):
     got = float(e2.train_batch(next(iter(random_batches(
         e2.train_batch_size, HIDDEN, num_batches=1, seed=9)))))
     assert got == pytest.approx(ref, abs=1e-5)
+
+
+def test_dpu_dispatch_counter_restores_from_global_steps(tmp_path):
+    """The xla-tier DPU rng stream is seeded from global_steps on
+    restore, NOT opt_state.count: count excludes overflow-skipped steps,
+    and seeding from it would replay dropout seeds already consumed
+    before the save (advisor finding, round 3)."""
+    def dpu_engine():
+        return _engine(
+            stage=2, precision="bf16",
+            zero_optimization={"stage": 2, "cpu_offload": True,
+                               "offload_impl": "xla",
+                               "delayed_param_update": True})
+
+    eng = dpu_engine()
+    _train(eng, steps=3)
+    assert eng._xla_dpu_dispatch == 3
+    # simulate a run that overflow-skipped one step: global_steps counts
+    # every dispatch, opt count only the applied ones
+    eng.skipped_steps = 1
+    eng.save_checkpoint(str(tmp_path), tag="t")
+    applied = int(np.asarray(jax.device_get(eng.state.opt_state.count)))
+
+    eng2 = dpu_engine()
+    eng2.load_checkpoint(str(tmp_path), tag="t")
+    assert eng2._xla_dpu_dispatch == 3  # == global_steps, NOT applied
+    assert eng2._xla_dpu_dispatch >= applied
+    # and the stream continues without error
+    _train(eng2, steps=2, seed=7)
+    assert eng2._xla_dpu_dispatch == 5

@@ -648,6 +648,225 @@ class _PipelinedTrainModule(TrainModule):
         return sm(place(params), micros_in, micros_lb, rng,
                   jnp.asarray(loss_scale, jnp.float32))
 
+    # -----------------------------------------------------------------
+    # Uniform-tick 1F1B: the schedule that carries seq-axis collectives.
+    #
+    # The cond-based 1F1B above cannot compose with sequence parallelism:
+    # its F and B cond branches lower to DISTINCT collective instances,
+    # and at any tick different pipe ranks take different branches, so a
+    # seq collective's rendezvous never assembles (the empirical deadlock
+    # behind the old gpipe fallback).  Here every tick runs BOTH units on
+    # every rank, masked by activity:
+    #
+    #   F(m_f = t - s):        the uniform stage forward (gpipe's body);
+    #   B(m_b = t - (2S-1-s)): jax.vjp of the SAME uniform body at the
+    #                          ring-stashed boundary input, seeded at the
+    #                          last stage by the collective-free loss
+    #                          head's gradient.
+    #
+    # Timetable: F(m) at tick m+s, B(m) at tick m+2S-1-s; T = M+2S-1
+    # ticks.  Dependencies hold tick-to-tick: the F handoff (s -> s+1)
+    # and the cotangent handoff (s+1 -> s) each cross exactly one tick,
+    # and the last stage's B(m) at tick m+S follows its F(m) at m+S-1.
+    # The collective footprint per tick is IDENTICAL on every rank —
+    # one uniform forward + one uniform vjp — so the seq ppermutes and
+    # their transposes rendezvous across the whole mesh.
+    #
+    # Cost model vs the alternatives: every tick pays fwd + (refwd+bwd)
+    # ~ 3 units x (M+2S-1) ticks — the same total as gpipe-with-remat's
+    # 3(M+S-1) for M >> S — while activation liveness stays a ring of
+    # min(2S-1, M) boundary slots instead of gpipe's O(M) (stage s holds
+    # a micro's input for 2(S-s)-1 ticks).  The reference has no
+    # analogue: its interpreter dispatches per-rank instruction lists
+    # (runtime/pipe/schedule.py:189-247) that SPMD cannot express
+    # divergently when collectives ride inside the stage body.
+    # -----------------------------------------------------------------
+    def value_and_grads_uniform(self, params, batch, rng, loss_scale):
+        """(scaled mean loss, grads), uniform-tick 1F1B.  Contract
+        matches ``value_and_grads``: grads of d(loss_scale * mean_loss)
+        accumulated in fp32; params arrive in compute dtype."""
+        pm, S, M = self.pm, self.num_stages, self.num_micro
+        mesh = self.mesh
+        plan = pm.stack_plan()
+        micros_in, micros_lb, boundary, parts = self._prepare(
+            params, batch, rng)
+        uni = self._uniform_stack_info()
+        if uni is None:
+            raise NotImplementedError(
+                "the uniform-tick 1F1B schedule needs a uniformly stacked "
+                "PipelineModule (equal stacked rows per stage, non-stacked "
+                "layers only as a stage-0 prefix / last-stage suffix); "
+                "this module's partition is not uniform — use gpipe")
+        uname, rows_tbl, prefix, suffix = uni
+        D = min(2 * S - 1, M)
+        T = M + 2 * S - 1
+
+        from jax.sharding import AxisType as _AT
+        from ..parallel.sequence import SEQ_AXIS as _SEQ
+        _seq_explicit = (
+            dict(zip(mesh.axis_names,
+                     getattr(mesh, "axis_types", ()))).get(_SEQ)
+            == _AT.Explicit)
+
+        param_in_specs = {
+            k: jax.tree.map(lambda _: P(PIPE_AXIS) if k in plan else P(),
+                            v)
+            for k, v in params.items()}
+
+        def place(tree):
+            out = {}
+            for k, v in tree.items():
+                spec = P(PIPE_AXIS) if k in plan else P()
+                out[k] = jax.tree.map(
+                    lambda l, spec=spec: jax.lax.with_sharding_constraint(
+                        l, NamedSharding(mesh, spec)), v)
+            return out
+
+        def spmd(params_in, micros_in, micros_lb, rng, scale):
+            stage = jax.lax.axis_index(PIPE_AXIS)
+            local = {k: (jax.tree.map(lambda a: jnp.squeeze(a, 0), v)
+                         if k in plan else v)
+                     for k, v in params_in.items()}
+            rows = jnp.asarray(rows_tbl)
+            layers = pm.build_layers()
+
+            def tag_seq(v):
+                # see loss_fn's tag_seq: pin the boundary layout at every
+                # producer so no resharding lands inside a divergent cond
+                nd = getattr(v, "ndim", 0)
+                if nd < 2:
+                    return v
+                spec = P(*([DATA_AXIS, _SEQ] + [None] * (nd - 2)))
+                if _seq_explicit:
+                    return jax.sharding.reshard(v, spec)
+                return jax.lax.with_sharding_constraint(
+                    v, NamedSharding(jax.sharding.get_abstract_mesh(),
+                                     spec))
+
+            def stacked_rows(local_tree, x, mrng):
+                st = local_tree[uname]
+                for j in range(rows_tbl.shape[1]):
+                    lp = jax.tree.map(lambda a, j=j: a[j], st)
+                    lrng = jax.random.fold_in(mrng, rows[stage, j])
+                    x = layers[int(rows_tbl[0][j])].apply(
+                        lp, x, lrng, train=True)
+                return x
+            if pm.stage_remat:
+                stacked_rows = jax.checkpoint(stacked_rows)
+
+            def stage_fn(local_tree, buf, m_idx):
+                """The uniform stage body (prefix + select + stacked
+                rows) — the SAME function the F unit runs forward and
+                the B unit vjps, so their collective footprints match."""
+                mrng = jax.random.fold_in(rng, m_idx)
+                x = jax.tree.map(lambda a: a[m_idx], micros_in)
+                for i in prefix:
+                    x = pm.apply_layer(i, local_tree, x, mrng, train=True)
+                x = jnp.where(stage == 0, tag_seq(x), buf)
+                return tag_seq(stacked_rows(local_tree, x, mrng))
+
+            def head_fn(local_tree, y, m_idx):
+                """Last-stage suffix + loss — collective-free by the
+                uniform contract, so it may live inside a cond."""
+                mrng = jax.random.fold_in(rng, m_idx)
+                z = y
+                for i in suffix:
+                    z = pm.apply_layer(i, local_tree, z, mrng, train=True)
+                lb = jax.tree.map(lambda a: tag_seq(a[m_idx]), micros_lb)
+                if self._loss_takes_params:
+                    lp = _ReplicatedParamsView(
+                        pm.replicated_view(local_tree))
+                    lv = pm.loss_fn(lp, z, lb)
+                else:
+                    lv = pm.loss_fn(z, lb)
+                return lv.astype(jnp.float32) * (scale / M)
+
+            def tick(carry, t):
+                buf_f, buf_ct, ring, gacc, loss_sum = carry
+                # B's stash read comes FIRST: when D divides 2S-1-2s the
+                # F unit's write this tick lands on the very slot B(m_b)
+                # needs (stashed at tick m_b+s) — read the old value
+                # before overwriting
+                m_b = t - (2 * S - 1 - stage)
+                mb_idx = jnp.clip(m_b, 0, M - 1)
+                act_b = (m_b >= 0) & (m_b < M)
+                x_b = jax.lax.dynamic_index_in_dim(ring, mb_idx % D, 0,
+                                                   keepdims=False)
+                # ---- F unit (uniform forward) ----
+                m_f = t - stage
+                mf_idx = jnp.clip(m_f, 0, M - 1)
+                act_f = (m_f >= 0) & (m_f < M)
+                y = stage_fn(local, buf_f, mf_idx)
+                y = jnp.where(act_f, y, jnp.zeros_like(y))
+                slot = mf_idx % D
+                cur = jax.lax.dynamic_index_in_dim(ring, slot, 0,
+                                                   keepdims=False)
+                ring = jax.lax.dynamic_update_slice_in_dim(
+                    ring, jnp.where(act_f, buf_f, cur)[None], slot, 0)
+                # ---- B unit (uniform vjp of the same body) ----
+                y_b, vjp_fn = jax.vjp(
+                    lambda lt, bb: stage_fn(lt, bb, mb_idx), local, x_b)
+
+                def head_branch(_):
+                    return jax.value_and_grad(
+                        head_fn, argnums=(0, 1))(local, y_b, mb_idx)
+
+                def head_skip(_):
+                    return (jnp.asarray(0.0, jnp.float32),
+                            (jax.tree.map(jnp.zeros_like, local),
+                             jnp.zeros_like(y_b)))
+
+                lv, (gl_h, gy) = jax.lax.cond(
+                    act_b & (stage == S - 1), head_branch, head_skip, None)
+                ct = jnp.where(stage == S - 1, gy.astype(buf_ct.dtype),
+                               buf_ct)
+                gl_s, gx = vjp_fn(ct)
+                gacc = jax.tree.map(
+                    lambda acc, g1, g2: acc + jnp.where(
+                        act_b, (g1.astype(jnp.float32)
+                                + g2.astype(jnp.float32)),
+                        jnp.zeros_like(acc)),
+                    gacc, gl_s, gl_h)
+                gx = jnp.where(act_b, gx, jnp.zeros_like(gx))
+                # ---- handoffs (every tick, schedule-invariant) ----
+                buf_f2 = jax.lax.ppermute(
+                    y, PIPE_AXIS,
+                    perm=[(i, i + 1) for i in range(S - 1)])
+                buf_ct2 = jax.lax.ppermute(
+                    gx.astype(boundary.dtype), PIPE_AXIS,
+                    perm=[(i + 1, i) for i in range(S - 1)])
+                return (buf_f2, buf_ct2, ring, gacc, loss_sum + lv), None
+
+            buf0 = tag_seq(jnp.zeros(boundary.shape, boundary.dtype))
+            ring0 = jnp.zeros((D,) + tuple(boundary.shape), boundary.dtype)
+            gacc0 = jax.tree.map(
+                lambda l: jnp.zeros(l.shape, jnp.float32), local)
+            carry0 = (buf0, tag_seq(jnp.zeros(boundary.shape,
+                                              boundary.dtype)),
+                      ring0, gacc0, jnp.asarray(0.0, jnp.float32))
+            (_, _, _, gacc, loss_sum), _ = jax.lax.scan(
+                tick, carry0, jnp.arange(T))
+
+            loss = jax.lax.psum(loss_sum, PIPE_AXIS)
+            grads = {}
+            for k, v in gacc.items():
+                if k in plan:
+                    grads[k] = jax.tree.map(
+                        lambda a: jnp.expand_dims(a, 0), v)
+                else:
+                    grads[k] = jax.tree.map(
+                        lambda a: jax.lax.psum(a, PIPE_AXIS), v)
+            return loss, grads
+
+        sm = jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(param_in_specs, P(), P(), P(), P()),
+            out_specs=(P(), param_in_specs),
+            axis_names={PIPE_AXIS},
+            check_vma=False)
+        return sm(place(params), micros_in, micros_lb, rng,
+                  jnp.asarray(loss_scale, jnp.float32))
+
 
 class PipelineEngine(DeepSpeedEngine):
     """DeepSpeedEngine whose step runs the compiled pipeline.
@@ -669,29 +888,28 @@ class PipelineEngine(DeepSpeedEngine):
             schedule = getattr(
                 getattr(config, "pipeline_config", None), "schedule",
                 "1f1b")
-        if schedule not in ("1f1b", "gpipe"):
+        if schedule not in ("1f1b", "1f1b_uniform", "gpipe"):
             raise ValueError(
-                f"pipeline schedule must be '1f1b' or 'gpipe', "
-                f"got {schedule!r}")
+                f"pipeline schedule must be '1f1b', '1f1b_uniform', or "
+                f"'gpipe', got {schedule!r}")
         from ..parallel.sequence import SEQ_AXIS
         if schedule == "1f1b" and dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
-            # 1F1B stages diverge per tick (F vs B parity), so seq-axis
-            # collectives inside the stage bodies would execute on only
-            # some pipe ranks — sequence parallelism rides the gpipe
-            # schedule's uniform tick body instead.  Verified empirically
-            # (round 3): forcing 1F1B here deadlocks at runtime — the F
-            # and B cond branches lower to DISTINCT collective-permute
-            # instances, stage-0 devices join the F-branch's rendezvous
-            # while stage-1 devices join the B-branch's, and each waits
-            # forever for the full participant set (XLA rendezvous
-            # "expected 8 threads, only 4 arrived").  Not fixable at this
-            # layer: XLA scopes the rendezvous to the op instance, not to
-            # the seq subgroup.
+            # The cond-based 1F1B stages diverge per tick (F vs B
+            # parity), so seq-axis collectives inside the stage bodies
+            # would execute on only some pipe ranks.  Verified
+            # empirically (round 3): forcing it deadlocks at runtime —
+            # the F and B cond branches lower to DISTINCT collective-
+            # permute instances and each rendezvous waits forever (XLA
+            # "expected 8 threads, only 4 arrived").  The uniform-tick
+            # 1F1B runs BOTH units masked on every tick, making the
+            # collective footprint schedule-invariant — 1F1B activation
+            # liveness (a min(2S-1, M) boundary ring, not gpipe's O(M))
+            # with seq collectives that rendezvous.
             log_dist(
-                "pipeline: seq axis > 1 — using the gpipe schedule "
-                "(1F1B's F/B tick divergence cannot carry seq-axis "
-                "collectives)", ranks=[0])
-            schedule = "gpipe"
+                "pipeline: seq axis > 1 — using the uniform-tick 1F1B "
+                "schedule (F+B units run masked every tick, so the seq "
+                "collectives are schedule-invariant)", ranks=[0])
+            schedule = "1f1b_uniform"
         pp = mesh_axis_size(mesh, PIPE_AXIS)
         if pp != model.num_stages:
             raise ValueError(
@@ -722,7 +940,7 @@ class PipelineEngine(DeepSpeedEngine):
         TrainSchedule's buffer bound, runtime/pipe/schedule.py:243-247).
         Same contract as the base implementation: fp32 mean grads and the
         per-scan-iteration scaled losses."""
-        if self.schedule != "1f1b":
+        if self.schedule not in ("1f1b", "1f1b_uniform"):
             return super()._scan_scaled_grads(
                 params, batch, scaler, step_rng, cast=cast,
                 constrain=constrain)
@@ -734,8 +952,10 @@ class PipelineEngine(DeepSpeedEngine):
         # grad-accum scan dim); the pipeline consumes all micros at once
         mb = jax.tree.map(lambda x: x[0], batch)
         rng = jax.random.fold_in(step_rng, 0)
-        scaled_loss, grads = self.module.value_and_grads(
-            pp, mb, rng, scaler.loss_scale)
+        vag = (self.module.value_and_grads_uniform
+               if self.schedule == "1f1b_uniform"
+               else self.module.value_and_grads)
+        scaled_loss, grads = vag(pp, mb, rng, scaler.loss_scale)
         if constrain:
             grads = constrain_grads(grads, self.zero_plan)
         inv = (1.0 / scaler.loss_scale).astype(jnp.float32)

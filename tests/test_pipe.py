@@ -382,7 +382,10 @@ def test_pipeline_sequence_parallel_ring():
     mesh = build_mesh(pp=2, dp=2, sp=2, tp=1)
     eng = PipelineEngine(build_gpt2_pipe(cfg_model, num_stages=2), cfg,
                          mesh)
-    assert eng.schedule == "gpipe"  # 1f1b auto-falls back under seq > 1
+    # 1f1b auto-upgrades to the uniform-tick variant under seq > 1 (the
+    # cond-based schedule's divergent branches cannot carry seq
+    # collectives; the uniform one runs F+B masked every tick)
+    assert eng.schedule == "1f1b_uniform"
     toks = np.random.default_rng(0).integers(
         0, 128, (cfg.train_batch_size, 33), dtype=np.int32)
     losses = [float(np.asarray(eng.train_batch(split_gpt2_batch(toks))))
@@ -407,6 +410,84 @@ def test_pipeline_sequence_parallel_ring():
           for _ in range(4)]
     for a, b in zip(losses, l2):
         assert abs(a - b) < 5e-2, (losses, l2)
+
+
+@pytest.mark.slow
+def test_uniform_1f1b_matches_cond_1f1b():
+    """The uniform-tick 1F1B (F+B units masked every tick — the
+    schedule-invariant collective footprint that composes with sequence
+    parallelism) must train identically to the cond-based 1F1B and to
+    gpipe on the same mesh/batch — it is a re-scheduling, not new math.
+    (reference contract: runtime/pipe/schedule.py:189-247 — TrainSchedule
+    is the default; this is its SPMD-expressible form.)"""
+    from deepspeed_tpu.models import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import (build_gpt2_pipe,
+                                                split_gpt2_batch)
+
+    cfg_model = GPT2Config(vocab_size=128, n_positions=64, d_model=32,
+                           n_layer=4, n_head=4, remat="block",
+                           attn_impl="dense")
+    toks = np.random.default_rng(3).integers(
+        0, 128, (8, 33), dtype=np.int32)
+    losses = {}
+    for sched in ("1f1b", "1f1b_uniform", "gpipe"):
+        mesh = build_mesh(pp=2, dp=4, tp=1)
+        cfg = DeepSpeedConfig({
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 2,
+            "steps_per_print": 10 ** 9,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        }, world_size=4)
+        eng = PipelineEngine(build_gpt2_pipe(cfg_model, num_stages=2),
+                             cfg, mesh, schedule=sched)
+        assert eng.schedule == sched
+        losses[sched] = [
+            float(np.asarray(eng.train_batch(split_gpt2_batch(toks))))
+            for _ in range(4)]
+    for k in ("1f1b_uniform", "gpipe"):
+        diffs = [abs(a - b)
+                 for a, b in zip(losses["1f1b"], losses[k])]
+        assert max(diffs) < 5e-3, (k, losses)
+    assert losses["1f1b_uniform"][-1] < losses["1f1b_uniform"][0]
+
+
+@pytest.mark.slow
+def test_uniform_1f1b_sp_matches_gpipe_sp():
+    """1F1B × sequence parallelism (the composition the old guard
+    forbade): ring attention over 'seq' inside the uniform-tick 1F1B
+    must match the gpipe×sp trajectory on the identical mesh/batch."""
+    from deepspeed_tpu.models import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import (build_gpt2_pipe,
+                                                split_gpt2_batch)
+
+    cfg_model = GPT2Config(vocab_size=128, n_positions=64, d_model=32,
+                           n_layer=4, n_head=4, remat=None,
+                           attn_impl="ring", dropout=0.0,
+                           embd_dropout=0.0)
+    toks = np.random.default_rng(5).integers(
+        0, 128, (8, 33), dtype=np.int32)
+    losses = {}
+    for sched in ("1f1b_uniform", "gpipe"):
+        mesh = build_mesh(pp=2, dp=2, sp=2, tp=1)
+        cfg = DeepSpeedConfig({
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 4,
+            "steps_per_print": 10 ** 9,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        }, world_size=2)
+        eng = PipelineEngine(build_gpt2_pipe(cfg_model, num_stages=2),
+                             cfg, mesh, schedule=sched)
+        losses[sched] = [
+            float(np.asarray(eng.train_batch(split_gpt2_batch(toks))))
+            for _ in range(4)]
+    diffs = [abs(a - b) for a, b in
+             zip(losses["1f1b_uniform"], losses["gpipe"])]
+    assert max(diffs) < 5e-3, losses
+    assert losses["1f1b_uniform"][-1] < losses["1f1b_uniform"][0]
 
 
 @pytest.mark.slow

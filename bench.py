@@ -166,9 +166,6 @@ def _bench_15b(jax, impl: str = "xla"):
     from deepspeed_tpu.config import DeepSpeedConfig
     from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 
-    cfg_model = GPT2Config(d_model=1600, n_layer=48, n_head=25,
-                           vocab_size=50257, n_positions=1024,
-                           remat="block", scan_layers=True)
     micro, ga, steps, _ = _15b_knobs()
     # OOM insurance: BENCH_15B_CHUNKS=K bounds device grad bytes to the
     # largest of K groups (offload_grad_chunks capacity mode) at K
@@ -178,6 +175,16 @@ def _bench_15b(jax, impl: str = "xla"):
     # compute (one-step param staleness) — flip on if the measured gap
     # to 45% MFU matches the host-section time
     dpu = os.environ.get("BENCH_15B_DPU", "0") == "1"
+    # BENCH_15B_STREAM=1: ZeRO-Infinity-style param streaming (host-
+    # resident stacked block params, one layer fetched per scan tick) —
+    # the deepest OOM fallback, and the capacity mode's throughput
+    # number when measured deliberately (xla tier only)
+    stream = (os.environ.get("BENCH_15B_STREAM", "0") == "1"
+              and impl == "xla")
+    cfg_model = GPT2Config(d_model=1600, n_layer=48, n_head=25,
+                           vocab_size=50257, n_positions=1024,
+                           remat="block", scan_layers=True,
+                           stream_scan=stream)
     seq = 1024
     mesh = build_mesh(devices=jax.devices()[:1])
     ds_cfg = DeepSpeedConfig({
@@ -190,6 +197,7 @@ def _bench_15b(jax, impl: str = "xla"):
             {"stage": 2, "cpu_offload": True, "offload_impl": impl},
             **({"offload_grad_chunks": chunks}
                if impl == "xla" and chunks > 1 else {}),
+            **({"param_streaming": True} if stream else {}),
             **({"delayed_param_update": True} if dpu else {})),
     }, world_size=1)
     if impl == "host":

@@ -193,6 +193,12 @@ def test_1f1b_activation_memory_independent_of_micro_count():
     # 4x the micro-batches, ~flat activation temp (ring is min(S,M)=2
     # boundaries; allow slack for per-tick scan bookkeeping)
     assert t16 < 1.6 * t4, (t4, t16)
+    # uniform-tick 1F1B keeps the same property (ring of min(2S-1, M))
+    # — it must not regress to gpipe's O(M) while buying seq-collective
+    # schedule-invariance
+    u4 = temp_bytes("1f1b_uniform", 4)
+    u16 = temp_bytes("1f1b_uniform", 16)
+    assert u16 < 1.6 * u4, (u4, u16)
     # the metric is real: the AD/GPipe schedule DOES grow with M
     g4 = temp_bytes("gpipe", 4)
     g16 = temp_bytes("gpipe", 16)

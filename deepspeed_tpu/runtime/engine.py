@@ -479,11 +479,6 @@ class DeepSpeedEngine:
                 # multi-host: dp-shard the fp32 master on device, let each
                 # process pull only ITS shards to host; compute params
                 # come back via one jitted all-gather over ICI
-                if config.zero_config.delayed_param_update:
-                    raise ValueError(
-                        "delayed_param_update × the multi-host host tier "
-                        "is not supported; use offload_impl='xla' for "
-                        "DPU at multi-host scale")
                 master_shardings = self.zero_plan.master_shardings(master)
                 master_dev = _device_put_tree(master, master_shardings)
                 self._host_opt = ShardedHostOffloadOptimizer(
@@ -1780,7 +1775,12 @@ class DeepSpeedEngine:
         there), each host Adams only its shards, and the updated lowp
         shards all-gather to the compute sharding on device."""
         if getattr(self, "_offload_sharded", False):
-            lowp = self._host_opt.step(self._reshard_to_master(grads))
+            if isinstance(grads, list):
+                # DPU-stashed host blocks (pull_local's form)
+                lowp = self._host_opt.step_local(grads)
+            else:
+                lowp = self._host_opt.step(
+                    self._reshard_to_master(grads))
             self._compute_params = self._sharded_gather(lowp)
             return
         lowp = self._host_opt.step(grads)
@@ -1827,7 +1827,6 @@ class DeepSpeedEngine:
             self._dpu_flush()
             finite_b = bool(finite)  # syncs: step t's compute done
             if finite_b:
-                self._start_small_leaf_d2h(grads)
                 # stash HOST copies: keeping the jax arrays would pin a
                 # full device gradient tree alive across the next step
                 # (one extra grad tree of peak HBM — the opposite of
@@ -1835,9 +1834,15 @@ class DeepSpeedEngine:
                 # flight, large leaves stream piece-wise — and every pull
                 # is watchdogged (dtype-preserving, so the stash stays at
                 # 1x the grads' bytes) so a link that degrades
-                # mid-training fails cleanly.
-                from .offload import guarded_tree_pull
-                self._dpu_pending = guarded_tree_pull(grads)
+                # mid-training fails cleanly.  Sharded tier: each process
+                # stashes only its dedup'd dp-shard blocks.
+                if getattr(self, "_offload_sharded", False):
+                    self._dpu_pending = self._host_opt.pull_local(
+                        self._reshard_to_master(grads))
+                else:
+                    self._start_small_leaf_d2h(grads)
+                    from .offload import guarded_tree_pull
+                    self._dpu_pending = guarded_tree_pull(grads)
         else:
             finite_b = bool(finite)
             if finite_b:

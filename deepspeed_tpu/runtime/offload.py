@@ -574,21 +574,13 @@ class ShardedHostOffloadOptimizer:
             if dt is not None else g["block"].copy(), np_dt)
 
     # -- the step -------------------------------------------------------
-    def step(self, grads):
-        """C++ Adam over THIS process's shards only.  ``grads``: global
-        jax arrays whose sharding must match the master's (the engine
-        constrains them with the ZeRO plan).  Returns global
-        compute-dtype params (master-sharded; gather happens in the
-        engine's jitted identity).  Poisons on mid-step failure exactly
-        like the single-controller tier."""
-        if self._poisoned is not None:
-            raise RuntimeError(
-                "ShardedHostOffloadOptimizer is poisoned: a previous "
-                "step failed mid-update. Restore from a checkpoint. "
-                f"Original error: {self._poisoned!r}")
-        g_leaves = jax.tree.leaves(grads)
-        flat_p, flat_g = [], []
-        for leaf_groups, gleaf in zip(self._local, g_leaves):
+    def _local_grad_shards(self, grads):
+        """This process's per-group grad shards (single-device jax
+        arrays) in the blocks' flat order.  ``grads``: global jax arrays
+        whose sharding must match the master's (the engine constrains
+        them with the ZeRO plan)."""
+        flat_g = []
+        for leaf_groups, gleaf in zip(self._local, jax.tree.leaves(grads)):
             by_key = {}
             for s in gleaf.addressable_shards:
                 by_key.setdefault(_index_key(s.index), s)
@@ -600,8 +592,33 @@ class ShardedHostOffloadOptimizer:
                         "sharding — the sharded host tier requires the "
                         "ZeRO plan's grad placement (engine constrains "
                         "this; custom grad trees must match)")
-                flat_p.append(g["block"])
                 flat_g.append(by_key[k].data)
+        return flat_g
+
+    def pull_local(self, grads):
+        """Pull this process's grad shards to host numpy (dedup by
+        index, dtype-preserving, chunked + watchdogged) — the DPU stash
+        form: the device grad tree can be freed while the host copies
+        wait for the overlapped ``step_local``."""
+        flat_g = self._local_grad_shards(grads)
+        cb = pull_chunk_bytes()
+        for a in flat_g:
+            if hasattr(a, "copy_to_host_async") and (
+                    cb <= 0 or getattr(a, "nbytes", 0) <= cb):
+                a.copy_to_host_async()
+        return guarded_tree_pull(flat_g)
+
+    def step(self, grads):
+        """C++ Adam over THIS process's shards only.  Returns global
+        compute-dtype params (master-sharded; gather happens in the
+        engine's jitted identity).  Poisons on mid-step failure exactly
+        like the single-controller tier."""
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "ShardedHostOffloadOptimizer is poisoned: a previous "
+                "step failed mid-update. Restore from a checkpoint. "
+                f"Original error: {self._poisoned!r}")
+        flat_g = self._local_grad_shards(grads)
         # async D2H only for shards the puller fetches in ONE native call
         # — larger shards stream piece-wise (chunked_device_get); a full-
         # shard async copy alongside the slice pulls would move the same
@@ -611,7 +628,22 @@ class ShardedHostOffloadOptimizer:
             if hasattr(a, "copy_to_host_async") and (
                     cb <= 0 or getattr(a, "nbytes", 0) <= cb):
                 a.copy_to_host_async()
-        puller = _PrefetchPuller(flat_g)
+        return self._adam_over_blocks(flat_g, prefetch=True)
+
+    def step_local(self, blocks):
+        """The DPU apply half: C++ Adam over host blocks that
+        ``pull_local`` staged earlier (numpy; no device access)."""
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "ShardedHostOffloadOptimizer is poisoned: a previous "
+                "step failed mid-update. Restore from a checkpoint. "
+                f"Original error: {self._poisoned!r}")
+        return self._adam_over_blocks(list(blocks), prefetch=False)
+
+    def _adam_over_blocks(self, flat_g, prefetch: bool):
+        flat_p = [g["block"] for leaf in self._local for g in leaf]
+        assert len(flat_p) == len(flat_g), (len(flat_p), len(flat_g))
+        puller = _PrefetchPuller(flat_g) if prefetch else None
         try:
             outs = self.opt.step(flat_p, flat_g,
                                  out_dtype=self._out_dtype,
@@ -620,7 +652,8 @@ class ShardedHostOffloadOptimizer:
             self._poisoned = e
             raise
         finally:
-            puller.close()
+            if puller is not None:
+                puller.close()
         dt = lowp_np_dtype(self._out_dtype)
         np_dt = dt if dt is not None else np.float32
         if outs is None:

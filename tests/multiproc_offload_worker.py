@@ -82,8 +82,19 @@ def main():
     got = float(np.asarray(engine2.train_batch((gx[lo:hi], gy[lo:hi]))))
     assert abs(got - cont) < 1e-5, (got, cont)
 
+    # --- delayed parameter update × sharded tier ----------------------
+    cfg_dpu = dict(cfg)
+    cfg_dpu["zero_optimization"] = dict(
+        cfg["zero_optimization"], delayed_param_update=True)
+    eng3, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN), config=cfg_dpu, mesh=mesh)
+    dl = [float(np.asarray(eng3.train_batch((gx[lo:hi], gy[lo:hi]))))
+          for _ in range(5)]
+    assert all(np.isfinite(v) for v in dl), dl
+    assert dl[-1] < dl[0], dl
+
     print(f"WORKER_{pid}_OK staged={staged} total={total_fp32} "
-          f"loss={losses[-1]:.6f} resume={got:.6f}")
+          f"loss={losses[-1]:.6f} resume={got:.6f} dpu={dl[-1]:.6f}")
 
 
 if __name__ == "__main__":

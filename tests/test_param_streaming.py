@@ -165,6 +165,31 @@ def test_engine_rejects_streaming_without_model_support():
                         _ds_cfg(1, stream=True), mesh=mesh)
 
 
+def test_streaming_composes_with_ring_sequence_parallel():
+    """Long-context × capacity: host-resident stacked params fetched per
+    scan tick WHILE the attention inside each layer runs ring-parallel
+    over the 'seq' axis (the fetch's device placement and the ring's
+    shard_map both read the engine's ambient mesh)."""
+    tok = _tokens()[:2]
+    mesh = build_mesh(dp=1, sp=2, devices=jax.devices()[:2])
+    cfg_m = GPT2Config(d_model=64, n_layer=3, n_head=4, vocab_size=256,
+                      n_positions=64, remat="block", scan_layers=True,
+                      stream_scan=True, attn_impl="ring", dropout=0.0)
+    ds = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "offload_impl": "xla",
+                              "param_streaming": True},
+    }, world_size=1)
+    eng = DeepSpeedEngine(GPT2Model(cfg_m), ds, mesh=mesh)
+    ls = _run(eng, tok, 3)
+    assert all(np.isfinite(v) for v in ls) and ls[-1] < ls[0], ls
+
+
 def test_engine_step_traces_under_ambient_mesh():
     """The engine must establish jax.set_mesh around compiled-step
     tracing: the streaming fetch, sequence-parallel axis discovery, and

@@ -238,8 +238,11 @@ PIPELINE_SEED_LAYERS_DEFAULT = False
 PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL = "activation_checkpoint_interval"
 PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT = 0
 # compiled schedule: "1f1b" (hand-scheduled backward, min(S,M) activation
-# ring — the reference TrainSchedule's memory bound) or "gpipe" (AD over
-# the fill/drain scan; O(M) boundary liveness, kept as the fallback)
+# ring — the reference TrainSchedule's memory bound), "1f1b_uniform"
+# (F+B units masked every tick: schedule-invariant collectives — the
+# variant that carries sequence parallelism; min(2S-1,M) ring; selected
+# automatically for "1f1b" when the mesh has seq > 1), or "gpipe" (AD
+# over the fill/drain scan; O(M) boundary liveness, kept as the fallback)
 PIPELINE_SCHEDULE = "schedule"
 PIPELINE_SCHEDULE_DEFAULT = "1f1b"
 

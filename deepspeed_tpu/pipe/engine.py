@@ -915,6 +915,21 @@ class PipelineEngine(DeepSpeedEngine):
             raise ValueError(
                 f"mesh pipe axis ({pp}) != PipelineModule.num_stages "
                 f"({model.num_stages})")
+        if getattr(config.zero_config, "cpu_offload", False):
+            # the reference never composed these either: its offload
+            # rides the ZeRO-2 engine, which its pipeline engine bypasses
+            # (reference runtime/pipe/engine.py drives fwd/bwd itself).
+            # Here the offload tiers flatten the master into dp-sharded
+            # pieces, a layout the pipe-sharded stacked params do not
+            # fit.  Capacity for big models: pipeline stages already
+            # hold 1/S of the params; compose with ZeRO-3 for the
+            # optimizer state, or use the plain engine's offload +
+            # param_streaming stack.
+            raise ValueError(
+                "cpu_offload × pipeline parallelism is not supported: "
+                "use ZeRO-3 with the pipeline engine (stage-local + "
+                "data-sharded state), or the plain engine's offload/"
+                "param_streaming capacity stack")
         self.pipeline_module = model
         self.schedule = schedule
         num_micro = config.gradient_accumulation_steps

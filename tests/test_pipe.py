@@ -412,6 +412,32 @@ def test_pipeline_sequence_parallel_ring():
         assert abs(a - b) < 5e-2, (losses, l2)
 
 
+def test_pipeline_rejects_cpu_offload():
+    """PP × cpu_offload must fail loudly at construction (the offload
+    tiers' dp-sharded flat master layout does not fit pipe-sharded
+    stacks; the reference never composed them either) — not crash deep
+    inside the step builder."""
+    from deepspeed_tpu.models import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import build_gpt2_pipe
+
+    cfg_model = GPT2Config(vocab_size=128, n_positions=64, d_model=32,
+                           n_layer=4, n_head=4, remat=None,
+                           attn_impl="dense")
+    mesh = build_mesh(pp=2, dp=2, tp=1, devices=jax.devices()[:4])
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 4,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "offload_impl": "xla"},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }, world_size=2)
+    with pytest.raises(ValueError, match="pipeline"):
+        PipelineEngine(build_gpt2_pipe(cfg_model, num_stages=2), cfg,
+                       mesh)
+
+
 @pytest.mark.slow
 def test_uniform_1f1b_matches_cond_1f1b():
     """The uniform-tick 1F1B (F+B units masked every tick — the

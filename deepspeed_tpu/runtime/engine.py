@@ -2383,6 +2383,24 @@ class DeepSpeedEngine:
     def get_skipped_steps(self):
         return int(self.state.skipped_steps)
 
+    @property
+    def skipped_steps(self) -> int:
+        """Live overflow-skip count (reads the traced state — a plain
+        python counter here would stay 0 forever on the compiled-step
+        paths, silently under-reporting fp16 warmdown skips)."""
+        state = getattr(self, "state", None)
+        if state is None:
+            return 0
+        return int(np.asarray(jax.device_get(state.skipped_steps)))
+
+    @skipped_steps.setter
+    def skipped_steps(self, v):
+        state = getattr(self, "state", None)
+        if state is not None:
+            self.state = state._replace(
+                skipped_steps=self._place_scalar(
+                    jnp.asarray(int(v), jnp.int32)))
+
     def _report(self, metrics: StepMetrics):
         # throughput from report-interval wall time, measured AFTER the
         # metrics materialization above drained the device: with async

@@ -93,8 +93,34 @@ def main():
     assert all(np.isfinite(v) for v in dl), dl
     assert dl[-1] < dl[0], dl
 
+    # --- ZeRO-3 × offload-xla × param streaming, 2 processes ----------
+    # (dryrun leg 10 runs this single-process; here the pieces and the
+    # host-resident streamed leaves span two REAL processes)
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    cfg_m = GPT2Config(d_model=32, n_layer=2, n_head=4, vocab_size=128,
+                       n_positions=32, remat="block", scan_layers=True,
+                       stream_scan=True, attn_impl="dense")
+    cfg_s = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3, "cpu_offload": True,
+                              "offload_impl": "xla",
+                              "param_streaming": True},
+    }
+    eng5, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2Model(cfg_m), config=cfg_s, mesh=mesh)
+    toks = np.random.default_rng(2).integers(0, 128, (8, 17),
+                                             dtype=np.int32)
+    sl = [float(np.asarray(eng5.train_batch(toks[4 * pid:4 * pid + 4])))
+          for _ in range(3)]
+    assert all(np.isfinite(v) for v in sl), sl
+
     print(f"WORKER_{pid}_OK staged={staged} total={total_fp32} "
-          f"loss={losses[-1]:.6f} resume={got:.6f} dpu={dl[-1]:.6f}")
+          f"loss={losses[-1]:.6f} resume={got:.6f} dpu={dl[-1]:.6f} "
+          f"stream={sl[-1]:.6f}")
 
 
 if __name__ == "__main__":

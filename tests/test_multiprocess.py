@@ -99,7 +99,8 @@ def test_two_process_host_offload(tmp_path):
     with open(os.path.join(tmp_path, "ref_losses.json"), "w") as f:
         json.dump(ref, f)
 
-    outs = _run_workers("multiproc_offload_worker.py", tmp_path)
+    outs = _run_workers("multiproc_offload_worker.py", tmp_path,
+                        timeout=360)
     # staged bytes printed by each worker prove the per-host partition
     for out in outs:
         assert "staged=" in out

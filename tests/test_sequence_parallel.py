@@ -229,8 +229,29 @@ def test_engine_level_sp_training_matches_dense():
     eng_dense = DeepSpeedEngine(
         GPT2Model(GPT2Config(attn_impl="dense", **kw)), cfg,
         mesh=build_mesh(pp=1, dp=2, tp=1, devices=jax.devices()[:2]))
-    for _ in range(3):
-        loss_sp = eng_sp.train_batch(toks)
-        loss_dense = eng_dense.train_batch(toks)
+    # The ring implementation must ACTUALLY engage inside the engine's
+    # jitted step.  The model discovers the 'seq' axis from
+    # jax.sharding.get_abstract_mesh() at trace time — empty inside jit
+    # unless the engine establishes the ambient mesh (jax.set_mesh in
+    # _pallas_scope), in which case ring would silently degrade to the
+    # GSPMD dense fallback and this parity test would still pass
+    # (regression guard for the round-4 ambient-mesh fix).
+    import deepspeed_tpu.parallel.sequence as seq_mod
+    calls = []
+    real_ring = seq_mod.ring_attention
+
+    def counting_ring(*a, **k):
+        calls.append(1)
+        return real_ring(*a, **k)
+
+    seq_mod.ring_attention = counting_ring
+    try:
+        for _ in range(3):
+            loss_sp = eng_sp.train_batch(toks)
+            loss_dense = eng_dense.train_batch(toks)
+    finally:
+        seq_mod.ring_attention = real_ring
+    assert calls, ("ring_attention never traced — the engine step saw "
+                   "an empty abstract mesh (sp silently degraded)")
     assert abs(float(np.asarray(loss_sp))
                - float(np.asarray(loss_dense))) < 0.05

@@ -242,26 +242,37 @@ def _device_memory_kind() -> str:
     return _DEVICE_MEMORY_KIND
 
 
+def stream_fetch(tree, specs_tree, index, rows=None):
+    """Fetch the streaming slice of every leaf's leading (layer) axis and
+    move it into device memory with the leaf's own TP sharding (leading
+    dim dropped for a single squeezed row when ``rows`` is None, kept at
+    length ``rows`` otherwise).  Uses the engine's ambient mesh
+    (``jax.set_mesh``); with no mesh set (eager unit use) the fetch
+    degrades to a plain index.  Shared by the GPT-2 layer scan and the
+    MoE group scan."""
+    am = jax.sharding.get_abstract_mesh()
+    has_mesh = am is not None and bool(dict(getattr(am, "shape", {})))
+    kind = _device_memory_kind() if has_mesh else None
+
+    def one(a, spec):
+        if rows is None:
+            w = jax.lax.dynamic_index_in_dim(a, index, 0, keepdims=False)
+            sp = P(*tuple(spec)[1:])
+        else:
+            w = jax.lax.dynamic_slice_in_dim(a, index, rows, 0)
+            sp = P(*((None,) + tuple(spec)[1:]))
+        if not has_mesh:
+            return w
+        return jax.device_put(
+            w, jax.sharding.NamedSharding(am, sp, memory_kind=kind))
+
+    return jax.tree.map(one, tree, specs_tree)
+
+
 def _layer_fetcher(block_specs):
-    """Build the per-layer fetch for the streaming scan: dynamic-index
-    the leading layer axis of every block leaf and move the slice into
-    device memory with the leaf's own TP sharding (leading layer dim
-    dropped).  Uses the engine's ambient mesh (``jax.set_mesh``); with no
-    mesh set (eager unit use) the fetch degrades to a plain index."""
+    """Per-layer fetch for GPT-2's streaming scan (see stream_fetch)."""
     def fetch(block_params, i):
-        am = jax.sharding.get_abstract_mesh()
-        has_mesh = am is not None and bool(dict(getattr(am, "shape", {})))
-        kind = _device_memory_kind() if has_mesh else None
-
-        def one(a, spec):
-            w = jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
-            if not has_mesh:
-                return w
-            sh = jax.sharding.NamedSharding(
-                am, P(*tuple(spec)[1:]), memory_kind=kind)
-            return jax.device_put(w, sh)
-
-        return jax.tree.map(one, block_params, block_specs)
+        return stream_fetch(block_params, block_specs, i)
     return fetch
 
 

@@ -49,12 +49,21 @@ class GPT2MoEConfig(GPT2Config):
     # compiled group body, compile time O(1) in depth
     scan_layers: bool = False
     scan_groups: bool = False
+    stream_scan: bool = False        # fetch ONE group's params per scan
+                                     # tick (requires scan_groups) — pair
+                                     # with zero_optimization.
+                                     # param_streaming so device param
+                                     # bytes ~ one group
 
     def __post_init__(self):
         if self.scan_layers:
             raise ValueError(
                 "GPT2MoEModel always unrolls its heterogeneous layer "
                 "loop; scan_layers=True is not supported")
+        if self.stream_scan and not self.scan_groups:
+            raise ValueError(
+                "stream_scan requires scan_groups=True (the streaming "
+                "fetch rides the group scan)")
         if self.moe_layer_freq < 1:
             raise ValueError(
                 f"moe_layer_freq must be >= 1, got {self.moe_layer_freq}")
@@ -227,7 +236,42 @@ class GPT2MoEModel(TrainModule):
             return x + _dropout(y, drop, jax.random.fold_in(r_ffn, 1)), aux
 
         aux0 = jnp.zeros((), jnp.float32)
-        if cfg.scan_groups:
+        if cfg.scan_groups and cfg.stream_scan:
+            # Param-streaming form of the group scan: the stacks stay
+            # scan CONSTANTS (host-resident under zero_optimization.
+            # param_streaming) and the body fetches group g's rows with
+            # an explicit transfer to device memory — inside the remat'd
+            # body, so the backward re-fetches instead of keeping the
+            # stacks alive (see GPT2Model's streaming scan for the
+            # dense-model form).
+            from .gpt2 import stream_fetch
+            freq = cfg.moe_layer_freq
+            G = cfg.n_layer // freq
+            specs = self.param_partition_specs(params)
+
+            def group_body(carry, g):
+                x, aux = carry
+                ag = stream_fetch(params["attn"], specs["attn"],
+                                  g * freq, rows=freq)
+                dg = stream_fetch(params["dense_ffn"], specs["dense_ffn"],
+                                  g * (freq - 1), rows=freq - 1)
+                mg = stream_fetch(params["moe"], specs["moe"], g)
+                for j in range(freq - 1):
+                    apj = jax.tree.map(lambda a, j=j: a[j], ag)
+                    dpj = jax.tree.map(lambda a, j=j: a[j], dg)
+                    x = dense_block(
+                        x, apj, dpj, jax.random.fold_in(rng, g * freq + j))
+                apm = jax.tree.map(lambda a: a[freq - 1], ag)
+                x, a = moe_block(
+                    x, apm, mg,
+                    jax.random.fold_in(rng, g * freq + freq - 1))
+                return (x, aux + a), None
+
+            if cfg.remat == "block":
+                group_body = jax.checkpoint(group_body)
+            (x, aux_total), _ = jax.lax.scan(
+                group_body, (x, aux0), jnp.arange(G))
+        elif cfg.scan_groups:
             # One compiled group body regardless of depth: the layer loop
             # scans over groups of ``freq`` blocks (freq-1 dense + 1 MoE,
             # the fixed pattern is_moe_layer defines), with the stored
@@ -288,6 +332,19 @@ class GPT2MoEModel(TrainModule):
         x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
         logits = x @ params["wte"].astype(x.dtype).T
         return logits, aux_total
+
+    def streaming_param_spec(self, params):
+        """The stacked attn/dense-FFN/MoE leaves stream (one group per
+        scan tick); embeddings/final LN stay device-resident.  Requires
+        the group-scan form with explicit per-group fetch
+        (``stream_scan``)."""
+        if not (self.config.scan_groups and self.config.stream_scan):
+            return None
+        stacked = {"attn", "dense_ffn", "moe"}
+        return {
+            k: jax.tree.map(lambda _: k in stacked, v)
+            for k, v in params.items()
+        }
 
     def loss_fn(self, params, batch, rng, train: bool = True):
         tokens = batch["input_ids"] if isinstance(batch, dict) else batch

@@ -165,6 +165,47 @@ def test_engine_rejects_streaming_without_model_support():
                         _ds_cfg(1, stream=True), mesh=mesh)
 
 
+def test_moe_streaming_matches_plain_offload():
+    """MoE param streaming (one GROUP of stacked attn/dense/expert
+    params fetched per scan tick) must match the unstreamed group-scan
+    offload path exactly — placement, not math."""
+    from deepspeed_tpu.models import GPT2MoEConfig, GPT2MoEModel
+
+    tok = _tokens()
+    mesh = build_mesh(devices=jax.devices()[:1])
+    losses = {}
+    for stream in (False, True):
+        cfg_m = GPT2MoEConfig(
+            vocab_size=256, n_positions=64, d_model=64, n_layer=4,
+            n_head=4, n_experts=4, moe_layer_freq=2, attn_impl="dense",
+            remat="block", scan_groups=True, stream_scan=stream,
+            dropout=0.0)
+        ds = DeepSpeedConfig({
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "steps_per_print": 10 ** 9,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": dict(
+                {"stage": 2, "cpu_offload": True, "offload_impl": "xla"},
+                **({"param_streaming": True} if stream else {})),
+        }, world_size=1)
+        eng = DeepSpeedEngine(GPT2MoEModel(cfg_m), ds, mesh=mesh)
+        losses[stream] = _run(eng, tok, 4)
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=1e-5, atol=1e-5)
+    assert losses[True][-1] < losses[True][0]
+
+
+def test_moe_stream_scan_requires_scan_groups():
+    from deepspeed_tpu.models import GPT2MoEConfig
+
+    with pytest.raises(ValueError, match="scan_groups"):
+        GPT2MoEConfig(vocab_size=256, n_positions=64, d_model=64,
+                      n_layer=4, n_head=4, n_experts=4,
+                      moe_layer_freq=2, stream_scan=True)
+
+
 def test_streaming_composes_with_ring_sequence_parallel():
     """Long-context × capacity: host-resident stacked params fetched per
     scan tick WHILE the attention inside each layer runs ring-parallel

@@ -254,6 +254,28 @@ def test_prefetch_puller_order_and_errors(monkeypatch):
         puller(h)
 
 
+def test_prefetch_puller_close_releases_skipped_leaves():
+    """The consumer may legitimately skip trailing leaves (the Adam loop
+    never requests non-fp32 ones).  close() must release the parked
+    worker — otherwise each step leaks a daemon thread holding a
+    reference to the whole grad tree — and fail any un-pulled slot a
+    late (buggy) request touches instead of hanging."""
+    leaves = [jnp.full((4,), float(i)) for i in range(8)]
+    before = threading.active_count()
+    puller = offload._PrefetchPuller(leaves)
+    out0 = puller(leaves[0])  # consume ONE leaf; skip the rest
+    np.testing.assert_array_equal(out0, np.zeros((4,), np.float32))
+    puller.close()
+    deadline = time.perf_counter() + 5.0
+    while threading.active_count() > before and \
+            time.perf_counter() < deadline:
+        time.sleep(0.02)
+    assert threading.active_count() <= before, "worker thread leaked"
+    # a late request for a never-pulled leaf fails, not hangs
+    with pytest.raises(RuntimeError, match="closed"):
+        puller(leaves[-1])
+
+
 def test_prefetch_puller_bounded_lookahead(monkeypatch):
     """The worker must stay <= LOOKAHEAD leaves past the consumer's need
     — the prefetch buffer is a few leaves, not a full grad tree."""

@@ -261,16 +261,18 @@ def test_prefetch_puller_close_releases_skipped_leaves():
     reference to the whole grad tree — and fail any un-pulled slot a
     late (buggy) request touches instead of hanging."""
     leaves = [jnp.full((4,), float(i)) for i in range(8)]
-    before = threading.active_count()
+    before = set(threading.enumerate())
     puller = offload._PrefetchPuller(leaves)
+    workers = set(threading.enumerate()) - before  # THIS puller's thread
+    assert workers, "no worker thread observed"
     out0 = puller(leaves[0])  # consume ONE leaf; skip the rest
     np.testing.assert_array_equal(out0, np.zeros((4,), np.float32))
     puller.close()
     deadline = time.perf_counter() + 5.0
-    while threading.active_count() > before and \
+    while any(t.is_alive() for t in workers) and \
             time.perf_counter() < deadline:
         time.sleep(0.02)
-    assert threading.active_count() <= before, "worker thread leaked"
+    assert not any(t.is_alive() for t in workers), "worker thread leaked"
     # a late request for a never-pulled leaf fails, not hangs
     with pytest.raises(RuntimeError, match="closed"):
         puller(leaves[-1])

@@ -1891,7 +1891,11 @@ class DeepSpeedEngine:
         return self._offload and not self._offload_host
 
     def _canonical_state(self):
-        """(master, opt_state) in per-parameter tree form, for saving."""
+        """(master, opt_state) in per-parameter tree form, for saving.
+        The optimizer plane is ALWAYS a FusedAdamState(count, mu, nu)
+        pytree regardless of tier — the one canonical shape is what lets
+        a checkpoint saved by any tier (plain device, xla offload, host
+        offload, sharded host offload) restore into any other."""
         if self._offload_xla:
             opt = self.state.opt_state
             return (self._unflatten_numpy(self.state.master_params),
@@ -1901,7 +1905,15 @@ class DeepSpeedEngine:
         if getattr(self, "_offload_sharded", False):
             # global (non-fully-addressable) fp32 arrays: the saver
             # writes per-process shard files and merges on load
-            return self._host_opt.canonical_state()
+            master, opt = self._host_opt.canonical_state()
+            return master, FusedAdamState(
+                count=np.asarray(opt["step"], np.int64),
+                mu=opt["mu"], nu=opt["nu"])
+        if self._offload_host:
+            opt = self.state.opt_state  # the host tier's {step, mu, nu}
+            return self.state.master_params, FusedAdamState(
+                count=np.asarray(opt["step"], np.int64),
+                mu=opt["mu"], nu=opt["nu"])
         return self.state.master_params, self.state.opt_state
 
     def _canonical_templates(self):
@@ -1915,7 +1927,15 @@ class DeepSpeedEngine:
             return tmpl(), FusedAdamState(
                 count=self.state.opt_state.count, mu=tmpl(), nu=tmpl())
         if getattr(self, "_offload_sharded", False):
-            return self._host_opt.canonical_templates()
+            master, opt = self._host_opt.canonical_templates()
+            return master, FusedAdamState(
+                count=np.asarray(opt["step"], np.int64),
+                mu=opt["mu"], nu=opt["nu"])
+        if self._offload_host:
+            opt = self.state.opt_state
+            return self.state.master_params, FusedAdamState(
+                count=np.asarray(opt["step"], np.int64),
+                mu=opt["mu"], nu=opt["nu"])
         return self.state.master_params, self.state.opt_state
 
     def _adopt_loaded(self, master_tree, opt_tree):
@@ -1953,7 +1973,12 @@ class DeepSpeedEngine:
         and refresh the device compute params."""
         self._dpu_pending = None  # loaded state supersedes any pending
         opt_tree = self.state.opt_state
-        if not (isinstance(opt_tree, dict) and "mu" in opt_tree):
+        if isinstance(opt_tree, FusedAdamState):
+            # canonical (cross-tier) form — a checkpoint saved by any
+            # tier, incl. plain device engines, restores here
+            opt_tree = {"step": opt_tree.count,
+                        "mu": opt_tree.mu, "nu": opt_tree.nu}
+        elif not (isinstance(opt_tree, dict) and "mu" in opt_tree):
             # module-only restore path: fresh moments (the loader built a
             # device optimizer state that doesn't apply to the host tier)
             opt_tree = None

@@ -298,3 +298,31 @@ def test_dpu_dispatch_counter_restores_from_global_steps(tmp_path):
     # and the stream continues without error
     _train(eng2, steps=2, seed=7)
     assert eng2._xla_dpu_dispatch == 5
+
+
+def test_cross_tier_offload_restore(tmp_path):
+    """The optimizer plane is saved as ONE canonical FusedAdamState
+    shape by every tier, so checkpoints cross freely between the xla
+    offload tier, the host (C++ Adam) tier, and plain device engines —
+    the reference's merge/re-partition elasticity extended across
+    offload implementations."""
+    def eng(impl, seed):
+        zero = {"stage": 2}
+        if impl:
+            zero.update({"cpu_offload": True, "offload_impl": impl})
+        return _engine(stage=2, seed=seed, dp=1, zero_optimization=zero)
+
+    batch = next(random_batches(2, HIDDEN, num_batches=1, seed=0))
+    for src, dst in (("xla", "host"), ("host", "xla"),
+                     (None, "host"), ("host", None)):
+        e1 = eng(src, seed=3)
+        for _ in range(3):
+            e1.train_batch(batch)
+        d = str(tmp_path / f"{src}-{dst}")
+        e1.save_checkpoint(d, tag="t")
+        ref = float(np.asarray(e1.train_batch(batch)))
+        e2 = eng(dst, seed=9)
+        path, _ = e2.load_checkpoint(d, tag="t")
+        assert path is not None, (src, dst)
+        got = float(np.asarray(e2.train_batch(batch)))
+        assert abs(got - ref) < 2e-4, (src, dst, got, ref)

@@ -326,3 +326,23 @@ def test_cross_tier_offload_restore(tmp_path):
         assert path is not None, (src, dst)
         got = float(np.asarray(e2.train_batch(batch)))
         assert abs(got - ref) < 2e-4, (src, dst, got, ref)
+
+
+def test_offload_elastic_dp_resize(tmp_path):
+    """ZeRO-Offload (xla tier) checkpoints resize across DP world sizes
+    like plain ZeRO ones — the flat host pieces are canonicalized to
+    per-parameter trees at save, so the dp=4 staging loads into a dp=2
+    engine's pieces (reference stage2.py:1712-1778 merge+repartition,
+    across the offload boundary)."""
+    zero = {"stage": 2, "cpu_offload": True, "offload_impl": "xla"}
+    eng = _engine(stage=2, dp=4, zero_optimization=zero)
+    _train(eng, steps=2)
+    eng.save_checkpoint(str(tmp_path), tag="oresize")
+    saved_master = eng._canonical_state()[0]
+
+    eng2 = _engine(stage=2, dp=2, seed=5, zero_optimization=zero)
+    path, _ = eng2.load_checkpoint(str(tmp_path), tag="oresize")
+    assert path is not None
+    _state_allclose(saved_master, eng2._canonical_state()[0])
+    losses = _train(eng2, steps=2, seed=11)
+    assert np.isfinite(losses).all()

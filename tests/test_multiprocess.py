@@ -104,3 +104,21 @@ def test_two_process_host_offload(tmp_path):
     # staged bytes printed by each worker prove the per-host partition
     for out in outs:
         assert "staged=" in out
+
+    # --- pod-shrink elasticity: the 2-process sharded save loads into
+    # THIS single process's single-controller host tier (per-process
+    # shard files merge on load; canonical FusedAdamState optimizer
+    # plane crosses the topology change) and reproduces the workers'
+    # post-restore step on the same global batch
+    import re
+    resume = {float(m.group(1)) for out in outs
+              for m in [re.search(r"resume=([0-9.]+)", out)] if m}
+    assert len(resume) == 1, resume  # global loss: both workers agree
+    eng1, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN), config=cfg, mesh=mesh,
+        seed=4)
+    assert not getattr(eng1, "_offload_sharded", False)
+    path, _ = eng1.load_checkpoint(str(tmp_path), tag="mpoff")
+    assert path is not None
+    got = float(np.asarray(eng1.train_batch((gx, gy))))
+    assert abs(got - resume.pop()) < 1e-4, got

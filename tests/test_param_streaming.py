@@ -165,6 +165,21 @@ def test_engine_rejects_streaming_without_model_support():
                         _ds_cfg(1, stream=True), mesh=mesh)
 
 
+def test_streaming_chunks_dpu_triple_composition():
+    """The full capacity+overlap stack at once: host-resident streamed
+    params × K-group chunked grads × delayed parameter update."""
+    mesh = build_mesh(devices=jax.devices()[:1])
+    tok = _tokens()
+    eng = DeepSpeedEngine(
+        GPT2Model(_model_cfg(True)),
+        _ds_cfg(1, stream=True, offload_grad_chunks=3,
+                delayed_param_update=True),
+        mesh=mesh)
+    ls = _run(eng, tok, 5)
+    assert all(np.isfinite(v) for v in ls), ls
+    assert ls[-1] < ls[0], ls
+
+
 def test_moe_streaming_matches_plain_offload():
     """MoE param streaming (one GROUP of stacked attn/dense/expert
     params fetched per scan tick) must match the unstreamed group-scan

@@ -37,6 +37,18 @@ def lowp_np_dtype(out_dtype: Optional[str]):
     raise ValueError(f"unsupported low-precision dtype {out_dtype!r}")
 
 
+def is_adam_float(dtype) -> bool:
+    """True for dtypes the offload tiers fp32-promote and Adam-step;
+    False for passthrough buffers (ints, bools) that keep their dtype
+    untouched.  Single source for the promote-vs-passthrough rule —
+    ml_dtypes floats (bfloat16, float8_*) are NOT np.floating subdtypes,
+    so the numpy predicate alone would silently route them down the
+    passthrough path."""
+    dt = np.dtype(dtype)
+    return (np.issubdtype(dt, np.floating)
+            or dt.name.startswith(("bfloat", "float8", "float4", "float6")))
+
+
 def _np_ptr(a: np.ndarray, typ):
     return a.ctypes.data_as(typ)
 

@@ -68,6 +68,20 @@ class StepMetrics(NamedTuple):
     lr: jnp.ndarray
 
 
+class _HostBlockStash:
+    """Explicit tag for the sharded host tier's DPU stash (the host
+    blocks ``ShardedHostOffloadOptimizer.pull_local`` returns).  The tag
+    exists so ``_apply_host_update`` can distinguish the stash from a
+    live gradient pytree without sniffing container types — a model
+    whose parameter tree is itself a top-level list must not be
+    misrouted into ``step_local``."""
+
+    __slots__ = ("blocks",)
+
+    def __init__(self, blocks):
+        self.blocks = list(blocks)
+
+
 class _FlatLeaf(NamedTuple):
     """Per-leaf record of the offload tier's partition-major flat layout.
 
@@ -1775,9 +1789,12 @@ class DeepSpeedEngine:
         there), each host Adams only its shards, and the updated lowp
         shards all-gather to the compute sharding on device."""
         if getattr(self, "_offload_sharded", False):
-            if isinstance(grads, list):
-                # DPU-stashed host blocks (pull_local's form)
-                lowp = self._host_opt.step_local(grads)
+            if isinstance(grads, _HostBlockStash):
+                # DPU-stashed host blocks (pull_local's form) — tagged
+                # explicitly rather than sniffed by container type, so a
+                # model whose parameter tree is a top-level list cannot
+                # be misrouted into step_local
+                lowp = self._host_opt.step_local(grads.blocks)
             else:
                 lowp = self._host_opt.step(
                     self._reshard_to_master(grads))
@@ -1837,8 +1854,9 @@ class DeepSpeedEngine:
                 # mid-training fails cleanly.  Sharded tier: each process
                 # stashes only its dedup'd dp-shard blocks.
                 if getattr(self, "_offload_sharded", False):
-                    self._dpu_pending = self._host_opt.pull_local(
-                        self._reshard_to_master(grads))
+                    self._dpu_pending = _HostBlockStash(
+                        self._host_opt.pull_local(
+                            self._reshard_to_master(grads)))
                 else:
                     self._start_small_leaf_d2h(grads)
                     from .offload import guarded_tree_pull
@@ -1910,7 +1928,14 @@ class DeepSpeedEngine:
                 count=np.asarray(opt["step"], np.int64),
                 mu=opt["mu"], nu=opt["nu"])
         if self._offload_host:
-            opt = self.state.opt_state  # the host tier's {step, mu, nu}
+            # Route through state_tree(), which refuses while poisoned:
+            # self.state.opt_state's mu/nu are live views of the native
+            # Adam buffers, so after a mid-step pull failure they hold
+            # partially-updated values even though self.state itself was
+            # never advanced.  Reading them directly would let
+            # save_checkpoint serialize exactly the inconsistency the
+            # poison guard exists to fence off.
+            opt = self._host_opt.state_tree()
             return self.state.master_params, FusedAdamState(
                 count=np.asarray(opt["step"], np.int64),
                 mu=opt["mu"], nu=opt["nu"])

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.cpu_adam import DeepSpeedCPUAdam, lowp_np_dtype
+from ..ops.cpu_adam import DeepSpeedCPUAdam, is_adam_float, lowp_np_dtype
 from ..utils.logging import logger
 
 
@@ -276,8 +276,7 @@ class HostOffloadOptimizer:
         # their dtype and are never touched by Adam (same rule the engine
         # applies building the master, engine.py master cast).
         def to_host(x):
-            dt = np.dtype(x.dtype)
-            if np.issubdtype(dt, np.floating) or dt.name == "bfloat16":
+            if is_adam_float(x.dtype):
                 # pull pieces straight into the fp32 master buffer —
                 # cast-on-assign, no transient full-leaf copy
                 out = np.empty(np.shape(x), np.float32)
@@ -510,13 +509,20 @@ class ShardedHostOffloadOptimizer:
         for leaf in leaves:
             groups: dict = {}
             order = []
+            # fp32-promote only floating shards — the same to_host rule
+            # as the single-controller tier: integer/bool buffers keep
+            # their dtype, cpu_adam's fp32-only check skips them, and
+            # they round-trip through assemble/checkpoint uncast.
+            ldt = np.dtype(leaf.dtype)
+            promote = is_adam_float(ldt)
             for s in leaf.addressable_shards:
                 k = _index_key(s.index)
                 if k not in groups:
+                    pulled = chunked_device_get(
+                        s.data, what="master shard pull")
                     blk = np.array(
-                        chunked_device_get(s.data,
-                                           what="master shard pull"),
-                        dtype=np.float32)
+                        pulled,
+                        dtype=np.float32 if promote else ldt)
                     groups[k] = {"index": s.index, "devices": [],
                                  "block": blk}
                     order.append(k)
@@ -550,13 +556,17 @@ class ShardedHostOffloadOptimizer:
         ``gi`` within leaf ``li``); each local device holding that index
         receives a copy and ``make_array_from_single_device_arrays``
         stitches the global view (non-addressable shards belong to the
-        other processes)."""
+        other processes).  ``np_dtype`` applies to FLOATING blocks only;
+        integer/bool blocks keep their own dtype (the single-controller
+        tier's rule — Adam never touched them, so no cast is correct)."""
         out = []
         for li, (leaf_groups, sharding, shape) in enumerate(
                 zip(self._local, self._shardings, self._shapes)):
             arrays = []
             for gi, g in enumerate(leaf_groups):
-                blk = np.asarray(block_fn(li, gi, g), dtype=np_dtype)
+                blk = np.asarray(block_fn(li, gi, g))
+                if is_adam_float(blk.dtype):
+                    blk = np.asarray(blk, dtype=np_dtype)
                 for d in g["devices"]:
                     arrays.append(jax.device_put(blk, d))
             out.append(jax.make_array_from_single_device_arrays(
@@ -569,9 +579,15 @@ class ShardedHostOffloadOptimizer:
         sharding — the fused ZeRO param all-gather on ICI)."""
         dt = lowp_np_dtype(self._out_dtype)
         np_dt = dt if dt is not None else np.float32
+        # one allocation per block: cast floating blocks here (a no-op
+        # for _assemble's float cast), copy uncast ones so the device
+        # buffer never aliases the live master block; int/bool blocks
+        # pass through at their own dtype either way
         return self._assemble(
-            lambda li, gi, g: g["block"].astype(np_dt)
-            if dt is not None else g["block"].copy(), np_dt)
+            lambda li, gi, g: (g["block"].astype(np_dt)
+                               if dt is not None and
+                               is_adam_float(g["block"].dtype)
+                               else g["block"].copy()), np_dt)
 
     # -- the step -------------------------------------------------------
     def _local_grad_shards(self, grads):
@@ -733,12 +749,15 @@ class ShardedHostOffloadOptimizer:
                             src, jax.Array) else chunked_device_get(
                                 src, what="restore pull"))
                         blk = arr[g["index"]]
+                    # cast-on-assign preserves the destination dtype
+                    # (fp32 for floating blocks, own dtype otherwise —
+                    # an explicit fp32 hop would corrupt wide ints)
                     if moments:
                         m, v = self.opt._moments(flat_i, g["block"])
                         dst = m if which == 0 else v
-                        dst[...] = np.asarray(blk, np.float32)
+                        dst[...] = np.asarray(blk)
                     else:
-                        g["block"][...] = np.asarray(blk, np.float32)
+                        g["block"][...] = np.asarray(blk)
                     flat_i += 1
 
         scatter(master_tree)
@@ -759,7 +778,9 @@ class ShardedHostOffloadOptimizer:
         process's addressable ranges into them (per-process shard files,
         merge-on-load).  Block-size transients only."""
         def zeros(li, gi, g):
-            return np.zeros(np.shape(g["block"]), np.float32)
+            # block dtype = fp32 for floating leaves, own dtype for
+            # int/bool (moments of untouched leaves are zeros_like)
+            return np.zeros(np.shape(g["block"]), g["block"].dtype)
         master = self._assemble(zeros, np.float32)
         mu = self._assemble(zeros, np.float32)
         nu = self._assemble(zeros, np.float32)

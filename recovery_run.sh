@@ -6,7 +6,7 @@
 cd /root/repo
 log=recovery_run.log
 echo "=== recovery run start $(date -u +%H:%M:%S) ===" >> "$log"
-python bench.py > BENCH_r04_raw.json 2>> "$log"
+python bench.py > BENCH_r05_raw.json 2>> "$log"
 echo "=== bench.py rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
 python bench_cpu_adam.py > BENCH_cpu_adam.txt 2>> "$log"
 echo "=== cpu_adam rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
@@ -17,7 +17,7 @@ echo "=== diag rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
 # Stage only bench/diag artifacts (tolerating missing ones) so a failed
 # bench never sweeps unrelated working-tree changes into the commit.
 # Globs cover every artifact the chain can write: BENCH_north_star.json,
-# BENCH_r04_raw.json, the suite's BENCH_*{,_raw}.json, BENCH_cpu_adam.txt,
+# BENCH_r05_raw.json, the suite's BENCH_*{,_raw}.json, BENCH_cpu_adam.txt,
 # DIAG_*.json and run logs.
 for f in BENCH_*.json BENCH_*.txt DIAG_*.json DIAG_*.log \
          DIAG_hostperf_run.log DIAG_offload_run.log MULTICHIP_*.json \

@@ -256,3 +256,17 @@ def test_dpu_checkpoint_flushes_pending():
     assert path is not None
     got = float(np.asarray(e2.train_batch(batch)))
     assert got == pytest.approx(ref, abs=1e-6)
+
+
+def test_poisoned_host_tier_blocks_save(tmp_path):
+    """The save path must honor the poison guard (advisor, round 4):
+    after a mid-step pull failure the native Adam buffers are partially
+    updated, so save_checkpoint must refuse — serializing them would
+    turn a clean failure into silent divergence on restore."""
+    cfg = _offload_config()
+    engine = DeepSpeedEngine(SimpleModel(hidden_dim=16), cfg, seed=7)
+    engine.train_batch(next(random_batches(
+        cfg.train_batch_size, 16, num_batches=1, seed=1)))
+    engine._host_opt._poisoned = ValueError("tunnel died mid-pull")
+    with pytest.raises(RuntimeError, match="refusing to serialize"):
+        engine.save_checkpoint(str(tmp_path))

@@ -332,3 +332,71 @@ def test_fast_probe_passes(monkeypatch):
     master = {"w": jnp.ones((600, 1024))}
     HostOffloadOptimizer._probe_transfer_path(
         master, min_mbps=0.001, probe_timeout=30)
+
+
+def test_sharded_tier_preserves_passthrough_dtypes():
+    """Int/bool buffers must ride the sharded tier UNCAST (the single-
+    controller to_host rule): blocks keep their dtype, Adam skips them,
+    assemble/canonical/load round-trip them exactly — including wide
+    int64 values an fp32 hop would corrupt."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.runtime.offload import ShardedHostOffloadOptimizer
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("data",))
+    wide = np.int32(2**24 + 1)  # exact in int32, corrupts via fp32
+    master = {
+        "w": jax.device_put(np.arange(32, dtype=np.float32).reshape(8, 4),
+                            NamedSharding(mesh, P("data", None))),
+        "counter": jax.device_put(np.array([wide, 7], np.int32),
+                                  NamedSharding(mesh, P())),
+        "flag": jax.device_put(np.array([True, False]),
+                               NamedSharding(mesh, P())),
+    }
+    opt = ShardedHostOffloadOptimizer(
+        master, lr=1e-2, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+        compute_dtype=jnp.bfloat16)
+
+    # blocks keep their own dtype (leaf order: sorted dict keys)
+    blocks = {k: [g["block"] for g in leaf]
+              for k, leaf in zip(sorted(master), opt._local)}
+    assert all(b.dtype == np.float32 for b in blocks["w"])
+    assert all(b.dtype == np.int32 for b in blocks["counter"])
+    assert all(b.dtype == np.bool_ for b in blocks["flag"])
+    assert blocks["counter"][0][0] == wide
+
+    # compute params: floats → bf16, passthrough buffers uncast
+    cp = opt.compute_params()
+    assert cp["w"].dtype == jnp.bfloat16
+    assert cp["counter"].dtype == jnp.int32
+    assert cp["flag"].dtype == jnp.bool_
+    assert int(cp["counter"][0]) == wide
+
+    # a step leaves passthrough buffers bit-identical
+    grads = {
+        "w": jax.device_put(np.ones((8, 4), np.float32),
+                            NamedSharding(mesh, P("data", None))),
+        "counter": jax.device_put(np.zeros(2, np.int32),
+                                  NamedSharding(mesh, P())),
+        "flag": jax.device_put(np.zeros(2, np.bool_),
+                               NamedSharding(mesh, P())),
+    }
+    out = opt.step(grads)
+    assert out["counter"].dtype == jnp.int32
+    assert int(out["counter"][0]) == wide
+    assert out["w"].dtype == jnp.bfloat16
+    # Adam actually ran on the float leaf ("w" is leaf 2 in sorted order)
+    w_blocks = [g["block"] for g in opt._local[2]]
+    assert not np.allclose(np.concatenate([b.ravel() for b in w_blocks]),
+                           np.arange(32, dtype=np.float32))
+
+    # canonical save form + load round-trip keep the exact wide int
+    m, st = opt.canonical_state()
+    assert m["counter"].dtype == jnp.int32
+    assert int(m["counter"][0]) == wide
+    opt.load_state_tree(m, st)
+    assert opt._local[0][0]["block"][0] == wide  # "counter" is leaf 0
+
+    tmpl_m, _ = opt.canonical_templates()
+    assert tmpl_m["counter"].dtype == jnp.int32
+    assert tmpl_m["flag"].dtype == jnp.bool_

@@ -44,6 +44,9 @@ class BertConfig:
     gelu_checkpoint: bool = False
     attn_dropout_checkpoint: bool = False
     stochastic_mode: bool = False
+    # 'flash' (Pallas kernel, the fused path the reference's CUDA BERT
+    # always takes) | 'dense' (jnp softmax); mirrors GPT2Config.attn_impl
+    attn_impl: str = "flash"
 
 
 BERT_BASE = BertConfig()
@@ -74,7 +77,8 @@ class BertModel(TrainModule):
                 normalize_invertible=config.normalize_invertible,
                 gelu_checkpoint=config.gelu_checkpoint,
                 attn_dropout_checkpoint=config.attn_dropout_checkpoint,
-                stochastic_mode=config.stochastic_mode))
+                stochastic_mode=config.stochastic_mode,
+                attn_impl=config.attn_impl))
 
     # ---------------- init ----------------
     def init(self, rng) -> Dict[str, Any]:

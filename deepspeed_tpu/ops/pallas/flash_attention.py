@@ -43,6 +43,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# additive-mask drop value for boolean key masks: large enough that the
+# dropped probability underflows to 0 after the lse subtraction, finite
+# so masked-out score arithmetic never produces inf - inf = nan
+NEG_MASK = -1e9
 
 
 def _use_interpret() -> bool:
@@ -131,14 +135,18 @@ def _grid_bh(bh_ref, period: int, stride: int):
 
 
 def _masked_scores(q, k, iq, ik, *, sm_scale, causal, block_q, block_k,
-                   seq_len):
+                   seq_len, kmask=None):
     """Scaled q·kᵀ for one (q-block, k-block) tile with padding + causal
     masking — the single source of the mask math shared by the forward
     and both backward kernels (they must stay bit-identical or forward
-    and backward silently disagree)."""
+    and backward silently disagree).  ``kmask``: optional [1, block_k]
+    fp32 additive key mask (0 keep / large-negative drop — the HF
+    convention), applied before the validity floor."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale      # [bq, bk]
+    if kmask is not None:
+        s = s + kmask
     k_ids = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     k_global = k_ids + ik * block_k
     valid = k_global < seq_len
@@ -153,11 +161,11 @@ def _masked_scores(q, k, iq, ik, *, sm_scale, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, bh_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr,
+def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, bh_ref, kmask_ref,
+                o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, sm_scale: float, causal: bool, block_q: int,
                 block_k: int, seq_len: int, dropout_rate: float,
-                bh_period: int, bh_stride: int):
+                bh_period: int, bh_stride: int, use_kmask: bool):
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     # program_id must be read OUTSIDE pl.when branches: interpret-mode
@@ -181,9 +189,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, bh_ref, o_ref, lse_ref,
         q = q_ref[0]                                   # [bq, d]
         k = k_ref[0]                                   # [bk, d]
         v = v_ref[0]                                   # [bk, d]
+        # row 0 of the 8-row sublane-broadcast mask tile (see _kmask_args)
+        km = kmask_ref[0][0:1, :] if use_kmask else None
         s = _masked_scores(q, k, iq, ik, sm_scale=sm_scale, causal=causal,
                            block_q=block_q, block_k=block_k,
-                           seq_len=seq_len)
+                           seq_len=seq_len, kmask=km)
 
         m_prev = m_scr[:, 0:1]                          # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)       # [bq, 1]
@@ -238,8 +248,31 @@ _SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 _BH_SPEC = _SEED_SPEC
 
 
-def _fwd(q, k, v, seed, bh_base, *, sm_scale, causal, block_q, block_k,
-         dropout_rate, bh_period, bh_stride, interpret):
+def _kmask_args(kmask, bh, tk_p, block_k, k_block_of):
+    """(operand, spec) for the additive key-mask input.
+
+    TPU blocked operands need their last two dims to satisfy the
+    (8, 128)-tile rule, so a per-key mask row travels as an 8-row
+    sublane broadcast [bh, 8, tk_p] with block (1, 8, block_k) — the
+    same layout trick as the lse output (see _fwd_kernel._finalize).
+    ``k_block_of(b, i, j)`` maps grid indices to the k-block index
+    (shared with the K/V specs so causal revisit elision applies).
+    When no mask is used a single zero tile with a constant index map is
+    passed: it is fetched once and never refetched, and the kernel's
+    static use_kmask flag skips the math entirely."""
+    if kmask is None:
+        op = jnp.zeros((1, 8, block_k), jnp.float32)
+        spec = pl.BlockSpec((1, 8, block_k), lambda b, i, j: (0, 0, 0))
+        return op, spec, False
+    km = _pad_seq(kmask.astype(jnp.float32), block_k, 1)       # [bh, tk_p]
+    op = jnp.broadcast_to(km[:, None, :], (bh, 8, km.shape[1]))
+    spec = pl.BlockSpec(
+        (1, 8, block_k), lambda b, i, j: (b, 0, k_block_of(b, i, j)))
+    return op, spec, True
+
+
+def _fwd(q, k, v, seed, bh_base, kmask, *, sm_scale, causal, block_q,
+         block_k, dropout_rate, bh_period, bh_stride, interpret):
     bh, t, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, max(t, 8))
@@ -250,23 +283,26 @@ def _fwd(q, k, v, seed, bh_base, *, sm_scale, causal, block_q, block_k,
     tq_p, tk_p = qp.shape[1], kp.shape[1]
     nq, nk = tq_p // block_q, tk_p // block_k
 
+    if causal:
+        def k_block_of(b, i, j):
+            return jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
+    else:
+        def k_block_of(b, i, j):
+            return j
+    kmask_op, kmask_spec, use_kmask = _kmask_args(
+        kmask, bh, tk_p, block_k, k_block_of)
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_len=tk,
         dropout_rate=dropout_rate, bh_period=bh_period,
-        bh_stride=bh_stride)
-    if causal:
-        # clamp the K/V block index at the causal diagonal: skipped
-        # (fully-masked) grid steps revisit the previous block, and Pallas
-        # elides the HBM→VMEM copy for revisited blocks — without this the
-        # pipeline streams every K/V block even though pl.when skips the
-        # compute (≈2× attention HBM traffic at long T)
-        def kv_im(b, i, j):
-            return (b, jnp.minimum(j, (i * block_q + block_q - 1)
-                                   // block_k), 0)
-    else:
-        def kv_im(b, i, j):
-            return (b, j, 0)
+        bh_stride=bh_stride, use_kmask=use_kmask)
+    # clamp the K/V block index at the causal diagonal: skipped
+    # (fully-masked) grid steps revisit the previous block, and Pallas
+    # elides the HBM→VMEM copy for revisited blocks — without this the
+    # pipeline streams every K/V block even though pl.when skips the
+    # compute (≈2× attention HBM traffic at long T)
+    def kv_im(b, i, j):
+        return (b, k_block_of(b, i, j), 0)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
@@ -276,6 +312,7 @@ def _fwd(q, k, v, seed, bh_base, *, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), kv_im),
             _SEED_SPEC,
             _BH_SPEC,
+            kmask_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -291,7 +328,7 @@ def _fwd(q, k, v, seed, bh_base, *, sm_scale, causal, block_q, block_k,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp, _seed_arr(seed), _seed_arr(bh_base))
+    )(qp, kp, vp, _seed_arr(seed), _seed_arr(bh_base), kmask_op)
     return out[:, :t], lse[:, :, 0, :].reshape(bh, tq_p)[:, :t]
 
 
@@ -301,9 +338,9 @@ def _fwd(q, k, v, seed, bh_base, *, sm_scale, causal, block_q, block_k,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   seed_ref, bh_ref, dq_ref, dq_scr,
+                   seed_ref, bh_ref, kmask_ref, dq_ref, dq_scr,
                    *, sm_scale, causal, block_q, block_k, seq_len,
-                   dropout_rate, bh_period, bh_stride):
+                   dropout_rate, bh_period, bh_stride, use_kmask):
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     bh_row = _grid_bh(bh_ref, bh_period, bh_stride)  # see _fwd_kernel
@@ -325,9 +362,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = jnp.transpose(lse_ref[0, 0, 0:1, :])      # [bq, 1]
         delta = jnp.transpose(delta_ref[0, 0, 0:1, :])  # [bq, 1]
 
+        km = kmask_ref[0][0:1, :] if use_kmask else None
         s = _masked_scores(q, k, iq, ik, sm_scale=sm_scale, causal=causal,
                            block_q=block_q, block_k=block_k,
-                           seq_len=seq_len)
+                           seq_len=seq_len, kmask=km)
         p = jnp.exp(s - lse)                            # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -351,9 +389,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    seed_ref, bh_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    seed_ref, bh_ref, kmask_ref, dk_ref, dv_ref,
+                    dk_scr, dv_scr,
                     *, sm_scale, causal, block_q, block_k, seq_len,
-                    dropout_rate, bh_period, bh_stride):
+                    dropout_rate, bh_period, bh_stride, use_kmask):
     ik, iq = pl.program_id(1), pl.program_id(2)
     bh_row = _grid_bh(bh_ref, bh_period, bh_stride)  # see _fwd_kernel
     nq = pl.num_programs(2)
@@ -376,9 +415,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = jnp.transpose(lse_ref[0, 0, 0:1, :])      # [bq, 1]
         delta = jnp.transpose(delta_ref[0, 0, 0:1, :])  # [bq, 1]
 
+        km = kmask_ref[0][0:1, :] if use_kmask else None
         s = _masked_scores(q, k, iq, ik, sm_scale=sm_scale, causal=causal,
                            block_q=block_q, block_k=block_k,
-                           seq_len=seq_len)
+                           seq_len=seq_len, kmask=km)
         p = jnp.exp(s - lse)                            # [bq, bk]
         pd = p
         dp = jax.lax.dot_general(
@@ -407,8 +447,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, out, lse, do, seed, bh_base, *, sm_scale, causal,
-         block_q, block_k, dropout_rate, bh_period, bh_stride,
+def _bwd(q, k, v, out, lse, do, seed, bh_base, kmask, *, sm_scale,
+         causal, block_q, block_k, dropout_rate, bh_period, bh_stride,
          interpret):
     bh, t, d = q.shape
     tk = k.shape[1]
@@ -435,29 +475,35 @@ def _bwd(q, k, v, out, lse, do, seed, bh_base, *, sm_scale, causal,
 
     q_spec_i = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     if causal:  # same revisit trick as the forward (see _fwd)
-        def kv_im_j(b, i, j):
-            return (b, jnp.minimum(j, (i * block_q + block_q - 1)
-                                   // block_k), 0)
+        def k_block_dq(b, i, j):
+            return jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
     else:
-        def kv_im_j(b, i, j):
-            return (b, j, 0)
+        def k_block_dq(b, i, j):
+            return j
+
+    def kv_im_j(b, i, j):
+        return (b, k_block_dq(b, i, j), 0)
     kv_spec_j = pl.BlockSpec((1, block_k, d), kv_im_j)
     row_spec = pl.BlockSpec((1, 1, 8, block_q),
                             lambda b, i, j: (b, i, 0, 0))
+    kmask_op, kmask_spec_dq, use_kmask = _kmask_args(
+        kmask, bh, tk_p, block_k, k_block_dq)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_len=tk,
                           dropout_rate=dropout_rate,
-                          bh_period=bh_period, bh_stride=bh_stride),
+                          bh_period=bh_period, bh_stride=bh_stride,
+                          use_kmask=use_kmask),
         grid=(bh, nq, nk),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec,
-                  row_spec, _SEED_SPEC, _BH_SPEC],
+                  row_spec, _SEED_SPEC, _BH_SPEC, kmask_spec_dq],
         out_specs=q_spec_i,
         out_shape=jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed), _seed_arr(bh_base))
+    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed), _seed_arr(bh_base),
+      kmask_op)
 
     # dK/dV: k blocks outer, q blocks inner.
     if causal:
@@ -477,21 +523,26 @@ def _bwd(q, k, v, out, lse, do, seed, bh_base, *, sm_scale, causal,
     q_spec_j = pl.BlockSpec((1, block_q, d), q_im_j)
     kv_spec_i = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
     row_spec_j = pl.BlockSpec((1, 1, 8, block_q), row_im_j)
+    # k blocks ride the SECOND grid axis here (i), not the third
+    _, kmask_spec_i, _ = _kmask_args(
+        kmask, bh, tk_p, block_k, lambda b, i, j: i)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_len=tk,
                           dropout_rate=dropout_rate,
-                          bh_period=bh_period, bh_stride=bh_stride),
+                          bh_period=bh_period, bh_stride=bh_stride,
+                          use_kmask=use_kmask),
         grid=(bh, nk, nq),
         in_specs=[q_spec_j, kv_spec_i, kv_spec_i, q_spec_j, row_spec_j,
-                  row_spec_j, _SEED_SPEC, _BH_SPEC],
+                  row_spec_j, _SEED_SPEC, _BH_SPEC, kmask_spec_i],
         out_specs=[kv_spec_i, kv_spec_i],
         out_shape=[jax.ShapeDtypeStruct((bh, tk_p, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, tk_p, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed), _seed_arr(bh_base))
+    )(qp, kp, vp, dop, lsep, deltap, _seed_arr(seed), _seed_arr(bh_base),
+      kmask_op)
     return dq[:, :t], dk[:, :tk], dv[:, :tk]
 
 
@@ -501,29 +552,29 @@ def _bwd(q, k, v, out, lse, do, seed, bh_base, *, sm_scale, causal,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
-def _flash(q, k, v, seed, bh_base, sm_scale, causal, block_q, block_k,
-           dropout_rate, bh_period, bh_stride, interpret):
-    out, _ = _fwd(q, k, v, seed, bh_base, sm_scale=sm_scale,
+                   nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13))
+def _flash(q, k, v, seed, bh_base, kmask, sm_scale, causal, block_q,
+           block_k, dropout_rate, bh_period, bh_stride, interpret):
+    out, _ = _fwd(q, k, v, seed, bh_base, kmask, sm_scale=sm_scale,
                   causal=causal, block_q=block_q, block_k=block_k,
                   dropout_rate=dropout_rate, bh_period=bh_period,
                   bh_stride=bh_stride, interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, seed, bh_base, sm_scale, causal, block_q,
+def _flash_fwd(q, k, v, seed, bh_base, kmask, sm_scale, causal, block_q,
                block_k, dropout_rate, bh_period, bh_stride, interpret):
-    out, lse = _fwd(q, k, v, seed, bh_base, sm_scale=sm_scale,
+    out, lse = _fwd(q, k, v, seed, bh_base, kmask, sm_scale=sm_scale,
                     causal=causal, block_q=block_q, block_k=block_k,
                     dropout_rate=dropout_rate, bh_period=bh_period,
                     bh_stride=bh_stride, interpret=interpret)
-    return out, (q, k, v, seed, bh_base, out, lse)
+    return out, (q, k, v, seed, bh_base, kmask, out, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, dropout_rate,
                bh_period, bh_stride, interpret, res, do):
-    q, k, v, seed, bh_base, out, lse = res
-    dq, dk, dv = _bwd(q, k, v, out, lse, do, seed, bh_base,
+    q, k, v, seed, bh_base, kmask, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, out, lse, do, seed, bh_base, kmask,
                       sm_scale=sm_scale, causal=causal, block_q=block_q,
                       block_k=block_k, dropout_rate=dropout_rate,
                       bh_period=bh_period, bh_stride=bh_stride,
@@ -531,7 +582,10 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, dropout_rate,
     # integer-dtype primals (seed, bh base) take float0 cotangents
     dseed = np.zeros(np.shape(seed), jax.dtypes.float0)
     dbh = np.zeros(np.shape(bh_base), jax.dtypes.float0)
-    return dq, dk, dv, dseed, dbh
+    # the key mask is a constant (0 / -1e9) in every caller; its true
+    # gradient is never consumed, so it is treated as non-differentiable
+    dkmask = None if kmask is None else jnp.zeros_like(kmask)
+    return dq, dk, dv, dseed, dbh, dkmask
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -546,6 +600,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     dropout_rng=None,
                     dropout_seed=None,
                     bh_affine=None,
+                    key_mask=None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over [B, H, T, Dh] inputs (differentiable).
 
@@ -559,6 +614,14 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     traced uint32 scalar; period/stride are static ints).  Sharded
     callers (Ulysses) pass their GLOBAL head mapping so the realization
     matches the unsharded layout — see _grid_bh.
+
+    ``key_mask``: optional per-key mask for padding (the BERT/HF case —
+    the reference's fused softmax applies the same additive mask,
+    csrc/transformer/softmax_kernels.cu).  Shape [B, Tk] (broadcast over
+    heads) or [B·H, Tk]; boolean (True = attend) or additive float (0
+    keep / large-negative drop).  Applied identically in forward and
+    both backward kernels; the mask rides as an 8-row sublane-broadcast
+    operand so the TPU tile rules accept it (see _kmask_args).
     """
     assert q.ndim == 4, f"expected [B, H, T, D], got {q.shape}"
     b, h, t, d = q.shape
@@ -587,11 +650,25 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if bh_affine is None:
         bh_affine = (0, b * h, 0)
     bh_base, bh_period, bh_stride = bh_affine
+    kmask = None
+    if key_mask is not None:
+        km = jnp.asarray(key_mask)
+        if km.dtype == jnp.bool_:
+            km = jnp.where(km, 0.0, NEG_MASK).astype(jnp.float32)
+        else:
+            km = km.astype(jnp.float32)
+        if km.shape == (b, tk):
+            km = jnp.broadcast_to(km[:, None, :], (b, h, tk))
+        elif km.shape != (b * h, tk):
+            raise ValueError(
+                f"key_mask shape {km.shape} must be [B, Tk]={b, tk} or "
+                f"[B*H, Tk]={b * h, tk}")
+        kmask = km.reshape(b * h, tk)
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
     out = _flash(qf, kf, vf, seed, jnp.asarray(bh_base, jnp.uint32),
-                 sm_scale, causal, block_q, block_k,
+                 kmask, sm_scale, causal, block_q, block_k,
                  dropout_rate, int(bh_period), int(bh_stride), interpret)
     return out.reshape(b, h, t, d)
 

@@ -54,6 +54,12 @@ class DeepSpeedTransformerConfig:
     adjust_init_range: bool = True
     attn_dropout_checkpoint: bool = False
     stochastic_mode: bool = False
+    # TPU extension mirroring models/gpt2.py:44 — the reference has no
+    # such knob because its fused CUDA attention IS the only path
+    # (csrc/transformer/ds_transformer_cuda.cpp:99-121); here 'flash'
+    # runs the Pallas flash kernel (O(T·D) memory, no seq cap, in-kernel
+    # dropout) and 'dense' the jnp softmax path.
+    attn_impl: str = "flash"
 
     def __post_init__(self):
         if self.intermediate_size <= 0 < self.hidden_size:
@@ -148,6 +154,29 @@ class DeepSpeedTransformerLayer:
         }
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _key_mask_rows(attention_mask, B, H, T):
+        """HF additive mask (broadcastable to [B, 1|H, 1, T]) → [B, T]
+        (shared across heads) or [B·H, T] (per-head) additive rows for
+        the flash kernel's per-key mask.  Masks with a genuine
+        q-position dimension cannot be expressed as a key mask — those
+        callers need attn_impl='dense'."""
+        m = jnp.asarray(attention_mask)
+        while m.ndim < 4:
+            m = m[:, None]
+        if m.shape[2] != 1:
+            raise ValueError(
+                f"attn_impl='flash' supports key-padding masks "
+                f"(broadcastable to [B, 1|H, 1, T]); got mask shape "
+                f"{attention_mask.shape} with a q-position dimension — "
+                "use attn_impl='dense' for arbitrary 2-D masks")
+        if m.shape[1] == 1:
+            return jnp.broadcast_to(m[:, 0, 0, :], (B, T)).astype(
+                jnp.float32)
+        # per-head masks keep their head dimension ([B·H, T] rows)
+        rows = jnp.broadcast_to(m[:, :, 0, :], (B, H, T))
+        return rows.reshape(B * H, T).astype(jnp.float32)
+
     def _attention(self, params, h, attention_mask, rng, train):
         cfg = self.config
         B, T, D = h.shape
@@ -159,6 +188,26 @@ class DeepSpeedTransformerLayer:
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         split = lambda t: t.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
         q, k, v = split(q), split(k), split(v)
+
+        if cfg.attn_impl == "flash":
+            # Pallas flash kernel: dropout fused in-kernel, padding mask
+            # as the per-key operand.  attn_dropout_checkpoint is
+            # structurally satisfied here — flash never materializes the
+            # [T, T] probabilities, in forward OR backward.
+            from ...ops.pallas.flash_attention import flash_attention
+            km = (None if attention_mask is None
+                  else self._key_mask_rows(attention_mask, B, H, T))
+            ctx = flash_attention(
+                q, k, v, causal=False,
+                dropout_rate=cfg.attn_dropout_ratio if train else 0.0,
+                dropout_rng=rng, key_mask=km)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(B, T, D)
+            return ctx @ params["attn_ow"].astype(h.dtype) \
+                + params["attn_ob"].astype(h.dtype)
+        if cfg.attn_impl != "dense":
+            raise ValueError(
+                f"attn_impl={cfg.attn_impl!r}: expected 'flash' or "
+                "'dense'")
 
         def probs_ctx(q, k, v):
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,

@@ -125,6 +125,18 @@ class ZeroShardingPlan:
         # model; ZeRO composes the 'data' axis on top.  Sanitized ONCE here
         # (indivisible dims → replicated); ``params`` supplies leaf shapes.
         if base_param_specs is not None and params is not None:
+            spec_def = jax.tree.structure(
+                base_param_specs, is_leaf=lambda x: isinstance(x, P))
+            param_def = jax.tree.structure(params)
+            if spec_def != param_def:
+                raise ValueError(
+                    "param_partition_specs tree structure does not match "
+                    "the param tree — every param leaf needs exactly one "
+                    "PartitionSpec at the same position (a silent "
+                    "mismatch would drop ALL tensor-parallel placement "
+                    "and replicate every leaf).\n"
+                    f"  specs tree:  {spec_def}\n"
+                    f"  params tree: {param_def}")
             base_param_specs = jax.tree.map(
                 lambda s, l: sanitize_base_spec(
                     s, _leaf_shape(l), mesh),
@@ -133,19 +145,22 @@ class ZeroShardingPlan:
         self.base_param_specs = base_param_specs
 
     # -- helpers --------------------------------------------------------
-    def _base_spec(self, path_leaf_idx, leaf):
-        if self.base_param_specs is None:
-            return None
-        try:
-            return jax.tree.leaves(self.base_param_specs)[path_leaf_idx]
-        except Exception:
-            return None
-
     def _specs(self, tree, sharded: bool):
         leaves, treedef = jax.tree.flatten(tree)
+        base_leaves = (None if self.base_param_specs is None
+                       else jax.tree.leaves(self.base_param_specs))
+        if base_leaves is not None and len(base_leaves) != len(leaves):
+            raise ValueError(
+                "param_partition_specs leaf count does not match the "
+                f"tree being placed: {len(base_leaves)} specs vs "
+                f"{len(leaves)} leaves — positional matching would "
+                "mis-assign tensor-parallel placement.\n"
+                f"  specs tree: "
+                f"{jax.tree.structure(self.base_param_specs)}\n"
+                f"  placed tree: {treedef}")
         specs = []
         for i, leaf in enumerate(leaves):
-            base = self._base_spec(i, leaf)
+            base = None if base_leaves is None else base_leaves[i]
             if sharded:
                 specs.append(shard_spec_for_leaf(
                     _leaf_shape(leaf), self.dp, DATA_AXIS, base))

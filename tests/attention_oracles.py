@@ -11,15 +11,18 @@ import jax.numpy as jnp
 from deepspeed_tpu.ops.pallas.flash_attention import dense_keep_mask
 
 
-def dense_dropout_oracle(q, k, v, rate, seed, causal=True):
+def dense_dropout_oracle(q, k, v, rate, seed, causal=True, key_mask=None):
     """q/k/v: [B, H, T, D]; ``seed``: uint32 scalar (callers holding a
     PRNGKey derive it with jax.random.bits(key, (), jnp.uint32), the same
-    derivation flash_attention uses)."""
+    derivation flash_attention uses).  ``key_mask``: optional [B, Tk]
+    boolean (True = attend), the kernel's padding-mask semantics."""
     b, h, t, d = q.shape
     tk = k.shape[2]
     scale = float(d) ** -0.5
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if key_mask is not None:
+        s = s + jnp.where(key_mask, 0.0, -1e9)[:, None, None, :]
     if causal:
         s = jnp.where(jnp.tril(jnp.ones((t, tk), bool)), s, -1e30)
     p = jax.nn.softmax(s, axis=-1)

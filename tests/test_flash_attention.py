@@ -192,3 +192,86 @@ def test_jit_compiles_once():
     np.testing.assert_allclose(f(q, k, v),
                                causal_attention(q, k, v),
                                atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# key (padding) masks — the BERT/HF case
+# ---------------------------------------------------------------------------
+def _dense_masked(q, k, v, add_mask):
+    """Dense oracle with an additive [B, Tk] key mask."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = s + add_mask[:, None, None, :]
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("t,lens", [(128, (128, 70)), (200, (200, 33))])
+def test_key_mask_forward_matches_dense(t, lens):
+    q, k, v = _rand_qkv(2, 2, t, 32, seed=4)
+    valid = jnp.asarray(
+        np.arange(t)[None, :] < np.asarray(lens)[:, None])   # [B, T] bool
+    add = jnp.where(valid, 0.0, -1e9).astype(jnp.float32)
+    out_bool = flash_attention(q, k, v, causal=False, key_mask=valid)
+    out_add = flash_attention(q, k, v, causal=False, key_mask=add)
+    ref = _dense_masked(q, k, v, add)
+    np.testing.assert_allclose(out_bool, ref, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(out_add, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_key_mask_backward_matches_dense():
+    t = 200  # multi-block with block_q=block_k=64
+    q, k, v = _rand_qkv(1, 2, t, 32, seed=5)
+    valid = jnp.asarray(np.arange(t)[None, :] < 131)
+    add = jnp.where(valid, 0.0, -1e9).astype(jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=False, key_mask=valid,
+            block_q=64, block_k=64) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_masked(q, k, v, add) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-3)
+    # masked keys receive zero dK/dV
+    np.testing.assert_allclose(np.asarray(gf[1])[:, :, 131:], 0.0,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gf[2])[:, :, 131:], 0.0,
+                               atol=1e-6)
+
+
+def test_key_mask_composes_with_causal_and_dropout():
+    """Mask × causal × in-kernel dropout: against the dense oracle that
+    applies the kernel's exact keep mask plus the key mask."""
+    from attention_oracles import dense_dropout_oracle
+    t = 128
+    q, k, v = _rand_qkv(1, 2, t, 32, seed=6)
+    valid = jnp.asarray(np.arange(t)[None, :] < 99)
+    seed = jnp.uint32(42)
+    out = flash_attention(q, k, v, causal=True, dropout_rate=0.3,
+                          dropout_seed=seed, key_mask=valid)
+    ref = dense_dropout_oracle(q, k, v, 0.3, seed, causal=True,
+                               key_mask=valid)
+    np.testing.assert_allclose(out, ref, atol=3e-5, rtol=3e-5)
+
+
+def test_key_mask_per_head_shape():
+    """[B*H, Tk] masks (per-head) are accepted verbatim."""
+    b, h, t = 2, 2, 64
+    q, k, v = _rand_qkv(b, h, t, 32, seed=7)
+    lens = np.array([50, 64, 20, 40])                      # one per b*h row
+    valid = jnp.asarray(np.arange(t)[None, :] < lens[:, None])
+    out = flash_attention(q, k, v, causal=False, key_mask=valid)
+    add = jnp.where(valid, 0.0, -1e9).astype(jnp.float32).reshape(b, h, t)
+    scale = 1.0 / np.sqrt(32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = s + add[:, :, None, :]
+    ref = jnp.einsum("bhqk,bhkd->bhqd",
+                     jax.nn.softmax(s, -1).astype(q.dtype), v)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

@@ -152,3 +152,41 @@ def test_warm_phase_matches_reference_adam_semantics():
     pb = jax.tree.leaves(eng_b.state.master_params)
     for a, b in zip(pa, pb):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_onebit_terminal_loss_parity_with_adam():
+    """Convergence parity past freeze_step — the reference's core 1-bit
+    Adam claim ("same convergence as Adam",
+    reference docs/_posts/2020-09-09-onebit-adam-blog-post.md:85): same
+    model/seeds/data, OneBitAdam vs plain Adam, terminal losses must
+    agree within a small multiple after the compressed stage has run
+    3x the warm stage."""
+    steps, freeze = 60, 15
+    batches = list(random_batches(64, 16, num_batches=steps, seed=21))
+
+    eng_1bit, _ = _engine(freeze=freeze, lr=5e-3)
+
+    cfg_dict = base_config(micro_bs=8, grad_acc=1)
+    cfg_dict["optimizer"] = {"type": "Adam", "params": {"lr": 5e-3}}
+    cfg_adam = DeepSpeedConfig(cfg_dict, world_size=8)
+    eng_adam = DeepSpeedEngine(
+        SimpleModel(hidden_dim=16, nlayers=2), cfg_adam,
+        mesh=build_mesh(dp=8, devices=jax.devices()))
+
+    l1 = [float(np.asarray(eng_1bit.train_batch(b))) for b in batches]
+    la = [float(np.asarray(eng_adam.train_batch(b))) for b in batches]
+
+    # both converge...
+    assert l1[-1] < l1[0] * 0.5, l1[:3] + l1[-3:]
+    assert la[-1] < la[0] * 0.5, la[:3] + la[-3:]
+    # ...and the compressed run tracks plain Adam at the end: terminal
+    # loss within 1.5x (the curves are identical until freeze_step, so a
+    # broken compressed stage shows up as a multiple-x gap or divergence)
+    tail1 = float(np.mean(l1[-5:]))
+    taila = float(np.mean(la[-5:]))
+    assert tail1 <= 1.5 * taila + 1e-3, (tail1, taila)
+    # warm stage runs the same Adam math pre-freeze; the first step is
+    # bit-near (init + first forward identical — the 1-bit engine's
+    # manual-collective program only reorders reductions), later warm
+    # steps drift at bf16 noise scale and are covered by the tail check
+    np.testing.assert_allclose(l1[0], la[0], rtol=2e-2, atol=2e-3)

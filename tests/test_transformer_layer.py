@@ -254,7 +254,7 @@ def test_bert_large_param_count():
     assert 105e6 < n < 115e6  # BERT-base ≈ 110M
 
 
-def _hf_bert_layer_and_params(D, H, I, seed):
+def _hf_bert_layer_and_params(D, H, I, seed, attn_impl="flash"):
     """Build an HF BertLayer and map its weights into our param dict
     (shared by the forward and backward differential tests)."""
     import torch
@@ -293,20 +293,23 @@ def _hf_bert_layer_and_params(D, H, I, seed):
     layer = DeepSpeedTransformerLayer(DeepSpeedTransformerConfig(
         hidden_size=D, heads=H, intermediate_size=I,
         attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
-        pre_layer_norm=False))  # classic BERT is post-LN, like HF
+        pre_layer_norm=False,   # classic BERT is post-LN, like HF
+        attn_impl=attn_impl))
     return hf_layer, layer, params
 
 
-def test_forward_matches_huggingface_bert_layer():
+@pytest.mark.parametrize("impl", ["flash", "dense"])
+def test_forward_matches_huggingface_bert_layer(impl):
     """The reference's exact differential pattern: weights copied from a
     HuggingFace BertLayer, outputs compared (reference
     tests/unit/test_cuda_forward.py:10-25 copies from the vendored HF
-    BertEncoder in tests/unit/modeling.py)."""
+    BertEncoder in tests/unit/modeling.py) — both attention impls."""
     torch = pytest.importorskip("torch")
     pytest.importorskip("transformers")
 
     B, T, D, H, I = 2, 33, 64, 4, 256
-    hf_layer, layer, params = _hf_bert_layer_and_params(D, H, I, seed=0)
+    hf_layer, layer, params = _hf_bert_layer_and_params(
+        D, H, I, seed=0, attn_impl=impl)
 
     x = np.random.default_rng(0).standard_normal((B, T, D)).astype(
         np.float32)
@@ -315,6 +318,62 @@ def test_forward_matches_huggingface_bert_layer():
     got = np.asarray(layer(params, jnp.asarray(x), attention_mask=None,
                            rng=jax.random.PRNGKey(0), train=False))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("impl", ["flash", "dense"])
+def test_forward_matches_huggingface_with_padding_mask(impl):
+    """HF differential WITH a padding mask — the flash path routes it
+    through the kernel's per-key mask operand."""
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+
+    B, T, D, H, I = 2, 40, 64, 4, 256
+    hf_layer, layer, params = _hf_bert_layer_and_params(
+        D, H, I, seed=2, attn_impl=impl)
+
+    x = np.random.default_rng(2).standard_normal((B, T, D)).astype(
+        np.float32)
+    add = np.zeros((B, 1, 1, T), np.float32)
+    add[0, :, :, 29:] = -10000.0     # batch 0 pads the last 11 keys
+    add[1, :, :, 7:] = -10000.0      # batch 1 keeps only 7
+    with torch.no_grad():
+        want = hf_layer(torch.from_numpy(x),
+                        attention_mask=torch.from_numpy(add))[0].numpy()
+    got = np.asarray(layer(params, jnp.asarray(x),
+                           attention_mask=jnp.asarray(add),
+                           rng=jax.random.PRNGKey(0), train=False))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_matches_dense_layer_with_mask():
+    """Impl-vs-impl equivalence on the same params, padding mask on."""
+    B, T, D, H = 2, 70, 64, 4
+    layer_f, params = make_layer(D, H, attn_impl="flash")
+    layer_d, _ = make_layer(D, H, attn_impl="dense")
+    x = jnp.asarray(np.random.default_rng(5).standard_normal((B, T, D)),
+                    jnp.float32)
+    add = np.zeros((B, 1, 1, T), np.float32)
+    add[0, :, :, 50:] = -10000.0
+    out_f = layer_f(params, x, jnp.asarray(add), train=False)
+    out_d = layer_d(params, x, jnp.asarray(add), train=False)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d),
+                               rtol=1e-4, atol=1e-4)
+    # and gradients agree
+    gf = jax.grad(lambda p: jnp.sum(
+        layer_f(p, x, jnp.asarray(add), train=False) ** 2))(params)
+    gd = jax.grad(lambda p: jnp.sum(
+        layer_d(p, x, jnp.asarray(add), train=False) ** 2))(params)
+    for k in gf:
+        np.testing.assert_allclose(np.asarray(gf[k]), np.asarray(gd[k]),
+                                   rtol=2e-3, atol=2e-3, err_msg=k)
+
+
+def test_flash_rejects_full_2d_masks():
+    layer, params = make_layer(64, 4, attn_impl="flash")
+    x = jnp.zeros((1, 16, 64), jnp.float32)
+    full = jnp.zeros((1, 1, 16, 16), jnp.float32)  # q-position dim
+    with pytest.raises(ValueError, match="key-padding"):
+        layer(params, x, full, train=False)
 
 
 def test_backward_matches_huggingface_bert_layer():
@@ -356,3 +415,20 @@ def test_backward_matches_huggingface_bert_layer():
     np.testing.assert_allclose(np.asarray(gp["norm_b"]), want_norm_b,
                                rtol=2e-3, atol=2e-3)
 
+
+
+def test_flash_per_head_mask_matches_dense():
+    """[B, H, 1, T] per-head masks route through the kernel's [B*H, T]
+    path instead of collapsing to head 0."""
+    B, T, D, H = 2, 48, 64, 4
+    layer_f, params = make_layer(D, H, attn_impl="flash")
+    layer_d, _ = make_layer(D, H, attn_impl="dense")
+    x = jnp.asarray(np.random.default_rng(8).standard_normal((B, T, D)),
+                    jnp.float32)
+    rng = np.random.default_rng(9)
+    add = np.where(rng.random((B, H, 1, T)) < 0.3, -10000.0, 0.0
+                   ).astype(np.float32)
+    out_f = layer_f(params, x, jnp.asarray(add), train=False)
+    out_d = layer_d(params, x, jnp.asarray(add), train=False)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d),
+                               rtol=1e-4, atol=1e-4)

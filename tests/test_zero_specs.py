@@ -63,3 +63,44 @@ def test_shard_spec_respects_base():
     # base TP spec on dim 1; ZeRO takes dim 0
     assert shard_spec_for_leaf((8, 16), 4, base_spec=P(None, "model")) == P(
         "data", "model")
+
+
+def test_spec_tree_structure_mismatch_raises(mesh):
+    """A model whose param_partition_specs tree disagrees structurally
+    with its param tree must ERROR, not silently replicate everything
+    (the positional spec-to-leaf matching would mis-assign or drop all
+    tensor-parallel placement)."""
+    from deepspeed_tpu.runtime.zero import ZeroShardingPlan
+
+    params = {"w": np.zeros((8, 4), np.float32),
+              "b": np.zeros((4,), np.float32)}
+    bad_specs = {"w": P(None, "model")}  # missing "b"
+    with pytest.raises(ValueError, match="does not match"):
+        ZeroShardingPlan(stage=2, mesh=mesh, base_param_specs=bad_specs,
+                         params=params)
+    # an extra key is just as structural a mismatch
+    bad_specs2 = {"w": P(None, "model"), "b": P(None), "ghost": P()}
+    with pytest.raises(ValueError, match="does not match"):
+        ZeroShardingPlan(stage=2, mesh=mesh, base_param_specs=bad_specs2,
+                         params=params)
+    # the matching tree still works and keeps TP placement
+    plan = ZeroShardingPlan(
+        stage=2, mesh=mesh,
+        base_param_specs={"w": P(None, "model"), "b": P(None)},
+        params=params)
+    assert plan.master_param_specs(params)["w"] == P("data", "model")
+
+
+def test_spec_leaf_count_mismatch_raises_at_query(mesh):
+    """Plans built WITHOUT params (no construction-time check) must still
+    refuse positional matching against a tree with a different leaf
+    count at query time."""
+    from deepspeed_tpu.runtime.zero import ZeroShardingPlan
+
+    plan = ZeroShardingPlan(
+        stage=2, mesh=mesh,
+        base_param_specs={"w": P(None, "model")})
+    two_leaves = {"w": np.zeros((8, 4), np.float32),
+                  "b": np.zeros((4,), np.float32)}
+    with pytest.raises(ValueError, match="leaf count"):
+        plan.master_param_specs(two_leaves)

@@ -95,6 +95,34 @@ def _flops_per_token(cfg, seq):
     return 6 * cfg.num_params + 12 * cfg.n_layer * cfg.d_model * seq
 
 
+def calibrated_time(fn, iters, min_window_s=None):
+    """Time fn() with an iteration count calibrated so the measured window
+    dwarfs dispatch/tunnel jitter — 20 iters of a ~35us kernel measures
+    noise, not the kernel (the round-5 first-window flash numbers exceeded
+    chip peak because of exactly this).  On CPU smoke runs the window is
+    skipped (accuracy there is irrelevant and calibration would inflate
+    cheap cases to thousands of iterations).  Shared by bench_flash /
+    bench_sparse."""
+    import jax
+    if min_window_s is None:
+        min_window_s = 0.2 if jax.devices()[0].platform != "cpu" else 0.0
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    while dt < min_window_s and iters < 1 << 16:
+        iters = int(iters * max(2.0, min_window_s / max(dt, 1e-6) * 1.3))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+    return dt / iters
+
+
 def _device_resident(engine, batch):
     """Upload a repeating batch ONCE: _shard_batch passes device arrays
     through, so steps pay zero H2D (per-step uploads ride the same

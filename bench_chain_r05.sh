@@ -1,0 +1,66 @@
+#!/bin/bash
+# Round-5 staged hardware evidence chain (VERDICT.md round-5 #1).
+# Shortest-first so even a brief healthy-tunnel window leaves committed
+# JSON; capacity runs LAST (its killed-subprocess probes are the known
+# tunnel-wedge risk, BENCH_NOTES.md round 3).  Commits after EVERY
+# artifact with a retry loop (another workflow may hold the git index).
+cd /root/repo
+log=bench_chain_r05.log
+echo "=== chain start $(date -u) ===" >> "$log"
+
+bank() {  # bank <msg> <files...>: stage+commit artifacts, retrying index locks
+  msg=$1; shift
+  for i in 1 2 3 4 5; do
+    ok=1
+    for f in "$@"; do [ -e "$f" ] && git add "$f" >> "$log" 2>&1 || true; done
+    git commit -q -m "$msg" >> "$log" 2>&1 && break
+    ok=0; sleep 7
+  done
+}
+
+run() {  # run <name> <outfile> <cmd...>
+  name=$1; out=$2; shift 2
+  echo "=== $name start $(date -u +%H:%M:%S) ===" >> "$log"
+  "$@" > "$out" 2>> "$log"
+  rc=$?
+  echo "=== $name rc=$rc $(date -u +%H:%M:%S) ===" >> "$log"
+}
+
+# 1. cpu_adam: host-only, fastest, tunnel-independent
+run cpu_adam BENCH_cpu_adam.txt python bench_cpu_adam.py
+bank "Bench artifact: CPU-Adam kernel microbench (hardware window)" \
+  BENCH_cpu_adam.txt "$log"
+
+# 2-5. short TPU benches
+run flash BENCH_flash_raw.json python bench_flash.py
+bank "Bench artifact: flash-attention block sweep on TPU" \
+  BENCH_flash.json BENCH_flash_raw.json "$log"
+
+run sparse BENCH_sparse_raw.json python bench_sparse.py
+bank "Bench artifact: block-sparse vs flash vs dense on TPU" \
+  BENCH_sparse.json BENCH_sparse_raw.json "$log"
+
+run bert BENCH_bert_raw.json python bench_bert.py
+bank "Bench artifact: BERT-large TFLOPS on TPU" \
+  BENCH_bert.json BENCH_bert_raw.json "$log"
+
+run moe BENCH_moe_raw.json python bench_moe.py
+bank "Bench artifact: MoE dispatch overhead on TPU" \
+  BENCH_moe.json BENCH_moe_raw.json "$log"
+
+# 6. the north star: GPT-2 1.5B ZeRO-Offload (suite chain disabled - already ran)
+run north_star BENCH_r05_raw.json env BENCH_SUITE=0 python bench.py
+bank "Bench artifact: GPT-2 1.5B north-star run on TPU" \
+  BENCH_north_star.json BENCH_r05_raw.json "$log"
+
+# 7. capacity LAST (wedge risk)
+run capacity BENCH_capacity_raw.json python bench_capacity.py
+bank "Bench artifact: measured single-chip capacity ratio (ZeRO-Offload)" \
+  BENCH_capacity.json BENCH_capacity_raw.json "$log"
+
+# 8. hostperf + offload diagnostics if the tunnel is still alive
+run hostperf DIAG_hostperf_run.log python diag_hostperf.py
+bank "Diag artifact: host-offload bandwidth/remat diagnostics" \
+  DIAG_hostperf_run.log DIAG_hostperf.json "$log"
+
+echo "=== chain done $(date -u) ===" >> "$log"

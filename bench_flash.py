@@ -14,14 +14,8 @@ import numpy as np
 
 
 def _time(fn, iters):
-    import jax
-    out = fn()
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn()
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    from bench import calibrated_time
+    return calibrated_time(fn, iters)
 
 
 def main():

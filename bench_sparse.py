@@ -13,16 +13,16 @@ import numpy as np
 
 
 def _bench(fn, *args, iters=None):
+    """Calibrated timing (the first round-5 hardware window produced flat
+    ~0.03 ms times across seq lengths — pure noise floor from a
+    10-iteration window); shared helper lives in bench.py."""
+    import sys as _sys
+    _sys.path.insert(0, ".")
     import jax
+    from bench import calibrated_time
     if iters is None:
         iters = 10 if jax.devices()[0].platform != "cpu" else 2
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    return calibrated_time(lambda: fn(*args), iters)
 
 
 def main():

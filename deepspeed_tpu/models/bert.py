@@ -47,6 +47,11 @@ class BertConfig:
     # 'flash' (Pallas kernel, the fused path the reference's CUDA BERT
     # always takes) | 'dense' (jnp softmax); mirrors GPT2Config.attn_impl
     attn_impl: str = "flash"
+    scan_layers: bool = True          # False: unroll the stack (XLA then
+                                      # optimizes across layer boundaries,
+                                      # ≈25% faster on TPU like
+                                      # GPT2Config.scan_layers, at
+                                      # depth-linear compile cost)
 
 
 BERT_BASE = BertConfig()
@@ -197,8 +202,13 @@ class BertModel(TrainModule):
             return y, None
 
         body_fn = jax.checkpoint(body) if cfg.remat == "block" else body
-        x, _ = jax.lax.scan(
-            body_fn, x, (params["layers"], jnp.arange(L)))
+        if cfg.scan_layers:
+            x, _ = jax.lax.scan(
+                body_fn, x, (params["layers"], jnp.arange(L)))
+        else:
+            for i in range(L):
+                lp = jax.tree.map(lambda a: a[i], params["layers"])
+                x, _ = body_fn(x, (lp, jnp.asarray(i, jnp.int32)))
         return x
 
     def apply(self, params, batch, rng=None, train: bool = True):

@@ -58,7 +58,16 @@ def build_kernel_luts(layout: np.ndarray
     Rows/cols with no active blocks get one self-referential padding entry
     with nvalid 0.  Trace-time numpy, like the reference's native
     segment_blocks build (csrc/sparse_attention/utils.cpp:14).
+
+    Head dedup: the LUTs ride in SMEM (scalar prefetch, ~1 MB on v5e), and
+    at long seq a per-head LUT overflows it — e.g. bigbird seq 16k/block 64
+    is 12x256x~170 int32 ≈ 2 MB, the exact AOT failure this guard exists
+    for.  Every stock SparsityConfig is head-uniform unless
+    ``different_layout_per_head`` is set, so identical head planes collapse
+    to one and the kernels index plane ``h % lut_heads``.
     """
+    if layout.shape[0] > 1 and bool((layout == layout[:1]).all()):
+        layout = layout[:1]
     H, nb, _ = layout.shape
     W = max(int(layout.sum(-1).max()), 1)
     Wt = max(int(layout.sum(-2).max()), 1)
@@ -94,9 +103,10 @@ def build_kernel_luts(layout: np.ndarray
 
 
 def _fwd_kernel(cols_ref, nvalid_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, sm_scale, heads, block, width):
+                m_scr, l_scr, acc_scr, *, sm_scale, heads, lut_heads,
+                block, width):
     bh, iq, w = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    h = bh % heads
+    h = (bh % heads) if lut_heads > 1 else 0
 
     @pl.when(w == 0)
     def _init():
@@ -141,12 +151,14 @@ def _sparse_fwd(q, k, v, cols, nvalid, *, sm_scale, heads, block,
     bh, t, d = q.shape
     nb = t // block
     width = cols.shape[-1]
+    lut_h = cols.shape[0]
 
     def q_im(b, i, w, cols_ref, nv_ref):
         return (b, i, 0)
 
     def kv_im(b, i, w, cols_ref, nv_ref):
-        return (b, cols_ref[b % heads, i, w], 0)
+        h = (b % heads) if lut_h > 1 else 0
+        return (b, cols_ref[h, i, w], 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -169,7 +181,7 @@ def _sparse_fwd(q, k, v, cols, nvalid, *, sm_scale, heads, block,
     )
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, heads=heads,
-                          block=block, width=width),
+                          lut_heads=lut_h, block=block, width=width),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
@@ -187,9 +199,9 @@ def _sparse_fwd(q, k, v, cols, nvalid, *, sm_scale, heads, block,
 
 def _bwd_dq_kernel(cols_ref, nvalid_ref, q_ref, k_ref, v_ref, do_ref,
                    lse_ref, delta_ref, dq_ref, dq_scr,
-                   *, sm_scale, heads, block, width):
+                   *, sm_scale, heads, lut_heads, block, width):
     bh, iq, w = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    h = bh % heads
+    h = (bh % heads) if lut_heads > 1 else 0
 
     @pl.when(w == 0)
     def _init():
@@ -222,9 +234,9 @@ def _bwd_dq_kernel(cols_ref, nvalid_ref, q_ref, k_ref, v_ref, do_ref,
 
 def _bwd_dkv_kernel(rows_ref, nvalid_ref, q_ref, k_ref, v_ref, do_ref,
                     lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, sm_scale, heads, block, width):
+                    *, sm_scale, heads, lut_heads, block, width):
     bh, ic, w = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    h = bh % heads
+    h = (bh % heads) if lut_heads > 1 else 0
 
     @pl.when(w == 0)
     def _init():
@@ -266,6 +278,8 @@ def _sparse_bwd(q, k, v, out, lse, do, cols, nvalid, rows_t, nvalid_t,
     nb = t // block
     width = cols.shape[-1]
     width_t = rows_t.shape[-1]
+    lut_h = cols.shape[0]
+    lut_ht = rows_t.shape[0]
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
 
@@ -280,14 +294,15 @@ def _sparse_bwd(q, k, v, out, lse, do, cols, nvalid, rows_t, nvalid_t,
         return (b, i, 0)
 
     def kv_im(b, i, w, cols_ref, nv_ref):
-        return (b, cols_ref[b % heads, i, w], 0)
+        h = (b % heads) if lut_h > 1 else 0
+        return (b, cols_ref[h, i, w], 0)
 
     def row_im(b, i, w, *_):
         return (b, i, 0, 0)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, heads=heads,
-                          block=block, width=width),
+                          lut_heads=lut_h, block=block, width=width),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(bh, nb, width),
@@ -309,17 +324,19 @@ def _sparse_bwd(q, k, v, out, lse, do, cols, nvalid, rows_t, nvalid_t,
     # dK/dV: walk the transposed LUT — q/do/lse/delta blocks come from the
     # query rows attending to key block ic
     def qrow_im(b, i, w, rows_ref, nv_ref):
-        return (b, rows_ref[b % heads, i, w], 0)
+        h = (b % heads) if lut_ht > 1 else 0
+        return (b, rows_ref[h, i, w], 0)
 
     def qrow_stat_im(b, i, w, rows_ref, nv_ref):
-        return (b, rows_ref[b % heads, i, w], 0, 0)
+        h = (b % heads) if lut_ht > 1 else 0
+        return (b, rows_ref[h, i, w], 0, 0)
 
     def kvself_im(b, i, w, *_):
         return (b, i, 0)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, heads=heads,
-                          block=block, width=width_t),
+                          lut_heads=lut_ht, block=block, width=width_t),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(bh, nb, width_t),
@@ -399,6 +416,19 @@ def block_sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if luts is None:
         luts = build_kernel_luts(np.asarray(layout))
     cols, nvalid, rows_t, nvalid_t = (jnp.asarray(a) for a in luts)
+    # The LUTs are scalar-prefetched into SMEM (~1 MB/core on v5e); an
+    # oversized LUT fails AOT compile with an opaque allocator error, so
+    # reject it here with the actual remedies.  Reachable only with
+    # different_layout_per_head at very long seq (head-uniform layouts
+    # dedup to one plane in build_kernel_luts).
+    smem_need = max(cols.nbytes + nvalid.nbytes,
+                    rows_t.nbytes + nvalid_t.nbytes)
+    if not interpret and smem_need > 900_000:
+        raise ValueError(
+            f"block-sparse LUT needs {smem_need} B of SMEM (~1 MB budget "
+            f"per TPU core): layout [{layout.shape[0]} heads x {nb} x {nb} "
+            f"blocks]. Use a larger sparsity block, a head-uniform layout "
+            f"(different_layout_per_head=False), or flash attention.")
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, T, D)
     vf = v.reshape(B * H, T, D)

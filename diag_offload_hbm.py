@@ -1,0 +1,99 @@
+"""Compile-time HBM accounting for the ZeRO-Offload xla tier.
+
+The first real-hardware 1.5B attempt (round-5 window) OOM'd at step
+compile: "program 22.76G ... broadcast(constant)" temps exactly the size
+of the fp32 master/moment pieces — the pinned_host residency did not keep
+the optimizer state out of HBM.  This probe compiles the SAME engine step
+at GPT-2 350M (fp32 state ~4.2 GB, fits even when fully materialized) and
+prints the compiler's own memory analysis per configuration knob, so the
+failing placement is identified from data rather than guesswork.
+
+Variants swept (env knobs already built into the engine):
+  * DS_OFFLOAD_COMPUTE_ON=1/0  — host-compute Adam vs device Adam with
+    streamed pinned_host transfers
+  * grad chunks 1 vs 4         — whole-step vs chunked capacity mode
+
+Prints one JSON line per variant with the compiler's argument / output /
+temp / alias byte totals — the HBM-temp total is the signal: pinned_host
+residency working ≈ temps of order activations; broken ≈ temps of order
+the fp32 state.
+"""
+import json
+import os
+import subprocess
+import sys
+
+VARIANTS = [
+    {"name": "compute_on", "env": {"DS_OFFLOAD_COMPUTE_ON": "1"}},
+    {"name": "device_math", "env": {"DS_OFFLOAD_COMPUTE_ON": "0"}},
+    {"name": "compute_on_chunks4", "env": {"DS_OFFLOAD_COMPUTE_ON": "1"},
+     "chunks": 4},
+]
+
+
+def probe_one(chunks: int):
+    import numpy as np
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from deepspeed_tpu.config import DeepSpeedConfig
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    cfg_model = GPT2Config(d_model=1024, n_layer=24, n_head=16,  # 350M
+                           n_positions=1024, remat="block")
+    zero = {"stage": 2, "cpu_offload": True, "offload_impl": "xla"}
+    if chunks > 1:
+        zero["offload_grad_chunks"] = chunks
+    ds_cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "zero_optimization": zero,
+    }, world_size=1)
+    engine = DeepSpeedEngine(GPT2Model(cfg_model), ds_cfg,
+                             mesh=build_mesh(devices=jax.devices()[:1]))
+    tokens = np.zeros((4, 1025), np.int32)
+    # compile WITHOUT executing: lower + compile the donated step
+    sharded = engine._shard_batch(tokens)
+    step = engine._train_step
+    if not hasattr(step, "lower"):
+        return {"memory_analysis_error": "step is not a single jit "
+                "(chunked mode composes several programs)"}
+    compiled = step.lower(engine.state, sharded).compile()
+    out = {}
+    try:
+        mem = compiled.memory_analysis()
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "generated_code_size_in_bytes",
+                  "alias_size_in_bytes"):
+            v = getattr(mem, k, None)
+            if v is not None:
+                out[k] = int(v)
+    except Exception as e:  # noqa: BLE001 - diagnostic surface
+        out["memory_analysis_error"] = repr(e)
+    return out
+
+
+def main():
+    if os.environ.get("DS_DIAG_CHILD"):
+        chunks = int(os.environ.get("DS_DIAG_CHUNKS", "1"))
+        print(json.dumps(probe_one(chunks)), flush=True)
+        return
+    here = os.path.abspath(__file__)
+    for var in VARIANTS:
+        env = dict(os.environ, DS_DIAG_CHILD="1",
+                   DS_DIAG_CHUNKS=str(var.get("chunks", 1)), **var["env"])
+        print(f"=== {var['name']} ===", flush=True)
+        r = subprocess.run([sys.executable, here], env=env,
+                           capture_output=True, text=True, timeout=1800)
+        tailerr = "\n".join(r.stdout.splitlines()[-1:]) if r.returncode == 0 \
+            else "\n".join(r.stderr.splitlines()[-30:])
+        print(tailerr, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""Real-data GPT-2 convergence run — the repo's analogue of the
+reference's Megatron-GPT2 convergence tier (it trains on real corpora and
+diffs loss curves against checked-in baselines; reference:
+tests/model/Megatron_GPT2/test_common.py:12+).
+
+Trains a scaled-down GPT-2 on the vendored real-text corpus
+(``data/tokens.npz`` — installed-package documentation prose, byte-BPE
+tokenized, see tools/build_corpus.py) through the full user path:
+``ds`` launcher -> argparse injection -> ``deepspeed_tpu.initialize`` ->
+``engine.train_batch``.  Writes the per-step loss curve as JSON.
+
+Baseline regeneration (the checked-in artifact the regression test
+diffs against):
+
+    python bin/ds --num_nodes 1 --num_gpus 1 examples/convergence_gpt2.py \
+        --deepspeed --cpu --steps 600 \
+        --out tests/baselines/convergence_gpt2.json
+
+Determinism: data order, init, and dropout(=0) are all driven by fixed
+seeds; on a fixed platform + mesh the curve reproduces to float32
+round-off, so the regression test uses a tight relative tolerance.
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models import GPT2Config, GPT2Model  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIG = {
+    "train_micro_batch_size_per_gpu": 8,
+    "gradient_accumulation_steps": 1,
+    "steps_per_print": 50,
+    "gradient_clipping": 1.0,
+    "optimizer": {
+        "type": "Adam",
+        "params": {"lr": 6e-4, "betas": [0.9, 0.95], "weight_decay": 0.01},
+    },
+    "scheduler": {
+        "type": "WarmupLR",
+        "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 6e-4,
+                   "warmup_num_steps": 40},
+    },
+}
+
+
+def real_batches(tokens: np.ndarray, seq: int, batch: int, seed: int = 0):
+    """Deterministic shuffled contiguous windows, cycling epochs."""
+    n_windows = (len(tokens) - 1) // seq
+    rng = np.random.default_rng(seed)
+    order = np.arange(n_windows)
+    while True:
+        rng.shuffle(order)
+        for i in range(0, n_windows - batch + 1, batch):
+            idx = order[i:i + batch]
+            yield np.stack([tokens[j * seq:j * seq + seq + 1]
+                            for j in idx]).astype(np.int32)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=600)
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--d_model", type=int, default=256)
+    parser.add_argument("--n_layer", type=int, default=4)
+    parser.add_argument("--n_head", type=int, default=8)
+    parser.add_argument("--out", type=str, default="convergence_gpt2.json")
+    parser.add_argument("--cpu", action="store_true",
+                        help="single-device CPU run (the baseline platform)")
+    parser = deepspeed_tpu.add_config_arguments(parser)
+    args = parser.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    blob = np.load(os.path.join(REPO, "data", "tokens.npz"))
+    tokens = blob["tokens"]
+    vocab = 4096
+    assert int(tokens.max()) < vocab
+
+    model = GPT2Model(GPT2Config(
+        vocab_size=vocab, n_positions=args.seq, d_model=args.d_model,
+        n_layer=args.n_layer, n_head=args.n_head, dropout=0.0))
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args, model=model, config=dict(CONFIG))
+
+    data = real_batches(tokens, args.seq, engine.train_batch_size, seed=1234)
+    losses = []
+    for step in range(args.steps):
+        loss = float(np.asarray(engine.train_batch(next(data))))
+        losses.append(round(loss, 6))
+        if (step + 1) % 50 == 0:
+            tail = np.mean(losses[-50:])
+            print(f"step {step + 1}: loss {loss:.4f} (50-step mean {tail:.4f})",
+                  flush=True)
+
+    first = float(np.mean(losses[:20]))
+    last = float(np.mean(losses[-50:]))
+    artifact = {
+        "model": {"vocab": vocab, "seq": args.seq, "d_model": args.d_model,
+                  "n_layer": args.n_layer, "n_head": args.n_head},
+        "config": CONFIG,
+        "data": "data/tokens.npz (real corpus, tools/build_corpus.py)",
+        "data_seed": 1234, "init_seed": 0,
+        "steps": args.steps,
+        "first20_mean": round(first, 4),
+        "last50_mean": round(last, 4),
+        "losses": losses,
+    }
+    out = args.out if os.path.isabs(args.out) else os.path.join(
+        os.getcwd(), args.out)
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"loss {first:.4f} -> {last:.4f} over {args.steps} steps; "
+          f"curve -> {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Byte-BPE tokenizer tests (deepspeed_tpu/utils/bpe.py) — the data plane
+of the real-corpus convergence tier (reference trains its convergence
+models on pre-tokenized real text, tests/model/Megatron_GPT2/test_common.py
+there)."""
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.utils.bpe import ByteBPE, _pretokenize
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(REPO, "data")
+
+SAMPLE = (
+    "The quick brown fox jumps over the lazy dog. "
+    "Training the tokenizer on repeated text: the the the fox fox. "
+    "Unicode survives byte-level round trips: naive café — δx ≈ 0.1!\n\n"
+    "Indented code-ish lines\n    stay intact too.\n"
+) * 50
+
+
+def test_pretokenize_partitions_exactly():
+    words = _pretokenize(SAMPLE)
+    assert b"".join(words).decode() == SAMPLE
+
+
+def test_train_and_roundtrip():
+    bpe = ByteBPE.train(SAMPLE, vocab_size=300)
+    assert 256 < bpe.vocab_size <= 300
+    ids = bpe.encode(SAMPLE)
+    assert bpe.decode(ids) == SAMPLE
+    # merges must actually compress repeated text
+    assert len(ids) < len(SAMPLE.encode()) * 0.6
+
+
+def test_byte_fallback_handles_unseen_text():
+    bpe = ByteBPE.train("aaaa bbbb " * 100, vocab_size=260)
+    weird = "完全 unseen ← ☃ text\x00\x07"
+    assert bpe.decode(bpe.encode(weird)) == weird
+
+
+def test_training_is_deterministic():
+    a = ByteBPE.train(SAMPLE, vocab_size=300)
+    b = ByteBPE.train(SAMPLE, vocab_size=300)
+    assert a.merges == b.merges
+
+
+def test_save_load(tmp_path):
+    bpe = ByteBPE.train(SAMPLE, vocab_size=300)
+    p = str(tmp_path / "tok.json")
+    bpe.save(p)
+    loaded = ByteBPE.load(p)
+    assert loaded.merges == bpe.merges
+    assert loaded.encode("fox jumps") == bpe.encode("fox jumps")
+
+
+def test_load_rejects_foreign_json(tmp_path):
+    p = str(tmp_path / "bad.json")
+    with open(p, "w") as f:
+        json.dump({"merges": []}, f)
+    with pytest.raises(ValueError):
+        ByteBPE.load(p)
+
+
+@pytest.mark.skipif(not os.path.exists(os.path.join(DATA, "tokens.npz")),
+                    reason="vendored corpus not built")
+def test_vendored_corpus_artifacts_consistent():
+    """The committed tokens must be exactly what the committed tokenizer
+    produces from the committed corpus (prefix check keeps it fast)."""
+    bpe = ByteBPE.load(os.path.join(DATA, "tokenizer.json"))
+    assert bpe.vocab_size == 4096
+    tokens = np.load(os.path.join(DATA, "tokens.npz"))["tokens"]
+    assert tokens.dtype == np.uint16
+    assert int(tokens.max()) < 4096
+    assert len(tokens) > 1_000_000          # enough for 500+ distinct steps
+    with gzip.open(os.path.join(DATA, "corpus.txt.gz"), "rt",
+                   encoding="utf-8") as f:
+        text = f.read(200_000)
+    enc = bpe.encode(text)
+    n = min(len(enc), 20_000) - 64  # stay clear of the read-boundary word
+    assert enc[:n] == tokens[:n].tolist()
+    # the corpus is real prose: natural-language word statistics
+    assert "the" in text.lower()

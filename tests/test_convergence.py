@@ -1,0 +1,63 @@
+"""Real-corpus convergence regression — the repo's analogue of the
+reference's Megatron-GPT2 convergence tier, which trains on real text and
+diffs the loss curve against a checked-in baseline (reference:
+tests/model/Megatron_GPT2/test_common.py:12+ and the checked-in
+ds_config/baseline curves next to it).
+
+The baseline artifact (tests/baselines/convergence_gpt2.json) is produced
+by examples/convergence_gpt2.py through the full user path (``ds``
+launcher -> initialize -> train_batch) on 600 steps of the vendored real
+corpus.  Tests here:
+
+  * the banked curve itself shows sustained convergence on real text
+  * a re-run of the first steps reproduces the banked curve (numerics
+    regression; same platform + seeds -> float round-off only)
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tests", "baselines", "convergence_gpt2.json")
+
+needs_baseline = pytest.mark.skipif(
+    not os.path.exists(BASELINE),
+    reason="baseline curve not banked yet (examples/convergence_gpt2.py)")
+
+
+@needs_baseline
+def test_banked_curve_shows_real_convergence():
+    with open(BASELINE) as f:
+        base = json.load(f)
+    losses = np.array(base["losses"], dtype=np.float64)
+    assert len(losses) >= 500, "convergence tier requires 500+ steps"
+    first, last = losses[:20].mean(), losses[-50:].mean()
+    # from ~ln(V)=8.3 the model must make sustained real progress
+    assert first > 7.0, f"suspicious start {first}"
+    assert last < first - 1.5, f"no convergence: {first} -> {last}"
+    # sustained, not a lucky dip: every quarter improves on the previous
+    q = len(losses) // 4
+    means = [losses[i * q:(i + 1) * q].mean() for i in range(4)]
+    assert all(b < a for a, b in zip(means, means[1:])), means
+
+
+@needs_baseline
+@pytest.mark.slow
+def test_rerun_reproduces_banked_prefix(tmp_path):
+    """80-step re-run through the same entry point must match the banked
+    curve — catches any numerics drift in engine/optimizer/model/data."""
+    out = str(tmp_path / "rerun.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "convergence_gpt2.py"),
+         "--cpu", "--steps", "80", "--out", out],
+        check=True, cwd=str(tmp_path), env=env, timeout=2400)
+    with open(out) as f:
+        rerun = np.array(json.load(f)["losses"], dtype=np.float64)
+    with open(BASELINE) as f:
+        base = np.array(json.load(f)["losses"][:80], dtype=np.float64)
+    np.testing.assert_allclose(rerun, base, rtol=2e-3, atol=2e-3)

@@ -10,12 +10,13 @@ echo "=== chain start $(date -u) ===" >> "$log"
 
 bank() {  # bank <msg> <files...>: stage+commit artifacts, retrying index locks
   msg=$1; shift
+  ok=0
   for i in 1 2 3 4 5; do
-    ok=1
     for f in "$@"; do [ -e "$f" ] && git add "$f" >> "$log" 2>&1 || true; done
-    git commit -q -m "$msg" >> "$log" 2>&1 && break
-    ok=0; sleep 7
+    git commit -q -m "$msg" >> "$log" 2>&1 && { ok=1; break; }
+    sleep 7
   done
+  [ "$ok" = 1 ] || echo "!!! commit FAILED after retries: $msg" >> "$log"
 }
 
 run() {  # run <name> <outfile> <cmd...>

@@ -8,7 +8,6 @@ defaults (ops/pallas/flash_attention.py:394-395).
 """
 import json
 import sys
-import time
 
 import numpy as np
 

@@ -7,7 +7,6 @@ line per (layout, seq) with tokens/s and speedups.
 """
 import json
 import sys
-import time
 
 import numpy as np
 
@@ -16,8 +15,6 @@ def _bench(fn, *args, iters=None):
     """Calibrated timing (the first round-5 hardware window produced flat
     ~0.03 ms times across seq lengths — pure noise floor from a
     10-iteration window); shared helper lives in bench.py."""
-    import sys as _sys
-    _sys.path.insert(0, ".")
     import jax
     from bench import calibrated_time
     if iters is None:

@@ -8,11 +8,12 @@ from .sparse_self_attention import SparseSelfAttention, build_lut
 from .bert_sparse_self_attention import (BertSelfAttentionConfig,
                                          BertSparseSelfAttention)
 from .sparse_attention_utils import SparseAttentionUtils
+from .matmul import MatMul, Softmax
 
 __all__ = [
     "BigBirdSparsityConfig", "BSLongformerSparsityConfig",
     "DenseSparsityConfig", "FixedSparsityConfig", "SparsityConfig",
     "VariableSparsityConfig", "SparseSelfAttention", "build_lut",
     "BertSelfAttentionConfig", "BertSparseSelfAttention",
-    "SparseAttentionUtils",
+    "SparseAttentionUtils", "MatMul", "Softmax",
 ]

@@ -1,0 +1,214 @@
+"""Standalone block-sparse MatMul (sdd / dsd / dds) and Softmax ops.
+
+The reference exposes its Triton block-sparse kernels as reusable ops —
+``MatMul(layout, block, mode)`` and ``Softmax(layout, block)``
+(reference: deepspeed/ops/sparse_attention/matmul.py:16, softmax.py) —
+which its attention composes as sdd -> softmax -> dsd.  This repo's
+attention runs a fused Pallas kernel instead
+(ops/pallas/block_sparse_attention.py), so these classes restore the
+*general-purpose* surface for users composing their own sparse programs.
+
+TPU-first formulation: the sparse operand is block-COO — active-block
+values ``[..., nnz, block, block]`` ordered row-major over a trace-time
+numpy index — and every mode is a gather + ONE batched matmul (XLA tiles
+batched [block x K x block] contractions straight onto the MXU) plus a
+segment-sum scatter where a sparse output accumulates.  No per-block
+Python loops, static shapes, differentiable end to end through jnp
+autodiff (the reference needs hand-written backward Triton passes;
+here dsd/dds ARE each other's VJPs automatically).
+
+Layout is a 2-D ``[nb_rows, nb_cols]`` 0/1 array: the standalone surface
+is per-matrix (multi-head attention layouts are head-uniform in every
+stock config — pass ``layout[0]``; genuinely per-head programs vmap over
+the head axis with per-head instances).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MatMul", "Softmax"]
+
+
+def _as_layout2d(layout) -> np.ndarray:
+    lay = np.asarray(layout)
+    if lay.ndim == 3:
+        if lay.shape[0] != 1 and not (lay == lay[:1]).all():
+            raise ValueError(
+                "standalone sparse ops take a single 2-D layout; this "
+                "3-D layout differs across heads — vmap per-head "
+                "instances instead")
+        lay = lay[0]
+    if lay.ndim != 2:
+        raise ValueError(f"layout must be 2-D [nb, nb], got {lay.shape}")
+    return (lay != 0)
+
+
+class _BlockIndex:
+    """Trace-time row-major block-COO index of a 0/1 layout."""
+
+    def __init__(self, layout):
+        self.mask = _as_layout2d(layout)
+        self.nb_r, self.nb_c = self.mask.shape
+        r, c = np.nonzero(self.mask)
+        order = np.lexsort((c, r))          # row-major
+        self.rows = r[order].astype(np.int32)
+        self.cols = c[order].astype(np.int32)
+        self.nnz = len(self.rows)
+        if self.nnz == 0:
+            raise ValueError("layout has no active blocks")
+
+
+class MatMul:
+    """Block-sparse matmul in one of the reference's three modes.
+
+    mode 'sdd':  C_sparse = A_dense @ B_dense   (only active blocks)
+        a: [..., M, K], b: [..., K, N] -> [..., nnz, block, block]
+    mode 'dsd':  C_dense  = A_sparse @ B_dense
+        a: [..., nnz, block, block], b: [..., K, N] -> [..., M, N]
+    mode 'dds':  C_dense  = A_dense @ B_sparse
+        a: [..., M, K], b: [..., nnz, block, block] -> [..., M, N]
+
+    ``trans_a`` / ``trans_b`` transpose the *dense* operand(s) before the
+    product (the reference flag surface); a transposed sparse operand is
+    expressed by transposing the layout and swapping to the dual mode.
+    """
+
+    def __init__(self, layout, block: int, mode: str,
+                 trans_a: bool = False, trans_b: bool = False):
+        if mode not in ("sdd", "dsd", "dds"):
+            raise ValueError(f"mode must be sdd|dsd|dds, got {mode!r}")
+        if mode != "sdd" and (trans_a if mode == "dsd" else trans_b):
+            raise ValueError(
+                "transposing the sparse operand: transpose the layout "
+                "and use the dual mode instead")
+        self.index = _BlockIndex(layout)
+        self.block = int(block)
+        self.mode = mode
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+
+    @property
+    def layout(self) -> np.ndarray:
+        return self.index.mask
+
+    def _blockify(self, x, nb: int, what: str):
+        """[..., nb*block, D] -> [..., nb, block, D]"""
+        if x.shape[-2] != nb * self.block:
+            raise ValueError(
+                f"{what} dim {x.shape[-2]} != {nb} blocks x {self.block}")
+        return x.reshape(*x.shape[:-2], nb, self.block, x.shape[-1])
+
+    def __call__(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        idx, blk = self.index, self.block
+        rows = jnp.asarray(idx.rows)
+        cols = jnp.asarray(idx.cols)
+        if self.mode == "sdd":
+            if self.trans_a:
+                a = jnp.swapaxes(a, -1, -2)
+            if self.trans_b:
+                b = jnp.swapaxes(b, -1, -2)
+            ab = self._blockify(a, idx.nb_r, "a rows")          # [..., nbr, blk, K]
+            bb = self._blockify(jnp.swapaxes(b, -1, -2),
+                                idx.nb_c, "b cols")             # [..., nbc, blk, K]
+            ga = jnp.take(ab, rows, axis=-3)                    # [..., nnz, blk, K]
+            gb = jnp.take(bb, cols, axis=-3)                    # [..., nnz, blk, K]
+            return jnp.einsum("...zik,...zjk->...zij", ga, gb)
+        if self.mode == "dsd":
+            if self.trans_b:
+                b = jnp.swapaxes(b, -1, -2)
+            bb = self._blockify(b, idx.nb_c, "b rows")          # [..., nbc, blk, N]
+            gb = jnp.take(bb, cols, axis=-3)                    # [..., nnz, blk, N]
+            part = jnp.einsum("...zij,...zjn->...zin", a, gb)   # [..., nnz, blk, N]
+            # scatter-add on the nnz axis IN PLACE (a leading-axis
+            # segment_sum needs moveaxis transposes that trip XLA CPU's
+            # algebraic simplifier — RET_CHECK crash observed)
+            out = jnp.zeros((*part.shape[:-3], idx.nb_r,
+                             blk, part.shape[-1]), part.dtype)
+            out = out.at[..., rows, :, :].add(part)             # [..., nbr, blk, N]
+            return out.reshape(*out.shape[:-3],
+                               idx.nb_r * blk, out.shape[-1])
+        # dds
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        ab = self._blockify(jnp.swapaxes(a, -1, -2),
+                            idx.nb_r, "a cols")                 # [..., nbr, blk, M]
+        ga = jnp.take(ab, rows, axis=-3)                        # [..., nnz, blk, M]
+        part = jnp.einsum("...zkm,...zkj->...zmj", ga, b)       # [..., nnz, M, blk]
+        out = jnp.zeros((*part.shape[:-3], idx.nb_c,
+                         part.shape[-2], blk), part.dtype)
+        out = out.at[..., cols, :, :].add(part)                 # [..., nbc, M, blk]
+        out = jnp.swapaxes(out, -3, -2)                         # [..., M, nbc, blk]
+        return out.reshape(*out.shape[:-2], idx.nb_c * blk)     # [..., M, N]
+
+
+class Softmax:
+    """Row softmax over a block-sparse matrix in block-COO values form.
+
+    x: [..., nnz, block, block] (the sdd output) -> same shape, where each
+    scores row (a row inside a row-block, spanning that row-block's active
+    column blocks) is softmaxed over the ACTIVE columns only — inactive
+    blocks are exactly zero, matching the reference's sparse softmax
+    (softmax.py there) and the fused kernel's masked-row semantics
+    (fully-inactive rows -> zeros, not NaN).
+
+    ``scale`` multiplies scores first; ``key_padding_mask`` /
+    ``attn_mask`` are additive fp masks ([..., N] / [M, N]) applied before
+    normalization, mirroring the reference's argument surface.
+    """
+
+    def __init__(self, layout, block: int):
+        self.index = _BlockIndex(layout)
+        self.block = int(block)
+
+    def __call__(self, x: jnp.ndarray, scale: float = 1.0,
+                 key_padding_mask: jnp.ndarray = None,
+                 attn_mask: jnp.ndarray = None) -> jnp.ndarray:
+        idx, blk = self.index, self.block
+        rows = jnp.asarray(idx.rows)
+        cols = jnp.asarray(idx.cols)
+        x = x * scale
+        if attn_mask is not None:
+            mb = attn_mask.reshape(idx.nb_r, blk, idx.nb_c, blk)
+            mb = jnp.swapaxes(mb, 1, 2)                         # [nbr, nbc, blk, blk]
+            x = x + mb[idx.rows, idx.cols]
+        if key_padding_mask is not None:
+            if key_padding_mask.ndim not in (1, 2):
+                raise ValueError(
+                    "key_padding_mask must be [N] or [batch, N]")
+            kb = key_padding_mask.reshape(
+                *key_padding_mask.shape[:-1], idx.nb_c, blk)
+            kb = jnp.take(kb, cols, axis=-2)        # [(B,) nnz, blk]
+            # align with x [..., nnz, blk_rows, blk_cols]: the mask hits
+            # the COLUMN axis and is constant over rows; a batched mask's
+            # B axis must line up with x's LEADING axis (head/extra axes
+            # sit between and get broadcast singletons)
+            if key_padding_mask.ndim == 1:
+                kb = kb[..., :, None, :]            # [nnz, 1, blk]
+            else:
+                # axes between B and nnz (e.g. the head axis)
+                extra = (x.ndim - 3) - (key_padding_mask.ndim - 1)
+                if extra < 0:
+                    raise ValueError(
+                        f"batched key_padding_mask {key_padding_mask.shape} "
+                        f"does not fit values of shape {x.shape}")
+                kb = kb.reshape(kb.shape[0], *([1] * extra),
+                                kb.shape[-2], 1, kb.shape[-1])
+            x = x + kb
+        # row-wise logsumexp across this row-block's active blocks via
+        # in-place max/sum scatters on the nnz axis (leading-axis segment
+        # ops need moveaxis transposes that trip XLA CPU's algebraic
+        # simplifier — RET_CHECK crash observed)
+        mx = jnp.max(x, axis=-1)                                # [..., nnz, blk]
+        row_max = jnp.full((*x.shape[:-3], idx.nb_r, blk),
+                           -1e30, x.dtype)
+        row_max = row_max.at[..., rows, :].max(mx)              # [..., nbr, blk]
+        p = jnp.exp(x - jnp.take(row_max, rows, axis=-2)[..., None])
+        row_sum = jnp.zeros_like(row_max).at[..., rows, :].add(
+            jnp.sum(p, axis=-1))
+        denom = jnp.take(row_sum, rows, axis=-2)[..., None]
+        return p / jnp.where(denom == 0.0, 1.0, denom)

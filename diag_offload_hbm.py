@@ -88,8 +88,14 @@ def main():
         env = dict(os.environ, DS_DIAG_CHILD="1",
                    DS_DIAG_CHUNKS=str(var.get("chunks", 1)), **var["env"])
         print(f"=== {var['name']} ===", flush=True)
-        r = subprocess.run([sys.executable, here], env=env,
-                           capture_output=True, text=True, timeout=1800)
+        try:
+            r = subprocess.run([sys.executable, here], env=env,
+                               capture_output=True, text=True, timeout=1800)
+        except subprocess.TimeoutExpired:
+            # a wedged variant must not cost the remaining variants'
+            # data — the comparison IS the tool's purpose
+            print(json.dumps({"timeout_s": 1800}), flush=True)
+            continue
         tailerr = "\n".join(r.stdout.splitlines()[-1:]) if r.returncode == 0 \
             else "\n".join(r.stderr.splitlines()[-30:])
         print(tailerr, flush=True)

@@ -1,0 +1,184 @@
+"""Standalone block-sparse MatMul/Softmax ops — differential tests vs
+dense masked math (reference exposes the same reusable surface:
+deepspeed/ops/sparse_attention/matmul.py:16, softmax.py; its unit tests
+diff against dense torch the same way, tests/unit/test_sparse_attention.py
+there)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                MatMul, Softmax)
+
+BLK = 16
+NB = 8
+M = N = K = BLK * NB
+
+
+def _layout(seed=0, density=0.4):
+    rng = np.random.default_rng(seed)
+    lay = (rng.random((NB, NB)) < density)
+    lay[np.arange(NB), np.arange(NB)] = True   # keep every row/col alive
+    return lay.astype(np.int64)
+
+
+def _dense_mask(lay):
+    return np.kron(lay, np.ones((BLK, BLK))) > 0
+
+
+def _to_blocks(dense, lay):
+    """Dense [., M, N] -> block-COO values in MatMul's row-major order."""
+    r, c = np.nonzero(lay)
+    order = np.lexsort((c, r))
+    r, c = r[order], c[order]
+    return np.stack([dense[..., i * BLK:(i + 1) * BLK,
+                           j * BLK:(j + 1) * BLK]
+                     for i, j in zip(r, c)], axis=-3)
+
+
+def test_sdd_matches_dense():
+    rng = np.random.default_rng(1)
+    lay = _layout()
+    a = rng.normal(size=(2, M, K)).astype(np.float32)
+    b = rng.normal(size=(2, K, N)).astype(np.float32)
+    vals = MatMul(lay, BLK, "sdd")(jnp.asarray(a), jnp.asarray(b))
+    ref = _to_blocks(np.moveaxis(
+        np.einsum("bmk,bkn->bmn", a, b), 0, 0), lay)
+    np.testing.assert_allclose(np.asarray(vals), ref, rtol=2e-5, atol=2e-4)
+
+
+def test_sdd_transpose_flags():
+    rng = np.random.default_rng(2)
+    lay = _layout(2)
+    a = rng.normal(size=(K, M)).astype(np.float32)   # pre-transposed
+    b = rng.normal(size=(N, K)).astype(np.float32)
+    vals = MatMul(lay, BLK, "sdd", trans_a=True, trans_b=True)(
+        jnp.asarray(a), jnp.asarray(b))
+    ref = _to_blocks(a.T @ b.T, lay)
+    np.testing.assert_allclose(np.asarray(vals), ref, rtol=2e-5, atol=2e-4)
+
+
+def test_dsd_matches_dense():
+    rng = np.random.default_rng(3)
+    lay = _layout(3)
+    a_dense = rng.normal(size=(2, M, K)).astype(np.float32) * \
+        _dense_mask(lay)
+    b = rng.normal(size=(2, K, N)).astype(np.float32)
+    vals = jnp.asarray(_to_blocks(a_dense, lay))
+    out = MatMul(lay, BLK, "dsd")(vals, jnp.asarray(b))
+    ref = np.einsum("bmk,bkn->bmn", a_dense, b)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-4)
+
+
+def test_dds_matches_dense():
+    rng = np.random.default_rng(4)
+    lay = _layout(4)
+    a = rng.normal(size=(2, M, K)).astype(np.float32)
+    b_dense = rng.normal(size=(2, K, N)).astype(np.float32) * \
+        _dense_mask(lay)
+    vals = jnp.asarray(_to_blocks(b_dense, lay))
+    out = MatMul(lay, BLK, "dds")(jnp.asarray(a), vals)
+    ref = np.einsum("bmk,bkn->bmn", a, b_dense)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-4)
+
+
+def test_softmax_matches_dense():
+    rng = np.random.default_rng(5)
+    lay = _layout(5)
+    scores = rng.normal(size=(2, M, N)).astype(np.float32)
+    mask = _dense_mask(lay)
+    vals = jnp.asarray(_to_blocks(scores, lay))
+    out = Softmax(lay, BLK)(vals, scale=0.5)
+    dense = np.where(mask, scores * 0.5, -1e30)
+    p = np.exp(dense - dense.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = _to_blocks(p, lay)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_softmax_key_padding_mask():
+    rng = np.random.default_rng(6)
+    lay = _layout(6)
+    scores = rng.normal(size=(M, N)).astype(np.float32)
+    kpm = np.where(rng.random(N) < 0.2, -1e30, 0.0).astype(np.float32)
+    vals = jnp.asarray(_to_blocks(scores, lay))
+    out = Softmax(lay, BLK)(vals, key_padding_mask=jnp.asarray(kpm))
+    dense = np.where(_dense_mask(lay), scores + kpm[None, :], -1e30)
+    p = np.exp(dense - dense.max(-1, keepdims=True))
+    s = p.sum(-1, keepdims=True)
+    p = p / np.where(s == 0, 1.0, s)
+    np.testing.assert_allclose(np.asarray(out), _to_blocks(p, lay),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_attention_composition_matches_fused_kernel():
+    """sdd -> softmax -> dsd composed from the standalone ops reproduces
+    the fused Pallas attention (the reference composes its attention from
+    exactly these three ops, sparse_self_attention.py there)."""
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention)
+    rng = np.random.default_rng(7)
+    H, D = 2, 32
+    cfg = BigBirdSparsityConfig(num_heads=H, block=BLK)
+    layout3 = np.asarray(cfg.make_layout(M))
+    lay = layout3[0]
+    q, k, v = (jnp.asarray(rng.normal(size=(1, H, M, D)), jnp.float32)
+               for _ in range(3))
+    sm = 1.0 / np.sqrt(D)
+    scores = MatMul(lay, BLK, "sdd", trans_b=True)(q, k)
+    probs = Softmax(lay, BLK)(scores, scale=sm)
+    out = MatMul(lay, BLK, "dsd")(probs, v)
+    ref = block_sparse_attention(q, k, v, layout3, BLK)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_matmul_is_differentiable():
+    rng = np.random.default_rng(8)
+    lay = _layout(8)
+    a = jnp.asarray(rng.normal(size=(M, K)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(K, N)), jnp.float32)
+    mm = MatMul(lay, BLK, "sdd")
+
+    def f(a, b):
+        return jnp.sum(mm(a, b) ** 2)
+
+    ga, gb = jax.grad(f, argnums=(0, 1))(a, b)
+    # dense reference gradient of sum((A@B * mask)^2)
+    mask = _dense_mask(lay)
+    c = np.asarray(a) @ np.asarray(b) * mask
+    ga_ref = 2 * c @ np.asarray(b).T
+    gb_ref = 2 * np.asarray(a).T @ c
+    np.testing.assert_allclose(np.asarray(ga), ga_ref, rtol=2e-4, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(gb), gb_ref, rtol=2e-4, atol=2e-3)
+
+
+def test_rejects_per_head_layout_and_bad_mode():
+    per_head = np.stack([_layout(1), _layout(9)])
+    with pytest.raises(ValueError, match="vmap"):
+        MatMul(per_head, BLK, "sdd")
+    with pytest.raises(ValueError, match="mode"):
+        MatMul(_layout(), BLK, "ssd")
+
+
+def test_softmax_batched_multihead_key_padding_mask():
+    """[B, N] masks must hit the batch axis, not the head axis (reviewed
+    bug: right-aligned broadcasting silently lined B up with H)."""
+    rng = np.random.default_rng(10)
+    B, H = 2, 3
+    lay = _layout(10)
+    scores = rng.normal(size=(B, H, M, N)).astype(np.float32)
+    kpm = np.where(rng.random((B, N)) < 0.2, -1e30, 0.0).astype(np.float32)
+    vals = jnp.asarray(np.stack([
+        np.stack([_to_blocks(scores[b, h], lay) for h in range(H)])
+        for b in range(B)]))
+    out = Softmax(lay, BLK)(vals, key_padding_mask=jnp.asarray(kpm))
+    dense = np.where(_dense_mask(lay)[None, None], scores
+                     + kpm[:, None, None, :], -1e30)
+    p = np.exp(dense - dense.max(-1, keepdims=True))
+    s = p.sum(-1, keepdims=True)
+    p = p / np.where(s == 0, 1.0, s)
+    ref = np.stack([np.stack([_to_blocks(p[b, h], lay) for h in range(H)])
+                    for b in range(B)])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)

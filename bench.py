@@ -184,10 +184,13 @@ def _15b_knobs():
 def _bench_15b(jax, impl: str = "xla"):
     """North star: GPT-2 1.5B, ZeRO-2 + host offload, one chip.
 
-    ``impl``: 'xla' — master/moments in pinned_host memory, Adam as an XLA
-    host computation (fastest path, but exercises compute_on through the
-    axon tunnel); 'host' — numpy staging + native C++ Adam (plan B: plain
-    jit step, no host-compute sections)."""
+    ``impl``: 'xla_split' — pinned_host master/moments with the optimizer
+    update as one compiled program per piece (program boundaries bound
+    HBM liveness; the fused update program OOM'd at compile on the AOT
+    path, round-5 window); 'xla' — same residency with ONE fused
+    host-compute update program (fastest when the compiler honors host
+    placement end to end); 'host' — numpy staging + native C++ Adam
+    (plan B: plain jit step, no host-compute sections)."""
     import jax.numpy as jnp  # noqa: F401
     from deepspeed_tpu.models import GPT2Config, GPT2Model
     from deepspeed_tpu.parallel import build_mesh
@@ -207,8 +210,10 @@ def _bench_15b(jax, impl: str = "xla"):
     # resident stacked block params, one layer fetched per scan tick) —
     # the deepest OOM fallback, and the capacity mode's throughput
     # number when measured deliberately (xla tier only)
+    split = impl == "xla_split"
+    impl_cfg = "xla" if split else impl
     stream = (os.environ.get("BENCH_15B_STREAM", "0") == "1"
-              and impl == "xla")
+              and impl_cfg == "xla")
     cfg_model = GPT2Config(d_model=1600, n_layer=48, n_head=25,
                            vocab_size=50257, n_positions=1024,
                            remat="block", scan_layers=True,
@@ -222,11 +227,13 @@ def _bench_15b(jax, impl: str = "xla"):
         "bf16": {"enabled": True},
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "zero_optimization": dict(
-            {"stage": 2, "cpu_offload": True, "offload_impl": impl},
+            {"stage": 2, "cpu_offload": True, "offload_impl": impl_cfg},
             **({"offload_grad_chunks": chunks}
-               if impl == "xla" and chunks > 1 else {}),
+               if impl_cfg == "xla" and chunks > 1 else {}),
             **({"param_streaming": True} if stream else {}),
-            **({"delayed_param_update": True} if dpu else {})),
+            **({"offload_split_update": True} if split else {}),
+            **({"delayed_param_update": True} if dpu and not split
+               else {})),
     }, world_size=1)
     if impl == "host":
         # strict probe semantics for the bench: a slow-but-working link
@@ -397,12 +404,16 @@ def main():
         # (no bulk tunnel traffic at all).  The host tier now fast-fails
         # on a bandwidth probe instead of stalling, so it is safe to keep
         # as the second attempt (it IS the right tier on a real TPU VM).
+        # xla_split first: the fused update program OOM'd at AOT compile
+        # (22.76G fp32 HLO temps, round-5 window); per-piece programs
+        # carry a hard liveness bound, so they are the reliable opener
         impls = [s.strip() for s in
-                 os.environ.get("BENCH_15B_IMPL", "xla,host").split(",")]
-        bad = [s for s in impls if s not in ("xla", "host")]
+                 os.environ.get("BENCH_15B_IMPL",
+                                "xla_split,xla,host").split(",")]
+        bad = [s for s in impls if s not in ("xla_split", "xla", "host")]
         if bad:
             raise ValueError(f"BENCH_15B_IMPL contains {bad}; valid: "
-                             "xla, host")
+                             "xla_split, xla, host")
         # ONE deadline shared across the whole chain: two wedged attempts
         # must not double the worst-case bound before the 124M fallback
         chain_deadline = time.monotonic() + deadline

@@ -80,6 +80,15 @@ class DeepSpeedZeroConfig:
             C.ZERO_DELAYED_PARAM_UPDATE_DEFAULT)
         self.param_streaming = get_scalar_param(
             zero, C.ZERO_PARAM_STREAMING, C.ZERO_PARAM_STREAMING_DEFAULT)
+        self.offload_split_update = get_scalar_param(
+            zero, C.ZERO_OFFLOAD_SPLIT_UPDATE,
+            C.ZERO_OFFLOAD_SPLIT_UPDATE_DEFAULT)
+        if self.offload_split_update and self.delayed_param_update:
+            raise DeepSpeedConfigError(
+                f"{C.ZERO_OFFLOAD_SPLIT_UPDATE} and "
+                f"{C.ZERO_DELAYED_PARAM_UPDATE} are mutually exclusive: "
+                "the DPU overlap dispatches one fused update program "
+                "behind the next step's gradients")
         if (not isinstance(self.offload_grad_chunks, int)
                 or self.offload_grad_chunks < 1):
             raise DeepSpeedConfigError(
@@ -429,6 +438,14 @@ class DeepSpeedConfig:
             if self.zero_config.offload_impl == "host":
                 raise DeepSpeedConfigError(
                     "offload_grad_chunks > 1 is an xla-tier capacity mode "
+                    "(offload_impl 'xla' or 'auto')")
+        if self.zero_config.offload_split_update:
+            if not self.zero_config.cpu_offload:
+                raise DeepSpeedConfigError(
+                    "offload_split_update requires cpu_offload")
+            if self.zero_config.offload_impl == "host":
+                raise DeepSpeedConfigError(
+                    "offload_split_update is an xla-tier mode "
                     "(offload_impl 'xla' or 'auto')")
         if self.zero_config.delayed_param_update:
             if not self.zero_config.cpu_offload:

@@ -161,6 +161,17 @@ ZERO_DELAYED_PARAM_UPDATE_DEFAULT = False
 # host-side collectives are ever needed).
 ZERO_PARAM_STREAMING = "param_streaming"
 ZERO_PARAM_STREAMING_DEFAULT = False
+# TPU extension (xla tier): run the optimizer update as ONE COMPILED
+# PROGRAM PER MASTER PIECE instead of one fused update program.  XLA
+# cannot extend buffer liveness across program boundaries, so device-
+# resident optimizer-state bytes are bounded by the largest piece even
+# where the compiler materializes host-placed buffers in HBM (observed
+# on the AOT compile path: the fused 1.5B update program allocated the
+# whole fp32 state as HBM temps).  Costs one dispatch per piece per
+# step; numerics identical.  Mutually exclusive with
+# delayed_param_update (the DPU overlap assumes the fused program).
+ZERO_OFFLOAD_SPLIT_UPDATE = "offload_split_update"
+ZERO_OFFLOAD_SPLIT_UPDATE_DEFAULT = False
 ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_ELASTIC_CHECKPOINT_DEFAULT = True
 ZERO_MAX_ELEMENTS_PER_COMM = "max_elements_per_comm"

@@ -139,6 +139,33 @@ def _unpack_leaf(sl, rec: _FlatLeaf, xp):
     return sl.reshape(-1)[:rec.size].reshape(rec.shape)
 
 
+def _offload_update_scalars(count, finites, sumsqs, *, b1, b2,
+                            bias_correction, clip, lr_at):
+    """Shared scalar math for the offload update programs (fused update_fn
+    AND the split-update stats program — one definition so the bias
+    correction / clip / lr semantics cannot drift): combine per-group
+    finiteness, cross-group global norm, Adam bias corrections at the
+    next count, the scheduled lr, and the fp32 clip factor."""
+    finite = finites[0]
+    for f in finites[1:]:
+        finite = jnp.logical_and(finite, f)
+    grad_norm = jnp.sqrt(sum(sumsqs))
+    count1 = count + 1
+    count_f = count1.astype(jnp.float32)
+    if bias_correction:
+        c1 = 1 - b1 ** count_f
+        c2 = 1 - b2 ** count_f
+    else:
+        c1 = c2 = jnp.asarray(1.0, jnp.float32)
+    step_lr = lr_at(count1)
+    # clip factor from the cross-group global norm, applied in fp32 on
+    # the host (the single-program path clips on device pre-pack; same
+    # linear scaling, fp32 here)
+    cscale = (jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+              if clip > 0 else jnp.asarray(1.0, jnp.float32))
+    return finite, grad_norm, c1, c2, step_lr, cscale
+
+
 class DeepSpeedEngine:
     def __init__(self,
                  model: TrainModule,
@@ -224,6 +251,10 @@ class DeepSpeedEngine:
         # the master leaf-by-leaf during init (below) so the full fp32
         # tree never has to fit in device memory.
         self._offload = bool(config.zero_config.cpu_offload)
+        # set when a partially-donated update leaves self.state pointing
+        # at deleted buffers (offload_split_update mid-piece failure);
+        # train/save must refuse rather than act on the corrupt state
+        self._fatal_state_error = None
         self._offload_impl = None
         if self._offload:
             impl = config.zero_config.offload_impl
@@ -465,6 +496,15 @@ class DeepSpeedEngine:
                     "param_streaming is an xla-tier capacity mode; "
                     "offload_impl resolved to 'host' on this platform. "
                     "Set offload_impl='xla' explicitly.")
+            if (getattr(config.zero_config, "offload_split_update", False)
+                    or os.environ.get("DS_OFFLOAD_SPLIT_UPDATE") == "1"):
+                # the env knob must fail as loudly as the config flag — a
+                # hardware experiment silently measuring the host tier is
+                # exactly the fallback confusion this raise prevents
+                raise ValueError(
+                    "offload_split_update is an xla-tier mode; "
+                    "offload_impl resolved to 'host' on this platform. "
+                    "Set offload_impl='xla' explicitly.")
             if config.zero_optimization_stage >= 3:
                 raise ValueError(
                     "ZeRO-3 × cpu_offload requires offload_impl='xla' "
@@ -544,13 +584,24 @@ class DeepSpeedEngine:
                                  "offload_grad_chunks", 1) or 1)
             chunks = min(chunks, len(self._flat_sizes))
             dpu_xla = bool(config.zero_config.delayed_param_update)
+            # env override for hardware experiments: flip the update
+            # structure without editing the config file
+            split_update = (
+                bool(getattr(config.zero_config,
+                             "offload_split_update", False))
+                or os.environ.get("DS_OFFLOAD_SPLIT_UPDATE") == "1")
+            if split_update and dpu_xla:
+                raise ValueError(
+                    "offload_split_update and delayed_param_update are "
+                    "mutually exclusive (config-level check bypassed via "
+                    "DS_OFFLOAD_SPLIT_UPDATE?)")
             self._xla_dpu_pending = None
             self._xla_dpu_update = None
             self._xla_dpu_dispatch = 0
-            if chunks > 1 or dpu_xla:
+            if chunks > 1 or dpu_xla or split_update:
                 self._train_step = self._build_chunked_offload_steps(
                     self._grad_group_indices(max(chunks, 1)),
-                    delayed=dpu_xla)
+                    delayed=dpu_xla, split_update=split_update)
             else:
                 self._train_step = self._build_xla_offload_step()
             self._eval_step = self._build_xla_offload_eval_step()
@@ -1526,6 +1577,121 @@ class DeepSpeedEngine:
                 new_nu.append(jnp.where(keep, nu2, nu_p))
             return (tuple(new_master), tuple(new_mu), tuple(new_nu))
 
+    def _build_split_update(self, *, b1, b2, eps, wd, adam_w_mode,
+                            bias_correction, clip, scale_config, lr_at,
+                            piece_host, host_scalar):
+        """Optimizer update as ONE COMPILED PROGRAM PER MASTER PIECE
+        (zero_optimization.offload_split_update).
+
+        Why program-per-piece: XLA cannot extend buffer liveness across
+        executable boundaries, so device-resident optimizer bytes are
+        bounded by ONE piece's temps even where the compiler materializes
+        host-placed buffers in HBM — the observed failure of the fused
+        update program on the AOT compile path (round-5 hardware window:
+        22.76 GB of fp32 piece-shaped HLO temps at 1.5B).  The reference
+        gets the same bound from its pinned-buffer tile loop
+        (csrc/adam/cpu_adam.cpp:64-113 there); here the boundary IS the
+        mechanism.  Numerics are identical to the fused update — same
+        _host_adam_pieces math per piece, same overflow-skip select.
+
+        Cost: one dispatch per piece per step (tens of microseconds each)
+        plus one scalar-stats program and one scalar-tail program; jit
+        caches by piece shape, so a scan-stacked transformer compiles a
+        handful of distinct piece programs, not one per layer.
+        """
+        dev = NamedSharding(self.mesh, P())
+
+        def stats_fn(count, finites, sumsqs):
+            finite, grad_norm, c1, c2, step_lr, cscale = \
+                _offload_update_scalars(
+                    count, finites, sumsqs, b1=b1, b2=b2,
+                    bias_correction=bias_correction, clip=clip,
+                    lr_at=lr_at)
+            return (finite, grad_norm, finite.astype(jnp.float32),
+                    jnp.asarray(c1, jnp.float32),
+                    jnp.asarray(c2, jnp.float32),
+                    jnp.asarray(step_lr, jnp.float32), cscale)
+
+        stats_jit = jax.jit(
+            stats_fn,
+            out_shardings=(dev, dev) + (host_scalar,) * 5)
+
+        def piece_fn(master, mu, nu, g, finite_f, c1, c2, lr, cs):
+            # delegate to _host_adam_pieces with one-piece tuples: it is
+            # the ONE definition of overflow-skip and weight-decay
+            # semantics (count is unused there; zero placeholder)
+            opt1 = FusedAdamState(count=jnp.zeros((), jnp.int32),
+                                  mu=(mu,), nu=(nu,))
+            new_m, new_mu, new_nu = self._host_adam_pieces(
+                (g,), (master,), opt1, finite_f, c1, c2, lr,
+                b1=b1, b2=b2, eps=eps, wd=wd, adam_w_mode=adam_w_mode,
+                clip_scale_h=cs)
+            return new_m[0], new_mu[0], new_nu[0]
+
+        # the grad piece (3) is donated too: it is dead after this program
+        piece_jit = jax.jit(piece_fn, donate_argnums=(0, 1, 2, 3),
+                            out_shardings=(piece_host,) * 3)
+
+        def tail_fn(scaler, global_steps, skipped, count, finite,
+                    mean_loss, grad_norm):
+            new_scaler = precision.update_scale(scaler, finite,
+                                                scale_config)
+            new_skipped = skipped + (1 - finite.astype(jnp.int32))
+            new_global = global_steps + 1
+            new_count = count + finite.astype(jnp.int32)
+            applied = new_global - new_skipped
+            packed = self._packed_metrics(mean_loss, grad_norm, scaler,
+                                          finite, lr_at(applied))
+            return new_scaler, new_global, new_skipped, new_count, packed
+
+        tail_jit = jax.jit(tail_fn)
+
+        def update_split(state: TrainState, gpieces, finites, sumsqs,
+                         mean_loss):
+            opt = state.opt_state
+            (finite, grad_norm, finite_f, c1_h, c2_h, lr_h,
+             cs_h) = stats_jit(opt.count, finites, sumsqs)
+            new_m, new_mu, new_nu = [], [], []
+            try:
+                for m, mu, nu, g in zip(state.master_params, opt.mu,
+                                        opt.nu, gpieces):
+                    m2, mu2, nu2 = piece_jit(m, mu, nu, g, finite_f,
+                                             c1_h, c2_h, lr_h, cs_h)
+                    new_m.append(m2)
+                    new_mu.append(mu2)
+                    new_nu.append(nu2)
+            except Exception as e:
+                # pieces updated so far were DONATED: self.state still
+                # points at their deleted buffers, so this engine's
+                # optimizer plane is unrecoverable.  Poison loudly rather
+                # than letting a later save_checkpoint serialize a
+                # half-donated state or die on 'Array has been deleted'.
+                self._fatal_state_error = (
+                    "offload_split_update failed mid-piece "
+                    f"({len(new_m)}/{len(gpieces)} pieces applied): the "
+                    "applied pieces' previous buffers were donated, so "
+                    "this engine's optimizer state is unusable. Rebuild "
+                    "the engine and load_checkpoint. Original error: "
+                    f"{e!r}")
+                raise RuntimeError(self._fatal_state_error) from e
+            (new_scaler, new_global, new_skipped, new_count,
+             packed) = tail_jit(state.scaler, state.global_steps,
+                                state.skipped_steps, opt.count, finite,
+                                mean_loss, grad_norm)
+            new_state = TrainState(
+                master_params=tuple(new_m),
+                opt_state=FusedAdamState(count=new_count,
+                                         mu=tuple(new_mu),
+                                         nu=tuple(new_nu)),
+                scaler=new_scaler,
+                global_steps=new_global,
+                skipped_steps=new_skipped,
+                rng=state.rng,
+            )
+            return new_state, packed
+
+        return update_split
+
     def _build_xla_offload_eval_step(self):
         module = self.module
 
@@ -1558,7 +1724,8 @@ class DeepSpeedEngine:
             loads[g] += self._flat_sizes[i]
         return [sorted(g) for g in groups if g]
 
-    def _build_chunked_offload_steps(self, groups, delayed: bool = False):
+    def _build_chunked_offload_steps(self, groups, delayed: bool = False,
+                                     split_update: bool = False):
         compute_dtype = self.compute_dtype
         clip = self.gradient_clipping
         scale_config = self.loss_scale_config
@@ -1646,24 +1813,12 @@ class DeepSpeedEngine:
             # per-group stats combine INSIDE the one compiled program —
             # eager op-by-op combination would dispatch ~2K tiny programs
             # per step (the class of overhead prior rounds removed)
-            finite = finites[0]
-            for f in finites[1:]:
-                finite = jnp.logical_and(finite, f)
-            grad_norm = jnp.sqrt(sum(sumsqs))
             opt = state.opt_state
-            count1 = opt.count + 1
-            count_f = count1.astype(jnp.float32)
-            if bias_correction:
-                c1 = 1 - b1 ** count_f
-                c2 = 1 - b2 ** count_f
-            else:
-                c1 = c2 = jnp.asarray(1.0, jnp.float32)
-            step_lr = lr_at(count1)
-            # clip factor from the cross-group global norm, applied in
-            # fp32 on the host (the single-program path clips on device
-            # pre-pack; same linear scaling, fp32 here)
-            cscale = (jnp.minimum(1.0, clip / (grad_norm + 1e-6))
-                      if clip > 0 else jnp.asarray(1.0, jnp.float32))
+            finite, grad_norm, c1, c2, step_lr, cscale = \
+                _offload_update_scalars(
+                    opt.count, finites, sumsqs, b1=b1, b2=b2,
+                    bias_correction=bias_correction, clip=clip,
+                    lr_at=lr_at)
             finite_f = jax.device_put(
                 finite.astype(jnp.float32), host_scalar)
             c1_h = jax.device_put(c1, host_scalar)
@@ -1695,6 +1850,13 @@ class DeepSpeedEngine:
             update_fn, donate_argnums=(() if delayed else (0,)),
             out_shardings=(state_shardings, dev))
         self._xla_dpu_update = update_jit if delayed else None
+
+        if split_update:
+            update_jit = self._build_split_update(
+                b1=b1, b2=b2, eps=eps, wd=wd, adam_w_mode=adam_w_mode,
+                bias_correction=bias_correction, clip=clip,
+                scale_config=scale_config, lr_at=lr_at,
+                piece_host=piece_host, host_scalar=host_scalar)
 
         def run_grads(state, batch, step_seed):
             pieces_by_leaf = [None] * n_leaves
@@ -2113,6 +2275,8 @@ class DeepSpeedEngine:
     def train_batch(self, batch=None, data_iter=None):
         """Run one full training step (grad-accum included) on a global
         batch of ``train_batch_size`` samples."""
+        if self._fatal_state_error is not None:
+            raise RuntimeError(self._fatal_state_error)
         if batch is None:
             it = data_iter or self._training_iter()
             if it is None:
@@ -2288,6 +2452,8 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
+        if self._fatal_state_error is not None:
+            raise RuntimeError(self._fatal_state_error)
         if self._offload_host:
             self._dpu_flush()  # the saved master must be fully applied
         elif self._offload_xla:

@@ -1,0 +1,131 @@
+"""Minimal mechanism repro for the xla-offload HBM question.
+
+The 1.5B step OOM'd with fp32 piece-shaped HBM temps despite pinned_host
+residency (round-5 window); the engine-scale diag compiles too slowly to
+iterate.  This strips the mechanism to its skeleton: N pinned_host fp32
+"master pieces", one donated jit that (a) host-casts them to bf16 and
+uploads, (b) computes a stand-in gradient on device, (c) ships grad
+pieces to host, (d) runs the Adam recurrences in a compute_on host
+section, returning updated pinned_host pieces.  Then prints the
+compiler's memory analysis and a one-step wall time.
+
+If HBM temps ~ bf16 bytes -> mechanism works; the engine's OOM is
+program structure.  If HBM temps ~ fp32 state -> the AOT path ignores
+host placement and the fix is program-boundary chunking.
+
+Knobs: PIECES (default 8), PIECE_MB (default 256), DS_MIN_COMPUTE_ON=0
+to run the optimizer math on device with pinned_host residency only.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_T0 = time.time()
+
+
+def _mark(m):
+    print(f"[min {time.time() - _T0:6.1f}s] {m}", file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental import compute_on
+
+    n_pieces = int(os.environ.get("PIECES", "8"))
+    piece_mb = int(os.environ.get("PIECE_MB", "256"))
+    use_compute_on = os.environ.get("DS_MIN_COMPUTE_ON", "1") == "1"
+    w = piece_mb * (1 << 20) // 4
+
+    dev = jax.devices()[0]
+    mesh = jax.sharding.Mesh(np.array([dev]), ("data",))
+    s_dev = NamedSharding(mesh, P())
+    s_host = s_dev.with_memory_kind("pinned_host")
+
+    def host_section():
+        if use_compute_on:
+            return compute_on.compute_on("device_host")
+        import contextlib
+        return contextlib.nullcontext()
+
+    _mark(f"staging {3 * n_pieces * piece_mb} MB fp32 to pinned_host")
+    masters = tuple(
+        jax.device_put(jnp.full((w,), 0.01 * (i + 1), jnp.float32), s_host)
+        for i in range(n_pieces))
+    mus = tuple(jax.device_put(jnp.zeros((w,), jnp.float32), s_host)
+                for _ in range(n_pieces))
+    nus = tuple(jax.device_put(jnp.zeros((w,), jnp.float32), s_host)
+                for _ in range(n_pieces))
+
+    def step(masters, mus, nus, x):
+        # (a) cast-up on host, upload bf16
+        with host_section():
+            lowp = [m.astype(jnp.bfloat16) for m in masters]
+        params = [jax.device_put(p, s_dev) for p in lowp]
+        # (b) stand-in gradient: a little device math per piece
+        grads = [jnp.tanh(p * x) * 0.1 for p in params]
+        # (c) ship grad pieces to host
+        ghost = [jax.device_put(g, s_host) for g in grads]
+        # (d) Adam on host
+        with host_section():
+            new_m, new_mu, new_nu = [], [], []
+            for m, mu, nu, g in zip(masters, mus, nus, ghost):
+                g32 = g.astype(jnp.float32)
+                mu2 = 0.9 * mu + 0.1 * g32
+                nu2 = 0.999 * nu + 0.001 * g32 * g32
+                upd = mu2 / (jnp.sqrt(nu2) + 1e-8)
+                new_m.append(m - 1e-3 * upd)
+                new_mu.append(mu2)
+                new_nu.append(nu2)
+        loss = sum(jnp.sum(g[:8].astype(jnp.float32)) for g in grads)
+        return tuple(new_m), tuple(new_mu), tuple(new_nu), loss
+
+    shard = (
+        (s_host,) * n_pieces, (s_host,) * n_pieces, (s_host,) * n_pieces,
+        s_dev)
+    fn = jax.jit(step, donate_argnums=(0, 1, 2), out_shardings=shard)
+    x = jax.device_put(jnp.asarray(2.0, jnp.bfloat16), s_dev)
+
+    jax.block_until_ready(masters)
+    _mark("staged; lowering")
+    t0 = time.time()
+    lowered = fn.lower(masters, mus, nus, x)
+    _mark("lowered; compiling")
+    compiled = lowered.compile()
+    _mark("compiled")
+    compile_s = time.time() - t0
+    rec = {"pieces": n_pieces, "piece_mb": piece_mb,
+           "compute_on": use_compute_on,
+           "compile_s": round(compile_s, 1),
+           "fp32_state_mb": 3 * n_pieces * piece_mb,
+           "bf16_params_mb": n_pieces * piece_mb // 2}
+    try:
+        mem = compiled.memory_analysis()
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "alias_size_in_bytes"):
+            v = getattr(mem, k, None)
+            if v is not None:
+                rec[k.replace("_size_in_bytes", "_mb")] = round(
+                    int(v) / (1 << 20), 1)
+    except Exception as e:  # noqa: BLE001
+        rec["memory_analysis_error"] = repr(e)
+
+    # one real step: does it run, and how fast
+    t0 = time.time()
+    masters, mus, nus, loss = compiled(masters, mus, nus, x)
+    jax.block_until_ready(loss)
+    rec["first_step_s"] = round(time.time() - t0, 2)
+    t0 = time.time()
+    masters, mus, nus, loss = compiled(masters, mus, nus, x)
+    jax.block_until_ready(loss)
+    rec["steady_step_s"] = round(time.time() - t0, 3)
+    rec["loss"] = float(np.asarray(loss))
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

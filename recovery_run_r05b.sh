@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-5 second-window recovery chain: cheapest + most informative first.
+# Run by the tunnel probe loop on recovery; 1.5B is intentionally NOT here
+# (its fix depends on the diag results — run bench.py manually after
+# reading DIAG_pinned_min*.json).
+cd /root/repo
+log=recovery_r05b.log
+echo "=== r05b start $(date -u) ===" >> "$log"
+
+bank() {
+  msg=$1; shift
+  ok=0
+  for i in 1 2 3 4 5; do
+    for f in "$@"; do [ -e "$f" ] && git add "$f" >> "$log" 2>&1 || true; done
+    git commit -q -m "$msg" >> "$log" 2>&1 && { ok=1; break; }
+    sleep 7
+  done
+  [ "$ok" = 1 ] || echo "!!! commit FAILED: $msg" >> "$log"
+}
+
+# 1. pinned-host mechanism diag, three variants, small then medium
+PIECES=4 PIECE_MB=64 timeout 900 python diag_pinned_host_min.py \
+  > DIAG_pinned_min_small.json 2>> "$log"
+echo "=== min small rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
+PIECES=4 PIECE_MB=64 DS_MIN_COMPUTE_ON=0 timeout 900 python diag_pinned_host_min.py \
+  > DIAG_pinned_min_devmath.json 2>> "$log"
+echo "=== min devmath rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
+PIECES=8 PIECE_MB=256 timeout 1200 python diag_pinned_host_min.py \
+  > DIAG_pinned_min_2g.json 2>> "$log"
+echo "=== min 2g rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
+bank "Diag artifacts: pinned-host mechanism probes" \
+  DIAG_pinned_min_small.json DIAG_pinned_min_devmath.json \
+  DIAG_pinned_min_2g.json "$log"
+
+# 2. re-run the fixed benches (perf-config bert, SMEM-fixed sparse,
+#    calibrated flash)
+python bench_bert.py > BENCH_bert_raw.json 2>> "$log"
+echo "=== bert rc=$? ===" >> "$log"
+bank "Bench artifact: BERT-large perf-config rerun" \
+  BENCH_bert.json BENCH_bert_raw.json "$log"
+python bench_sparse.py > BENCH_sparse_raw.json 2>> "$log"
+echo "=== sparse rc=$? ===" >> "$log"
+bank "Bench artifact: block-sparse rerun (SMEM fix + calibrated timing)" \
+  BENCH_sparse.json BENCH_sparse_raw.json "$log"
+python bench_flash.py > BENCH_flash_raw.json 2>> "$log"
+echo "=== flash rc=$? ===" >> "$log"
+bank "Bench artifact: flash sweep rerun (calibrated timing)" \
+  BENCH_flash.json BENCH_flash_raw.json "$log"
+
+echo "=== r05b done $(date -u) ===" >> "$log"
+touch /tmp/r05b_done

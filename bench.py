@@ -212,6 +212,12 @@ def _bench_15b(jax, impl: str = "xla"):
     # number when measured deliberately (xla tier only)
     split = impl == "xla_split"
     impl_cfg = "xla" if split else impl
+    if split and os.environ.get("BENCH_15B_DPU", "0") == "1":
+        # loud, not silent: DPU's overlap assumes the fused update
+        # program, so this leg measures non-DPU throughput
+        _mark("1.5B[xla_split]: BENCH_15B_DPU=1 ignored on this leg "
+              "(split update and DPU are mutually exclusive; the 'xla' "
+              "fallback leg will honor it)")
     stream = (os.environ.get("BENCH_15B_STREAM", "0") == "1"
               and impl_cfg == "xla")
     cfg_model = GPT2Config(d_model=1600, n_layer=48, n_head=25,

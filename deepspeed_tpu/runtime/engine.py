@@ -251,6 +251,15 @@ class DeepSpeedEngine:
         # the master leaf-by-leaf during init (below) so the full fp32
         # tree never has to fit in device memory.
         self._offload = bool(config.zero_config.cpu_offload)
+        if (os.environ.get("DS_OFFLOAD_SPLIT_UPDATE") == "1"
+                and not self._offload):
+            # the env knob must fail exactly like the config flag would
+            # (DeepSpeedConfigError: 'offload_split_update requires
+            # cpu_offload') — silently measuring the plain step is the
+            # confusion these raises exist to prevent
+            raise ValueError(
+                "DS_OFFLOAD_SPLIT_UPDATE=1 requires "
+                "zero_optimization.cpu_offload")
         # set when a partially-donated update leaves self.state pointing
         # at deleted buffers (offload_split_update mid-piece failure);
         # train/save must refuse rather than act on the corrupt state
@@ -939,16 +948,34 @@ class DeepSpeedEngine:
             lr,
         ])
 
+    def _epilogue_scalars(self, scaler, global_steps, skipped_steps,
+                          finite, mean_loss, grad_norm, lr_at,
+                          scale_config):
+        """Scalar core of the step tail — ONE definition of loss-scale
+        update, skip/step counters, and the packed metrics contract, used
+        by _step_epilogue (fused paths) AND the split-update tail program
+        so they cannot drift."""
+        new_scaler = precision.update_scale(scaler, finite, scale_config)
+        new_skipped = skipped_steps + (1 - finite.astype(jnp.int32))
+        new_global = global_steps + 1
+        # lr is reported at the *applied*-step count so it matches what
+        # the optimizer's schedule actually used (skipped steps don't
+        # advance the schedule)
+        applied = new_global - new_skipped
+        packed = self._packed_metrics(mean_loss, grad_norm, scaler,
+                                      finite, lr_at(applied))
+        return new_scaler, new_global, new_skipped, packed
+
     def _step_epilogue(self, state, new_master, new_opt, finite,
                        mean_loss, grad_norm, lr_at, scale_config):
         """Shared step tail: loss-scale update, skip/step counters, the
         next TrainState, and the packed metrics vector.  One copy so skip
         semantics and the metrics contract can't drift across the step
         builders."""
-        new_scaler = precision.update_scale(state.scaler, finite,
-                                            scale_config)
-        new_skipped = state.skipped_steps + (1 - finite.astype(jnp.int32))
-        new_global = state.global_steps + 1
+        new_scaler, new_global, new_skipped, packed = \
+            self._epilogue_scalars(state.scaler, state.global_steps,
+                                   state.skipped_steps, finite, mean_loss,
+                                   grad_norm, lr_at, scale_config)
         new_state = TrainState(
             master_params=new_master,
             opt_state=new_opt,
@@ -957,12 +984,6 @@ class DeepSpeedEngine:
             skipped_steps=new_skipped,
             rng=state.rng,
         )
-        # lr is reported at the *applied*-step count so it matches what
-        # the optimizer's schedule actually used (skipped steps don't
-        # advance the schedule)
-        applied = new_global - new_skipped
-        packed = self._packed_metrics(mean_loss, grad_norm, state.scaler,
-                                      finite, lr_at(applied))
         return new_state, packed
 
     def _build_train_step(self):
@@ -1634,14 +1655,11 @@ class DeepSpeedEngine:
 
         def tail_fn(scaler, global_steps, skipped, count, finite,
                     mean_loss, grad_norm):
-            new_scaler = precision.update_scale(scaler, finite,
-                                                scale_config)
-            new_skipped = skipped + (1 - finite.astype(jnp.int32))
-            new_global = global_steps + 1
+            new_scaler, new_global, new_skipped, packed = \
+                self._epilogue_scalars(scaler, global_steps, skipped,
+                                       finite, mean_loss, grad_norm,
+                                       lr_at, scale_config)
             new_count = count + finite.astype(jnp.int32)
-            applied = new_global - new_skipped
-            packed = self._packed_metrics(mean_loss, grad_norm, scaler,
-                                          finite, lr_at(applied))
             return new_scaler, new_global, new_skipped, new_count, packed
 
         tail_jit = jax.jit(tail_fn)
@@ -1660,6 +1678,14 @@ class DeepSpeedEngine:
                     new_m.append(m2)
                     new_mu.append(mu2)
                     new_nu.append(nu2)
+                # the tail must sit inside the guard too: by now every
+                # old master/mu/nu buffer is donated, so a tail failure
+                # leaves self.state just as unrecoverable as a mid-piece
+                # one
+                (new_scaler, new_global, new_skipped, new_count,
+                 packed) = tail_jit(state.scaler, state.global_steps,
+                                    state.skipped_steps, opt.count,
+                                    finite, mean_loss, grad_norm)
             except Exception as e:
                 # pieces updated so far were DONATED: self.state still
                 # points at their deleted buffers, so this engine's
@@ -1667,17 +1693,14 @@ class DeepSpeedEngine:
                 # than letting a later save_checkpoint serialize a
                 # half-donated state or die on 'Array has been deleted'.
                 self._fatal_state_error = (
-                    "offload_split_update failed mid-piece "
-                    f"({len(new_m)}/{len(gpieces)} pieces applied): the "
+                    "offload_split_update failed after "
+                    f"{len(new_m)}/{len(gpieces)} piece updates: the "
                     "applied pieces' previous buffers were donated, so "
-                    "this engine's optimizer state is unusable. Rebuild "
-                    "the engine and load_checkpoint. Original error: "
+                    "this engine's optimizer state is unusable. "
+                    "load_checkpoint on this engine (or rebuild it) to "
+                    "recover. Original error: "
                     f"{e!r}")
                 raise RuntimeError(self._fatal_state_error) from e
-            (new_scaler, new_global, new_skipped, new_count,
-             packed) = tail_jit(state.scaler, state.global_steps,
-                                state.skipped_steps, opt.count, finite,
-                                mean_loss, grad_norm)
             new_state = TrainState(
                 master_params=tuple(new_m),
                 opt_state=FusedAdamState(count=new_count,
@@ -2471,11 +2494,17 @@ class DeepSpeedEngine:
         # offload host-state sync happens inside load_checkpoint itself so
         # the public runtime.checkpointing API is consistent when called
         # directly (advisor finding, round 1)
-        return load_checkpoint(
+        out = load_checkpoint(
             self, load_dir, tag=tag,
             load_optimizer_states=load_optimizer_states,
             load_lr_scheduler_states=load_lr_scheduler_states,
             load_module_only=load_module_only)
+        # a successful load rebuilt self.state wholesale (module-only
+        # loads get a fresh optimizer plane), so a donation-poisoned
+        # engine is healthy again — the poison message's own recovery
+        # instruction must actually work on this engine instance
+        self._fatal_state_error = None
+        return out
 
     # ------------------------------------------------------------------
     # introspection / logging

@@ -34,15 +34,15 @@ bank "Diag artifacts: pinned-host mechanism probes" \
 
 # 2. re-run the fixed benches (perf-config bert, SMEM-fixed sparse,
 #    calibrated flash)
-python bench_bert.py > BENCH_bert_raw.json 2>> "$log"
+timeout 2400 python bench_bert.py > BENCH_bert_raw.json 2>> "$log"
 echo "=== bert rc=$? ===" >> "$log"
 bank "Bench artifact: BERT-large perf-config rerun" \
   BENCH_bert.json BENCH_bert_raw.json "$log"
-python bench_sparse.py > BENCH_sparse_raw.json 2>> "$log"
+timeout 2400 python bench_sparse.py > BENCH_sparse_raw.json 2>> "$log"
 echo "=== sparse rc=$? ===" >> "$log"
 bank "Bench artifact: block-sparse rerun (SMEM fix + calibrated timing)" \
   BENCH_sparse.json BENCH_sparse_raw.json "$log"
-python bench_flash.py > BENCH_flash_raw.json 2>> "$log"
+timeout 2400 python bench_flash.py > BENCH_flash_raw.json 2>> "$log"
 echo "=== flash rc=$? ===" >> "$log"
 bank "Bench artifact: flash sweep rerun (calibrated timing)" \
   BENCH_flash.json BENCH_flash_raw.json "$log"

@@ -565,3 +565,47 @@ def test_split_update_env_knob_rejected_on_host_tier(monkeypatch):
     with pytest.raises(ValueError, match="xla-tier"):
         DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg,
                         mesh=build_mesh(dp=1, devices=jax.devices()[:1]))
+
+
+def test_split_update_env_knob_requires_offload(monkeypatch):
+    monkeypatch.setenv("DS_OFFLOAD_SPLIT_UPDATE", "1")
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+    }, world_size=1)
+    with pytest.raises(ValueError, match="cpu_offload"):
+        DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg,
+                        mesh=build_mesh(dp=1, devices=jax.devices()[:1]))
+
+
+def test_poisoned_engine_recovers_via_load_checkpoint(mesh, tmp_path):
+    """The poison message tells users to load_checkpoint; a successful
+    load rebuilds the whole TrainState, so it must clear the poison."""
+    def cfg():
+        return DeepSpeedConfig({
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "steps_per_print": 10 ** 9,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2, "cpu_offload": True,
+                                  "offload_impl": "xla",
+                                  "offload_split_update": True},
+        }, world_size=4)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg(), mesh=mesh,
+                          seed=3)
+    x, y = _batch()
+    eng.train_batch((x, y))
+    eng.save_checkpoint(str(tmp_path), tag="ok")
+    eng._fatal_state_error = "simulated mid-piece donation failure"
+    with pytest.raises(RuntimeError, match="simulated"):
+        eng.train_batch((x, y))
+    with pytest.raises(RuntimeError, match="simulated"):
+        eng.save_checkpoint(str(tmp_path), tag="nope")
+    eng.load_checkpoint(str(tmp_path), tag="ok")
+    loss = float(np.asarray(eng.train_batch((x, y))))   # healthy again
+    assert np.isfinite(loss)

@@ -52,10 +52,23 @@ def test_rerun_reproduces_banked_prefix(tmp_path):
     curve — catches any numerics drift in engine/optimizer/model/data."""
     out = str(tmp_path / "rerun.json")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # the baseline trained on ONE cpu device; pytest's conftest appends
+    # an 8-virtual-device token to XLA_FLAGS that would hand the
+    # subprocess a dp=8 mesh (8x the work on this 1-core host AND
+    # different batch semantics than the banked curve).  Strip ONLY that
+    # token — any other inherited XLA flags also applied when the
+    # baseline was banked outside pytest.
+    flags = " ".join(
+        tok for tok in env.get("XLA_FLAGS", "").split()
+        if not tok.startswith("--xla_force_host_platform_device_count"))
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
     subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "convergence_gpt2.py"),
          "--cpu", "--steps", "80", "--out", out],
-        check=True, cwd=str(tmp_path), env=env, timeout=2400)
+        check=True, cwd=str(tmp_path), env=env, timeout=1200)
     with open(out) as f:
         rerun = np.array(json.load(f)["losses"], dtype=np.float64)
     with open(BASELINE) as f:

@@ -95,7 +95,7 @@ def _flops_per_token(cfg, seq):
     return 6 * cfg.num_params + 12 * cfg.n_layer * cfg.d_model * seq
 
 
-def calibrated_time(fn, iters, min_window_s=None):
+def calibrated_time(fn, iters=None, min_window_s=None):
     """Time fn() with an iteration count calibrated so the measured window
     dwarfs dispatch/tunnel jitter — 20 iters of a ~35us kernel measures
     noise, not the kernel (the round-5 first-window flash numbers exceeded
@@ -104,8 +104,11 @@ def calibrated_time(fn, iters, min_window_s=None):
     cheap cases to thousands of iterations).  Shared by bench_flash /
     bench_sparse."""
     import jax
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if iters is None:
+        iters = 10 if on_tpu else 2
     if min_window_s is None:
-        min_window_s = 0.2 if jax.devices()[0].platform != "cpu" else 0.0
+        min_window_s = 0.2 if on_tpu else 0.0
     out = fn()
     jax.block_until_ready(out)
     t0 = time.perf_counter()

@@ -15,10 +15,7 @@ def _bench(fn, *args, iters=None):
     """Calibrated timing (the first round-5 hardware window produced flat
     ~0.03 ms times across seq lengths — pure noise floor from a
     10-iteration window); shared helper lives in bench.py."""
-    import jax
     from bench import calibrated_time
-    if iters is None:
-        iters = 10 if jax.devices()[0].platform != "cpu" else 2
     return calibrated_time(lambda: fn(*args), iters)
 
 

@@ -1357,12 +1357,13 @@ class DeepSpeedEngine:
         produced by a jit whose output IS pinned_host: the eager
         jnp.zeros + device_put form allocates each moment plane in HBM
         first and moves it over the slow client path."""
-        dp = self.dp_world_size
         zero_piece = getattr(self, "_zero_piece_jit", None)
         if zero_piece is None:
             # one jit for the engine's lifetime: a fresh wrapper per call
             # would retrace/compile every distinct width on every call
-            # (init makes two calls for mu/nu, checkpoint load two more)
+            # (init makes two calls for mu/nu, checkpoint load two more).
+            # dp is captured ONCE here — it is fixed per engine.
+            dp = self.dp_world_size
             zero_piece = jax.jit(
                 lambda w: jnp.zeros((dp, w), jnp.float32),
                 static_argnums=0,

@@ -46,6 +46,10 @@ timeout 2400 python bench_flash.py > BENCH_flash_raw.json 2>> "$log"
 echo "=== flash rc=$? ===" >> "$log"
 bank "Bench artifact: flash sweep rerun (calibrated timing)" \
   BENCH_flash.json BENCH_flash_raw.json "$log"
+timeout 2400 python bench_moe.py > BENCH_moe_raw.json 2>> "$log"
+echo "=== moe rc=$? ===" >> "$log"
+bank "Bench artifact: MoE dispatch rerun (calibrated timing)" \
+  BENCH_moe.json BENCH_moe_raw.json "$log"
 
 echo "=== r05b done $(date -u) ===" >> "$log"
 touch /tmp/r05b_done

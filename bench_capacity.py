@@ -52,6 +52,13 @@ if offload and chunks > 1:
     zero["offload_grad_chunks"] = chunks
 if offload and stream:
     zero["param_streaming"] = True
+# split update by default for offload probes: the fused update program
+# materializes the whole fp32 state as HBM temps on the AOT compile
+# path (the round-5 1.5B OOM), which would cap the measured offload
+# capacity at roughly the no-offload level.  CAPACITY_SPLIT_UPDATE=0
+# measures the fused structure deliberately.
+if offload and os.environ.get("CAPACITY_SPLIT_UPDATE", "1") == "1":
+    zero["offload_split_update"] = True
 ds_cfg = DeepSpeedConfig({{
     "train_micro_batch_size_per_gpu": 1,
     "gradient_accumulation_steps": 1,
@@ -69,6 +76,13 @@ print("PROBE_OK", cfg_model.num_params)
 """
 
 
+def _split_update_env() -> str:
+    """One resolution of the split-update knob, recorded in the artifact:
+    a fused-structure run's capacity number must be distinguishable from
+    the (default) split-update run's."""
+    return os.environ.get("CAPACITY_SPLIT_UPDATE", "1")
+
+
 def _probe(n_layer: int, offload: bool, timeout: int,
            smoke: bool = False, chunks: int = 0,
            stream: bool = False) -> int:
@@ -81,6 +95,7 @@ def _probe(n_layer: int, offload: bool, timeout: int,
     env = dict(os.environ)
     env["CAPACITY_GRAD_CHUNKS"] = str(chunks)
     env["CAPACITY_PARAM_STREAM"] = "1" if stream else "0"
+    env["CAPACITY_SPLIT_UPDATE"] = _split_update_env()
     try:
         proc = subprocess.run(argv, capture_output=True, text=True,
                               timeout=timeout, env=env)
@@ -253,6 +268,7 @@ def main():
         "offload_chunked_params_b": round(ck_params / 1e9, 3),
         "offload_stream_params_b": round(st_params / 1e9, 3),
         "grad_chunks": chunks,
+        "split_update": _split_update_env() == "1",
         "offload_layers": off_layers,
         "offload_chunked_layers": ck_layers,
         "offload_stream_layers": st_layers,

@@ -27,7 +27,7 @@ def main():
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
     on_tpu = guarded_devices()[0].platform != "cpu"
-    iters = 20 if on_tpu else 2
+    iters = None  # calibrated_time owns the platform default + window
     B, H, D = (4, 12, 64) if on_tpu else (1, 2, 32)
     seqs = [1024, 4096, 8192] if on_tpu else [128]
     blocks = ([256, 512, 1024] if on_tpu else [64])

@@ -51,5 +51,19 @@ echo "=== moe rc=$? ===" >> "$log"
 bank "Bench artifact: MoE dispatch rerun (calibrated timing)" \
   BENCH_moe.json BENCH_moe_raw.json "$log"
 
+# 3. the north star: 1.5B chain opens with xla_split (suite disabled -
+#    already rerun above); generous timeout, internal watchdogs
+timeout 3600 env BENCH_SUITE=0 python bench.py > BENCH_r05_raw.json 2>> "$log"
+echo "=== north star rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
+bank "Bench artifact: GPT-2 1.5B north star (split-update opener)" \
+  BENCH_north_star.json BENCH_r05_raw.json "$log"
+
+# 4. capacity with split-update probes, LAST (kill-on-timeout wedge risk)
+CAPACITY_PROBE_TIMEOUT=900 timeout 5400 python bench_capacity.py \
+  > BENCH_capacity_raw.json 2>> "$log"
+echo "=== capacity rc=$? $(date -u +%H:%M:%S) ===" >> "$log"
+bank "Bench artifact: capacity ratio with split-update probes" \
+  BENCH_capacity.json BENCH_capacity_raw.json "$log"
+
 echo "=== r05b done $(date -u) ===" >> "$log"
 touch /tmp/r05b_done

@@ -9,6 +9,7 @@ per sequence length.  Beating the reference here means a higher fraction of
 chip peak than its >50%/V100.
 """
 import json
+import os
 import sys
 import time
 
@@ -42,6 +43,14 @@ def main():
 
     import dataclasses
     cases = ([(128, 64), (512, 16)] if on_tpu else [(64, 4)])
+    # BENCH_BERT_BATCH="128:96,512:24" overrides per-seq batch for
+    # tuning experiments in a hardware window (no remat -> activations
+    # scale linearly with batch; headroom depends on what else resides)
+    override = os.environ.get("BENCH_BERT_BATCH", "")
+    if override and on_tpu:
+        ovr = dict(tuple(map(int, pair.split(":")))
+                   for pair in override.split(","))
+        cases = [(seq, ovr.get(seq, b)) for seq, b in cases]
     cfg_model = BERT_LARGE if on_tpu else dataclasses.replace(
         BERT_LARGE, num_hidden_layers=2, hidden_size=128,
         num_attention_heads=4, intermediate_size=512, vocab_size=1024)

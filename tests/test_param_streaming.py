@@ -296,3 +296,39 @@ def test_stream_mask_marks_blocks_only():
     assert len(paths) == len(eng._stream_mask)
     for path, m in zip(paths, eng._stream_mask):
         assert m == ("blocks" in path), (path, m)
+
+
+def test_streaming_composes_with_split_update():
+    """param_streaming x offload_split_update x grad chunks: the deepest
+    capacity stack the 1.5B/bench_capacity chain can select.  Trajectory
+    must match the fused-update streaming engine."""
+    mesh = build_mesh(dp=1, devices=jax.devices()[:1])
+    es = DeepSpeedEngine(GPT2Model(_model_cfg(True)),
+                         _ds_cfg(1, offload_split_update=True,
+                                 offload_grad_chunks=2),
+                         mesh=mesh, seed=3)
+    ef = DeepSpeedEngine(GPT2Model(_model_cfg(True)),
+                         _ds_cfg(1, offload_grad_chunks=2),
+                         mesh=mesh, seed=3)
+    toks = _tokens()
+    ls = _run(es, toks)
+    lf = _run(ef, toks)
+    np.testing.assert_allclose(ls, lf, rtol=0, atol=3e-4)
+    assert ls[-1] < ls[0]
+
+
+def test_zero3_dp4_split_update():
+    """ZeRO-3 x split update at dp=4: per-piece programs must respect the
+    data-sharded piece placement (each update touches only local rows)."""
+    mesh = build_mesh(dp=4, devices=jax.devices()[:4])
+    e3 = DeepSpeedEngine(GPT2Model(_model_cfg(False)),
+                         _ds_cfg(4, stage=3, stream=False,
+                                 offload_split_update=True),
+                         mesh=mesh, seed=3)
+    ef = DeepSpeedEngine(GPT2Model(_model_cfg(False)),
+                         _ds_cfg(4, stage=3, stream=False),
+                         mesh=mesh, seed=3)
+    toks = _tokens()
+    ls = _run(e3, toks)
+    lf = _run(ef, toks)
+    np.testing.assert_allclose(ls, lf, rtol=0, atol=3e-4)

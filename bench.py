@@ -213,14 +213,29 @@ def _bench_15b(jax, impl: str = "xla"):
     # resident stacked block params, one layer fetched per scan tick) —
     # the deepest OOM fallback, and the capacity mode's throughput
     # number when measured deliberately (xla tier only)
-    split = impl == "xla_split"
+    split = impl.startswith("xla_split")
     impl_cfg = "xla" if split else impl
+    # 'xla_split4': split update + 4 gradient chunks — the fallback when
+    # the single grad program's liveness (bf16 params + grads + packed
+    # pieces + activations ≈ 14 GB at 1.5B) is still too tight.  With
+    # BENCH_15B_CHUNKS pinned by the operator the leg is redundant (the
+    # xla_split leg already ran that chunk count): fail loudly so the
+    # chain logs it and moves on instead of re-running an identical or
+    # silently-different program.
+    if impl == "xla_split4":
+        if os.environ.get("BENCH_15B_CHUNKS") is not None:
+            raise RuntimeError(
+                "BENCH_15B_CHUNKS pins the chunk count for every leg; "
+                "the xla_split4 leg is redundant under it — set "
+                "BENCH_15B_IMPL explicitly instead")
+        chunks = 4
     if split and os.environ.get("BENCH_15B_DPU", "0") == "1":
         # loud, not silent: DPU's overlap assumes the fused update
         # program, so this leg measures non-DPU throughput
-        _mark("1.5B[xla_split]: BENCH_15B_DPU=1 ignored on this leg "
-              "(split update and DPU are mutually exclusive; the 'xla' "
-              "fallback leg will honor it)")
+        _mark(f"1.5B[{impl}]: BENCH_15B_DPU=1 ignored on this leg "
+              "(split update and DPU are mutually exclusive; add 'xla' "
+              "to BENCH_15B_IMPL to measure the DPU overlap — the "
+              "default chain no longer includes it)")
     stream = (os.environ.get("BENCH_15B_STREAM", "0") == "1"
               and impl_cfg == "xla")
     cfg_model = GPT2Config(d_model=1600, n_layer=48, n_head=25,
@@ -411,18 +426,22 @@ def main():
         # again every step, so ON THIS TUNNELED PLATFORM it cannot work;
         # the xla tier's pinned_host staging stays on the remote TPU VM
         # (no bulk tunnel traffic at all).  The host tier now fast-fails
-        # on a bandwidth probe instead of stalling, so it is safe to keep
-        # as the second attempt (it IS the right tier on a real TPU VM).
-        # xla_split first: the fused update program OOM'd at AOT compile
-        # (22.76G fp32 HLO temps, round-5 window); per-piece programs
-        # carry a hard liveness bound, so they are the reliable opener
+        # on a bandwidth probe instead of stalling, so it is safe to
+        # keep as the chain's closer (it IS the right tier on a real
+        # TPU VM).  xla_split opens: the fused update program OOM'd at
+        # AOT compile (22.76G fp32 HLO temps, round-5 window);
+        # per-piece programs carry a hard liveness bound.  xla_split4
+        # adds grad chunking if the grad program is still too tight.
+        # 'xla' (fused) left out of the default chain — request it via
+        # BENCH_15B_IMPL where the compiler honors host placement.
         impls = [s.strip() for s in
                  os.environ.get("BENCH_15B_IMPL",
-                                "xla_split,xla,host").split(",")]
-        bad = [s for s in impls if s not in ("xla_split", "xla", "host")]
+                                "xla_split,xla_split4,host").split(",")]
+        bad = [s for s in impls
+               if s not in ("xla_split", "xla_split4", "xla", "host")]
         if bad:
             raise ValueError(f"BENCH_15B_IMPL contains {bad}; valid: "
-                             "xla_split, xla, host")
+                             "xla_split, xla_split4, xla, host")
         # ONE deadline shared across the whole chain: two wedged attempts
         # must not double the worst-case bound before the 124M fallback
         chain_deadline = time.monotonic() + deadline

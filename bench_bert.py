@@ -50,6 +50,13 @@ def main():
     if override and on_tpu:
         ovr = dict(tuple(map(int, pair.split(":")))
                    for pair in override.split(","))
+        unknown = set(ovr) - {seq for seq, _ in cases}
+        if unknown:
+            # a typo'd seq key must fail loudly, not silently measure
+            # the default batch under the operator's label
+            raise ValueError(
+                f"BENCH_BERT_BATCH keys {sorted(unknown)} match no "
+                f"benched seq ({sorted(s for s, _ in cases)})")
         cases = [(seq, ovr.get(seq, b)) for seq, b in cases]
     cfg_model = BERT_LARGE if on_tpu else dataclasses.replace(
         BERT_LARGE, num_hidden_layers=2, hidden_size=128,

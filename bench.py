@@ -229,13 +229,6 @@ def _bench_15b(jax, impl: str = "xla"):
                 "the xla_split4 leg is redundant under it — set "
                 "BENCH_15B_IMPL explicitly instead")
         chunks = 4
-    if split and os.environ.get("BENCH_15B_DPU", "0") == "1":
-        # loud, not silent: DPU's overlap assumes the fused update
-        # program, so this leg measures non-DPU throughput
-        _mark(f"1.5B[{impl}]: BENCH_15B_DPU=1 ignored on this leg "
-              "(split update and DPU are mutually exclusive; add 'xla' "
-              "to BENCH_15B_IMPL to measure the DPU overlap — the "
-              "default chain no longer includes it)")
     stream = (os.environ.get("BENCH_15B_STREAM", "0") == "1"
               and impl_cfg == "xla")
     cfg_model = GPT2Config(d_model=1600, n_layer=48, n_head=25,
@@ -256,8 +249,7 @@ def _bench_15b(jax, impl: str = "xla"):
                if impl_cfg == "xla" and chunks > 1 else {}),
             **({"param_streaming": True} if stream else {}),
             **({"offload_split_update": True} if split else {}),
-            **({"delayed_param_update": True} if dpu and not split
-               else {})),
+            **({"delayed_param_update": True} if dpu else {})),
     }, world_size=1)
     if impl == "host":
         # strict probe semantics for the bench: a slow-but-working link

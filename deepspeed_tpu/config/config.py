@@ -83,12 +83,6 @@ class DeepSpeedZeroConfig:
         self.offload_split_update = get_scalar_param(
             zero, C.ZERO_OFFLOAD_SPLIT_UPDATE,
             C.ZERO_OFFLOAD_SPLIT_UPDATE_DEFAULT)
-        if self.offload_split_update and self.delayed_param_update:
-            raise DeepSpeedConfigError(
-                f"{C.ZERO_OFFLOAD_SPLIT_UPDATE} and "
-                f"{C.ZERO_DELAYED_PARAM_UPDATE} are mutually exclusive: "
-                "the DPU overlap dispatches one fused update program "
-                "behind the next step's gradients")
         if (not isinstance(self.offload_grad_chunks, int)
                 or self.offload_grad_chunks < 1):
             raise DeepSpeedConfigError(

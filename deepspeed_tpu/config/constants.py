@@ -168,8 +168,10 @@ ZERO_PARAM_STREAMING_DEFAULT = False
 # where the compiler materializes host-placed buffers in HBM (observed
 # on the AOT compile path: the fused 1.5B update program allocated the
 # whole fp32 state as HBM temps).  Costs one dispatch per piece per
-# step; numerics identical.  Mutually exclusive with
-# delayed_param_update (the DPU overlap assumes the fused program).
+# step; numerics identical.  Composes with delayed_param_update: the
+# deferred per-piece programs run without donation (ping-pong, the same
+# transient 2x host state the fused DPU pays) so the next step's grad
+# program can keep reading the old pieces.
 ZERO_OFFLOAD_SPLIT_UPDATE = "offload_split_update"
 ZERO_OFFLOAD_SPLIT_UPDATE_DEFAULT = False
 ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"

@@ -602,11 +602,6 @@ class DeepSpeedEngine:
                 bool(getattr(config.zero_config,
                              "offload_split_update", False))
                 or os.environ.get("DS_OFFLOAD_SPLIT_UPDATE") == "1")
-            if split_update and dpu_xla:
-                raise ValueError(
-                    "offload_split_update and delayed_param_update are "
-                    "mutually exclusive (config-level check bypassed via "
-                    "DS_OFFLOAD_SPLIT_UPDATE?)")
             self._xla_dpu_pending = None
             self._xla_dpu_update = None
             self._xla_dpu_dispatch = 0
@@ -1614,7 +1609,7 @@ class DeepSpeedEngine:
 
     def _build_split_update(self, *, b1, b2, eps, wd, adam_w_mode,
                             bias_correction, clip, scale_config, lr_at,
-                            piece_host, host_scalar):
+                            piece_host, host_scalar, donate: bool = True):
         """Optimizer update as ONE COMPILED PROGRAM PER MASTER PIECE
         (zero_optimization.offload_split_update).
 
@@ -1633,6 +1628,14 @@ class DeepSpeedEngine:
         plus one scalar-stats program and one scalar-tail program; jit
         caches by piece shape, so a scan-stacked transformer compiles a
         handful of distinct piece programs, not one per layer.
+
+        ``donate=False`` is the DPU composition: the deferred update for
+        step t-1 runs while the already-dispatched grad program for step
+        t still READS the same master pieces, so the old buffers must
+        stay live (ping-pong; transient 2x fp32 host state, same price
+        the fused DPU pays).  Without donation a mid-loop failure leaves
+        the old state fully intact, so the poison guard applies only to
+        the donating variant.
         """
         dev = NamedSharding(self.mesh, P())
 
@@ -1663,9 +1666,12 @@ class DeepSpeedEngine:
                 clip_scale_h=cs)
             return new_m[0], new_mu[0], new_nu[0]
 
-        # the grad piece (3) is donated too: it is dead after this program
-        piece_jit = jax.jit(piece_fn, donate_argnums=(0, 1, 2, 3),
-                            out_shardings=(piece_host,) * 3)
+        # the grad piece (3) is donated in both variants: it is dead
+        # after this program either way
+        piece_jit = jax.jit(
+            piece_fn,
+            donate_argnums=((0, 1, 2, 3) if donate else (3,)),
+            out_shardings=(piece_host,) * 3)
 
         def tail_fn(scaler, global_steps, skipped, count, finite,
                     mean_loss, grad_norm):
@@ -1701,6 +1707,10 @@ class DeepSpeedEngine:
                                     state.skipped_steps, opt.count,
                                     finite, mean_loss, grad_norm)
             except Exception as e:
+                if not donate:
+                    # ping-pong variant: the old buffers are intact;
+                    # discarding the partial update leaves state valid
+                    raise
                 # pieces updated so far were DONATED: self.state still
                 # points at their deleted buffers, so this engine's
                 # optimizer plane is unrecoverable.  Poison loudly rather
@@ -1883,17 +1893,18 @@ class DeepSpeedEngine:
         # already-dispatched grad program for step t still READS the same
         # master pieces, so aliasing would be refused anyway (ping-pong
         # buffers; transient 2× host state is the price of the overlap)
-        update_jit = jax.jit(
-            update_fn, donate_argnums=(() if delayed else (0,)),
-            out_shardings=(state_shardings, dev))
-        self._xla_dpu_update = update_jit if delayed else None
-
         if split_update:
             update_jit = self._build_split_update(
                 b1=b1, b2=b2, eps=eps, wd=wd, adam_w_mode=adam_w_mode,
                 bias_correction=bias_correction, clip=clip,
                 scale_config=scale_config, lr_at=lr_at,
-                piece_host=piece_host, host_scalar=host_scalar)
+                piece_host=piece_host, host_scalar=host_scalar,
+                donate=not delayed)
+        else:
+            update_jit = jax.jit(
+                update_fn, donate_argnums=(() if delayed else (0,)),
+                out_shardings=(state_shardings, dev))
+        self._xla_dpu_update = update_jit if delayed else None
 
         def run_grads(state, batch, step_seed):
             pieces_by_leaf = [None] * n_leaves

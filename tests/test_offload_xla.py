@@ -535,17 +535,38 @@ def test_split_update_overflow_skips_whole_step(mesh):
     assert eng.get_skipped_steps() == 1
 
 
-def test_split_update_rejects_dpu():
-    with pytest.raises(Exception, match="mutually exclusive"):
-        DeepSpeedConfig({
+def test_split_update_composes_with_dpu(mesh):
+    """split update x DPU: the deferred per-piece programs run without
+    donation so the next step's grad program keeps reading the old
+    pieces.  DPU's defining semantics must hold: steps 0 and 1 compute
+    at the INITIAL params (the first update applies during step 1's
+    dispatch), so their losses on a fixed batch are identical — and the
+    split-DPU trajectory must equal the fused-DPU trajectory."""
+    def cfg(split):
+        zero = {"stage": 2, "cpu_offload": True, "offload_impl": "xla",
+                "delayed_param_update": True}
+        if split:
+            zero["offload_split_update"] = True
+        return DeepSpeedConfig({
             "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "steps_per_print": 10 ** 9,
             "bf16": {"enabled": True},
             "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
-            "zero_optimization": {"stage": 2, "cpu_offload": True,
-                                  "offload_impl": "xla",
-                                  "offload_split_update": True,
-                                  "delayed_param_update": True},
-        }, world_size=1)
+            "zero_optimization": zero,
+        }, world_size=4)
+    es = DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg(True), mesh=mesh,
+                         seed=3)
+    ef = DeepSpeedEngine(SimpleModel(hidden_dim=32), cfg(False), mesh=mesh,
+                         seed=3)
+    x, y = _batch()
+    ls = [float(np.asarray(es.train_batch((x, y)))) for _ in range(5)]
+    lf = [float(np.asarray(ef.train_batch((x, y)))) for _ in range(5)]
+    assert abs(ls[0] - ls[1]) < 1e-6, "DPU staleness: steps 0,1 equal"
+    np.testing.assert_allclose(ls, lf, rtol=0, atol=3e-4)
+    # flush applies the pending update before a save
+    es._xla_dpu_flush()
+    assert es._xla_dpu_pending is None
 
 
 def test_split_update_env_knob_rejected_on_host_tier(monkeypatch):

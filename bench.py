@@ -215,6 +215,11 @@ def _bench_15b(jax, impl: str = "xla"):
     # number when measured deliberately (xla tier only)
     split = impl.startswith("xla_split")
     impl_cfg = "xla" if split else impl
+    # 'xla_split_dpu': split update + delayed parameter update — the
+    # per-piece host Adam overlaps the next step's grad program (the
+    # reference's peak-throughput offload mode; ~10-15% of step time
+    # at 1.5B if the update runs serially)
+    dpu = dpu or impl == "xla_split_dpu"
     # 'xla_split4': split update + 4 gradient chunks — the fallback when
     # the single grad program's liveness (bf16 params + grads + packed
     # pieces + activations ≈ 14 GB at 1.5B) is still too tight.  With
@@ -427,13 +432,16 @@ def main():
         # 'xla' (fused) left out of the default chain — request it via
         # BENCH_15B_IMPL where the compiler honors host placement.
         impls = [s.strip() for s in
-                 os.environ.get("BENCH_15B_IMPL",
-                                "xla_split,xla_split4,host").split(",")]
-        bad = [s for s in impls
-               if s not in ("xla_split", "xla_split4", "xla", "host")]
+                 os.environ.get(
+                     "BENCH_15B_IMPL",
+                     "xla_split_dpu,xla_split,xla_split4,host"
+                 ).split(",")]
+        valid = ("xla_split_dpu", "xla_split", "xla_split4", "xla",
+                 "host")
+        bad = [s for s in impls if s not in valid]
         if bad:
             raise ValueError(f"BENCH_15B_IMPL contains {bad}; valid: "
-                             "xla_split, xla_split4, xla, host")
+                             + ", ".join(valid))
         # ONE deadline shared across the whole chain: two wedged attempts
         # must not double the worst-case bound before the 124M fallback
         chain_deadline = time.monotonic() + deadline

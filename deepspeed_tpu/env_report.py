@@ -6,6 +6,51 @@ from __future__ import annotations
 import sys
 
 
+def _device_line(deadline_s: int = 45) -> tuple:
+    """Device enumeration with a hard deadline.
+
+    ``jax.devices()`` blocks INDEFINITELY when a remote TPU runtime is
+    wedged (the tunneled-platform failure mode this repo's bench guards
+    against) — and a report tool that hangs is worse than useless when
+    diagnosing exactly that situation.  The probe runs in a subprocess
+    so a hung backend init cannot take the report down with it; the
+    parent never initializes a backend itself.
+    """
+    import os
+    import subprocess
+    try:
+        deadline_s = int(os.environ.get("DS_REPORT_DEVICE_TIMEOUT",
+                                        str(deadline_s)))
+    except ValueError:
+        # the diagnostic tool must not die on a malformed knob — that is
+        # the exact robustness this function exists for
+        pass
+    # honor JAX_PLATFORMS even where a sitecustomize force-registers a
+    # remote platform (env alone is not enough there — the config update
+    # must run before first device use)
+    code = ("import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+            "p and jax.config.update('jax_platforms', p); "
+            "d = jax.devices(); "
+            "print(d[0].platform, len(d), "
+            "getattr(d[0], 'device_kind', '?'), sep='|')")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=deadline_s)
+    except subprocess.TimeoutExpired:
+        return ("devices", f"UNREACHABLE (no response in {deadline_s}s "
+                "— remote runtime down or wedged)")
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        why = tail[-1] if tail else "init failed"
+        return ("devices", f"unavailable ({why})")
+    try:
+        platform, n, kind = r.stdout.strip().split("|")
+        return ("devices", f"{n} × {kind} (platform {platform})")
+    except ValueError:
+        return ("devices", f"unparseable probe output {r.stdout!r}")
+
+
 def collect_report() -> list:
     lines = []
     lines.append(("python", sys.version.split()[0]))
@@ -15,14 +60,7 @@ def collect_report() -> list:
             lines.append((mod, getattr(m, "__version__", "?")))
         except ImportError:
             lines.append((mod, "NOT INSTALLED"))
-    try:
-        import jax
-        devs = jax.devices()
-        lines.append(("platform", devs[0].platform))
-        lines.append(("devices", f"{len(devs)} × "
-                      f"{getattr(devs[0], 'device_kind', '?')}"))
-    except Exception as e:  # backend init can fail off-TPU
-        lines.append(("devices", f"unavailable ({e})"))
+    lines.append(_device_line())
     from .ops.op_builder import cpu_ops_status
     lines.append(("native host ops", cpu_ops_status()))
     # per-op compatibility matrix (the reference ds_report's main table)

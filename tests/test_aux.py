@@ -560,3 +560,24 @@ def test_partitioned_tensor_roundtrip():
     with pytest.raises(ValueError, match="8 parts"):
         jax.jit(shard_map(bad, mesh=mesh4, in_specs=P(),
                           out_specs=P()))(jnp.asarray(x))
+
+
+def test_env_report_device_probe_deadline(monkeypatch):
+    """A wedged remote runtime must yield an UNREACHABLE line within the
+    deadline, not hang the report (observed: ds_report blocked forever
+    on a wedged tunnel).  Deterministic: the probe's subprocess.run is
+    stubbed to time out."""
+    import subprocess
+
+    def fake_run(*a, **kw):
+        raise subprocess.TimeoutExpired(cmd=a[0], timeout=kw["timeout"])
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    from deepspeed_tpu.env_report import _device_line
+    key, val = _device_line()
+    assert key == "devices"
+    assert "UNREACHABLE" in val
+    # a malformed deadline knob degrades instead of crashing the report
+    monkeypatch.setenv("DS_REPORT_DEVICE_TIMEOUT", "45s")
+    key, val = _device_line()
+    assert "UNREACHABLE" in val

@@ -204,10 +204,19 @@ class Softmax:
         # ops need moveaxis transposes that trip XLA CPU's algebraic
         # simplifier — RET_CHECK crash observed)
         mx = jnp.max(x, axis=-1)                                # [..., nnz, blk]
+        fill = jnp.asarray(-1e30, x.dtype)  # -inf in fp16: handled below
         row_max = jnp.full((*x.shape[:-3], idx.nb_r, blk),
                            -1e30, x.dtype)
         row_max = row_max.at[..., rows, :].max(mx)              # [..., nbr, blk]
-        p = jnp.exp(x - jnp.take(row_max, rows, axis=-2)[..., None])
+        # A row whose active columns are ALL masked to -inf never raises
+        # row_max above the fill, and in fp16 the fill itself IS -inf —
+        # subtracting it would give -inf - -inf = NaN.  Dead rows get a
+        # zero shift instead, making exp underflow to 0; the denominator
+        # guard below then emits zeros, matching the fused kernel's
+        # zeros-for-dead-rows semantics.
+        safe_max = jnp.where(row_max <= fill, jnp.zeros_like(row_max),
+                             row_max)
+        p = jnp.exp(x - jnp.take(safe_max, rows, axis=-2)[..., None])
         row_sum = jnp.zeros_like(row_max).at[..., rows, :].add(
             jnp.sum(p, axis=-1))
         denom = jnp.take(row_sum, rows, axis=-2)[..., None]

@@ -212,7 +212,12 @@ class DeepSpeedEngine:
             import contextlib
             stack = contextlib.ExitStack()
             stack.enter_context(interpret_scope(self._pallas_interpret))
-            stack.enter_context(jax.set_mesh(self.mesh))
+            if hasattr(jax, "set_mesh"):
+                stack.enter_context(jax.set_mesh(self.mesh))
+            else:
+                # jax<0.6 compat: entering the Mesh context sets the same
+                # ambient mesh for trace-time reads
+                stack.enter_context(self.mesh)
             return stack
 
         self._pallas_scope = _step_scope
@@ -253,13 +258,15 @@ class DeepSpeedEngine:
         self._offload = bool(config.zero_config.cpu_offload)
         if (os.environ.get("DS_OFFLOAD_SPLIT_UPDATE") == "1"
                 and not self._offload):
-            # the env knob must fail exactly like the config flag would
-            # (DeepSpeedConfigError: 'offload_split_update requires
-            # cpu_offload') — silently measuring the plain step is the
-            # confusion these raises exist to prevent
-            raise ValueError(
-                "DS_OFFLOAD_SPLIT_UPDATE=1 requires "
-                "zero_optimization.cpu_offload")
+            # The env knob is process-wide; an unrelated comparison/eval
+            # engine constructed alongside the experiment engine must not
+            # die on it (the config-flag path would not reject it either).
+            # Warn instead of raising: the knob simply has nothing to
+            # flip on an engine without cpu_offload.
+            logger.warning(
+                "DS_OFFLOAD_SPLIT_UPDATE=1 ignored: this engine has no "
+                "zero_optimization.cpu_offload, so there is no offload "
+                "update to split")
         # set when a partially-donated update leaves self.state pointing
         # at deleted buffers (offload_split_update mid-piece failure);
         # train/save must refuse rather than act on the corrupt state
@@ -315,6 +322,9 @@ class DeepSpeedEngine:
                         "DS_OFFLOAD_FP32_INIT_LIMIT", str(2 << 30))))
                     if total > limit:
                         init_out_dtype = self.compute_dtype
+                # one-shot construction program: the master's placement is
+                # settled by the zero plan / offload staging right below
+                # jaxlint: disable=JL003
                 master = jax.jit(
                     _init_cast, static_argnums=(1,))(init_rng,
                                                      init_out_dtype)
@@ -1682,7 +1692,11 @@ class DeepSpeedEngine:
             new_count = count + finite.astype(jnp.int32)
             return new_scaler, new_global, new_skipped, new_count, packed
 
-        tail_jit = jax.jit(tail_fn)
+        # scaler/counter/packed-metric outputs pinned replicated exactly
+        # like the fused path's state_shardings — without this the split
+        # tail's scalars ride default placement and their avals diverge
+        # from the fused state on a multi-device mesh
+        tail_jit = jax.jit(tail_fn, out_shardings=dev)
 
         def update_split(state: TrainState, gpieces, finites, sumsqs,
                          mean_loss):
@@ -1706,7 +1720,10 @@ class DeepSpeedEngine:
                  packed) = tail_jit(state.scaler, state.global_steps,
                                     state.skipped_steps, opt.count,
                                     finite, mean_loss, grad_norm)
-            except Exception as e:
+            except BaseException as e:
+                # BaseException, not Exception: a KeyboardInterrupt mid
+                # piece-loop deletes donated buffers exactly like a crash
+                # does, and must poison the state the same way
                 if not donate:
                     # ping-pong variant: the old buffers are intact;
                     # discarding the partial update leaves state valid
@@ -1724,6 +1741,11 @@ class DeepSpeedEngine:
                     "load_checkpoint on this engine (or rebuild it) to "
                     "recover. Original error: "
                     f"{e!r}")
+                if not isinstance(e, Exception):
+                    # KeyboardInterrupt/SystemExit must keep their type —
+                    # wrapping them in RuntimeError would stop Ctrl-C from
+                    # actually interrupting the run
+                    raise
                 raise RuntimeError(self._fatal_state_error) from e
             new_state = TrainState(
                 master_params=tuple(new_m),
@@ -2429,6 +2451,10 @@ class DeepSpeedEngine:
         raises instead of falling back to the training iterator — silently
         consuming training batches during evaluation would skew the
         training stream (the reference requires an explicit data_iter)."""
+        if self._fatal_state_error is not None:
+            # donation-poisoned state: surface the recovery message, not a
+            # raw 'Array has been deleted' from the deleted master pieces
+            raise RuntimeError(self._fatal_state_error)
         if batch is None:
             if data_iter is None:
                 raise ValueError(
@@ -2451,6 +2477,9 @@ class DeepSpeedEngine:
     def forward(self, batch):
         """Compat shim for the reference trio (engine.py:779): computes the
         micro-batch loss and queues the batch for the fused step."""
+        if self._fatal_state_error is not None:
+            # same guard as eval_batch: this reads self.state below
+            raise RuntimeError(self._fatal_state_error)
         if not getattr(self, "_facade_warned", False):
             self._facade_warned = True
             log_dist(

@@ -1,0 +1,34 @@
+"""JL001 positive fixture: host syncs reachable from jitted code."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def direct_sync(x):
+    return np.asarray(x) + 1          # JL001: np.asarray under trace
+
+
+def helper(x):
+    return x.item()                   # JL001: reachable from jitted f
+
+
+@jax.jit
+def via_helper(x):
+    return helper(x)
+
+
+def concretize(x):
+    return float(x)                   # JL001: float() on a tracer
+
+
+step = jax.jit(concretize)
+
+
+class Engine:
+    def _wait(self, x):
+        return x.block_until_ready()  # JL001: via self-method call
+
+    def build(self):
+        def step(x):
+            return self._wait(x)
+        return jax.jit(step)

@@ -1,0 +1,21 @@
+"""JL001 negative fixture: the same call names OUTSIDE traced code, and
+trace-safe jnp equivalents inside it."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def traced(x):
+    # jnp.asarray is trace-safe; astype is not a sync
+    return jnp.asarray(x).astype(jnp.float32)
+
+
+def eager_driver(batch, step):
+    micro = np.asarray(batch)         # eager host code: fine
+    loss = step(micro)
+    return float(loss), loss.item()   # after the step returns: fine
+
+
+def helper_not_called_from_jit(x):
+    return np.asarray(x)              # never reachable from a jit body

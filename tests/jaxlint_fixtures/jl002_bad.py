@@ -1,0 +1,22 @@
+"""JL002 positive fixture: reads after donation."""
+import jax
+
+
+def plain_read_after_donate(state, step_fn):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    new_state, metrics = step(state)
+    return state.loss_scale            # JL002: state was donated
+
+
+class Engine:
+    def train(self, batch):
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+        st = self.state                # alias of self.state
+        out = step(st, batch)
+        return self.state.scaler       # JL002: donated via the alias
+
+
+def donate_by_name(state, step_fn):
+    step = jax.jit(step_fn, donate_argnames=("state",))
+    out = step(state=state)
+    return state                       # JL002: donated by argname

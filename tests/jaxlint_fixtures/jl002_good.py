@@ -1,0 +1,22 @@
+"""JL002 negative fixture: donation with the name rebound before any
+read — the train-loop idiom."""
+import jax
+
+
+def rebind(state, step_fn):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    state = step(state)                # rebound from the result
+    return state.loss_scale            # fine: reads the NEW buffers
+
+
+class Engine:
+    def train(self, batch):
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+        self.state = step(self.state, batch)   # rebound in place
+        return self.state.scaler
+
+
+def non_donated_args_are_free(state, aux, step_fn):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    state = step(state, aux)
+    return aux                         # arg 1 was not donated

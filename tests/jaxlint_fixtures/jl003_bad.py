@@ -1,0 +1,16 @@
+"""JL003 positive fixture: in_shardings without out_shardings, and a
+bare jit site among pinned siblings."""
+import jax
+
+in_spec = out_spec = None
+
+
+def build_step(fn):
+    # JL003: in_shardings given, out_shardings omitted
+    return jax.jit(fn, in_shardings=(in_spec,))
+
+
+def build_split(stats_fn, tail_fn):
+    stats = jax.jit(stats_fn, out_shardings=(out_spec,))
+    tail = jax.jit(tail_fn)            # JL003: bare among pinned siblings
+    return stats, tail

@@ -1,0 +1,20 @@
+"""JL003 negative fixture: both shardings pinned, or neither given
+(single-device code has no placement to pin)."""
+import jax
+
+in_spec = out_spec = None
+
+
+def build_step(fn):
+    return jax.jit(fn, in_shardings=(in_spec,),
+                   out_shardings=(out_spec,))
+
+
+def build_plain(fn):
+    return jax.jit(fn)                 # no shardings at all: fine
+
+
+def build_split(stats_fn, tail_fn):
+    stats = jax.jit(stats_fn, out_shardings=(out_spec,))
+    tail = jax.jit(tail_fn, out_shardings=(out_spec,))
+    return stats, tail

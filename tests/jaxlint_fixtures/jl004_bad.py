@@ -1,0 +1,17 @@
+"""JL004 positive fixture: Python side effects inside traced bodies."""
+import jax
+
+metrics_log = []
+STEP_COUNT = 0
+
+
+class Engine:
+    def build(self):
+        def step(state, batch):
+            self.last_state = state     # JL004: self.* assignment
+            print(state)                # JL004: print under trace
+            metrics_log.append(batch)   # JL004: closed-over list mutation
+            global STEP_COUNT           # JL004: global under trace
+            STEP_COUNT += 1
+            return state
+        return jax.jit(step)

@@ -1,0 +1,19 @@
+"""JL004 negative fixture: local mutation inside the trace (fine — it
+builds the program), side effects in eager driver code."""
+import jax
+
+
+class Engine:
+    def build(self):
+        def step(state, batch):
+            pieces = []                   # local list: fine
+            for leaf in state:
+                pieces.append(leaf * 2)
+            jax.debug.print("loss {}", pieces[0])   # trace-safe print
+            return tuple(pieces)
+        return jax.jit(step)
+
+    def train(self, batch):
+        self.count = getattr(self, "count", 0) + 1   # eager: fine
+        print("step", self.count)                    # eager: fine
+        return self._step(batch)

@@ -1,0 +1,27 @@
+"""JL005 positive fixture: unhashable static args and trace-time clocks."""
+import time
+
+import jax
+
+
+@jax.jit(static_argnums=(1,))
+def step(x, cfg):
+    return x
+
+
+def run(x):
+    return step(x, {"lr": 0.1})        # JL005: dict in a static slot
+
+
+@jax.jit(static_argnames=("tag",))
+def tagged(x, tag):
+    return x
+
+
+def run_tagged(x, i):
+    return tagged(x, tag=f"step{i}")   # JL005: f-string static arg
+
+
+@jax.jit
+def stamped(x):
+    return x * time.time()             # JL005: clock baked at trace time

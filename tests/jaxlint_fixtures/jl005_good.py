@@ -1,0 +1,19 @@
+"""JL005 negative fixture: hashable statics, clocks outside the trace."""
+import time
+
+import jax
+
+
+@jax.jit(static_argnums=(1,))
+def step(x, n):
+    return x * n
+
+
+def run(x):
+    return step(x, 4)                  # int static: hashable, stable
+
+
+def timed_driver(x):
+    t0 = time.time()                   # eager timing: fine
+    y = step(x, 2)
+    return y, time.time() - t0

@@ -1,0 +1,15 @@
+"""JL101 positive fixture: unknown, bypassed, defaultless, cross-wired."""
+from . import constants as C
+
+
+def get_scalar_param(d, key, default):
+    return d.get(key, default) if d is not None else default
+
+
+class Config:
+    def __init__(self, pd):
+        self.ok = get_scalar_param(pd, C.TRAIN_BATCH, C.TRAIN_BATCH_DEFAULT)
+        self.unknown = get_scalar_param(pd, C.MISSING_KEY, None)
+        self.bypassed = get_scalar_param(pd, "raw_key", 3)
+        self.defaultless = pd.get(C.STEPS)
+        self.crossed = pd.get(C.TRAIN_BATCH, C.STEPS_DEFAULT)

@@ -1,0 +1,17 @@
+"""JL101 negative fixture: every read routed through matching constants."""
+from . import constants as C
+
+
+def get_scalar_param(d, key, default):
+    return d.get(key, default) if d is not None else default
+
+
+class Config:
+    def __init__(self, pd):
+        self.train_batch = get_scalar_param(pd, C.TRAIN_BATCH,
+                                            C.TRAIN_BATCH_DEFAULT)
+        self.steps = get_scalar_param(pd, C.STEPS, C.STEPS_DEFAULT)
+        # block key with no schema default: a bare read is legitimate
+        self.optimizer = pd.get(C.OPTIMIZER)
+        # explicit literal default is a local decision, not a schema gap
+        self.zero = pd.get(C.TRAIN_BATCH, None)

@@ -1,0 +1,8 @@
+"""JL101 fixture constants: the checkable schema."""
+TRAIN_BATCH = "train_batch"
+TRAIN_BATCH_DEFAULT = None
+
+STEPS = "steps"
+STEPS_DEFAULT = 10
+
+OPTIMIZER = "optimizer"          # block key: no _DEFAULT on purpose

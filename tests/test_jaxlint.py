@@ -1,0 +1,237 @@
+"""jaxlint: fixture-driven rule tests + the tier-1 regression gate.
+
+The gate (test_tree_is_clean) runs the full pass over ``deepspeed_tpu/``
+and fails on any non-baselined finding — the linter IS a permanent
+regression gate, not an advisory tool.  Pure-stdlib: no jax import
+needed, so these tests run even where jax is broken.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "jaxlint_fixtures")
+sys.path.insert(0, REPO)
+
+from tools.jaxlint import lint_paths, load_baseline          # noqa: E402
+from tools.jaxlint.core import (default_baseline_path,       # noqa: E402
+                                lint_file, lint_source, write_baseline)
+
+
+def _rules(path):
+    return sorted({f.rule for f in lint_file(path)})
+
+
+def _fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+# ---------------------------------------------------------------------------
+# per-rule positive/negative fixtures
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("rule,bad,good", [
+    ("JL001", "jl001_bad.py", "jl001_good.py"),
+    ("JL002", "jl002_bad.py", "jl002_good.py"),
+    ("JL003", "jl003_bad.py", "jl003_good.py"),
+    ("JL004", "jl004_bad.py", "jl004_good.py"),
+    ("JL005", "jl005_bad.py", "jl005_good.py"),
+    ("JL101", os.path.join("jl101", "config_bad.py"),
+     os.path.join("jl101", "config_good.py")),
+])
+def test_rule_fires_on_bad_and_not_on_good(rule, bad, good):
+    assert rule in _rules(_fixture(bad)), \
+        f"{rule} must fire on {bad}"
+    assert rule not in _rules(_fixture(good)), \
+        f"{rule} must stay silent on {good}"
+
+
+def test_jl001_flags_every_sync_shape():
+    lines = {f.line for f in lint_file(_fixture("jl001_bad.py"))
+             if f.rule == "JL001"}
+    # np.asarray, .item via helper, float via wrap-assign, self-method
+    assert len(lines) == 4, lines
+
+
+def test_jl002_alias_and_argname_forms():
+    msgs = [f.message for f in lint_file(_fixture("jl002_bad.py"))
+            if f.rule == "JL002"]
+    assert len(msgs) == 3
+    assert any("self.state" in m for m in msgs)   # attribute alias caught
+
+
+def test_jl003_sibling_pinning_heuristic():
+    findings = [f for f in lint_file(_fixture("jl003_bad.py"))
+                if f.rule == "JL003"]
+    assert len(findings) == 2
+    assert any("in_shardings" in f.message for f in findings)
+    assert any("sibling" in f.message for f in findings)
+
+
+def test_jl004_all_side_effect_shapes():
+    cats = [f.message for f in lint_file(_fixture("jl004_bad.py"))
+            if f.rule == "JL004"]
+    assert len(cats) == 4
+    joined = "\n".join(cats)
+    for needle in ("assignment to 'self.last_state'", "'print'",
+                   "'.append'", "'global'"):
+        assert needle in joined, (needle, joined)
+
+
+def test_jl101_finding_kinds():
+    msgs = "\n".join(f.message for f in
+                     lint_file(_fixture(os.path.join("jl101",
+                                                     "config_bad.py")))
+                     if f.rule == "JL101")
+    assert "unknown config key constant C.MISSING_KEY" in msgs
+    assert "'raw_key' bypasses constants.py" in msgs
+    assert "defaultless read of C.STEPS" in msgs
+    assert "cross-wired" in msgs
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline machinery
+# ---------------------------------------------------------------------------
+
+def test_suppression_same_line_and_line_above():
+    src = (
+        "import jax, numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.asarray(x)  # jaxlint: disable=JL001\n"
+        "@jax.jit\n"
+        "def g(x):\n"
+        "    # jaxlint: disable\n"
+        "    return np.asarray(x)\n"
+        "@jax.jit\n"
+        "def h(x):\n"
+        "    return np.asarray(x)  # jaxlint: disable=JL999\n"
+    )
+    findings = lint_source(src, path="t.py")
+    # only h's survives: its comment disables a different rule
+    assert [(f.rule, f.line) for f in findings] == [("JL001", 11)]
+
+
+def test_baseline_roundtrip(tmp_path):
+    src = "import jax, numpy as np\n@jax.jit\ndef f(x):\n    return np.asarray(x)\n"
+    bad = tmp_path / "mod.py"
+    bad.write_text(src)
+    findings = lint_file(str(bad))
+    assert findings
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(findings, str(bl_path))
+    baseline = load_baseline(str(bl_path))
+    assert all(f.key() in baseline for f in findings)
+    # baseline keys are line-number independent: shifting the file down
+    # must not un-baseline the finding
+    bad.write_text("# a new comment line\n" + src)
+    shifted = lint_file(str(bad))
+    assert shifted and all(f.key() in baseline for f in shifted)
+
+
+def test_syntax_error_is_a_finding():
+    findings = lint_source("def broken(:\n", path="b.py")
+    assert [f.rule for f in findings] == ["JL000"]
+
+
+def test_decorator_jit_call_registers_once():
+    """@jax.jit(...) must not be double-registered by the plain-call walk
+    (duplicate findings + a phantom non-decorator site that defeats
+    JL003's sibling heuristic)."""
+    src = ("import jax\n"
+           "@jax.jit(in_shardings=(None,))\n"
+           "def f(x):\n"
+           "    return x\n")
+    findings = lint_source(src, path="t.py")
+    assert [(f.rule, f.line) for f in findings] == [("JL003", 2)]
+
+
+def test_write_baseline_preserves_justifications(tmp_path):
+    src = "import jax, numpy as np\n@jax.jit\ndef f(x):\n    return np.asarray(x)\n"
+    bad = tmp_path / "mod.py"
+    bad.write_text(src)
+    findings = lint_file(str(bad))
+    bl = tmp_path / "baseline.json"
+    write_baseline(findings, str(bl))
+    data = json.loads(bl.read_text())
+    data["findings"][0]["why"] = "accepted: legacy module"
+    bl.write_text(json.dumps(data))
+    write_baseline(findings, str(bl))          # regenerate
+    again = json.loads(bl.read_text())
+    assert again["findings"][0]["why"] == "accepted: legacy module"
+
+
+def test_nonexistent_path_is_an_error(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        lint_paths([str(tmp_path / "no_such_dir")])
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "deepspeed_tpuu"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "no such file" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate + CLI contract
+# ---------------------------------------------------------------------------
+
+def test_tree_is_clean():
+    """The permanent regression gate: zero non-baselined findings over
+    the whole package.  Fix new findings (or suppress inline with a
+    justification; baseline only with a 'why' — docs/jaxlint.md)."""
+    findings = lint_paths([os.path.join(REPO, "deepspeed_tpu")])
+    baseline = load_baseline()
+    rel = []
+    for f in findings:
+        key = f.key().replace(REPO + os.sep, "")
+        if key not in baseline and f.key() not in baseline:
+            rel.append(f.render())
+    assert not rel, "new jaxlint findings:\n" + "\n".join(rel)
+
+
+def test_baseline_entries_are_justified():
+    """Every baselined finding must carry a non-empty 'why'."""
+    path = default_baseline_path()
+    with open(path) as fh:
+        data = json.load(fh)
+    for entry in data.get("findings", []):
+        assert isinstance(entry, dict) and entry.get("why"), \
+            f"baseline entry without justification: {entry}"
+
+
+def test_cli_runs_clean_from_repo_root():
+    """``python -m tools.jaxlint deepspeed_tpu/ --format=github`` is the
+    CI entry point and must exit 0 on the current tree with no deps
+    beyond the stdlib."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "deepspeed_tpu",
+         "--format=github"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_reports_findings_in_github_format(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text(
+        "import jax, numpy as np\n@jax.jit\ndef f(x):\n"
+        "    return np.asarray(x)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", str(bad),
+         "--format=github", "--baseline", str(tmp_path / "none.json")],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1
+    assert "::error file=" in proc.stdout
+    assert "JL001" in proc.stdout
+
+
+def test_cli_list_rules_covers_all_ids():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    for rule_id in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL101"):
+        assert rule_id in proc.stdout

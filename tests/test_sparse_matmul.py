@@ -182,3 +182,37 @@ def test_softmax_batched_multihead_key_padding_mask():
     ref = np.stack([np.stack([_to_blocks(p[b, h], lay) for h in range(H)])
                     for b in range(B)])
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_softmax_fully_masked_rows_emit_zeros():
+    """A sequence whose keys are ALL padded must produce zero attention
+    rows, matching the fused kernel's zeros-for-dead-rows semantics —
+    not NaN from x - row_max = -inf - -inf (ADVICE.md round 5,
+    matmul.py:210)."""
+    lay = _layout(8)
+    rng = np.random.default_rng(8)
+    scores = rng.normal(size=(M, N)).astype(np.float32)
+    vals = jnp.asarray(_to_blocks(scores, lay))
+    kpm = np.full(N, -np.inf, np.float32)          # every key padded
+    out = np.asarray(Softmax(lay, BLK)(vals,
+                                       key_padding_mask=jnp.asarray(kpm)))
+    assert np.isfinite(out).all()
+    np.testing.assert_array_equal(out, np.zeros_like(out))
+    # and an unpadded call on the same scores still normalizes properly
+    live = np.asarray(Softmax(lay, BLK)(vals))
+    assert np.isfinite(live).all() and live.max() > 0
+
+
+def test_softmax_fully_masked_rows_fp16():
+    """fp16 is where the NaN actually bit: the -1e30 row-max fill itself
+    overflows to -inf, so every fully-masked row subtracted -inf from
+    -inf before the dead-row guard."""
+    lay = _layout(9)
+    rng = np.random.default_rng(9)
+    scores = rng.normal(size=(M, N)).astype(np.float32)
+    vals = jnp.asarray(_to_blocks(scores, lay), jnp.float16)
+    kpm = np.full(N, -np.inf, np.float16)
+    out = np.asarray(Softmax(lay, BLK)(vals,
+                                       key_padding_mask=jnp.asarray(kpm)))
+    assert np.isfinite(out).all()
+    assert (out == 0).all()

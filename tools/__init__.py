@@ -1,0 +1,3 @@
+# Repo tooling namespace (not shipped in the wheel — see setup.py
+# packages list).  Lets ``python -m tools.jaxlint`` work from a clean
+# checkout.

@@ -1,0 +1,29 @@
+"""jaxlint — AST static analysis for JAX tracer-safety hazards.
+
+The failure modes this package exists to catch are the JAX mutations of
+the classic DeepSpeed engine bugs (PAPER.md §L4): silent host syncs
+inside the train loop, buffers read after ``donate_argnums`` donation,
+``in_shardings`` without ``out_shardings`` (retrace-per-step on real
+meshes), Python side effects baked in at trace time, and recompilation
+hazards.  Every rule here started as a hand-found advisor finding; the
+linter keeps the whole family out permanently.
+
+Rules (see docs/jaxlint.md):
+  JL001  host-sync call reachable from jit-traced code
+  JL002  read of a buffer after it was donated to a jitted call
+  JL003  in_shardings without out_shardings
+  JL004  Python side effect under trace
+  JL005  recompilation hazard (unhashable static arg, trace-time clock)
+  JL101  config key not cross-checked against constants.py defaults
+
+Zero dependencies beyond the stdlib: ``python -m tools.jaxlint`` must
+run on a clean checkout before any environment is built.
+"""
+from .core import (Finding, ModuleContext, RULE_REGISTRY, lint_paths,
+                   load_baseline, write_baseline)
+from . import rules as _rules  # noqa: F401  (registers the rule classes)
+
+__all__ = ["Finding", "ModuleContext", "RULE_REGISTRY", "lint_paths",
+           "load_baseline", "write_baseline"]
+
+__version__ = "0.1.0"

@@ -1,0 +1,81 @@
+"""CLI: ``python -m tools.jaxlint [paths] [--format github] ...``.
+
+Exit status: 0 when every finding is baselined or suppressed, 1 when new
+findings exist, 2 on usage errors.  Stdlib only — runs on a clean
+checkout before any environment is built.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import (RULE_REGISTRY, default_baseline_path, lint_paths,
+                   load_baseline, write_baseline)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="Static analysis for JAX tracer-safety hazards "
+                    "(host syncs, use-after-donation, sharding and "
+                    "recompilation bugs). See docs/jaxlint.md.")
+    p.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
+                   help="files or directories to lint "
+                        "(default: deepspeed_tpu)")
+    p.add_argument("--format", choices=("text", "github"), default="text",
+                   help="finding format; 'github' emits ::error workflow "
+                        "commands")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help=f"baseline file (default: {default_baseline_path()})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report baselined findings too")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept all current findings into the baseline "
+                        "file and exit 0")
+    p.add_argument("--select", default=None, metavar="IDS",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, cls in sorted(RULE_REGISTRY.items()):
+            print(f"{rule_id}  {cls.summary}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+        unknown = [s for s in select if s not in RULE_REGISTRY]
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        findings = lint_paths(args.paths, rules=select)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        print(f"baseline written: {len(findings)} finding(s) accepted")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    fresh = [f for f in findings if f.key() not in baseline]
+    for f in fresh:
+        print(f.render(args.format))
+    baselined = len(findings) - len(fresh)
+    tail = f" ({baselined} baselined)" if baselined else ""
+    print(f"jaxlint: {len(fresh)} finding(s){tail}", file=sys.stderr)
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

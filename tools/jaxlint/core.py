@@ -1,0 +1,210 @@
+"""Rule engine: Finding, ModuleContext, suppression, baseline, walking.
+
+Rules are classes with a string ``id``, a one-line ``summary`` and a
+``check(ctx) -> iterable[Finding]``; ``@register`` adds them to
+``RULE_REGISTRY``.  The engine parses each file once into a
+:class:`ModuleContext` and hands it to every rule, then filters the
+findings through per-line suppressions (``# jaxlint: disable=JL003``)
+and the baseline file.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional
+
+RULE_REGISTRY: Dict[str, type] = {}
+
+# `# jaxlint: disable` silences every rule on the line; `=JL001,JL002`
+# silences only those ids.  The comment can sit on the flagged line or
+# alone on the line directly above it.
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable(?:=(?P<ids>[A-Za-z0-9,\s]+))?")
+
+
+def register(cls):
+    RULE_REGISTRY[cls.id] = cls
+    return cls
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    line_text: str = ""
+
+    def key(self) -> str:
+        # Baseline identity deliberately omits the line NUMBER: unrelated
+        # edits above a baselined finding must not un-baseline it.
+        return f"{self.path}::{self.rule}::{self.line_text.strip()}"
+
+    def render(self, fmt: str = "text") -> str:
+        if fmt == "github":
+            return (f"::error file={self.path},line={self.line},"
+                    f"col={self.col},title={self.rule}::{self.message}")
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class Rule:
+    """Base class so rules share the finding constructor."""
+
+    id = "JL000"
+    summary = "base rule"
+
+    def finding(self, ctx: "ModuleContext", node, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(path=ctx.path, line=line, col=col, rule=self.id,
+                       message=message, line_text=ctx.line_text(line))
+
+    def check(self, ctx: "ModuleContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+class ModuleContext:
+    """One parsed file plus the lazily-built jit analysis shared by rules."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._jaxlint_parent = node  # type: ignore[attr-defined]
+        self._jit = None
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def parent(self, node):
+        return getattr(node, "_jaxlint_parent", None)
+
+    @property
+    def jit(self):
+        if self._jit is None:
+            from .jitmodel import JitAnalysis
+            self._jit = JitAnalysis(self)
+        return self._jit
+
+    def suppressed(self, finding: Finding) -> bool:
+        for lineno in (finding.line, finding.line - 1):
+            text = self.line_text(lineno)
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            if lineno != finding.line and text.lstrip()[:1] != "#":
+                continue  # line above counts only when comment-only
+            ids = m.group("ids")
+            if ids is None:
+                return True
+            if finding.rule in {i.strip() for i in ids.split(",")}:
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, str]:
+    """Baseline keys -> justification strings ('' when none recorded)."""
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    entries = data.get("findings", [])
+    out: Dict[str, str] = {}
+    for e in entries:
+        if isinstance(e, str):
+            out[e] = ""
+        else:
+            out[e["key"]] = e.get("why", "")
+    return out
+
+
+def write_baseline(findings: List[Finding], path: Optional[str] = None):
+    path = path or default_baseline_path()
+    existing = load_baseline(path)  # keep recorded justifications
+    payload = {
+        "version": 1,
+        "comment": ("Accepted pre-existing findings. Every entry needs a "
+                    "'why'; prefer fixing over baselining (docs/jaxlint.md)."),
+        "findings": [{"key": f.key(), "why": existing.get(f.key(), "")}
+                     for f in findings],
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# walking + running
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {".git", "__pycache__", ".mypy_cache", ".pytest_cache",
+              "build", "dist", "node_modules"}
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        if not os.path.isdir(p):
+            # a typoed path must not silently turn the gate into a no-op
+            raise FileNotFoundError(f"jaxlint: no such file or directory: {p}")
+        for root, dirs, names in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in _SKIP_DIRS and not d.startswith("."))
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    files.append(os.path.join(root, n))
+    return files
+
+
+def lint_file(path: str, rules: Optional[List[str]] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, path=path, rules=rules)
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[List[str]] = None) -> List[Finding]:
+    try:
+        ctx = ModuleContext(path, source)
+    except SyntaxError as e:
+        return [Finding(path=path, line=e.lineno or 1, col=e.offset or 0,
+                        rule="JL000", message=f"syntax error: {e.msg}",
+                        line_text="")]
+    out: List[Finding] = []
+    for rule_id, cls in sorted(RULE_REGISTRY.items()):
+        if rules is not None and rule_id not in rules:
+            continue
+        for f in cls().check(ctx):
+            if not ctx.suppressed(f):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[List[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for fp in iter_python_files(paths):
+        findings.extend(lint_file(fp, rules=rules))
+    return findings

@@ -1,0 +1,289 @@
+"""Shared per-module jit analysis.
+
+Answers the three questions every tracer-safety rule needs:
+
+1. Which function bodies run under trace?  (``jitted_defs`` — wrapped
+   directly by ``jit``/``pjit`` as a decorator, a call argument, or a
+   ``partial(jax.jit, ...)`` — plus ``reachable_defs``, the transitive
+   closure over local calls and ``self.method`` calls.)
+2. Where are the ``jit`` wrapper call sites and what options do they
+   carry?  (``sites`` — donate/static argnums+argnames, in/out
+   shardings.)
+3. Which *names* are known-jitted callables?  (``callables`` — a def
+   decorated with jit, or the target of ``f = jax.jit(...)`` /
+   ``self._step = jax.jit(...)``, so call sites of those names can be
+   checked for donation misuse and unhashable static arguments.)
+
+Purely syntactic — no imports are executed.  Aliases of jit through
+other names (``from jax import jit as J``) and wrappers hidden behind
+helper functions are out of scope by design: under-approximate, never
+guess.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: last dotted component that marks a call as a jit wrapper
+WRAPPER_LAST = {"jit", "pjit"}
+#: accepted full spellings (guards against unrelated ``.jit`` methods)
+WRAPPER_TEXTS = {"jit", "pjit", "jax.jit", "jax.pjit", "pjit.pjit",
+                 "jax.experimental.pjit.pjit"}
+
+
+def dotted(node) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _int_tuple(node) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _str_tuple(node) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def is_wrapper_ref(node) -> bool:
+    text = dotted(node)
+    if text is None:
+        return False
+    return text in WRAPPER_TEXTS or (text.split(".")[-1] in WRAPPER_LAST
+                                     and text.startswith("jax."))
+
+
+@dataclasses.dataclass
+class JitSite:
+    node: ast.AST                      # the jit Call (or bare decorator ref)
+    wrapped: Optional[ast.AST] = None  # resolved FunctionDef/Lambda
+    donate_argnums: Tuple[int, ...] = ()
+    donate_argnames: Tuple[str, ...] = ()
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    has_in_shardings: bool = False
+    has_out_shardings: bool = False
+    is_decorator: bool = False
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donate_argnums or self.donate_argnames)
+
+
+class JitAnalysis:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        tree = ctx.tree
+        self.defs: List[ast.AST] = [n for n in ast.walk(tree)
+                                    if isinstance(n, _FUNC_DEFS)]
+        self.sites: List[JitSite] = []
+        self.callables: Dict[str, JitSite] = {}
+        # same name bound in different scopes (every builder calls its
+        # jitted closure 'step'...) — the scoped map disambiguates
+        self.scoped_callables: Dict[Tuple[int, str], JitSite] = {}
+        self.jitted_defs: Set[ast.AST] = set()
+        self._collect_sites(tree)
+        self.reachable_defs: Set[ast.AST] = self._close_over_calls()
+
+    # -- scope helpers ---------------------------------------------------
+    def enclosing_function(self, node) -> Optional[ast.AST]:
+        n = self.ctx.parent(node)
+        while n is not None and not isinstance(n, _FUNC_DEFS):
+            n = self.ctx.parent(n)
+        return n
+
+    def enclosing_class(self, node) -> Optional[ast.ClassDef]:
+        n = self.ctx.parent(node)
+        while n is not None and not isinstance(n, ast.ClassDef):
+            n = self.ctx.parent(n)
+        return n
+
+    def _resolve_name(self, name: str, from_def) -> Optional[ast.AST]:
+        """A bare called name -> the def it refers to, lexically."""
+        scope = from_def
+        while scope is not None:
+            for stmt in ast.walk(scope):
+                if isinstance(stmt, _FUNC_DEFS) and stmt.name == name \
+                        and stmt is not scope \
+                        and self.enclosing_function(stmt) is scope:
+                    return stmt
+            scope = self.enclosing_function(scope)
+        for stmt in self.ctx.tree.body:
+            if isinstance(stmt, _FUNC_DEFS) and stmt.name == name:
+                return stmt
+        return None
+
+    def _resolve_self_method(self, name: str, from_def) -> Optional[ast.AST]:
+        cls = self.enclosing_class(from_def)
+        if cls is None:
+            return None
+        for stmt in cls.body:
+            if isinstance(stmt, _FUNC_DEFS) and stmt.name == name:
+                return stmt
+        return None
+
+    def resolve_call(self, call: ast.Call, from_def) -> Optional[ast.AST]:
+        text = dotted(call.func)
+        if text is None:
+            return None
+        if "." not in text:
+            return self._resolve_name(text, from_def)
+        base, _, attr = text.rpartition(".")
+        if base == "self":
+            return self._resolve_self_method(attr, from_def)
+        return None
+
+    # -- site collection -------------------------------------------------
+    def _parse_site(self, call: ast.Call, decorator: bool = False) -> JitSite:
+        site = JitSite(node=call, is_decorator=decorator)
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                site.donate_argnums = _int_tuple(kw.value)
+            elif kw.arg == "donate_argnames":
+                site.donate_argnames = _str_tuple(kw.value)
+            elif kw.arg == "static_argnums":
+                site.static_argnums = _int_tuple(kw.value)
+            elif kw.arg == "static_argnames":
+                site.static_argnames = _str_tuple(kw.value)
+            elif kw.arg == "in_shardings":
+                site.has_in_shardings = True
+            elif kw.arg == "out_shardings":
+                site.has_out_shardings = True
+        return site
+
+    def _collect_sites(self, tree):
+        # decorator expressions are handled by the decorator loop below;
+        # the plain-call walk must skip them or @jax.jit(...) registers
+        # twice (once without is_decorator, breaking JL003's skip)
+        decorator_nodes = {id(dec) for fn in self.defs
+                           for dec in fn.decorator_list}
+        # plain jit calls: jax.jit(f, ...) anywhere in the module
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not is_wrapper_ref(node.func):
+                continue
+            if id(node) in decorator_nodes:
+                continue
+            site = self._parse_site(node)
+            if node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Lambda):
+                    site.wrapped = first
+                else:
+                    name = dotted(first)
+                    if name is not None:
+                        site.wrapped = self._resolve_ref(
+                            name, self.enclosing_function(node))
+            self.sites.append(site)
+            if site.wrapped is not None:
+                self.jitted_defs.add(site.wrapped)
+            self._bind_assignment(node, site)
+
+        # decorators: @jax.jit / @partial(jax.jit, ...) / @jax.jit(...)
+        for fn in self.defs:
+            for dec in fn.decorator_list:
+                site = self._decorator_site(dec)
+                if site is None:
+                    continue
+                site.wrapped = fn
+                self.jitted_defs.add(fn)
+                self.sites.append(site)
+                self.callables.setdefault(fn.name, site)
+
+    def _resolve_ref(self, name: str, from_def) -> Optional[ast.AST]:
+        if "." in name:
+            base, _, attr = name.rpartition(".")
+            if base == "self" and from_def is not None:
+                return self._resolve_self_method(attr, from_def)
+            return None
+        if from_def is not None:
+            return self._resolve_name(name, from_def)
+        for stmt in self.ctx.tree.body:
+            if isinstance(stmt, _FUNC_DEFS) and stmt.name == name:
+                return stmt
+        return None
+
+    def _bind_assignment(self, call: ast.Call, site: JitSite):
+        """Register ``x = jax.jit(...)`` / ``self.x = jax.jit(...)``."""
+        parent = self.ctx.parent(call)
+        scope = self.enclosing_function(call)
+        targets = []
+        if isinstance(parent, ast.Assign):
+            targets = parent.targets
+        elif isinstance(parent, ast.AnnAssign):
+            targets = [parent.target]
+        for tgt in targets:
+            text = dotted(tgt)
+            if text is not None:
+                self.callables[text] = site
+                self.scoped_callables[(id(scope), text)] = site
+
+    def lookup_callable(self, name: str, scope) -> Optional[JitSite]:
+        """The jit site a called name refers to, innermost scope first."""
+        while scope is not None:
+            site = self.scoped_callables.get((id(scope), name))
+            if site is not None:
+                return site
+            scope = self.enclosing_function(scope)
+        site = self.scoped_callables.get((id(None), name))
+        if site is not None:
+            return site
+        return self.callables.get(name)
+
+    def _decorator_site(self, dec) -> Optional[JitSite]:
+        if is_wrapper_ref(dec):  # @jax.jit
+            return JitSite(node=dec, is_decorator=True)
+        if isinstance(dec, ast.Call):
+            if is_wrapper_ref(dec.func):  # @jax.jit(static_argnums=...)
+                return self._parse_site(dec, decorator=True)
+            func_text = dotted(dec.func)
+            if func_text in ("partial", "functools.partial") and dec.args \
+                    and is_wrapper_ref(dec.args[0]):
+                return self._parse_site(dec, decorator=True)
+        return None
+
+    # -- reachability ----------------------------------------------------
+    def _close_over_calls(self) -> Set[ast.AST]:
+        seen = set(self.jitted_defs)
+        work = list(self.jitted_defs)
+        while work:
+            fn = work.pop()
+            if isinstance(fn, ast.Lambda):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = self.resolve_call(node, fn)
+                if target is not None and target not in seen:
+                    seen.add(target)
+                    work.append(target)
+        return seen
+
+    # -- convenience for rules -------------------------------------------
+    def traced_bodies(self):
+        """(def, is_root) for every function whose body runs under trace."""
+        for fn in sorted(self.reachable_defs,
+                         key=lambda n: getattr(n, "lineno", 0)):
+            yield fn, fn in self.jitted_defs

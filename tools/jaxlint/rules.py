@@ -1,0 +1,525 @@
+"""The jaxlint rules: JL001-JL005 (tracer safety) and JL101 (config schema).
+
+Each rule documents the TPU failure mode it prevents; docs/jaxlint.md
+is the user-facing version of the same list.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, ModuleContext, Rule, register
+from .jitmodel import _FUNC_DEFS, dotted, is_wrapper_ref
+
+
+def scope_walk(root):
+    """Walk ``root`` without descending into nested function/class defs —
+    the statement-level view of ONE scope."""
+    stack = [root]
+    first = True
+    while stack:
+        node = stack.pop()
+        if not first and isinstance(node, _FUNC_DEFS + (ast.Lambda,
+                                                        ast.ClassDef)):
+            continue
+        first = False
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _local_names(fn) -> Set[str]:
+    """Parameter and locally-assigned names of a def."""
+    names: Set[str] = set()
+    if isinstance(fn, _FUNC_DEFS + (ast.Lambda,)):
+        a = fn.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])):
+            names.add(arg.arg)
+    for node in scope_walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, _FUNC_DEFS) and node is not fn:
+            names.add(node.name)
+    return names
+
+
+def _call_text(node: ast.Call) -> Optional[str]:
+    return dotted(node.func)
+
+
+# ---------------------------------------------------------------------------
+# JL001 — host syncs under trace
+# ---------------------------------------------------------------------------
+
+_SYNC_CALLS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+               "jax.device_get"}
+_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+_SYNC_BUILTINS = {"float", "int", "bool"}
+
+
+@register
+class HostSyncRule(Rule):
+    id = "JL001"
+    summary = "host-sync call reachable from jit-traced code"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        jit = ctx.jit
+        for fn, is_root in jit.traced_bodies():
+            if isinstance(fn, ast.Lambda):
+                continue
+            where = (f"'{fn.name}' (jitted)" if is_root
+                     else f"'{fn.name}' (called from jit-traced code)")
+            for node in scope_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                text = _call_text(node)
+                if text in _SYNC_CALLS:
+                    yield self.finding(
+                        ctx, node,
+                        f"host sync '{text}' inside {where}: forces a "
+                        "device->host transfer every step (or a tracer "
+                        "leak); hoist it out of the traced region")
+                elif text in _SYNC_BUILTINS and len(node.args) == 1 \
+                        and not isinstance(node.args[0], ast.Constant) \
+                        and not node.keywords:
+                    yield self.finding(
+                        ctx, node,
+                        f"'{text}(...)' on a non-literal inside {where}: "
+                        "concretizes a traced array (host sync / "
+                        "ConcretizationTypeError on TPU)")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _SYNC_METHODS \
+                        and not node.args and not node.keywords:
+                    yield self.finding(
+                        ctx, node,
+                        f"'.{node.func.attr}()' inside {where}: blocks on "
+                        "device results under trace")
+
+
+# ---------------------------------------------------------------------------
+# JL002 — use after donation
+# ---------------------------------------------------------------------------
+
+def _store_events(scope_root) -> List[Tuple[str, int]]:
+    """(dotted-target-text, lineno) for every assignment in the scope."""
+    out: List[Tuple[str, int]] = []
+
+    def add_target(t):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                add_target(e)
+            return
+        if isinstance(t, ast.Starred):
+            add_target(t.value)
+            return
+        text = dotted(t)
+        if text is not None:
+            out.append((text, t.lineno))
+
+    for node in scope_walk(scope_root):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                add_target(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            add_target(node.target)
+        elif isinstance(node, ast.For):
+            add_target(node.target)
+        elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+            add_target(node.optional_vars)
+    return out
+
+
+def _alias_map(scope_root) -> Dict[str, Set[str]]:
+    """Bidirectional alias pairs from simple ``a = self.b`` assignments."""
+    aliases: Dict[str, Set[str]] = {}
+    for node in scope_walk(scope_root):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            lhs, rhs = dotted(node.targets[0]), dotted(node.value)
+            if lhs and rhs:
+                aliases.setdefault(lhs, set()).add(rhs)
+                aliases.setdefault(rhs, set()).add(lhs)
+    return aliases
+
+
+@register
+class UseAfterDonationRule(Rule):
+    id = "JL002"
+    summary = "buffer read after being donated to a jitted call"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        jit = ctx.jit
+        scopes = [ctx.tree] + [fn for fn in jit.defs]
+        for scope in scopes:
+            yield from self._check_scope(ctx, jit, scope)
+
+    def _donation_site(self, jit, call: ast.Call, scope):
+        """Donation info for a call, by callee name or inline jit(...)()."""
+        text = _call_text(call)
+        if text is not None:
+            site = jit.lookup_callable(
+                text, scope if not isinstance(scope, ast.Module) else None)
+            if site is not None:
+                return site if site.donates else None
+        # inline form: jax.jit(f, donate_argnums=...)(x)
+        if isinstance(call.func, ast.Call) and is_wrapper_ref(call.func.func):
+            site = jit._parse_site(call.func)
+            return site if site.donates else None
+        return None
+
+    def _check_scope(self, ctx, jit, scope):
+        stores = _store_events(scope)
+        aliases = _alias_map(scope)
+        for call in scope_walk(scope):
+            if not isinstance(call, ast.Call):
+                continue
+            site = self._donation_site(jit, call, scope)
+            if site is None:
+                continue
+            donated: List[ast.AST] = []
+            for i in site.donate_argnums:
+                if i < len(call.args):
+                    donated.append(call.args[i])
+            for kw in call.keywords:
+                if kw.arg in site.donate_argnames:
+                    donated.append(kw.value)
+            callee = _call_text(call) or "<jitted call>"
+            end = getattr(call, "end_lineno", call.lineno)
+            for arg in donated:
+                text = dotted(arg)
+                if text is None:
+                    continue  # expression result: nothing to alias-track
+                tainted = {text} | aliases.get(text, set())
+                yield from self._reads_after(
+                    ctx, scope, tainted, end, stores, callee, call.lineno)
+
+    def _reads_after(self, ctx, scope, tainted, after_line, stores,
+                     callee, call_line):
+        reported: Set[str] = set()
+        loads = []
+        for node in scope_walk(scope):
+            if isinstance(node, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(node, "ctx", None), ast.Load):
+                text = dotted(node)
+                if text in tainted and node.lineno > after_line:
+                    loads.append((node.lineno, node, text))
+        for lineno, node, text in sorted(loads, key=lambda t: t[0]):
+            if text in reported:
+                continue
+            # a reassignment between the donating call and the read
+            # revives the name (e.g. ``state = step(state)``)
+            if any(s_text == text and call_line <= s_line <= lineno
+                   for s_text, s_line in stores):
+                continue
+            reported.add(text)
+            yield self.finding(
+                ctx, node,
+                f"'{text}' is read after being donated to '{callee}' "
+                f"(line {call_line}): donated buffers are deleted by XLA; "
+                "rebind the name from the call's result first")
+
+
+# ---------------------------------------------------------------------------
+# JL003 — in_shardings without out_shardings
+# ---------------------------------------------------------------------------
+
+@register
+class OutShardingsRule(Rule):
+    id = "JL003"
+    summary = "jit with in_shardings but no out_shardings"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        jit = ctx.jit
+        for site in jit.sites:
+            if site.has_in_shardings and not site.has_out_shardings:
+                yield self.finding(
+                    ctx, site.node,
+                    "jit call passes in_shardings but no out_shardings: "
+                    "outputs fall back to default placement, so the next "
+                    "step sees different avals and retraces/recompiles "
+                    "every call on a multi-device mesh")
+        # second check, the engine.py:1685 bug class: inside ONE builder
+        # function, some jit sites pin out_shardings and a sibling site
+        # does not — its outputs ride default placement while the rest of
+        # the state is pinned, which diverges on a multi-device mesh
+        by_scope: Dict = {}
+        for site in jit.sites:
+            if site.is_decorator:
+                continue
+            scope = jit.enclosing_function(site.node)
+            if scope is not None:
+                by_scope.setdefault(scope, []).append(site)
+        for scope, sites in by_scope.items():
+            pinned = [s for s in sites if s.has_out_shardings]
+            bare = [s for s in sites if not s.has_out_shardings]
+            if pinned and bare:
+                for s in bare:
+                    yield self.finding(
+                        ctx, s.node,
+                        f"jit site without out_shardings in "
+                        f"'{scope.name}' while sibling jit sites pin "
+                        "theirs: this program's outputs fall back to "
+                        "default placement and diverge from the pinned "
+                        "state on a multi-device mesh")
+
+
+# ---------------------------------------------------------------------------
+# JL004 — Python side effects under trace
+# ---------------------------------------------------------------------------
+
+# 'update' and 'pop' are deliberately absent: tx.update(...) is the
+# (pure) optax GradientTransformation idiom and .pop shows up on plenty
+# of non-container objects — too ambiguous without type information
+_MUTATORS = {"append", "extend", "insert", "add", "setdefault",
+             "remove", "discard", "clear", "popitem"}
+
+
+@register
+class SideEffectRule(Rule):
+    id = "JL004"
+    summary = "Python side effect inside a jit-traced body"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        jit = ctx.jit
+        for fn, is_root in jit.traced_bodies():
+            if isinstance(fn, ast.Lambda):
+                continue
+            local = _local_names(fn)
+            where = (f"'{fn.name}'" if is_root
+                     else f"'{fn.name}' (called from jit-traced code)")
+            for node in scope_walk(fn):
+                yield from self._check_node(ctx, node, local, where)
+
+    def _check_node(self, ctx, node, local, where):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                base = t
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    base = base.value
+                if isinstance(t, (ast.Attribute, ast.Subscript)) \
+                        and isinstance(base, ast.Name):
+                    if base.id == "self":
+                        yield self.finding(
+                            ctx, t,
+                            f"assignment to '{dotted(t) or base.id + '[...]'}' "
+                            f"inside jit-traced {where}: runs once at trace "
+                            "time, not per step — the object mutation is a "
+                            "silent no-op on later calls")
+                    elif isinstance(t, ast.Subscript) \
+                            and base.id not in local:
+                        yield self.finding(
+                            ctx, t,
+                            f"subscript store to closed-over '{base.id}' "
+                            f"inside jit-traced {where}: mutates a Python "
+                            "object at trace time only")
+        elif isinstance(node, ast.Global):
+            yield self.finding(
+                ctx, node,
+                f"'global' inside jit-traced {where}: global mutation "
+                "happens at trace time only")
+        elif isinstance(node, ast.Call):
+            text = _call_text(node)
+            if text == "print":
+                yield self.finding(
+                    ctx, node,
+                    f"'print' inside jit-traced {where}: prints tracers "
+                    "once at trace time; use jax.debug.print for runtime "
+                    "values")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id not in local \
+                    and node.func.value.id != "self":
+                yield self.finding(
+                    ctx, node,
+                    f"'.{node.func.attr}' on closed-over "
+                    f"'{node.func.value.id}' inside jit-traced {where}: "
+                    "mutates a Python container at trace time only")
+
+
+# ---------------------------------------------------------------------------
+# JL005 — recompilation hazards
+# ---------------------------------------------------------------------------
+
+_CLOCK_CALLS = {"time.time", "time.time_ns", "time.perf_counter",
+                "time.monotonic", "datetime.now", "datetime.utcnow",
+                "datetime.datetime.now", "datetime.datetime.utcnow",
+                "date.today", "datetime.date.today"}
+_NONDET_PREFIXES = ("np.random.", "numpy.random.", "random.")
+
+
+@register
+class RecompilationRule(Rule):
+    id = "JL005"
+    summary = "recompilation hazard (unhashable static arg, trace-time clock)"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        jit = ctx.jit
+        # (a) unhashable / per-call-varying values in static positions of
+        # known jitted callables: every call re-traces (dict/list) or
+        # re-specializes (f-string) the program
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            text = _call_text(node)
+            site = jit.callables.get(text) if text else None
+            if site is None or not (site.static_argnums
+                                    or site.static_argnames):
+                continue
+            static_args = [(i, node.args[i]) for i in site.static_argnums
+                           if i < len(node.args)]
+            static_args += [(kw.arg, kw.value) for kw in node.keywords
+                            if kw.arg in site.static_argnames]
+            for pos, arg in static_args:
+                if isinstance(arg, (ast.Dict, ast.List, ast.Set)):
+                    yield self.finding(
+                        ctx, arg,
+                        f"unhashable literal passed for static argument "
+                        f"{pos!r} of jitted '{text}': static args must be "
+                        "hashable and stable or every call recompiles")
+                elif isinstance(arg, ast.JoinedStr):
+                    yield self.finding(
+                        ctx, arg,
+                        f"f-string passed for static argument {pos!r} of "
+                        f"jitted '{text}': a fresh string per call defeats "
+                        "the jit cache (one recompile per distinct value)")
+        # (b) trace-time clocks / RNG inside traced bodies: each trace
+        # bakes a different constant, so shapes or cache keys derived from
+        # them force retraces (and silently freeze otherwise)
+        for fn, is_root in jit.traced_bodies():
+            if isinstance(fn, ast.Lambda):
+                continue
+            for node in scope_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                text = _call_text(node)
+                if text is None:
+                    continue
+                if text in _CLOCK_CALLS or \
+                        any(text.startswith(p) for p in _NONDET_PREFIXES):
+                    yield self.finding(
+                        ctx, node,
+                        f"'{text}' inside jit-traced '{fn.name}': evaluated "
+                        "once at trace time — a frozen constant at best, a "
+                        "shape-varying recompile trigger at worst; pass the "
+                        "value in as an argument")
+
+
+# ---------------------------------------------------------------------------
+# JL101 — config keys cross-checked against constants.py
+# ---------------------------------------------------------------------------
+
+def _constants_alias(tree) -> Optional[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name.split(".")[-1] == "constants":
+                    return a.asname or a.name
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.split(".")[-1] == "constants":
+                    return a.asname or a.name.split(".")[-1]
+    return None
+
+
+def _constants_names(path: str) -> Optional[Set[str]]:
+    const_path = os.path.join(os.path.dirname(os.path.abspath(path)),
+                              "constants.py")
+    if not os.path.exists(const_path):
+        return None
+    with open(const_path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read(), filename=const_path)
+        except SyntaxError:
+            return None
+    names: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                            ast.Name):
+            names.add(node.target.id)
+    return names
+
+
+@register
+class ConfigSchemaRule(Rule):
+    id = "JL101"
+    summary = "config key not cross-checked against constants.py defaults"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        alias = _constants_alias(ctx.tree)
+        if alias is None:
+            return
+        names = _constants_names(ctx.path)
+        if names is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            text = _call_text(node)
+            if text is not None and text.split(".")[-1] == "get_scalar_param":
+                if len(node.args) >= 2:
+                    yield from self._check_read(
+                        ctx, alias, names, node.args[1],
+                        node.args[2] if len(node.args) > 2 else None,
+                        explicit_default=len(node.args) > 2)
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get" and node.args:
+                key = node.args[0]
+                if self._const_name(alias, key) is not None:
+                    yield from self._check_read(
+                        ctx, alias, names, key,
+                        node.args[1] if len(node.args) > 1 else None,
+                        explicit_default=len(node.args) > 1)
+
+    @staticmethod
+    def _const_name(alias: str, node) -> Optional[str]:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == alias:
+            return node.attr
+        return None
+
+    def _check_read(self, ctx, alias, names, key, default, explicit_default):
+        key_name = self._const_name(alias, key)
+        if key_name is None:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                yield self.finding(
+                    ctx, key,
+                    f"string-literal config key {key.value!r} bypasses "
+                    f"constants.py: define a constant (and a _DEFAULT) so "
+                    "the schema stays checkable")
+            return
+        if key_name not in names:
+            yield self.finding(
+                ctx, key,
+                f"unknown config key constant {alias}.{key_name}: not "
+                "defined in constants.py")
+            return
+        default_name = self._const_name(alias, default) if default is not None \
+            else None
+        if default is not None and default_name is not None:
+            if default_name not in names:
+                yield self.finding(
+                    ctx, default,
+                    f"unknown default constant {alias}.{default_name}: not "
+                    "defined in constants.py")
+            elif default_name.endswith("_DEFAULT") \
+                    and default_name != key_name + "_DEFAULT":
+                yield self.finding(
+                    ctx, default,
+                    f"default {alias}.{default_name} is cross-wired: key "
+                    f"{alias}.{key_name} expects "
+                    f"{key_name + '_DEFAULT'}")
+        elif not explicit_default and (key_name + "_DEFAULT") in names:
+            yield self.finding(
+                ctx, key,
+                f"defaultless read of {alias}.{key_name}: constants.py "
+                f"defines {key_name}_DEFAULT — pass it so the schema has "
+                "one source of truth")

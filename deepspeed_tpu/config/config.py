@@ -228,6 +228,34 @@ class DeepSpeedTensorboardConfig:
             tb, C.TENSORBOARD_JOB_NAME, C.TENSORBOARD_JOB_NAME_DEFAULT)
 
 
+class DeepSpeedTelemetryConfig:
+    """Unified telemetry block: metrics registry + span tracing +
+    compile/memory instrumentation (docs/observability.md)."""
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        tel = param_dict.get(C.TELEMETRY) or {}
+        self.enabled = get_scalar_param(
+            tel, C.TELEMETRY_ENABLED, C.TELEMETRY_ENABLED_DEFAULT)
+        self.output_path = get_scalar_param(
+            tel, C.TELEMETRY_OUTPUT_PATH, C.TELEMETRY_OUTPUT_PATH_DEFAULT)
+        self.trace = get_scalar_param(
+            tel, C.TELEMETRY_TRACE, C.TELEMETRY_TRACE_DEFAULT)
+        self.compile_events = get_scalar_param(
+            tel, C.TELEMETRY_COMPILE_EVENTS,
+            C.TELEMETRY_COMPILE_EVENTS_DEFAULT)
+        self.memory = get_scalar_param(
+            tel, C.TELEMETRY_MEMORY, C.TELEMETRY_MEMORY_DEFAULT)
+        self.recompile_storm_threshold = get_scalar_param(
+            tel, C.TELEMETRY_STORM_THRESHOLD,
+            C.TELEMETRY_STORM_THRESHOLD_DEFAULT)
+        if (not isinstance(self.recompile_storm_threshold, int)
+                or isinstance(self.recompile_storm_threshold, bool)
+                or self.recompile_storm_threshold < 1):
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY_STORM_THRESHOLD} must be an int >= 1, "
+                f"got {self.recompile_storm_threshold!r}")
+
+
 class DeepSpeedPipelineConfig:
     def __init__(self, param_dict: Dict[str, Any]):
         pipe = param_dict.get(C.PIPELINE) or {}
@@ -349,6 +377,7 @@ class DeepSpeedConfig:
         self.pld_config = DeepSpeedPLDConfig(pd)
         self.tensorboard_config = DeepSpeedTensorboardConfig(pd)
         self.profiler_config = DeepSpeedProfilerConfig(pd)
+        self.telemetry_config = DeepSpeedTelemetryConfig(pd)
         self.pipeline_config = DeepSpeedPipelineConfig(pd)
 
         self._solve_batch_triangle()

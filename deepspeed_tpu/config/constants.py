@@ -296,6 +296,31 @@ TENSORBOARD_OUTPUT_PATH_DEFAULT = ""
 TENSORBOARD_JOB_NAME = "job_name"
 TENSORBOARD_JOB_NAME_DEFAULT = "DeepSpeedJobName"
 
+# Unified telemetry block (TPU extension; docs/observability.md): one
+# structured observability layer — metrics registry with JSONL /
+# Prometheus / SummaryWriter exporters, Chrome-trace span tracing that
+# rides the engine's EXISTING sync points (zero added per-step device
+# syncs, unlike wall_clock_breakdown), jax.monitoring compile tracking
+# (recompiles_total{program=...} — jaxlint JL005's runtime complement),
+# and device-memory gauges from the structured memory_status.
+TELEMETRY = "telemetry"
+TELEMETRY_ENABLED = "enabled"
+TELEMETRY_ENABLED_DEFAULT = False
+# "" resolves to <cwd>/telemetry; files: events.jsonl, trace.json,
+# metrics.prom
+TELEMETRY_OUTPUT_PATH = "output_path"
+TELEMETRY_OUTPUT_PATH_DEFAULT = ""
+TELEMETRY_TRACE = "trace"
+TELEMETRY_TRACE_DEFAULT = True
+TELEMETRY_COMPILE_EVENTS = "compile_events"
+TELEMETRY_COMPILE_EVENTS_DEFAULT = True
+TELEMETRY_MEMORY = "memory"
+TELEMETRY_MEMORY_DEFAULT = True
+# retraces of one program within a single sample window that trigger the
+# recompile-storm warning
+TELEMETRY_STORM_THRESHOLD = "recompile_storm_threshold"
+TELEMETRY_STORM_THRESHOLD_DEFAULT = 3
+
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 PLD_ENABLED = "enabled"
 PLD_ENABLED_DEFAULT = False

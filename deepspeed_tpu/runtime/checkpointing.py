@@ -24,6 +24,7 @@ logical dtype recorded in the manifest.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 from typing import Any, Dict, Optional, Tuple
@@ -34,6 +35,16 @@ import numpy as np
 from ..utils.logging import log_dist
 
 LATEST_FILE = "latest"
+
+
+def _tel_span(engine, name: str, **args):
+    """Per-plane telemetry span via the engine's hub (nullcontext when
+    telemetry is off or the caller isn't a full engine — this module's
+    public API also accepts engine-shaped ducks in tests)."""
+    span = getattr(engine, "_tel_span", None)
+    if span is None:
+        return contextlib.nullcontext()
+    return span(name, cat="checkpoint", **args)
 
 
 # ---------------------------------------------------------------------------
@@ -390,14 +401,17 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     master_tree, opt_tree = engine._canonical_state()
     module_params = precision.cast_to_compute(
         master_tree, engine.compute_dtype)
-    save_tree(os.path.join(tmp_dir, "model"), {"module": module_params})
-    save_tree(os.path.join(tmp_dir, "optim"), {
-        "master_params": master_tree,
-        "opt_state": opt_tree,
-        "scaler": state.scaler,
-        "rng": state.rng,
-        "data_rng": engine._data_rng,
-    })
+    with _tel_span(engine, "checkpoint/save_model_plane"):
+        save_tree(os.path.join(tmp_dir, "model"),
+                  {"module": module_params})
+    with _tel_span(engine, "checkpoint/save_optim_plane"):
+        save_tree(os.path.join(tmp_dir, "optim"), {
+            "master_params": master_tree,
+            "opt_state": opt_tree,
+            "scaler": state.scaler,
+            "rng": state.rng,
+            "data_rng": engine._data_rng,
+        })
 
     if multiproc:
         # every process's shard files must be on disk before the rename
@@ -474,13 +488,14 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         # fp32 master restore (reference 'load_from_fp32_weights',
         # stage2.py:1780-1835); rng restore keeps dropout masks identical
         # to an uninterrupted run.
-        loaded = load_tree(optim_dir, {
-            "master_params": tmpl_master,
-            "opt_state": tmpl_opt,
-            "scaler": state.scaler,
-            "rng": state.rng,
-            "data_rng": engine._data_rng,
-        })
+        with _tel_span(engine, "checkpoint/load_optim_plane"):
+            loaded = load_tree(optim_dir, {
+                "master_params": tmpl_master,
+                "opt_state": tmpl_opt,
+                "scaler": state.scaler,
+                "rng": state.rng,
+                "data_rng": engine._data_rng,
+            })
         master, opt_state = engine._adopt_loaded(
             loaded["master_params"], loaded["opt_state"])
         scaler = loaded["scaler"]
@@ -491,8 +506,9 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         from . import precision
         module_tmpl = precision.cast_to_compute(
             tmpl_master, engine.compute_dtype)
-        loaded = load_tree(os.path.join(ckpt_dir, "model"),
-                           {"module": module_tmpl})
+        with _tel_span(engine, "checkpoint/load_model_plane"):
+            loaded = load_tree(os.path.join(ckpt_dir, "model"),
+                               {"module": module_tmpl})
         def _promote(cur, new):
             sharding = getattr(cur, "sharding", None)  # numpy (offload): none
             from jax.sharding import NamedSharding

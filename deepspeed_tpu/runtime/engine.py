@@ -22,8 +22,10 @@ API surface preserved from the reference:
 from __future__ import annotations
 
 import collections
+import contextlib
 import os
 import time
+import weakref
 from typing import Any, Callable, Dict, NamedTuple, Optional
 
 import jax
@@ -683,19 +685,80 @@ class DeepSpeedEngine:
                 job_name=config.tensorboard_config.job_name)
             # scalars are buffered until the steps_per_print sync; make the
             # writer's own flush()/close() drain the buffer first so either
-            # shutdown path sees every step
+            # shutdown path sees every step.  The wrappers hold the engine
+            # via weakref: the GC finalizer below keeps the WRITER alive
+            # until the engine dies, and a strong capture here would turn
+            # that into engine-keeps-itself-alive.
             _orig_flush = self.summary_writer.flush
-            _orig_close = getattr(self.summary_writer, "close", None)
+            _orig_close = self.summary_writer.close
+            eng_ref = weakref.ref(self)
 
             def _flush_all():
-                self._flush_tensorboard()
+                eng = eng_ref()
+                if eng is not None:
+                    eng._flush_tensorboard()
                 _orig_flush()
+
+            def _close_all():
+                eng = eng_ref()
+                if eng is not None:
+                    eng._flush_tensorboard()
+                _orig_close()
             self.summary_writer.flush = _flush_all
-            if _orig_close is not None:
-                def _close_all():
-                    self._flush_tensorboard()
-                    _orig_close()
-                self.summary_writer.close = _close_all
+            self.summary_writer.close = _close_all
+        # unified telemetry hub (docs/observability.md): metrics registry,
+        # span tracing, compile tracking, memory gauges — all riding the
+        # engine's EXISTING sync points (per-step recording is host-only)
+        self.telemetry = None
+        if config.telemetry_config.enabled and jax.process_index() == 0:
+            from ..telemetry import TelemetryHub
+            tcfg = config.telemetry_config
+            self.telemetry = TelemetryHub(
+                tcfg.output_path or os.path.join(os.getcwd(), "telemetry"),
+                trace=bool(tcfg.trace),
+                compile_events=bool(tcfg.compile_events),
+                memory=bool(tcfg.memory),
+                storm_threshold=tcfg.recompile_storm_threshold,
+                summary_writer=self.summary_writer,
+                process_index=jax.process_index())
+            # per-program retrace counters (track_program skips drivers
+            # without a jit cache, e.g. the chunked offload python loops)
+            for name, fn in (
+                    ("train_step", getattr(self, "_train_step", None)),
+                    ("eval_step", getattr(self, "_eval_step", None)),
+                    ("grad_step", getattr(self, "_grad_step", None)),
+                    ("offload_eval_step",
+                     getattr(self, "_offload_eval_step", None))):
+                if fn is not None:
+                    self.telemetry.track_program(name, fn)
+            if self._onebit_steps is not None:
+                self.telemetry.track_program(
+                    "onebit_warm", self._onebit_steps[0])
+                self.telemetry.track_program(
+                    "onebit_frozen", self._onebit_steps[1])
+            if self.telemetry.tracer is not None:
+                # offload D2H pulls emit transfer spans (module-level
+                # hook: the last telemetry-enabled engine wins)
+                from .offload import set_transfer_tracer
+                set_transfer_tracer(self.telemetry.tracer)
+        # GC/exit finalizer: buffered scalars and the trace file survive a
+        # dropped engine even when close() is never called explicitly.
+        # Holds only the output objects (not the engine — see the weakref
+        # wrappers above), so the engine itself stays collectable.
+        self._finalizer = None
+        _closeables = tuple(
+            c for c in (self.summary_writer, self.telemetry)
+            if c is not None)
+        if _closeables:
+            # the finalizer gets the buffer LIST (drained in place), the
+            # raw writer, and the tracer so a dropped engine still
+            # flushes its scalars and releases the process-wide hook
+            self._finalizer = weakref.finalize(
+                self, _close_quietly, _closeables,
+                tb_pending=self._tb_pending,
+                writer=self.summary_writer,
+                tracer=(self.telemetry.tracer
+                        if self.telemetry is not None else None))
         # xplane trace window (jax.profiler) — the TPU-native tracer slot
         # the reference leaves empty (SURVEY §5.1)
         self._profiler = None
@@ -904,6 +967,15 @@ class DeepSpeedEngine:
                  f"max_rel={max_rel:.3e}", ranks=[0])
         return {"max_abs_diff": max_abs, "max_rel_diff": max_rel}
 
+    def _tel_span(self, name: str, cat: str = "runtime", **args):
+        """Telemetry span context — a nullcontext when telemetry is off,
+        so call sites stay unconditional.  Host-side stamps only; never
+        a device sync."""
+        tel = getattr(self, "telemetry", None)
+        if tel is None:
+            return contextlib.nullcontext()
+        return tel.span(name, cat=cat, **args)
+
     def _profiler_window_tick(self):
         """Open/close the xplane capture window around train_batch calls:
         steps ``[start_step, start_step + num_steps)`` are traced."""
@@ -926,8 +998,12 @@ class DeepSpeedEngine:
         training ends inside the capture window)."""
         if not self._profiler_active:
             return
-        _ = self.last_metrics  # device sync: the window must contain the work
-        jax.profiler.stop_trace()
+        with self._tel_span("profiler/stop_trace", cat="profiler",
+                            step=self.global_steps):
+            # device sync: the window must contain the work — one of the
+            # engine's existing sync points telemetry rides
+            _ = self.last_metrics
+            jax.profiler.stop_trace()
         self._profiler_active = False
         path = self._profiler.output_path
         self._profiler = None
@@ -2026,15 +2102,23 @@ class DeepSpeedEngine:
                 # explicitly rather than sniffed by container type, so a
                 # model whose parameter tree is a top-level list cannot
                 # be misrouted into step_local
-                lowp = self._host_opt.step_local(grads.blocks)
+                with self._tel_span("offload/host_adam", cat="offload"):
+                    lowp = self._host_opt.step_local(grads.blocks)
             else:
-                lowp = self._host_opt.step(
-                    self._reshard_to_master(grads))
-            self._compute_params = self._sharded_gather(lowp)
+                with self._tel_span("offload/host_adam", cat="offload"):
+                    lowp = self._host_opt.step(
+                        self._reshard_to_master(grads))
+            with self._tel_span("offload/h2d_params", cat="offload"):
+                self._compute_params = self._sharded_gather(lowp)
             return
-        lowp = self._host_opt.step(grads)
-        self._compute_params = _device_put_tree(
-            lowp, self._compute_shardings)
+        # host_adam covers the grad D2H pulls too (the optimizer's
+        # prefetch puller overlaps them with the C++ Adam); per-leaf
+        # transfer spans come from offload.set_transfer_tracer
+        with self._tel_span("offload/host_adam", cat="offload"):
+            lowp = self._host_opt.step(grads)
+        with self._tel_span("offload/h2d_params", cat="offload"):
+            self._compute_params = _device_put_tree(
+                lowp, self._compute_shardings)
 
     def _dpu_flush(self):
         """Apply a pending delayed update (checkpoint save, eval, and
@@ -2086,13 +2170,17 @@ class DeepSpeedEngine:
                 # mid-training fails cleanly.  Sharded tier: each process
                 # stashes only its dedup'd dp-shard blocks.
                 if getattr(self, "_offload_sharded", False):
-                    self._dpu_pending = _HostBlockStash(
-                        self._host_opt.pull_local(
-                            self._reshard_to_master(grads)))
+                    with self._tel_span("offload/d2h_grads",
+                                        cat="offload"):
+                        self._dpu_pending = _HostBlockStash(
+                            self._host_opt.pull_local(
+                                self._reshard_to_master(grads)))
                 else:
                     self._start_small_leaf_d2h(grads)
                     from .offload import guarded_tree_pull
-                    self._dpu_pending = guarded_tree_pull(grads)
+                    with self._tel_span("offload/d2h_grads",
+                                        cat="offload"):
+                        self._dpu_pending = guarded_tree_pull(grads)
         else:
             finite_b = bool(finite)
             if finite_b:
@@ -2360,12 +2448,18 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.update_state(self.global_steps)
             batch = dict(batch)
             batch["pld_theta"] = np.full(
-                (np.asarray(next(iter(batch.values()))).shape[0],),
+                (len(next(iter(batch.values()))),),
                 self.progressive_layer_drop.get_theta(), np.float32)
         if self.timers is not None:
             self.timers("train_batch_data").start()
         self._profiler_window_tick()
-        sharded = self._shard_batch(batch)
+        # telemetry spans are HOST-side stamps (time.perf_counter + a
+        # list append): a dispatch span measures enqueue latency, and the
+        # periodic on_sync below emits the synced ground truth — zero
+        # device syncs are added per step (the acceptance contract
+        # tests/test_telemetry.py::test_train_batch_adds_zero_device_syncs)
+        with self._tel_span("train/shard_batch", cat="data"):
+            sharded = self._shard_batch(batch)
         if self._pg_check_pending:
             # first-step sweep, before any update mutates the state
             self._pg_check_pending = False
@@ -2373,32 +2467,42 @@ class DeepSpeedEngine:
         if self.timers is not None:
             self.timers("train_batch_data").stop()
             self.timers("train_batch_step").start()
-        if self._offload_host:
-            metrics = self._train_batch_offload(sharded)
-            self._last_metrics = metrics
-            loss_out = metrics.loss
-        else:
-            step_fn = self._train_step if self._onebit_steps is None \
-                else self._select_onebit_step()
-            with self._pallas_scope():
-                self.state, packed = step_fn(self.state, sharded)
-            # NO host sync here: every np.asarray is a full round-trip
-            # (expensive through the axon tunnel) and a serialization
-            # point.  The packed metrics vector stays on device; steps
-            # queue back-to-back and the transfer latency overlaps with
-            # compute.  ``last_metrics`` materializes on demand, and the
-            # steps_per_print report is the periodic sync (the reference
-            # likewise returns the live loss tensor, engine.py:818).
-            self._last_packed = packed
-            self._last_metrics = None
-            loss_out = packed[0]
+        # step arg uses the POST-increment number so the span correlates
+        # with record_step / on_sync / the report line for the same batch
+        with self._tel_span("train/dispatch", cat="train",
+                            step=self.global_steps + 1):
+            if self._offload_host:
+                metrics = self._train_batch_offload(sharded)
+                self._last_metrics = metrics
+                loss_out = metrics.loss
+            else:
+                step_fn = self._train_step if self._onebit_steps is None \
+                    else self._select_onebit_step()
+                with self._pallas_scope():
+                    self.state, packed = step_fn(self.state, sharded)
+                # NO host sync here: every np.asarray is a full round-trip
+                # (expensive through the axon tunnel) and a serialization
+                # point.  The packed metrics vector stays on device; steps
+                # queue back-to-back and the transfer latency overlaps with
+                # compute.  ``last_metrics`` materializes on demand, and the
+                # steps_per_print report is the periodic sync (the reference
+                # likewise returns the live loss tensor, engine.py:818).
+                self._last_packed = packed
+                self._last_metrics = None
+                loss_out = packed[0]
         if self.timers is not None:
             # materializing the metrics is the device sync
             _ = self.last_metrics
             self.timers("train_batch_step").stop()
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
-        self._step_times.append(time.time() - t0)
+        # dispatch-only delta by design — the _report path measures the
+        # synced interval; see the baselined jaxlint JL006 finding
+        dispatch_s = time.time() - t0
+        self._step_times.append(dispatch_s)
+        if self.telemetry is not None:
+            self.telemetry.record_step(self.global_steps, dispatch_s,
+                                       samples=int(self.train_batch_size))
         if self.summary_writer is not None:
             # buffer the (device) packed metrics; materializing per step
             # would force a full device sync every step and negate the
@@ -2414,24 +2518,47 @@ class DeepSpeedEngine:
         if self.global_steps % self.config.steps_per_print == 0:
             if self.timers is not None:
                 self.timers.log(["train_batch_data", "train_batch_step"])
+            # interval bookkeeping BEFORE _report (which resets it): the
+            # telemetry sync reuses the same synced wall-clock window
+            prev_t = getattr(self, "_last_report", None)
+            prev_step = getattr(self, "_last_report_step", 0)
             self._report(self.last_metrics)
             self._flush_tensorboard()
+            if self.telemetry is not None:
+                self._telemetry_sync(prev_t, prev_step)
         return loss_out
+
+    def _telemetry_sync(self, prev_t, prev_step):
+        """Telemetry's periodic drain, riding the steps_per_print sync
+        that ``_report``'s metrics materialization already paid for:
+        synced step-time histogram, memory gauges, compile samples,
+        exporter flushes.  The first interval has no synced baseline
+        (prev_t is None) and records no step-time sample — dispatch
+        times would inflate samples/sec by orders of magnitude
+        (engine._report's rule)."""
+        m = self.last_metrics
+        steps = self.global_steps - prev_step
+        interval = (self._last_report - prev_t) if prev_t is not None \
+            else None
+        scalars = {}
+        if m is not None:
+            scalars = {"loss": float(m.loss),
+                       "grad_norm": float(m.grad_norm),
+                       "loss_scale": float(m.loss_scale),
+                       "lr": float(m.lr)}
+        self.telemetry.on_sync(
+            self.global_steps,
+            interval_s=interval,
+            steps=steps if interval is not None else None,
+            samples_per_step=int(self.train_batch_size),
+            scalars=scalars)
 
     def _flush_tensorboard(self):
         if self.summary_writer is None or not self._tb_pending:
             return
-        for step, rec in self._tb_pending:
-            if isinstance(rec, StepMetrics):
-                loss, lr, scale = rec.loss, rec.lr, rec.loss_scale
-            else:
-                vec = np.asarray(rec)
-                loss, lr, scale = vec[0], vec[4], vec[2]
-            self.summary_writer.add_scalar("Train/loss", float(loss), step)
-            self.summary_writer.add_scalar("Train/lr", float(lr), step)
-            self.summary_writer.add_scalar("Train/loss_scale", float(scale),
-                                           step)
-        self._tb_pending = []
+        # in-place drain: the GC finalizer holds this SAME list object,
+        # so rebinding here would desynchronize the two paths
+        _drain_tb_pending(self._tb_pending, self.summary_writer)
 
     def _training_iter(self):
         """Persistent iterator over the training dataloader (a fresh
@@ -2536,9 +2663,11 @@ class DeepSpeedEngine:
         elif self._offload_xla:
             self._xla_dpu_flush()
         from .checkpointing import save_checkpoint
-        return save_checkpoint(self, save_dir, tag=tag,
-                               client_state=client_state,
-                               save_latest=save_latest)
+        with self._tel_span("checkpoint/save", cat="checkpoint",
+                            step=self.global_steps):
+            return save_checkpoint(self, save_dir, tag=tag,
+                                   client_state=client_state,
+                                   save_latest=save_latest)
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_optimizer_states=True,
@@ -2548,17 +2677,46 @@ class DeepSpeedEngine:
         # offload host-state sync happens inside load_checkpoint itself so
         # the public runtime.checkpointing API is consistent when called
         # directly (advisor finding, round 1)
-        out = load_checkpoint(
-            self, load_dir, tag=tag,
-            load_optimizer_states=load_optimizer_states,
-            load_lr_scheduler_states=load_lr_scheduler_states,
-            load_module_only=load_module_only)
+        with self._tel_span("checkpoint/load", cat="checkpoint"):
+            out = load_checkpoint(
+                self, load_dir, tag=tag,
+                load_optimizer_states=load_optimizer_states,
+                load_lr_scheduler_states=load_lr_scheduler_states,
+                load_module_only=load_module_only)
         # a successful load rebuilt self.state wholesale (module-only
         # loads get a fresh optimizer plane), so a donation-poisoned
         # engine is healthy again — the poison message's own recovery
         # instruction must actually work on this engine instance
         self._fatal_state_error = None
         return out
+
+    # ------------------------------------------------------------------
+    # shutdown
+    # ------------------------------------------------------------------
+    def close(self):
+        """Flush and close the engine's observability outputs: buffered
+        tensorboard scalars, the telemetry hub (exports the Chrome
+        trace), and an open xplane window.  Idempotent; the GC finalizer
+        registered at construction covers engines that are dropped
+        without an explicit close, so buffered ``_tb_pending`` scalars
+        are never lost either way."""
+        try:
+            self.stop_profiler()  # no-op unless a window is open
+        except Exception:
+            pass
+        self._flush_tensorboard()
+        tel = getattr(self, "telemetry", None)
+        if tel is not None:
+            from . import offload
+            if tel.tracer is not None \
+                    and offload._TRANSFER_TRACER is tel.tracer:
+                offload.set_transfer_tracer(None)
+            tel.close()
+        if self.summary_writer is not None:
+            self.summary_writer.close()
+        if getattr(self, "_finalizer", None) is not None:
+            self._finalizer.detach()
+            self._finalizer = None
 
     # ------------------------------------------------------------------
     # introspection / logging
@@ -2722,6 +2880,48 @@ class DeepSpeedEngine:
             f"loss_scale={float(metrics.loss_scale):.1f} "
             f"skipped={self.get_skipped_steps()} "
             f"samples/sec={tput:.1f}", ranks=[0])
+
+
+def _drain_tb_pending(pending, writer):
+    """Flush buffered (step, packed-metrics) records into the summary
+    writer.  Mutates ``pending`` IN PLACE (clear, not rebind) so the GC
+    finalizer — which holds the same list object — always sees the live
+    buffer.  One definition shared by engine._flush_tensorboard and the
+    finalizer path."""
+    for step, rec in pending:
+        if isinstance(rec, StepMetrics):
+            loss, lr, scale = rec.loss, rec.lr, rec.loss_scale
+        else:
+            vec = np.asarray(rec)
+            loss, lr, scale = vec[0], vec[4], vec[2]
+        writer.add_scalar("Train/loss", float(loss), step)
+        writer.add_scalar("Train/lr", float(lr), step)
+        writer.add_scalar("Train/loss_scale", float(scale), step)
+    pending.clear()
+
+
+def _close_quietly(objs, tb_pending=None, writer=None, tracer=None):
+    """GC-finalizer body: drain buffered scalars, clear the process-wide
+    transfer-tracer hook if it is ours, close observability outputs.
+    Never raises (runs during interpreter shutdown, where half the world
+    may be gone)."""
+    try:
+        if tb_pending and writer is not None:
+            _drain_tb_pending(tb_pending, writer)
+    except Exception:
+        pass
+    try:
+        if tracer is not None:
+            from . import offload
+            if offload._TRANSFER_TRACER is tracer:
+                offload.set_transfer_tracer(None)
+    except Exception:
+        pass
+    for obj in objs:
+        try:
+            obj.close()
+        except Exception:
+            pass
 
 
 class _CallableInt(int):

@@ -30,6 +30,7 @@ flow, exactly what the reference does in Python, stage2.py:1341-1362).
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -41,6 +42,30 @@ import numpy as np
 
 from ..ops.cpu_adam import DeepSpeedCPUAdam, is_adam_float, lowp_np_dtype
 from ..utils.logging import logger
+
+# ---------------------------------------------------------------------------
+# telemetry hook: per-pull transfer spans.  Module-level because the pull
+# helpers below are free functions shared by both offload tiers; the
+# engine installs its hub's tracer at construction (last telemetry-
+# enabled engine wins — acceptable for a process-wide transfer log).
+# Spans stamp host wall-clock around calls that ALREADY block on the
+# transfer, so no sync is added anywhere.
+# ---------------------------------------------------------------------------
+_TRANSFER_TRACER = None
+
+
+def set_transfer_tracer(tracer):
+    """Install (or clear, with None) the tracer that receives
+    ``offload/d2h`` spans from the guarded pull helpers."""
+    global _TRANSFER_TRACER
+    _TRANSFER_TRACER = tracer
+
+
+def _transfer_span(name: str, **args):
+    tracer = _TRANSFER_TRACER
+    if tracer is None:
+        return contextlib.nullcontext()
+    return tracer.span(name, cat="transfer", **args)
 
 
 def _watchdog_get(x, timeout_s: float, what: str = "D2H transfer"):
@@ -134,27 +159,29 @@ def chunked_device_get(x, chunk_mb: Optional[float] = None,
 
     if not isinstance(x, jax.Array):
         return _deliver(np.asarray(x))
-    if piece_timeout <= 0:
-        return _deliver(np.asarray(jax.device_get(x)))
-    if chunk_bytes <= 0 or x.nbytes <= chunk_bytes or x.ndim == 0:
-        return _deliver(_watchdog_get(x, piece_timeout, what))
-    dt = np.dtype(x.dtype)
-    elems_per = max(1, chunk_bytes // dt.itemsize)
-    flat = x.reshape(-1)
-    n = flat.shape[0]
-    if out is None:
-        out = np.empty(x.shape, dt)
-    if out.flags.c_contiguous and out.size == n:
-        out_flat = out.reshape(-1)
-    else:  # exotic destination: pull to a temp flat, assign once
-        out_flat = np.empty(n, out.dtype)
-    for start in range(0, n, elems_per):
-        out_flat[start:start + elems_per] = _watchdog_get(
-            flat[start:start + elems_per], piece_timeout,
-            f"{what} piece [{start}:{start + elems_per}]")
-    if out_flat.base is not out and out_flat is not out:
-        out[...] = out_flat.reshape(out.shape)
-    return out
+    with _transfer_span("offload/d2h", what=what,
+                        bytes=int(getattr(x, "nbytes", 0))):
+        if piece_timeout <= 0:
+            return _deliver(np.asarray(jax.device_get(x)))
+        if chunk_bytes <= 0 or x.nbytes <= chunk_bytes or x.ndim == 0:
+            return _deliver(_watchdog_get(x, piece_timeout, what))
+        dt = np.dtype(x.dtype)
+        elems_per = max(1, chunk_bytes // dt.itemsize)
+        flat = x.reshape(-1)
+        n = flat.shape[0]
+        if out is None:
+            out = np.empty(x.shape, dt)
+        if out.flags.c_contiguous and out.size == n:
+            out_flat = out.reshape(-1)
+        else:  # exotic destination: pull to a temp flat, assign once
+            out_flat = np.empty(n, out.dtype)
+        for start in range(0, n, elems_per):
+            out_flat[start:start + elems_per] = _watchdog_get(
+                flat[start:start + elems_per], piece_timeout,
+                f"{what} piece [{start}:{start + elems_per}]")
+        if out_flat.base is not out and out_flat is not out:
+            out[...] = out_flat.reshape(out.shape)
+        return out
 
 
 class _PrefetchPuller:

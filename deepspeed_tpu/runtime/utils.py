@@ -40,32 +40,64 @@ def see_memory_usage(message: str = "", force: bool = False) -> str:
     return memory_status(message)
 
 
-def memory_status(message: str = "") -> str:
+def collect_memory_stats() -> dict:
+    """Structured device + host memory snapshot — the ONE collection
+    path shared by the ``memory_status`` log line and the telemetry
+    gauges (``telemetry.memory.MemorySampler``), so neither re-parses
+    the other's formatting.
+
+    Returns ``{"devices": [{"id", "platform", "bytes_in_use",
+    "peak_bytes_in_use", "bytes_limit"}, ...], "host_rss_bytes": int |
+    None}``.  Reads PJRT allocator bookkeeping (``memory_stats()``) and
+    ``/proc/self/status`` only — never drains the device, so it is safe
+    to call at the engine's sync cadence without adding a sync."""
     import jax
 
-    parts = []
-    for d in jax.devices()[:8]:
+    devices = []
+    for d in jax.local_devices():
         stats = None
         try:
             stats = d.memory_stats()
-        except Exception:
+        except Exception:  # backend without allocator stats (CPU)
             pass
         if stats:
-            used = stats.get("bytes_in_use", 0) / 2 ** 30
-            peak = stats.get("peak_bytes_in_use", 0) / 2 ** 30
-            lim = stats.get("bytes_limit", 0) / 2 ** 30
-            parts.append(f"{d.id}: {used:.2f}/{lim:.2f}GB peak {peak:.2f}")
+            devices.append({
+                "id": d.id,
+                "platform": getattr(d, "platform", None),
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+            })
+    rss = None
     try:
         with open("/proc/self/status") as f:
             for line in f:
                 if line.startswith("VmRSS"):
-                    rss_gb = int(line.split()[1]) / 2 ** 20
-                    parts.append(f"host RSS {rss_gb:.2f}GB")
+                    rss = int(line.split()[1]) * 1024
                     break
     except OSError:
         pass
-    report = (f"MEMORY {message}: " if message else "MEMORY: ") + \
+    return {"devices": devices, "host_rss_bytes": rss}
+
+
+def format_memory_status(stats: dict, message: str = "") -> str:
+    """Render ``collect_memory_stats()`` output the way ``memory_status``
+    always has (first 8 devices, GiB with peaks, host RSS)."""
+    parts = []
+    for dev in stats.get("devices", [])[:8]:
+        used = (dev.get("bytes_in_use") or 0) / 2 ** 30
+        peak = (dev.get("peak_bytes_in_use") or 0) / 2 ** 30
+        lim = (dev.get("bytes_limit") or 0) / 2 ** 30
+        parts.append(f"{dev['id']}: {used:.2f}/{lim:.2f}GB peak {peak:.2f}")
+    rss = stats.get("host_rss_bytes")
+    if rss is not None:
+        parts.append(f"host RSS {rss / 2 ** 30:.2f}GB")
+    return (f"MEMORY {message}: " if message else "MEMORY: ") + \
         ("; ".join(parts) if parts else "no stats available")
+
+
+def memory_status(message: str = "") -> str:
+    report = format_memory_status(collect_memory_stats(), message)
     from ..utils.logging import log_dist
     log_dist(report, ranks=[0])
     return report

@@ -1,0 +1,27 @@
+"""Unified telemetry for the TPU engine.
+
+    metrics registry  -> Prometheus text / JSONL / SummaryWriter bridge
+    span tracing      -> Chrome/Perfetto trace-event JSON (host-side,
+                         zero added device syncs)
+    compile tracking  -> recompiles_total{program=...} + storm warning
+    memory gauges     -> structured memory_status at sync points
+
+The engine constructs ONE :class:`TelemetryHub` per run when the
+``telemetry`` config block is enabled; see docs/observability.md.
+
+``python -m deepspeed_tpu.telemetry summarize <events.jsonl>`` reports
+p50/p95/p99 step time, samples/sec, and peak HBM offline.
+"""
+from .compile_monitor import CompileMonitor
+from .exporters import (JsonlExporter, SummaryWriterBridge,
+                        prometheus_text, write_prometheus)
+from .hub import TelemetryHub
+from .memory import MemorySampler
+from .registry import Counter, Gauge, Histogram, MetricsRegistry
+from .tracing import SpanHandle, TraceRecorder
+
+__all__ = [
+    "CompileMonitor", "Counter", "Gauge", "Histogram", "JsonlExporter",
+    "MemorySampler", "MetricsRegistry", "SpanHandle", "SummaryWriterBridge",
+    "TelemetryHub", "TraceRecorder", "prometheus_text", "write_prometheus",
+]

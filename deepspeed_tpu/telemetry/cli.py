@@ -1,0 +1,151 @@
+"""``python -m deepspeed_tpu.telemetry summarize events.jsonl``
+
+Offline report over the JSONL event stream the hub writes: p50/p95/p99
+step time, samples/sec, peak HBM.  This module is pure stdlib, but the
+``-m`` entry point imports the ``deepspeed_tpu`` package (which imports
+jax) — on a box without the runtime stack, copy this one file and run
+it directly: ``python cli.py summarize events.jsonl``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = (len(sorted_vals) - 1) * q
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    if v is None:
+        return "n/a"
+    if v < 1e-3:
+        return f"{v * 1e6:.0f}us"
+    if v < 1.0:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v:.3f}s"
+
+
+def _fmt_bytes(v: Optional[float]) -> str:
+    if v is None:
+        return "n/a"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.2f}{unit}"
+        v /= 1024
+    return f"{v:.2f}TiB"
+
+
+def summarize(path: str, out=None) -> dict:
+    # resolve stdout at call time (a definition-time default would pin
+    # the stream captured before any test/redirect wrapping)
+    out = out if out is not None else sys.stdout
+    steps = 0
+    dispatch: List[float] = []
+    synced: List[float] = []
+    sps: List[float] = []
+    peak_hbm: Optional[float] = None
+    host_rss: Optional[float] = None
+    bad_lines = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad_lines += 1
+                continue
+            kind = rec.get("kind")
+            if kind == "step":
+                steps += 1
+                if rec.get("dispatch_s") is not None:
+                    dispatch.append(float(rec["dispatch_s"]))
+            elif kind == "sync":
+                if rec.get("step_avg_s") is not None:
+                    # one synced average per interval; weight by the
+                    # interval's step count so percentiles are per-step
+                    n = int(rec.get("steps") or 1)
+                    synced.extend([float(rec["step_avg_s"])] * n)
+                if rec.get("samples_per_sec") is not None:
+                    sps.append(float(rec["samples_per_sec"]))
+            elif kind == "memory":
+                stats = rec.get("stats") or {}
+                for dev in stats.get("devices", []):
+                    p = dev.get("peak_bytes_in_use")
+                    if p is not None:
+                        peak_hbm = max(peak_hbm or 0, float(p))
+                rss = stats.get("host_rss_bytes")
+                if rss is not None:
+                    host_rss = max(host_rss or 0, float(rss))
+
+    source = "synced intervals"
+    times = sorted(synced)
+    if not times:
+        # dispatch latency is enqueue time, not device step time — still
+        # report it, loudly labelled (the JL006 bug class)
+        source = "DISPATCH-ONLY (no sync events; async enqueue latency, " \
+                 "not device step time)"
+        times = sorted(dispatch)
+    p50 = _percentile(times, 0.50)
+    p95 = _percentile(times, 0.95)
+    p99 = _percentile(times, 0.99)
+    avg_sps = sum(sps) / len(sps) if sps else None
+
+    report = {
+        "steps": steps,
+        "step_time_source": source,
+        "p50_s": p50, "p95_s": p95, "p99_s": p99,
+        "samples_per_sec": avg_sps,
+        "peak_hbm_bytes": peak_hbm,
+        "host_rss_bytes": host_rss,
+        "bad_lines": bad_lines,
+    }
+    print(f"telemetry summary: {path}", file=out)
+    print(f"  steps recorded     {steps}", file=out)
+    print(f"  step time ({source})", file=out)
+    print(f"    p50 {_fmt_s(p50)}  p95 {_fmt_s(p95)}  p99 {_fmt_s(p99)}",
+          file=out)
+    if avg_sps is not None:
+        print(f"  samples/sec        {avg_sps:.1f}", file=out)
+    print(f"  peak HBM           {_fmt_bytes(peak_hbm)}", file=out)
+    if host_rss is not None:
+        print(f"  peak host RSS      {_fmt_bytes(host_rss)}", file=out)
+    if bad_lines:
+        print(f"  (skipped {bad_lines} unparseable lines)", file=out)
+    return report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry",
+        description="offline reports over telemetry event files")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_sum = sub.add_parser("summarize",
+                           help="p50/p95/p99 step time, samples/sec, "
+                                "peak HBM from an events.jsonl")
+    p_sum.add_argument("events", help="path to events.jsonl")
+    args = parser.parse_args(argv)
+    if args.cmd == "summarize":
+        try:
+            summarize(args.events)
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

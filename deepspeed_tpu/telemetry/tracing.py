@@ -1,0 +1,152 @@
+"""Span tracing → Chrome/Perfetto trace-event JSON.
+
+Spans are HOST-side intervals: ``span()`` stamps ``time.perf_counter``
+at enter/exit and appends one complete ("ph": "X") event — no device
+sync anywhere in this module.  For compiled-step work that means a span
+measures *dispatch* latency, which is exactly the point: the engine
+emits a ``train/steps_interval`` span at its periodic ``steps_per_print``
+materialization, and that synced interval is the ground truth the
+per-step dispatch spans are read against (the same discipline as
+``engine._report``; see docs/observability.md).  Unlike the
+``wall_clock_breakdown`` timers, tracing never adds a
+``block_until_ready`` to the step path.
+
+The exported file loads in ``chrome://tracing`` / Perfetto and in
+``json.loads`` — every event carries ``ph``/``ts``/``name`` (the
+acceptance contract tests assert).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class SpanHandle:
+    """An open span; ``end()`` closes it (idempotent).  Used where a
+    ``with`` block cannot bracket the interval — e.g. a span opened at
+    dispatch and closed at the next periodic sync."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_start", "_done")
+
+    def __init__(self, tracer: "TraceRecorder", name: str, cat: str,
+                 args: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._start = tracer._now_us()
+        self._done = False
+
+    def end(self, **extra_args):
+        if self._done:
+            return
+        self._done = True
+        args = dict(self.args or {})
+        args.update(extra_args)
+        self._tracer._emit_complete(self.name, self.cat, self._start,
+                                    self._tracer._now_us() - self._start,
+                                    args or None)
+
+
+class TraceRecorder:
+    """Thread-safe, bounded trace-event buffer.
+
+    ``max_events`` bounds memory for long runs; overflow increments a
+    drop counter that ``export`` records as metadata instead of silently
+    truncating (the no-silent-caps rule)."""
+
+    def __init__(self, process_name: str = "deepspeed_tpu",
+                 pid: int = 0, max_events: int = 200_000):
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._dropped = 0
+        self._origin = time.perf_counter()
+        self.pid = pid
+        self.process_name = process_name
+        self.max_events = max_events
+        self._tids: Dict[int, int] = {}
+
+    # -- clock / ids ----------------------------------------------------
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._origin) * 1e6
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = self._tids[ident] = len(self._tids)
+            return tid
+
+    # -- recording ------------------------------------------------------
+    def _append(self, ev: dict):
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
+    def _emit_complete(self, name: str, cat: str, ts_us: float,
+                       dur_us: float, args: Optional[dict]):
+        ev = {"name": name, "cat": cat, "ph": "X", "pid": self.pid,
+              "tid": self._tid(), "ts": round(ts_us, 3),
+              "dur": round(max(dur_us, 0.0), 3)}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "runtime", **args):
+        handle = SpanHandle(self, name, cat, args or None)
+        try:
+            yield handle
+        finally:
+            handle.end()
+
+    def begin(self, name: str, cat: str = "runtime", **args) -> SpanHandle:
+        return SpanHandle(self, name, cat, args or None)
+
+    def instant(self, name: str, cat: str = "runtime", **args):
+        ev = {"name": name, "cat": cat, "ph": "i", "s": "p",
+              "pid": self.pid, "tid": self._tid(),
+              "ts": round(self._now_us(), 3)}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def counter(self, name: str, values: Dict[str, float],
+                cat: str = "runtime"):
+        """Chrome counter-track event ("ph": "C") — HBM over time renders
+        as a filled graph in the trace viewer."""
+        self._append({"name": name, "cat": cat, "ph": "C", "pid": self.pid,
+                      "tid": 0, "ts": round(self._now_us(), 3),
+                      "args": {k: float(v) for k, v in values.items()}})
+
+    # -- introspection / export -----------------------------------------
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def export(self, path: str):
+        """Write the Chrome trace-event JSON object form."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        meta = [{"name": "process_name", "ph": "M", "pid": self.pid,
+                 "tid": 0, "ts": 0,
+                 "args": {"name": self.process_name}}]
+        payload = {"traceEvents": meta + events,
+                   "displayTimeUnit": "ms"}
+        if dropped:
+            payload["otherData"] = {"dropped_events": dropped}
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path

@@ -22,16 +22,32 @@ def _synchronize():
 
     ``effects_barrier`` alone only waits for *effectful* computations; the
     per-device ``synchronize_all_activity`` is what actually drains pure
-    jitted work from the execution stream."""
+    jitted work from the execution stream.  A device without the PJRT
+    sync hook must not short-circuit the loop (the old ``break`` left
+    every later device undrained — unbounded timed sections); those
+    devices instead get a dispatched token blocked to completion, which
+    rides the per-device in-order execution stream behind any
+    outstanding work."""
     try:
         jax.effects_barrier()
     except Exception:
         pass
+    undrained = []
     for d in jax.local_devices():
         try:
             d.synchronize_all_activity()
         except Exception:  # backend without the PJRT sync hook
-            break
+            undrained.append(d)
+    for d in undrained:
+        try:
+            import jax.numpy as jnp
+            # committed input -> the add executes ON d, queued behind any
+            # outstanding programs on its (in-order) execution stream;
+            # blocking on it therefore bounds the timed section
+            token = jax.device_put(jnp.zeros((), jnp.float32), d)
+            jax.block_until_ready(token + 1.0)
+        except Exception:
+            pass  # diagnostic path: never let timing kill the step
 
 
 class _Timer:

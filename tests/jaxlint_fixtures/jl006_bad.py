@@ -1,0 +1,24 @@
+"""JL006 fixture (bad): wall-clock deltas that bracket async jax
+dispatch with no intervening sync — they time the ENQUEUE, not the
+device."""
+import time
+
+import jax
+
+
+@jax.jit
+def compiled(x):
+    return x * 2
+
+
+def timed_enqueue(x):
+    t0 = time.time()
+    y = compiled(x)              # async dispatch: returns immediately
+    return y, time.time() - t0   # JL006: enqueue latency only
+
+
+def timed_step_driver(step_fn, state, batch):
+    start = time.perf_counter()
+    state = step_fn(state, batch)    # compiled-step naming convention
+    now = time.perf_counter()
+    return state, now - start        # JL006: same bug, two stored reads

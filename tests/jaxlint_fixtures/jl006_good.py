@@ -1,0 +1,30 @@
+"""JL006 fixture (good): timed sections bounded by a sync (or with no
+device work inside at all)."""
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def compiled(x):
+    return x * 2
+
+
+def timed_synced(x):
+    t0 = time.time()
+    y = compiled(x)
+    jax.block_until_ready(y)     # drains the dispatch queue
+    return y, time.time() - t0
+
+
+def timed_materialized(x):
+    t0 = time.time()
+    y = np.asarray(compiled(x))  # materialization is the sync
+    return y, time.time() - t0
+
+
+def timed_pure_python(values):
+    t0 = time.time()
+    total = sum(values)          # no device work timed
+    return total, time.time() - t0

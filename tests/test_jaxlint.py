@@ -40,6 +40,7 @@ def _fixture(name):
     ("JL003", "jl003_bad.py", "jl003_good.py"),
     ("JL004", "jl004_bad.py", "jl004_good.py"),
     ("JL005", "jl005_bad.py", "jl005_good.py"),
+    ("JL006", "jl006_bad.py", "jl006_good.py"),
     ("JL101", os.path.join("jl101", "config_bad.py"),
      os.path.join("jl101", "config_good.py")),
 ])
@@ -80,6 +81,34 @@ def test_jl004_all_side_effect_shapes():
     for needle in ("assignment to 'self.last_state'", "'print'",
                    "'.append'", "'global'"):
         assert needle in joined, (needle, joined)
+
+
+def test_jl006_both_delta_shapes_and_sync_kinds():
+    """Direct-call delta AND two-stored-reads delta fire; every sync
+    shape in the good fixture (block_until_ready, np.asarray
+    materialization, no-device-work) stays silent (covered by the
+    parametrized good-file check; here: exactly the two bad lines)."""
+    findings = [f for f in lint_file(_fixture("jl006_bad.py"))
+                if f.rule == "JL006"]
+    assert len(findings) == 2, [(f.line, f.message) for f in findings]
+    msgs = "\n".join(f.message for f in findings)
+    assert "ENQUEUE latency" in msgs
+    assert "'compiled'" in msgs      # known-jitted callable detected
+    assert "'step_fn'" in msgs       # compiled-step naming heuristic
+
+
+def test_jl006_ignores_traced_bodies():
+    """Clocks inside jit-traced code are JL005's finding, not JL006's."""
+    src = (
+        "import jax, time\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    t0 = time.time()\n"
+        "    y = jax.numpy.sin(x)\n"
+        "    return y, time.time() - t0\n")
+    rules = {f.rule for f in lint_source(src, path="t.py")}
+    assert "JL006" not in rules
+    assert "JL005" in rules
 
 
 def test_jl101_finding_kinds():
@@ -233,5 +262,6 @@ def test_cli_list_rules_covers_all_ids():
         [sys.executable, "-m", "tools.jaxlint", "--list-rules"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
-    for rule_id in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL101"):
+    for rule_id in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
+                    "JL101"):
         assert rule_id in proc.stdout

@@ -1,0 +1,201 @@
+"""utils/timer.py + utils/monitor.py satellites: the _synchronize
+per-device drain fix, ThroughputTimer semantics, and SummaryWriter
+lifecycle hardening."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils import timer as timer_mod
+from deepspeed_tpu.utils.timer import ThroughputTimer
+
+
+# ---------------------------------------------------------------------------
+# _synchronize: continue past devices lacking the PJRT sync hook
+# ---------------------------------------------------------------------------
+
+class _HookDevice:
+    def __init__(self, log):
+        self._log = log
+
+    def synchronize_all_activity(self):
+        self._log.append(self)
+
+
+class _NoHookDevice:
+    pass  # no synchronize_all_activity attribute
+
+
+def test_synchronize_drains_every_device(monkeypatch):
+    """A device without synchronize_all_activity must not short-circuit
+    the loop (the old ``break`` left later devices undrained), and gets
+    the dispatched-token block_until_ready fallback instead."""
+    drained = []
+    no_hook = _NoHookDevice()
+    hooked = _HookDevice(drained)
+    monkeypatch.setattr(timer_mod.jax, "local_devices",
+                        lambda: [no_hook, hooked])
+    fallback_devices = []
+    monkeypatch.setattr(
+        timer_mod.jax, "device_put",
+        lambda x, d: (fallback_devices.append(d), jnp.asarray(x))[1])
+    blocked = []
+    monkeypatch.setattr(timer_mod.jax, "block_until_ready",
+                        lambda x: (blocked.append(x), x)[1])
+    timer_mod._synchronize()
+    assert drained == [hooked], \
+        "device after the hook-less one was not drained"
+    assert fallback_devices == [no_hook]
+    assert len(blocked) == 1
+
+
+def test_synchronize_fallback_failure_is_swallowed(monkeypatch):
+    monkeypatch.setattr(timer_mod.jax, "local_devices",
+                        lambda: [_NoHookDevice()])
+
+    def boom(x, d):
+        raise RuntimeError("no transfers to fake devices")
+    monkeypatch.setattr(timer_mod.jax, "device_put", boom)
+    timer_mod._synchronize()  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# ThroughputTimer (previously untested)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fake_clock(monkeypatch):
+    state = {"t": 100.0}
+
+    def now():
+        state["t"] += 0.25
+        return state["t"]
+    monkeypatch.setattr(timer_mod.time, "time", now)
+    # keep _synchronize out of the fake-clock path entirely
+    monkeypatch.setattr(timer_mod, "_synchronize", lambda: None)
+    return state
+
+
+def test_throughput_timer_warmup_skip(fake_clock):
+    logs = []
+    tt = ThroughputTimer(batch_size=8, start_step=2,
+                         logging_fn=logs.append)
+    tt.start()
+    tt.stop()  # local step 1 < start_step: warmup, not counted
+    assert tt.counted_steps == 0
+    assert tt.total_step_count == 1
+    assert tt.avg_samples_per_sec() == 0.0
+    tt.start()
+    tt.stop()  # step 2: counted
+    assert tt.counted_steps == 1
+    assert tt.avg_samples_per_sec() > 0.0
+
+
+def test_throughput_timer_counted_steps_survive_epochs(fake_clock):
+    tt = ThroughputTimer(batch_size=4, start_step=1,
+                         logging_fn=lambda m: None)
+    for _ in range(3):
+        tt.start()
+        tt.stop()
+    assert tt.counted_steps == 3
+    elapsed_before = tt.total_elapsed_time
+    tt.update_epoch_count()
+    assert tt.local_step_count == 0       # per-epoch counter resets
+    assert tt.counted_steps == 3          # cumulative stats survive
+    assert tt.total_elapsed_time == elapsed_before
+    tt.start()
+    tt.stop()
+    assert tt.counted_steps == 4
+    # rate uses CUMULATIVE elapsed / CUMULATIVE counted steps
+    expect = 4 / (tt.total_elapsed_time / tt.counted_steps)
+    assert tt.avg_samples_per_sec() == pytest.approx(expect)
+
+
+def test_throughput_timer_zero_division_guards(fake_clock):
+    tt = ThroughputTimer(batch_size=4, logging_fn=lambda m: None)
+    assert tt.avg_samples_per_sec() == 0.0     # no steps at all
+    tt.stop()                                  # stop without start: no-op
+    assert tt.counted_steps == 0
+    # counted steps but zero elapsed (frozen clock) must not divide
+    tt2 = ThroughputTimer(batch_size=4, start_step=1,
+                          logging_fn=lambda m: None)
+    tt2.counted_steps = 1
+    tt2.total_elapsed_time = 0.0
+    assert tt2.avg_samples_per_sec() == 0.0
+
+
+def test_throughput_timer_periodic_report(fake_clock):
+    logs = []
+    tt = ThroughputTimer(batch_size=4, start_step=1, steps_per_output=2,
+                         logging_fn=logs.append)
+    for _ in range(4):
+        tt.start()
+        tt.stop()
+    assert len(logs) == 2
+    assert "samples/sec" in logs[0]
+
+
+# ---------------------------------------------------------------------------
+# SummaryWriter lifecycle (forced JSONL fallback: torch import blocked)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def jsonl_writer_cls(monkeypatch):
+    monkeypatch.setitem(sys.modules, "torch", None)  # force the fallback
+    from deepspeed_tpu.utils.monitor import SummaryWriter
+    return SummaryWriter
+
+
+def test_summary_writer_lifecycle(tmp_path, jsonl_writer_cls):
+    w = jsonl_writer_cls(output_path=str(tmp_path), job_name="job")
+    w.add_scalar("Train/loss", 1.5, 1)
+    w.flush()
+    w.flush()            # idempotent
+    w.close()
+    w.close()            # second close: previously died on closed handle
+    assert w.closed
+    w.add_scalar("Train/loss", 2.5, 2)   # post-close: dropped, no raise
+    w.flush()                            # post-close flush: no-op
+    lines = [json.loads(l) for l in
+             open(os.path.join(str(tmp_path), "job", "events.jsonl"))]
+    assert [l["step"] for l in lines] == [1]
+
+
+def test_summary_writer_context_manager(tmp_path, jsonl_writer_cls):
+    with jsonl_writer_cls(output_path=str(tmp_path), job_name="cm") as w:
+        w.add_scalar("t", 1.0, 1)
+    assert w.closed
+    lines = open(os.path.join(str(tmp_path), "cm", "events.jsonl")).read()
+    assert '"t"' in lines
+
+
+def test_engine_close_flushes_buffered_scalars(tmp_path, monkeypatch):
+    """Buffered _tb_pending scalars (steps_per_print never reached) land
+    in the writer on engine.close() instead of being lost."""
+    monkeypatch.setitem(sys.modules, "torch", None)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from simple_model import SimpleModel, base_config
+    import deepspeed_tpu
+    cfg = base_config(micro_bs=2, grad_acc=1, stage=0)
+    cfg["steps_per_print"] = 10 ** 9
+    cfg["tensorboard"] = {"enabled": True, "output_path": str(tmp_path),
+                          "job_name": "close_test"}
+    eng, *_ = deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=16),
+                                       config=cfg)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((int(eng.train_batch_size), 16)) \
+        .astype(np.float32)
+    eng.train_batch((x, 0.5 * x))
+    eng.train_batch((x, 0.5 * x))
+    assert eng._tb_pending, "scalars should be buffered pre-sync"
+    eng.close()
+    eng.close()  # idempotent
+    path = os.path.join(str(tmp_path), "close_test", "events.jsonl")
+    steps = sorted({json.loads(l)["step"] for l in open(path)})
+    assert steps == [1, 2]
+    tags = {json.loads(l)["tag"] for l in open(path)}
+    assert "Train/loss" in tags

@@ -68,12 +68,17 @@ def _str_tuple(node) -> Tuple[str, ...]:
     return ()
 
 
-def is_wrapper_ref(node) -> bool:
-    text = dotted(node)
+def is_wrapper_text(text: Optional[str]) -> bool:
+    """jit-wrapper spelling check on a dotted string (the one
+    definition; ``is_wrapper_ref`` is the AST-node view of it)."""
     if text is None:
         return False
     return text in WRAPPER_TEXTS or (text.split(".")[-1] in WRAPPER_LAST
                                      and text.startswith("jax."))
+
+
+def is_wrapper_ref(node) -> bool:
+    return is_wrapper_text(dotted(node))
 
 
 @dataclasses.dataclass

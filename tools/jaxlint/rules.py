@@ -10,7 +10,7 @@ import os
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .core import Finding, ModuleContext, Rule, register
-from .jitmodel import _FUNC_DEFS, dotted, is_wrapper_ref
+from .jitmodel import _FUNC_DEFS, dotted, is_wrapper_ref, is_wrapper_text
 
 
 def scope_walk(root):
@@ -406,6 +406,140 @@ class RecompilationRule(Rule):
                         "once at trace time — a frozen constant at best, a "
                         "shape-varying recompile trigger at worst; pass the "
                         "value in as an argument")
+
+
+# ---------------------------------------------------------------------------
+# JL006 — dispatch-only timing
+# ---------------------------------------------------------------------------
+
+_JL006_CLOCKS = {"time.time", "time.time_ns", "time.perf_counter",
+                 "time.monotonic"}
+# calls that drain (or materialize) device work, bounding a timed
+# section — JL001's sync sets plus the drain-only spellings that are
+# fine under trace but DO bound a host-side timed window (derived, not
+# re-listed, so a new sync spelling teaches both rules)
+_JL006_SYNC_CALLS = _SYNC_CALLS | {"jax.block_until_ready",
+                                   "jax.effects_barrier"}
+_JL006_SYNC_METHODS = _SYNC_METHODS | {"synchronize_all_activity"}
+# jax.* namespaces that never enqueue device work worth timing
+_JL006_JAX_EXCLUDE = ("jax.tree", "jax.tree_util", "jax.profiler",
+                      "jax.config", "jax.debug", "jax.monitoring",
+                      "jax.sharding", "jax.eval_shape")
+
+
+@register
+class DispatchOnlyTimingRule(Rule):
+    id = "JL006"
+    summary = "wall-clock delta brackets async jax dispatch with no sync"
+
+    # Under jax's async dispatch, ``t0 = time.time(); y = step(x);
+    # dt = time.time() - t0`` measures ENQUEUE latency, not device step
+    # time — samples/sec derived from it inflates by orders of magnitude
+    # (the engine documents exactly this bug class for ``_step_times``).
+    # The timed section is bounded only if something between the two
+    # clock reads drains the device (block_until_ready / device_get /
+    # np.asarray / a synchronize helper).
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        jit = ctx.jit
+        scopes = [ctx.tree] + list(jit.defs)
+        for scope in scopes:
+            # traced bodies are JL005's territory (clocks there freeze at
+            # trace time; "dispatch-only" timing is a host-side bug)
+            if scope in jit.reachable_defs:
+                continue
+            yield from self._check_scope(ctx, jit, scope)
+
+    # -- classification --------------------------------------------------
+    @staticmethod
+    def _is_clock_call(node) -> bool:
+        return (isinstance(node, ast.Call)
+                and _call_text(node) in _JL006_CLOCKS)
+
+    def _is_sync(self, text: Optional[str], node: ast.Call) -> bool:
+        if text in _JL006_SYNC_CALLS:
+            return True
+        last = text.split(".")[-1] if text else ""
+        if last in _JL006_SYNC_METHODS:
+            return True
+        if "synchronize" in last.lower():
+            return True  # _synchronize()-style helpers
+        if text in _SYNC_BUILTINS and len(node.args) == 1 \
+                and not isinstance(node.args[0], ast.Constant):
+            return True  # float(x)/int(x) materializes
+        return False
+
+    def _is_dispatch(self, jit, text: Optional[str], scope) -> bool:
+        if text is None:
+            return False
+        fn_scope = scope if not isinstance(scope, ast.Module) else None
+        if jit.lookup_callable(text, fn_scope) is not None:
+            return True  # known jitted callable
+        last = text.split(".")[-1]
+        if last.endswith("_step") or last in ("step_fn",) \
+                or last.endswith("_jit"):
+            return True  # compiled-step driver naming convention
+        if text.startswith("jax.") and not is_wrapper_text(text) \
+                and not any(text.startswith(p) for p in _JL006_JAX_EXCLUDE):
+            return True  # direct jax op/dispatch
+        return False
+
+    # -- the scan --------------------------------------------------------
+    def _check_scope(self, ctx, jit, scope):
+        clock_stores: Dict[str, List[int]] = {}
+        syncs: List[int] = []
+        dispatches: List[Tuple[int, str]] = []
+        deltas: List[ast.BinOp] = []
+        for node in scope_walk(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = dotted(node.targets[0])
+                if tgt is not None and self._is_clock_call(node.value):
+                    clock_stores.setdefault(tgt, []).append(node.lineno)
+            if isinstance(node, ast.Call):
+                text = _call_text(node)
+                if text in _JL006_CLOCKS:
+                    continue
+                if self._is_sync(text, node):
+                    syncs.append(node.lineno)
+                elif self._is_dispatch(jit, text, scope):
+                    dispatches.append((node.lineno, text))
+            elif isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                            ast.Sub):
+                deltas.append(node)
+        if not clock_stores or not dispatches:
+            return
+        for delta in deltas:
+            rhs = dotted(delta.right)
+            starts = clock_stores.get(rhs, []) if rhs else []
+            starts = [ln for ln in starts if ln < delta.lineno]
+            if not starts:
+                continue
+            start = max(starts)
+            # left side must read a clock: a direct call, or a name the
+            # scope stored a later clock read into
+            if self._is_clock_call(delta.left):
+                end = delta.lineno
+            else:
+                lhs = dotted(delta.left)
+                ends = [ln for ln in clock_stores.get(lhs, [])
+                        if start < ln <= delta.lineno] if lhs else []
+                if not ends:
+                    continue
+                end = max(ends)
+            window = [(ln, t) for ln, t in dispatches if start < ln <= end]
+            if not window:
+                continue
+            if any(start < ln <= end for ln in syncs):
+                continue
+            _, first_dispatch = min(window)
+            yield self.finding(
+                ctx, delta,
+                f"wall-clock delta over '{rhs}' (line {start}) brackets "
+                f"the async dispatch '{first_dispatch}' with no "
+                "intervening sync: under jax async dispatch this measures "
+                "ENQUEUE latency, not device time — block_until_ready (or "
+                "materialize a result) before reading the clock, or time "
+                "a synced interval instead")
 
 
 # ---------------------------------------------------------------------------

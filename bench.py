@@ -303,6 +303,93 @@ def _bench_124m(jax):
     return cfg_model, seq, tokens_per_sec, "gpt2_124m_zero0"
 
 
+def bench_offload_pipeline(jax, pipeline_on: bool, steps: int = None):
+    """A/B one leg of the streaming offload update pipeline (host tier):
+    per-stage step-time breakdown (d2h / cpu_adam / h2d / hidden) plus
+    measured step wall time.  The breakdown comes from the engine's
+    ``last_offload_breakdown`` host timestamps — d2h is the prefetch
+    puller's transfer time (already overlapped with the Adam), h2d the
+    per-leaf upload time, hidden the part of h2d that ran under the Adam
+    window (the pipeline's win; 0 by construction on the serial leg).
+
+    Size is platform-scaled: tiny on CPU (a smoke the tier-1 suite runs
+    with an injected slow-transfer delay to prove overlap > 0), mid-size
+    on TPU via BENCH_PIPE_* knobs so one healthy tunnel window banks the
+    A/B number in a single run."""
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.config import DeepSpeedConfig
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        d_model = int(os.environ.get("BENCH_PIPE_D_MODEL", "1024"))
+        n_layer = int(os.environ.get("BENCH_PIPE_LAYERS", "12"))
+        micro = int(os.environ.get("BENCH_PIPE_MICRO", "4"))
+        seq, vocab, remat = 1024, 50257, "block"
+        steps = steps or int(os.environ.get("BENCH_PIPE_STEPS", "3"))
+    else:
+        d_model, n_layer, micro = 64, 2, 2
+        seq, vocab, remat = 64, 256, None
+        steps = steps or 2
+    cfg_model = GPT2Config(d_model=d_model, n_layer=n_layer,
+                           n_head=max(2, d_model // 64), vocab_size=vocab,
+                           n_positions=seq, remat=remat)
+    mesh = build_mesh(devices=jax.devices()[:1])
+    ds_cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "offload_impl": "host",
+                              "offload_pipeline": pipeline_on},
+    }, world_size=1)
+    _mark(f"offload-pipeline[{'on' if pipeline_on else 'off'}]: "
+          "constructing engine")
+    engine = DeepSpeedEngine(GPT2Model(cfg_model), ds_cfg, mesh=mesh)
+    tokens = np.random.default_rng(0).integers(
+        0, vocab, (micro, seq + 1), dtype=np.int32)
+    tokens = _device_resident(engine, tokens)
+    np.asarray(engine.train_batch(tokens))  # warmup/compile
+    acc = {"d2h_s": 0.0, "cpu_adam_s": 0.0, "h2d_s": 0.0,
+           "h2d_hidden_s": 0.0, "h2d_tail_s": 0.0, "overlap_ratio": 0.0}
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = float(np.asarray(engine.train_batch(tokens)))
+        bd = engine.last_offload_breakdown
+        for k in acc:
+            acc[k] += bd[k]
+    dt = (time.perf_counter() - t0) / steps
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+    out = {k: round(v / steps, 6) for k, v in acc.items()}
+    out["step_s"] = round(dt, 6)
+    out["pipeline"] = "on" if pipeline_on else "off"
+    _mark(f"offload-pipeline[{out['pipeline']}]: {dt:.3f}s/step, "
+          f"overlap {out['overlap_ratio'] * 100:.0f}%")
+    return out
+
+
+def _offload_pipeline_ab(jax, mode: str):
+    """``--offload-pipeline={on,off,ab}``: run the requested leg(s) and
+    print ONE JSON line with the per-stage breakdown(s)."""
+    legs = {"on": [True], "off": [False], "ab": [True, False]}[mode]
+    results = [bench_offload_pipeline(jax, leg) for leg in legs]
+    rec = {"metric": "offload_pipeline_step_breakdown",
+           "unit": "s/step",
+           "legs": results}
+    if len(results) == 2:
+        off_t, on_t = results[1]["step_s"], results[0]["step_s"]
+        rec["speedup"] = round(off_t / on_t, 4) if on_t > 0 else 0.0
+    try:
+        with open("BENCH_offload_pipeline.json", "w") as f:
+            json.dump(rec, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(rec), flush=True)
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache shared across bench runs.  The
     1.5B program (48-layer scan + offload staging) is compile-heavy and
@@ -376,11 +463,30 @@ def guarded_devices():
 
 
 def main():
+    import argparse
+
     import jax
+
+    parser = argparse.ArgumentParser(
+        description="GPT-2 1.5B ZeRO-2 offload north-star bench "
+                    "(one JSON line); env knobs in the module docstring")
+    parser.add_argument("--offload-pipeline", choices=("on", "off", "ab"),
+                        default=None,
+                        help="A/B the streaming offload update pipeline: "
+                             "per-stage step-time breakdown (d2h / "
+                             "cpu_adam / h2d / hidden) instead of the "
+                             "north-star bench")
+    # strict parse: a typo'd flag must fail loudly, not silently launch
+    # the multi-hour north-star run (the _15b_knobs eager-validation rule)
+    args = parser.parse_args()
 
     devices = guarded_devices()
     on_tpu = devices[0].platform != "cpu"
     sys.path.insert(0, ".")
+
+    if args.offload_pipeline is not None:
+        _offload_pipeline_ab(jax, args.offload_pipeline)
+        return
 
     if not on_tpu:  # CPU smoke (driver runs the real thing on TPU)
         from deepspeed_tpu.models import GPT2Config, GPT2Model

@@ -83,6 +83,13 @@ class DeepSpeedZeroConfig:
         self.offload_split_update = get_scalar_param(
             zero, C.ZERO_OFFLOAD_SPLIT_UPDATE,
             C.ZERO_OFFLOAD_SPLIT_UPDATE_DEFAULT)
+        self.offload_pipeline = get_scalar_param(
+            zero, C.ZERO_OFFLOAD_PIPELINE,
+            C.ZERO_OFFLOAD_PIPELINE_DEFAULT)
+        # default-True knob: only an EXPLICIT offload_pipeline entry is
+        # validated against cpu_offload (the default must not make every
+        # non-offload config invalid); explicit false is always allowed
+        self.offload_pipeline_explicit = C.ZERO_OFFLOAD_PIPELINE in zero
         if (not isinstance(self.offload_grad_chunks, int)
                 or self.offload_grad_chunks < 1):
             raise DeepSpeedConfigError(
@@ -474,6 +481,12 @@ class DeepSpeedConfig:
             if not self.zero_config.cpu_offload:
                 raise DeepSpeedConfigError(
                     "delayed_param_update requires cpu_offload")
+        if (self.zero_config.offload_pipeline_explicit
+                and self.zero_config.offload_pipeline
+                and not self.zero_config.cpu_offload):
+            raise DeepSpeedConfigError(
+                "offload_pipeline requires cpu_offload (it streams the "
+                "host-tier optimizer update)")
         if self.zero_config.param_streaming:
             if not self.zero_config.cpu_offload:
                 raise DeepSpeedConfigError(

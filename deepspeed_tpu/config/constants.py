@@ -174,6 +174,16 @@ ZERO_PARAM_STREAMING_DEFAULT = False
 # program can keep reading the old pieces.
 ZERO_OFFLOAD_SPLIT_UPDATE = "offload_split_update"
 ZERO_OFFLOAD_SPLIT_UPDATE_DEFAULT = False
+# TPU extension (host tier): streaming offload update pipeline — the
+# engine uploads each leaf's updated low-precision copy H2D the moment
+# the C++ Adam writes its block, so while Adam updates leaf i, leaf
+# i+1's gradient D2H is in flight AND leaf i-1's upload is already
+# streaming (the full three-stage overlap of the ZeRO-Offload design;
+# the serial path only overlapped the D2H half).  Numerics identical to
+# the serial path.  Default ON; set false (or DS_OFFLOAD_PIPELINE=0,
+# the no-config escape hatch) to restore the serial post-step upload.
+ZERO_OFFLOAD_PIPELINE = "offload_pipeline"
+ZERO_OFFLOAD_PIPELINE_DEFAULT = True
 ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_ELASTIC_CHECKPOINT_DEFAULT = True
 ZERO_MAX_ELEMENTS_PER_COMM = "max_elements_per_comm"

@@ -113,50 +113,75 @@ class DeepSpeedCPUAdam:
         copies returned as a matching pytree of reinterpreted uint16
         views."""
         import jax
+        _, treedef = jax.tree.flatten(params)
+        outs = []
+        for _i, out in self.step_leaves(params, grads, out_dtype=out_dtype,
+                                        leaf_get=leaf_get):
+            outs.append(out)
+        return (jax.tree.unflatten(treedef, outs)
+                if out_dtype is not None else None)
+
+    def step_leaves(self, params, grads, out_dtype=None, leaf_get=None,
+                    leaf_span=None):
+        """Per-leaf generator form of ``step``: yields ``(i, out_leaf)``
+        the moment leaf ``i``'s master/moment blocks are written — the
+        hook the streaming offload pipeline consumes to start leaf
+        ``i``'s H2D upload while the Adam loop continues on leaf ``i+1``
+        (runtime/offload.py).  ``out_leaf`` is the low-precision view
+        when ``out_dtype`` is set (the leaf itself for non-fp32
+        passthrough state), None otherwise.  ``leaf_span`` (optional):
+        ``leaf_span(i)`` returns a context manager bracketing leaf i's
+        compute — telemetry's per-leaf Adam spans, which the overlap
+        tests read against the per-leaf H2D spans.  The step counter
+        increments once, when iteration starts."""
+        import contextlib
+        import jax
         if leaf_get is None:
             leaf_get = lambda a: np.asarray(a, dtype=np.float32)  # noqa: E731
         self.step_count += 1
         lr = self._lr_now()
-        p_leaves, treedef = jax.tree.flatten(params)
+        p_leaves = jax.tree.leaves(params)
         g_leaves = jax.tree.leaves(grads)
         assert len(p_leaves) == len(g_leaves)
         lowp_kind = {None: _LOWP_NONE, "bfloat16": _LOWP_BF16,
                      "float16": _LOWP_FP16}[out_dtype]
-        outs = []
         for i, (p, g) in enumerate(zip(p_leaves, g_leaves)):
             if p.dtype != np.float32:
                 # non-floating state (step counters, int buffers): no Adam
-                outs.append(p if lowp_kind else None)
+                yield i, (p if lowp_kind else None)
                 continue
-            assert p.flags.c_contiguous, (
-                f"leaf {i} is not C-contiguous; reshape(-1) would update a "
-                "copy and silently drop the result — pass a contiguous "
-                "master buffer")
-            m, v = self._moments(i, p)
-            flat_p = p.reshape(-1)
-            flat_g = np.ascontiguousarray(
-                np.asarray(leaf_get(g), dtype=np.float32).reshape(-1))
-            out = (np.empty(flat_p.shape, np.uint16)
-                   if lowp_kind else np.empty(0, np.uint16))
-            if self._lib is not None:
-                fp = ctypes.POINTER(ctypes.c_float)
-                u16 = ctypes.POINTER(ctypes.c_uint16)
-                self._lib.ds_cpu_adam_step(
-                    flat_p.size, _np_ptr(flat_p, fp), _np_ptr(flat_g, fp),
-                    _np_ptr(m.reshape(-1), fp), _np_ptr(v.reshape(-1), fp),
-                    lr, self.betas[0], self.betas[1], self.eps,
-                    self.weight_decay, int(self.adamw_mode),
-                    int(self.bias_correction), self.step_count,
-                    _np_ptr(out, u16), lowp_kind)
-            else:
-                self._numpy_step(flat_p, flat_g, m.reshape(-1),
-                                 v.reshape(-1), lr, out, lowp_kind)
-            if lowp_kind:
-                outs.append(out.view(lowp_np_dtype(out_dtype))
-                            .reshape(p.shape))
-            else:
-                outs.append(None)
-        return jax.tree.unflatten(treedef, outs) if lowp_kind else None
+            # the span brackets leaf i's COMPUTE only (grad pull + Adam
+            # kernel) — the yield happens outside it, so consumer time
+            # (the pipeline's upload submit) never inflates it
+            with (leaf_span(i) if leaf_span is not None
+                  else contextlib.nullcontext()):
+                assert p.flags.c_contiguous, (
+                    f"leaf {i} is not C-contiguous; reshape(-1) would "
+                    "update a copy and silently drop the result — pass a "
+                    "contiguous master buffer")
+                m, v = self._moments(i, p)
+                flat_p = p.reshape(-1)
+                flat_g = np.ascontiguousarray(
+                    np.asarray(leaf_get(g), dtype=np.float32).reshape(-1))
+                out = (np.empty(flat_p.shape, np.uint16)
+                       if lowp_kind else np.empty(0, np.uint16))
+                if self._lib is not None:
+                    fp = ctypes.POINTER(ctypes.c_float)
+                    u16 = ctypes.POINTER(ctypes.c_uint16)
+                    self._lib.ds_cpu_adam_step(
+                        flat_p.size, _np_ptr(flat_p, fp),
+                        _np_ptr(flat_g, fp),
+                        _np_ptr(m.reshape(-1), fp), _np_ptr(v.reshape(-1), fp),
+                        lr, self.betas[0], self.betas[1], self.eps,
+                        self.weight_decay, int(self.adamw_mode),
+                        int(self.bias_correction), self.step_count,
+                        _np_ptr(out, u16), lowp_kind)
+                else:
+                    self._numpy_step(flat_p, flat_g, m.reshape(-1),
+                                     v.reshape(-1), lr, out, lowp_kind)
+                out_leaf = (out.view(lowp_np_dtype(out_dtype))
+                            .reshape(p.shape) if lowp_kind else None)
+            yield i, out_leaf
 
     # ------------------------------------------------------------------
     def _numpy_step(self, p, g, m, v, lr, out, lowp_kind):

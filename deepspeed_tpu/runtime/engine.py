@@ -552,6 +552,14 @@ class DeepSpeedEngine:
             self._compute_shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), specs,
                 is_leaf=lambda x: isinstance(x, P))
+            # flat-order view + treedef of the compute shardings — the
+            # streaming pipeline uploads leaf-by-leaf against these
+            self._compute_shard_leaves = jax.tree.leaves(
+                self._compute_shardings,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
+            self._compute_treedef = jax.tree.structure(
+                self._compute_shardings,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
             self._offload_sharded = jax.process_count() > 1
             if self._offload_sharded:
                 # multi-host: dp-shard the fp32 master on device, let each
@@ -575,6 +583,15 @@ class DeepSpeedEngine:
                     self._compute_shardings)
             self._dpu = bool(config.zero_config.delayed_param_update)
             self._dpu_pending = None
+            # streaming offload update pipeline (tentpole, docs/
+            # observability.md): while the C++ Adam updates leaf i, leaf
+            # i+1's grad D2H is in flight AND leaf i-1's updated compute
+            # copy is already uploading H2D.  DS_OFFLOAD_PIPELINE=0 is
+            # the escape hatch back to the serial post-step upload.
+            self._offload_pipeline = (
+                bool(getattr(config.zero_config, "offload_pipeline", True))
+                and os.environ.get("DS_OFFLOAD_PIPELINE", "1") != "0")
+            self.last_offload_breakdown = None
             master = self._host_opt.master       # host numpy identity
             opt_state = self._host_opt.state_tree()
         elif self._onebit_path and self.dp_world_size > 1:
@@ -604,6 +621,17 @@ class DeepSpeedEngine:
             self._grad_step = self._build_offload_grad_step()
             self._offload_eval_step = self._build_offload_eval_step()
         elif self._offload:
+            if (getattr(config.zero_config, "offload_pipeline_explicit",
+                        False) and config.zero_config.offload_pipeline):
+                # explicit opt-in must not be silently ignored (the
+                # DS_OFFLOAD_SPLIT_UPDATE warn-not-raise precedent):
+                # the pipeline is a host-tier structure; the xla tier's
+                # update is already scheduled end-to-end by XLA
+                logger.warning(
+                    "offload_pipeline is a host-tier knob; offload_impl "
+                    "resolved to 'xla' on this platform, where the "
+                    "update/upload overlap is XLA-scheduled — the flag "
+                    "is ignored.")
             chunks = int(getattr(config.zero_config,
                                  "offload_grad_chunks", 1) or 1)
             chunks = min(chunks, len(self._flat_sizes))
@@ -2091,11 +2119,22 @@ class DeepSpeedEngine:
                 g.copy_to_host_async()
 
     def _apply_host_update(self, grads):
-        """C++ Adam over host grads + async re-upload of compute params.
+        """C++ Adam over host grads + re-upload of compute params.
+
+        Default (``offload_pipeline``): the three-stage streaming path —
+        per-leaf H2D uploads are issued WHILE the Adam loop runs, so the
+        transfer tail hides under host compute instead of serializing
+        after it.  ``DS_OFFLOAD_PIPELINE=0`` / ``offload_pipeline:
+        false`` falls back to this serial path: full CPU step, then one
+        post-step upload.
+
         Sharded (multi-host) tier: grads are first pinned to the master's
         dp-sharding (a no-op when the ZeRO plan already placed them
         there), each host Adams only its shards, and the updated lowp
         shards all-gather to the compute sharding on device."""
+        if getattr(self, "_offload_pipeline", False):
+            return self._apply_host_update_pipelined(grads)
+        t0 = time.perf_counter()
         if getattr(self, "_offload_sharded", False):
             if isinstance(grads, _HostBlockStash):
                 # DPU-stashed host blocks (pull_local's form) — tagged
@@ -2108,17 +2147,139 @@ class DeepSpeedEngine:
                 with self._tel_span("offload/host_adam", cat="offload"):
                     lowp = self._host_opt.step(
                         self._reshard_to_master(grads))
+            t1 = time.perf_counter()
             with self._tel_span("offload/h2d_params", cat="offload"):
                 self._compute_params = self._sharded_gather(lowp)
+                # drain inside the span: gather/put only enqueue, and a
+                # dispatch-only h2d_s (JL006 class) would make the bench
+                # A/B's serial leg look free.  The next dispatch gates
+                # on these params anyway — this moves the wait, not adds
+                # one.
+                jax.block_until_ready(self._compute_params)
+            self._record_offload_overlap([], t0, t1,
+                                         time.perf_counter())
             return
         # host_adam covers the grad D2H pulls too (the optimizer's
         # prefetch puller overlaps them with the C++ Adam); per-leaf
         # transfer spans come from offload.set_transfer_tracer
         with self._tel_span("offload/host_adam", cat="offload"):
             lowp = self._host_opt.step(grads)
+        t1 = time.perf_counter()
         with self._tel_span("offload/h2d_params", cat="offload"):
             self._compute_params = _device_put_tree(
                 lowp, self._compute_shardings)
+            # honest h2d_s for the serial reference leg (see the
+            # sharded branch above)
+            jax.block_until_ready(self._compute_params)
+        self._record_offload_overlap([], t0, t1, time.perf_counter())
+
+    def _apply_host_update_pipelined(self, grads):
+        """Streaming offload update (the ZeRO-Offload overlap completed
+        for the H2D direction): while CPU-Adam updates leaf i, leaf
+        i+1's gradient D2H is in flight (``_PrefetchPuller``) AND leaf
+        i-1's updated low-precision copy is already uploading
+        (``StreamingUploader``).  ``_compute_params`` is swapped only
+        after EVERY upload resolves — a mid-pipeline failure poisons the
+        optimizer and leaves the old compute tree fully intact (never
+        half-swapped).  Composes with DPU: a flush during step t+1's
+        dispatch window streams its uploads under the already-running
+        device fwd/bwd as well."""
+        from . import offload as offload_mod
+        from .offload import StreamingUploader
+        sharded = getattr(self, "_offload_sharded", False)
+        if sharded:
+            put = self._host_opt.upload_block
+        else:
+            shard_leaves = self._compute_shard_leaves
+            put = lambda i, a: offload_mod.device_put_leaf(  # noqa: E731
+                a, shard_leaves[i])
+        up = StreamingUploader(put)
+        t0 = time.perf_counter()
+        try:
+            with self._tel_span("offload/host_adam", cat="offload",
+                                pipelined=True):
+                if sharded:
+                    if isinstance(grads, _HostBlockStash):
+                        # DPU stash — tagged, never sniffed (see the
+                        # serial path)
+                        self._host_opt.step_local(grads.blocks,
+                                                  on_leaf=up.submit)
+                    else:
+                        self._host_opt.step(self._reshard_to_master(grads),
+                                            on_leaf=up.submit)
+                else:
+                    self._host_opt.step(grads, on_leaf=up.submit)
+        except BaseException:
+            # Adam-side failure: the optimizer poisoned itself; release
+            # the upload worker without waiting on queued transfers
+            up.abort()
+            raise
+        t1 = time.perf_counter()
+        try:
+            # the exposed tail: whatever transfer time did NOT hide
+            # under the Adam loop above
+            with self._tel_span("offload/h2d_tail", cat="offload"):
+                results, timings = up.finish()
+        except BaseException as e:
+            # Adam completed but an upload failed: host master carries
+            # step t while the device would keep step t-1 params —
+            # poison so the mismatch can neither train nor serialize.
+            # _compute_params was never touched (still the old tree).
+            self._host_opt.poison(e)
+            raise
+        if sharded:
+            n = len(self._host_opt._flat_groups)
+            assert len(results) == n, (len(results), n)
+            self._compute_params = self._sharded_gather(
+                self._host_opt.assemble_uploaded(
+                    [results[i] for i in range(n)]))
+        else:
+            n = len(self._compute_shard_leaves)
+            assert len(results) == n, (len(results), n)
+            self._compute_params = jax.tree.unflatten(
+                self._compute_treedef, [results[i] for i in range(n)])
+        self._record_offload_overlap(timings, t0, t1,
+                                     time.perf_counter())
+
+    def _record_offload_overlap(self, timings, adam_start, adam_end, end):
+        """Per-step pipeline accounting from host timestamps: how much
+        of the H2D transfer time hid under the Adam window.  Feeds
+        ``last_offload_breakdown`` (bench A/B), the
+        ``offload_overlap_ratio`` gauge, and the periodic sync scalars.
+        Serial path passes no timings — its upload is all tail."""
+        h2d = sum(t1 - t0 for _, t0, t1, _ in timings)
+        hidden = sum(max(0.0, min(t1, adam_end) - max(t0, adam_start))
+                     for _, t0, t1, _ in timings)
+        ratio = (hidden / h2d) if h2d > 0 else 0.0
+        self.last_offload_breakdown = {
+            "pipelined": bool(timings) or bool(
+                getattr(self, "_offload_pipeline", False)),
+            "d2h_s": float(getattr(self._host_opt, "last_d2h_seconds",
+                                   0.0) or 0.0),
+            "cpu_adam_s": adam_end - adam_start,
+            "h2d_s": h2d if timings else end - adam_end,
+            "h2d_hidden_s": hidden,
+            "h2d_tail_s": end - adam_end,
+            "overlap_ratio": ratio,
+        }
+        # interval accumulators: the sync scalar must aggregate EVERY
+        # step in the steps_per_print window, not snapshot the last one
+        # (a checkpoint-adjacent straggler step would misrepresent the
+        # whole interval in summarize)
+        acc = getattr(self, "_offload_interval_acc", None)
+        if acc is None:
+            acc = self._offload_interval_acc = {
+                "h2d": 0.0, "hidden": 0.0, "cpu_adam": 0.0, "steps": 0}
+        acc["h2d"] += self.last_offload_breakdown["h2d_s"]
+        acc["hidden"] += hidden
+        acc["cpu_adam"] += self.last_offload_breakdown["cpu_adam_s"]
+        acc["steps"] += 1
+        if self.telemetry is not None:
+            self.telemetry.registry.gauge(
+                "offload_overlap_ratio",
+                "fraction of offload H2D param-upload time hidden under "
+                "the host Adam (streaming pipeline; serial path = 0)",
+            ).set(ratio)
 
     def _dpu_flush(self):
         """Apply a pending delayed update (checkpoint save, eval, and
@@ -2546,6 +2707,16 @@ class DeepSpeedEngine:
                        "grad_norm": float(m.grad_norm),
                        "loss_scale": float(m.loss_scale),
                        "lr": float(m.lr)}
+        acc = getattr(self, "_offload_interval_acc", None)
+        if acc is not None and acc["steps"]:
+            # the pipeline's headline number, aggregated over the WHOLE
+            # interval (hidden/h2d sums, per-step means) — summarize's
+            # step-count weighting is then exact
+            scalars["offload_overlap_ratio"] = (
+                acc["hidden"] / acc["h2d"] if acc["h2d"] > 0 else 0.0)
+            scalars["offload_h2d_s"] = acc["h2d"] / acc["steps"]
+            scalars["offload_cpu_adam_s"] = acc["cpu_adam"] / acc["steps"]
+            acc.update(h2d=0.0, hidden=0.0, cpu_adam=0.0, steps=0)
         self.telemetry.on_sync(
             self.global_steps,
             interval_s=interval,

@@ -61,46 +61,131 @@ def set_transfer_tracer(tracer):
     _TRANSFER_TRACER = tracer
 
 
-def _transfer_span(name: str, **args):
+def _transfer_span(name: str, cat: str = "transfer", **args):
     tracer = _TRANSFER_TRACER
     if tracer is None:
         return contextlib.nullcontext()
-    return tracer.span(name, cat="transfer", **args)
+    return tracer.span(name, cat=cat, **args)
+
+
+class _PullWorkerAbandoned(Exception):
+    """Internal: a job hit a worker that was already stopped (another
+    pull timed out and abandoned it).  ``_watchdog_get`` retries once on
+    a fresh worker — this must never surface as a user-facing error on a
+    healthy link."""
+
+
+class _PullWorker:
+    """ONE persistent daemon thread serving every watchdogged pull.
+
+    The old shape spawned a fresh daemon thread per pulled piece — ~100
+    spawns per step for a 6 GB master at 64 MB chunks, pure overhead on
+    the step path.  One long-lived worker drains a queue instead; the
+    watchdog semantics live in the CALLER (``_watchdog_get`` waits on
+    the per-job event with the timeout).  On a timeout the caller
+    abandons this worker — wedged inside one un-interruptible native
+    call — and the next pull lazily creates a replacement, so later
+    pulls never queue behind a stalled one.  ``stop()`` flags the
+    worker: jobs still queued (or submitted after — the sentinel race)
+    fail fast with ``_PullWorkerAbandoned`` instead of being stranded,
+    and the thread exits once its in-flight native call (if any) ever
+    returns.  Note one semantic shift vs the thread-per-pull design:
+    concurrent pulls serialize through this worker, so a piece's timeout
+    window includes queue wait behind other pulls — transfers share one
+    link anyway, and per-piece timeouts are generous (default 120 s for
+    <=64 MB), so only a genuinely non-progressing link trips it."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._q: list = []
+        self._stopped = False
+        threading.Thread(target=self._run, daemon=True,
+                         name="ds-offload-pull").start()
+
+    def _run(self):
+        while True:
+            with self._cond:
+                self._cond.wait_for(lambda: self._q or self._stopped)
+                if self._stopped:
+                    for _fn, box, done in self._q:  # never strand a job
+                        box["e"] = _PullWorkerAbandoned()
+                        done.set()
+                    self._q.clear()
+                    return
+                fn, box, done = self._q.pop(0)
+            try:
+                box["v"] = fn()
+            except BaseException as e:  # surfaced to the waiting caller
+                box["e"] = e
+            finally:
+                done.set()
+
+    def submit(self, fn):
+        box: dict = {}
+        done = threading.Event()
+        with self._cond:
+            if self._stopped:
+                box["e"] = _PullWorkerAbandoned()
+                done.set()
+            else:
+                self._q.append((fn, box, done))
+                self._cond.notify_all()
+        return box, done
+
+    def stop(self):
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+
+_PULL_WORKER_LOCK = threading.Lock()
+_PULL_WORKER: Optional[_PullWorker] = None
 
 
 def _watchdog_get(x, timeout_s: float, what: str = "D2H transfer"):
-    """jax.device_get guarded by a daemon-thread watchdog.
+    """jax.device_get guarded by a persistent-worker watchdog.
 
     Bulk transfers on a tunneled dev platform can stall *inside one
     native call* — un-interruptible by signals (round-3 root cause,
-    BENCH_NOTES.md).  Running the pull in a daemon thread converts the
-    forever-stall into a RuntimeError after ``timeout_s``; the wedged
-    native call is abandoned (the thread never joins), which costs this
-    process its device handle but keeps the failure clean and lets the
-    caller fall back to another tier instead of hanging the session.
+    BENCH_NOTES.md).  Running the pull on the shared ``_PullWorker``
+    converts the forever-stall into a RuntimeError after ``timeout_s``;
+    the wedged worker is abandoned (replaced lazily on the next pull),
+    which costs this process its device handle but keeps the failure
+    clean and lets the caller fall back to another tier instead of
+    hanging the session.  A job that lands on a worker another pull just
+    abandoned retries once on a fresh one — that race must not
+    masquerade as a stall.
     """
-    out: dict = {}
-    done = threading.Event()
-
-    def pull():
-        try:
-            out["v"] = np.asarray(jax.device_get(x))
-        except BaseException as e:  # surfaced to the caller below
-            out["e"] = e
-        finally:
-            done.set()
-
-    threading.Thread(target=pull, daemon=True).start()
-    if not done.wait(timeout=timeout_s):
-        nbytes = getattr(x, "nbytes", 0)
-        raise RuntimeError(
-            f"{what} ({nbytes >> 20} MB) did not complete within "
-            f"{timeout_s:.0f}s: bulk D2H appears stalled on this platform "
-            "(tunneled dev harness?). Aborting the pull piece-wise instead "
-            "of wedging the session; use offload_impl='xla' here.")
-    if "e" in out:
-        raise out["e"]
-    return out["v"]
+    global _PULL_WORKER
+    for _attempt in range(2):
+        with _PULL_WORKER_LOCK:
+            worker = _PULL_WORKER
+            if worker is None:
+                worker = _PULL_WORKER = _PullWorker()
+        box, done = worker.submit(lambda: np.asarray(jax.device_get(x)))
+        if not done.wait(timeout=timeout_s):
+            with _PULL_WORKER_LOCK:
+                if _PULL_WORKER is worker:
+                    _PULL_WORKER = None  # abandoned: next pull starts fresh
+            worker.stop()
+            nbytes = getattr(x, "nbytes", 0)
+            raise RuntimeError(
+                f"{what} ({nbytes >> 20} MB) did not complete within "
+                f"{timeout_s:.0f}s: bulk D2H appears stalled on this "
+                "platform (tunneled dev harness?). Aborting the pull "
+                "piece-wise instead of wedging the session; use "
+                "offload_impl='xla' here.")
+        if "e" in box:
+            if isinstance(box["e"], _PullWorkerAbandoned):
+                with _PULL_WORKER_LOCK:
+                    if _PULL_WORKER is worker:
+                        _PULL_WORKER = None
+                continue  # fresh worker, one retry
+            raise box["e"]
+        return box["v"]
+    raise RuntimeError(
+        f"{what}: pull worker abandoned twice in a row — concurrent "
+        "timeouts on this link; treat as stalled.")
 
 
 def pull_chunk_bytes() -> int:
@@ -213,6 +298,11 @@ class _PrefetchPuller:
         self._cond = threading.Condition()
         self._want = -1
         self._closed = False
+        # best-effort transfer accounting (written by the worker, read by
+        # the owner after consumption finishes) — feeds the pipeline's
+        # d2h row in the engine's per-step breakdown
+        self.seconds = 0.0
+        self.bytes = 0
         order = []
         self._slots: dict = {}
         for idx, g in enumerate(jax.tree.leaves(tree)):
@@ -229,7 +319,10 @@ class _PrefetchPuller:
                     if self._closed:
                         return  # consumer is done; drop the tree refs
                 try:
+                    t0 = time.perf_counter()
                     box["v"] = chunked_device_get(g, what=what)
+                    self.seconds += time.perf_counter() - t0
+                    self.bytes += int(getattr(g, "nbytes", 0))
                 except BaseException as e:
                     box["e"] = e
                     ev.set()
@@ -288,6 +381,143 @@ def guarded_tree_pull(tree):
         puller.close()
 
 
+def device_put_leaf(arr, sharding):
+    """H2D for ONE updated leaf (single-controller streaming pipeline).
+    A module hook rather than an inline ``jax.device_put`` so tests can
+    inject transfer failures/delays without patching jax globally."""
+    return jax.device_put(arr, sharding)
+
+
+def _batched_device_put_pairs(blks, devices):
+    """ONE batched transfer call placing ``blks[i]`` on ``devices[i]``
+    (the list form of ``jax.device_put`` dispatches them together) —
+    replicated small leaves must not pay one client round-trip per
+    replica device.  Falls back to the per-pair loop on jax versions
+    without the list form.  The single fallback implementation: both
+    the serial ``_assemble`` and the streamed ``upload_block`` route
+    through here."""
+    if not blks:
+        return []
+    try:
+        return list(jax.device_put(list(blks), list(devices)))
+    except (TypeError, ValueError):
+        return [jax.device_put(b, d) for b, d in zip(blks, devices)]
+
+
+def _batched_device_put(blk, devices):
+    """Replicate one host block onto every device in ``devices`` with a
+    single batched call."""
+    return _batched_device_put_pairs([blk] * len(devices), devices)
+
+
+class StreamingUploader:
+    """Third stage of the streaming offload update pipeline: a single
+    worker thread that issues H2D uploads for updated leaves WHILE the
+    CPU Adam continues on later leaves.
+
+    The consumer loop (``HostOffloadOptimizer.step`` /
+    ``ShardedHostOffloadOptimizer`` with an ``on_leaf`` callback) calls
+    ``submit(idx, arr)`` the moment leaf ``idx``'s block is written; the
+    worker runs ``put_fn(idx, arr)`` off-thread, so a put that blocks on
+    the actual transfer still overlaps the remaining host compute — with
+    D2H prefetch (``_PrefetchPuller``) this closes the pipeline: leaf
+    i+1's grad pull, leaf i's Adam, and leaf i-1's upload are all in
+    flight at once.
+
+    ``finish()`` drains the queue, re-raises the first failure, and
+    returns ``(results, timings)``: ``results[idx]`` is ``put_fn``'s
+    value, ``timings`` is ``[(idx, t_start, t_end, nbytes), ...]`` in
+    host ``perf_counter`` seconds — the engine's overlap accounting
+    (``offload/overlap_ratio``) reads these against the Adam window.
+    Each upload also emits a per-leaf ``offload/h2d_params`` span on the
+    module transfer tracer.
+
+    On failure the worker stops touching the device and ``finish()``
+    raises; the caller must then POISON the optimizer and leave its old
+    compute-param tree in place (the master already carries step t, the
+    device would keep step t-1 — the half-swapped state the pipeline
+    contract forbids).
+
+    DS_OFFLOAD_H2D_DELAY_S: fault-injection knob (tests/bench smoke
+    only) — each upload sleeps this long INSIDE its span/timing window,
+    emulating a slow PCIe link so a CPU run can measure real overlap.
+    """
+
+    def __init__(self, put_fn, what: str = "offload/h2d_params"):
+        self._put = put_fn
+        self._what = what
+        self._delay = float(os.environ.get("DS_OFFLOAD_H2D_DELAY_S", "0"))
+        self._q: list = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._err: Optional[BaseException] = None
+        self._done = threading.Event()
+        self.results: dict = {}
+        self.timings: list = []
+        threading.Thread(target=self._work, daemon=True,
+                         name="ds-offload-h2d").start()
+
+    def _work(self):
+        while True:
+            with self._cond:
+                self._cond.wait_for(lambda: self._q or self._closed)
+                if not self._q:
+                    break  # closed and drained
+                idx, arr = self._q.pop(0)
+            if self._err is not None:
+                continue  # poisoned: drain submissions, touch nothing
+            nbytes = int(getattr(arr, "nbytes", 0))
+            t0 = time.perf_counter()
+            try:
+                with _transfer_span(self._what, leaf=idx, bytes=nbytes):
+                    if self._delay > 0:
+                        time.sleep(self._delay)
+                    out = self._put(idx, arr)
+                    # drain the transfer INSIDE the span/timing window:
+                    # device_put only dispatches, so without this the
+                    # timings (and overlap_ratio) would measure enqueue
+                    # latency (the JL006 bug class) — and an async
+                    # transfer failure would escape the poison contract
+                    # by surfacing after finish() already succeeded.
+                    # Off-thread, so the Adam loop still overlaps.
+                    jax.block_until_ready(out)
+            except BaseException as e:  # re-raised from finish()
+                self._err = e
+                continue
+            self.results[idx] = out
+            self.timings.append((idx, t0, time.perf_counter(), nbytes))
+        self._done.set()
+
+    def submit(self, idx: int, arr):
+        """Enqueue leaf ``idx``'s updated host block (called from the
+        Adam loop; never blocks on the transfer)."""
+        with self._cond:
+            self._q.append((idx, arr))
+            self._cond.notify_all()
+
+    def finish(self):
+        """Close the queue, wait for every upload, raise the first
+        failure.  NOT watchdogged: the upload direction shares the probe
+        warning's contract (a stalled H2D hangs — see
+        ``_probe_transfer_path``)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._done.wait()
+        if self._err is not None:
+            raise self._err
+        return self.results, self.timings
+
+    def abort(self):
+        """Release the worker without waiting (the Adam side failed: its
+        exception is the one that matters; queued uploads are dropped).
+        The in-flight put, if any, finishes in the background."""
+        with self._cond:
+            self._closed = True
+            self._q.clear()
+            self._cond.notify_all()
+
+
 class HostOffloadOptimizer:
     """Owns the host-side master params + moments and the upload cast."""
 
@@ -312,6 +542,7 @@ class HostOffloadOptimizer:
 
         self._probe_transfer_path(master_params)
         self._poisoned: Optional[BaseException] = None
+        self.last_d2h_seconds = 0.0  # last step's grad-pull wall time
         self.master = jax.tree.map(to_host, master_params)
         self.opt = DeepSpeedCPUAdam(
             lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
@@ -411,7 +642,7 @@ class HostOffloadOptimizer:
 
         return jax.tree.map(cast, self.master)
 
-    def step(self, host_grads):
+    def step(self, host_grads, on_leaf: Optional[Callable] = None):
         """Update master/moments in place; return upload copies in the
         configured compute dtype (fp32 configs get fp32 copies — no silent
         bf16 downgrade).  Grad leaves may be numpy OR jax Arrays — the
@@ -420,6 +651,13 @@ class HostOffloadOptimizer:
         while a link that degrades into a stall MID-TRAINING still fails
         cleanly (the construction-time probe only certifies the link once;
         this guard holds for every step after; see _PrefetchPuller).
+
+        ``on_leaf(i, upload_leaf)`` (optional) fires the moment leaf
+        ``i``'s block is written — the streaming pipeline's hook: the
+        engine submits each leaf to its H2D uploader while the Adam loop
+        continues, so the re-upload overlaps the remaining host compute
+        instead of serializing after it.  The returned tree holds the
+        same objects the callback saw.
 
         A mid-step pull failure leaves master/moments PARTIALLY updated
         (leaves before the failing one carry step t, later ones do not,
@@ -435,18 +673,36 @@ class HostOffloadOptimizer:
                 "mid-update, leaving master/moments inconsistent. Restore "
                 f"from a checkpoint. Original error: {self._poisoned!r}")
         leaf_get = _PrefetchPuller(host_grads)
+        p_leaves, treedef = jax.tree.flatten(self.master)
+        outs: list = [None] * len(p_leaves)
         try:
-            out = self.opt.step(self.master, host_grads,
-                                out_dtype=self._out_dtype,
-                                leaf_get=leaf_get)
+            for i, out in self.opt.step_leaves(
+                    self.master, host_grads, out_dtype=self._out_dtype,
+                    leaf_get=leaf_get,
+                    leaf_span=lambda i: _transfer_span(
+                        "offload/adam_leaf", cat="offload", leaf=i)):
+                # fp32 configs upload fp32 copies of the freshly-updated
+                # master leaf (the no-downgrade rule, same values the old
+                # post-step tree.map(copy) produced)
+                up = out if out is not None else p_leaves[i].copy()
+                outs[i] = up
+                if on_leaf is not None:
+                    on_leaf(i, up)
         except BaseException as e:
             self._poisoned = e
             raise
         finally:
+            self.last_d2h_seconds = leaf_get.seconds
             leaf_get.close()
-        if self._out_dtype is None:
-            return jax.tree.map(lambda x: x.copy(), self.master)
-        return out
+        return jax.tree.unflatten(treedef, outs)
+
+    def poison(self, err: BaseException):
+        """Mark the optimizer inconsistent from OUTSIDE the step — the
+        engine's streaming pipeline calls this when an H2D upload fails
+        AFTER the Adam completed: the host master already carries step t
+        while the device would keep step t-1 params, a mismatch that
+        must not keep training or serialize (load_state_tree clears)."""
+        self._poisoned = err
 
     # -- checkpoint plumbing -------------------------------------------
     def state_tree(self):
@@ -555,6 +811,13 @@ class ShardedHostOffloadOptimizer:
                     order.append(k)
                 groups[k]["devices"].append(s.device)
             self._local.append([groups[k] for k in order])
+        # flat-order view of the unique groups — the streaming pipeline's
+        # addressing: on_leaf/upload_block/assemble_uploaded all speak
+        # this index
+        self._flat_groups = [(li, gi, g)
+                             for li, leaf in enumerate(self._local)
+                             for gi, g in enumerate(leaf)]
+        self.last_d2h_seconds = 0.0  # last step's grad-pull wall time
 
     # -- introspection --------------------------------------------------
     def staged_bytes(self) -> int:
@@ -585,17 +848,57 @@ class ShardedHostOffloadOptimizer:
         stitches the global view (non-addressable shards belong to the
         other processes).  ``np_dtype`` applies to FLOATING blocks only;
         integer/bool blocks keep their own dtype (the single-controller
-        tier's rule — Adam never touched them, so no cast is correct)."""
-        out = []
-        for li, (leaf_groups, sharding, shape) in enumerate(
-                zip(self._local, self._shardings, self._shapes)):
-            arrays = []
+        tier's rule — Adam never touched them, so no cast is correct).
+
+        All H2D puts are issued as ONE batched ``jax.device_put`` call:
+        replicated small leaves (biases, norms) must not pay a client
+        round-trip per replica device per leaf.  The stitch is
+        ``assemble_uploaded`` — the same tail the streamed path uses."""
+        blks, devs, group_sizes = [], [], []
+        for li, leaf_groups in enumerate(self._local):
             for gi, g in enumerate(leaf_groups):
                 blk = np.asarray(block_fn(li, gi, g))
                 if is_adam_float(blk.dtype):
                     blk = np.asarray(blk, dtype=np_dtype)
-                for d in g["devices"]:
-                    arrays.append(jax.device_put(blk, d))
+                blks.extend([blk] * len(g["devices"]))
+                devs.extend(g["devices"])
+                group_sizes.append(len(g["devices"]))
+        puts = _batched_device_put_pairs(blks, devs)
+        uploaded, pos = [], 0
+        for n in group_sizes:
+            uploaded.append(puts[pos:pos + n])
+            pos += n
+        return self.assemble_uploaded(uploaded)
+
+    def upload_block(self, flat_idx: int, blk):
+        """H2D for ONE updated group (streaming pipeline): apply
+        ``_assemble``'s float cast rule, then one batched put to every
+        replica device of the group.  Returns the per-device arrays in
+        the group's device order — ``assemble_uploaded`` stitches them
+        once every group is in."""
+        li, gi, g = self._flat_groups[flat_idx]
+        blk = np.asarray(blk)
+        if is_adam_float(blk.dtype):
+            dt = lowp_np_dtype(self._out_dtype)
+            blk = np.asarray(blk,
+                             dtype=dt if dt is not None else np.float32)
+        return _batched_device_put(blk, g["devices"])
+
+    def assemble_uploaded(self, uploaded):
+        """Global arrays from already-uploaded per-group device arrays
+        (``uploaded[flat_idx]`` = what ``upload_block`` returned).  The
+        streaming pipeline's tail: every transfer was issued leaf by
+        leaf under the Adam loop; this only stitches the global views —
+        no host bytes move here."""
+        assert len(uploaded) == len(self._flat_groups), (
+            len(uploaded), len(self._flat_groups))
+        out, i = [], 0
+        for leaf_groups, sharding, shape in zip(
+                self._local, self._shardings, self._shapes):
+            arrays = []
+            for _ in leaf_groups:
+                arrays.extend(uploaded[i])
+                i += 1
             out.append(jax.make_array_from_single_device_arrays(
                 shape, sharding, arrays))
         return jax.tree.unflatten(self._treedef, out)
@@ -651,11 +954,14 @@ class ShardedHostOffloadOptimizer:
                 a.copy_to_host_async()
         return guarded_tree_pull(flat_g)
 
-    def step(self, grads):
+    def step(self, grads, on_leaf: Optional[Callable] = None):
         """C++ Adam over THIS process's shards only.  Returns global
         compute-dtype params (master-sharded; gather happens in the
-        engine's jitted identity).  Poisons on mid-step failure exactly
-        like the single-controller tier."""
+        engine's jitted identity), or None when ``on_leaf`` is given —
+        the streaming pipeline: ``on_leaf(flat_idx, block)`` fires per
+        updated group, the engine uploads each via ``upload_block`` and
+        stitches with ``assemble_uploaded``.  Poisons on mid-step
+        failure exactly like the single-controller tier."""
         if self._poisoned is not None:
             raise RuntimeError(
                 "ShardedHostOffloadOptimizer is poisoned: a previous "
@@ -671,41 +977,59 @@ class ShardedHostOffloadOptimizer:
             if hasattr(a, "copy_to_host_async") and (
                     cb <= 0 or getattr(a, "nbytes", 0) <= cb):
                 a.copy_to_host_async()
-        return self._adam_over_blocks(flat_g, prefetch=True)
+        return self._adam_over_blocks(flat_g, prefetch=True,
+                                      on_leaf=on_leaf)
 
-    def step_local(self, blocks):
+    def step_local(self, blocks, on_leaf: Optional[Callable] = None):
         """The DPU apply half: C++ Adam over host blocks that
-        ``pull_local`` staged earlier (numpy; no device access)."""
+        ``pull_local`` staged earlier (numpy; no device access).
+        ``on_leaf``: same streaming hook as ``step``."""
         if self._poisoned is not None:
             raise RuntimeError(
                 "ShardedHostOffloadOptimizer is poisoned: a previous "
                 "step failed mid-update. Restore from a checkpoint. "
                 f"Original error: {self._poisoned!r}")
-        return self._adam_over_blocks(list(blocks), prefetch=False)
+        return self._adam_over_blocks(list(blocks), prefetch=False,
+                                      on_leaf=on_leaf)
 
-    def _adam_over_blocks(self, flat_g, prefetch: bool):
+    def _adam_over_blocks(self, flat_g, prefetch: bool,
+                          on_leaf: Optional[Callable] = None):
         flat_p = [g["block"] for leaf in self._local for g in leaf]
         assert len(flat_p) == len(flat_g), (len(flat_p), len(flat_g))
         puller = _PrefetchPuller(flat_g) if prefetch else None
+        outs: list = [None] * len(flat_p)
         try:
-            outs = self.opt.step(flat_p, flat_g,
-                                 out_dtype=self._out_dtype,
-                                 leaf_get=puller)
+            for i, out in self.opt.step_leaves(
+                    flat_p, flat_g, out_dtype=self._out_dtype,
+                    leaf_get=puller,
+                    leaf_span=lambda i: _transfer_span(
+                        "offload/adam_leaf", cat="offload", leaf=i)):
+                # fp32 configs stream fp32 copies of the updated block
+                # (the single-controller no-downgrade rule)
+                up = out if out is not None else flat_p[i].copy()
+                outs[i] = up
+                if on_leaf is not None:
+                    on_leaf(i, up)
         except BaseException as e:
             self._poisoned = e
             raise
         finally:
+            self.last_d2h_seconds = puller.seconds if puller else 0.0
             if puller is not None:
                 puller.close()
+        if on_leaf is not None:
+            return None  # uploads already in flight; engine assembles
         dt = lowp_np_dtype(self._out_dtype)
         np_dt = dt if dt is not None else np.float32
-        if outs is None:
-            return self._assemble(
-                lambda li, gi, g: g["block"].copy(), np_dt)
         it = iter(outs)
-        lowp = [[next(it) for _ in leaf] for leaf in self._local]
+        nested = [[next(it) for _ in leaf] for leaf in self._local]
         return self._assemble(
-            lambda li, gi, g, _l=lowp: _l[li][gi], np_dt)
+            lambda li, gi, g, _l=nested: _l[li][gi], np_dt)
+
+    def poison(self, err: BaseException):
+        """Engine-side poison (an H2D upload failed after the Adam
+        completed) — same contract as the single-controller tier."""
+        self._poisoned = err
 
     # -- checkpoint plumbing --------------------------------------------
     def state_tree(self):

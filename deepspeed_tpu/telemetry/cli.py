@@ -54,6 +54,7 @@ def summarize(path: str, out=None) -> dict:
     dispatch: List[float] = []
     synced: List[float] = []
     sps: List[float] = []
+    overlap: List[float] = []
     peak_hbm: Optional[float] = None
     host_rss: Optional[float] = None
     bad_lines = 0
@@ -80,6 +81,14 @@ def summarize(path: str, out=None) -> dict:
                     synced.extend([float(rec["step_avg_s"])] * n)
                 if rec.get("samples_per_sec") is not None:
                     sps.append(float(rec["samples_per_sec"]))
+                ov = (rec.get("scalars") or {}).get(
+                    "offload_overlap_ratio")
+                if ov is not None:
+                    # weight by the interval's step count, same as the
+                    # step-time percentiles — a 1-step straggler interval
+                    # must not count like a full one
+                    overlap.extend([float(ov)]
+                                   * int(rec.get("steps") or 1))
             elif kind == "memory":
                 stats = rec.get("stats") or {}
                 for dev in stats.get("devices", []):
@@ -103,11 +112,14 @@ def summarize(path: str, out=None) -> dict:
     p99 = _percentile(times, 0.99)
     avg_sps = sum(sps) / len(sps) if sps else None
 
+    avg_overlap = sum(overlap) / len(overlap) if overlap else None
+
     report = {
         "steps": steps,
         "step_time_source": source,
         "p50_s": p50, "p95_s": p95, "p99_s": p99,
         "samples_per_sec": avg_sps,
+        "offload_overlap_ratio": avg_overlap,
         "peak_hbm_bytes": peak_hbm,
         "host_rss_bytes": host_rss,
         "bad_lines": bad_lines,
@@ -119,6 +131,11 @@ def summarize(path: str, out=None) -> dict:
           file=out)
     if avg_sps is not None:
         print(f"  samples/sec        {avg_sps:.1f}", file=out)
+    if avg_overlap is not None:
+        # streaming offload pipeline: 1.0 = the H2D param re-upload is
+        # fully hidden under the host Adam; 0 = serial (all tail)
+        print(f"  offload H2D overlap {avg_overlap * 100:.0f}% hidden "
+              "under host Adam", file=out)
     print(f"  peak HBM           {_fmt_bytes(peak_hbm)}", file=out)
     if host_rss is not None:
         print(f"  peak host RSS      {_fmt_bytes(host_rss)}", file=out)

@@ -327,6 +327,75 @@ def test_poisoned_optimizer_refuses(monkeypatch):
     assert opt.state_tree()["step"] >= 1
 
 
+def _pull_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("ds-offload-pull")]
+
+
+def test_watchdog_reuses_one_persistent_worker():
+    """No thread spawn per pulled piece (was ~100 spawns/step for a 6 GB
+    master at 64 MB chunks): many chunked pulls ride ONE daemon worker.
+    Counted by the worker's thread name, not process-wide active_count()
+    — unrelated pools must not flake this."""
+    # warm: create the worker
+    chunked_device_get(jnp.ones((64, 64)), chunk_mb=0.001,
+                       piece_timeout=30)
+    worker = offload._PULL_WORKER
+    assert worker is not None
+    before = set(_pull_threads())
+    assert before, "no pull worker thread observed"
+    for _ in range(3):
+        chunked_device_get(jnp.ones((100, 128)), chunk_mb=0.01,
+                           piece_timeout=30)  # ~13 pieces each
+    assert offload._PULL_WORKER is worker, "worker was replaced"
+    # no NEW pull threads across ~40 pieces (an abandoned predecessor
+    # from an earlier stall test may still be draining out of `before`,
+    # which is why this is a no-new-threads check, not a count of 1)
+    assert not (set(_pull_threads()) - before), (
+        "watchdogged pulls must not spawn threads")
+
+
+def test_watchdog_timeout_abandons_worker(monkeypatch):
+    """A timed-out pull abandons the wedged worker (later pulls must not
+    queue behind its stalled native call) and the next pull lazily gets
+    a fresh one — the per-spawn semantics, paid only on failure."""
+    chunked_device_get(jnp.ones((4, 4)), piece_timeout=10)  # ensure one
+    wedged = offload._PULL_WORKER
+    release = threading.Event()
+    real_get = jax.device_get
+
+    def stalled(x):
+        release.wait()
+        return real_get(x)
+
+    monkeypatch.setattr(offload.jax, "device_get", stalled)
+    try:
+        with pytest.raises(RuntimeError, match="did not complete"):
+            chunked_device_get(jnp.ones((32, 32)), chunk_mb=0.001,
+                               piece_timeout=0.3)
+    finally:
+        release.set()  # let the abandoned worker drain and exit
+    monkeypatch.undo()
+    assert offload._PULL_WORKER is not wedged  # abandoned
+    got = chunked_device_get(jnp.ones((4, 4)), piece_timeout=10)
+    np.testing.assert_array_equal(got, np.ones((4, 4), np.float32))
+    assert offload._PULL_WORKER is not None
+    assert offload._PULL_WORKER is not wedged
+
+
+def test_watchdog_retries_after_abandoned_worker():
+    """The sentinel race: a pull landing on a worker that a concurrent
+    timeout just stopped must retry transparently on a fresh worker —
+    never surface a spurious 'stalled' error on a healthy link."""
+    chunked_device_get(jnp.ones((4, 4)), piece_timeout=10)  # ensure one
+    worker = offload._PULL_WORKER
+    worker.stop()  # simulate the concurrent-timeout abandonment
+    got = chunked_device_get(jnp.ones((4, 4)), piece_timeout=10)
+    np.testing.assert_array_equal(got, np.ones((4, 4), np.float32))
+    assert offload._PULL_WORKER is not None
+    assert offload._PULL_WORKER is not worker
+
+
 def test_fast_probe_passes(monkeypatch):
     monkeypatch.setenv("DS_OFFLOAD_SLOW_LINK", "error")
     master = {"w": jnp.ones((600, 1024))}

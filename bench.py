@@ -390,6 +390,117 @@ def _offload_pipeline_ab(jax, mode: str):
     print(json.dumps(rec), flush=True)
 
 
+def bench_prefetch(jax, prefetch_on: bool, steps: int = None,
+                   collate_delay_s: float = None):
+    """A/B one leg of the async input pipeline: the same seeded
+    dataloader (with a deliberately slow collate emulating real
+    tokenize/augment cost — both legs pay it) feeds the engine with
+    prefetch ON (collate + H2D placement on the daemon worker, hidden
+    under the previous step) vs OFF (inline on the step path).  Reports
+    measured step wall time plus the pipeline's own numbers
+    (``prefetch_wait_s`` per step, ``hit_ratio``) from the engine's
+    prefetcher stats.
+
+    Size is platform-scaled like ``bench_offload_pipeline``: tiny on
+    CPU (the tier-1 smoke injects BENCH_PREFETCH_COLLATE_S to prove
+    hiding), mid-size on TPU via BENCH_PREFETCH_* knobs."""
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.config import DeepSpeedConfig
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        d_model = int(os.environ.get("BENCH_PREFETCH_D_MODEL", "768"))
+        n_layer = int(os.environ.get("BENCH_PREFETCH_LAYERS", "8"))
+        micro = int(os.environ.get("BENCH_PREFETCH_MICRO", "8"))
+        seq, vocab = 1024, 50257
+        steps = steps or int(os.environ.get("BENCH_PREFETCH_STEPS", "5"))
+    else:
+        d_model, n_layer, micro = 64, 2, 2
+        seq, vocab = 64, 256
+        steps = steps or 3
+    if collate_delay_s is None:
+        collate_delay_s = float(
+            os.environ.get("BENCH_PREFETCH_COLLATE_S",
+                           "0" if on_tpu else "0.02"))
+
+    def slow_collate(samples):
+        # emulated host-side collate cost (tokenize/augment/pad) — paid
+        # by BOTH legs; the on leg hides it on the worker
+        if collate_delay_s > 0:
+            time.sleep(collate_delay_s)
+        return np.stack([np.asarray(s) for s in samples])
+
+    cfg_model = GPT2Config(d_model=d_model, n_layer=n_layer,
+                           n_head=max(2, d_model // 64), vocab_size=vocab,
+                           n_positions=seq, remat=None)
+    mesh = build_mesh(devices=jax.devices()[:1])
+    ds_cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "data_prefetch": {"enabled": prefetch_on, "depth": 2},
+    }, world_size=1)
+    rng = np.random.default_rng(0)
+    dataset = [rng.integers(0, vocab, (seq + 1,), dtype=np.int32)
+               for _ in range(micro * 4)]
+    _mark(f"prefetch[{'on' if prefetch_on else 'off'}]: "
+          "constructing engine")
+    engine = DeepSpeedEngine(GPT2Model(cfg_model), ds_cfg, mesh=mesh,
+                             training_data=dataset,
+                             collate_fn=slow_collate)
+    # finite dataset, repeated: the A/B must measure steady state, not
+    # epoch boundaries
+    engine.training_dataloader = RepeatingLoader(engine.training_dataloader)
+    np.asarray(engine.train_batch())  # warmup/compile
+    pf = engine._train_prefetcher
+    s0 = pf.stats() if pf is not None else None
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = float(np.asarray(engine.train_batch()))
+    dt = (time.perf_counter() - t0) / steps
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+    out = {"prefetch": "on" if prefetch_on else "off",
+           "step_s": round(dt, 6),
+           "collate_delay_s": collate_delay_s}
+    if pf is not None:
+        s1 = pf.stats()
+        n = max(s1["consumed"] - s0["consumed"], 1)
+        out["prefetch_wait_s"] = round(
+            (s1["wait_s"] - s0["wait_s"]) / n, 6)
+        hm = (s1["hits"] - s0["hits"]) + (s1["misses"] - s0["misses"])
+        out["hit_ratio"] = round(
+            (s1["hits"] - s0["hits"]) / hm, 4) if hm else 0.0
+    engine.close()
+    _mark(f"prefetch[{out['prefetch']}]: {dt:.3f}s/step"
+          + (f", wait {out['prefetch_wait_s']:.3f}s"
+             if "prefetch_wait_s" in out else ""))
+    return out
+
+
+def _prefetch_ab(jax, mode: str):
+    """``--prefetch={on,off,ab}``: run the requested leg(s) and print
+    ONE JSON line; the A/B also records the off/on speedup."""
+    legs = {"on": [True], "off": [False], "ab": [True, False]}[mode]
+    results = [bench_prefetch(jax, leg) for leg in legs]
+    rec = {"metric": "input_prefetch_step_breakdown",
+           "unit": "s/step",
+           "legs": results}
+    if len(results) == 2:
+        off_t, on_t = results[1]["step_s"], results[0]["step_s"]
+        rec["speedup"] = round(off_t / on_t, 4) if on_t > 0 else 0.0
+    try:
+        with open("BENCH_prefetch.json", "w") as f:
+            json.dump(rec, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(rec), flush=True)
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache shared across bench runs.  The
     1.5B program (48-layer scan + offload staging) is compile-heavy and
@@ -476,6 +587,12 @@ def main():
                              "per-stage step-time breakdown (d2h / "
                              "cpu_adam / h2d / hidden) instead of the "
                              "north-star bench")
+    parser.add_argument("--prefetch", choices=("on", "off", "ab"),
+                        default=None,
+                        help="A/B the async input pipeline (prefetched "
+                             "collate + H2D batch placement): step time "
+                             "+ prefetch wait/hit breakdown instead of "
+                             "the north-star bench")
     # strict parse: a typo'd flag must fail loudly, not silently launch
     # the multi-hour north-star run (the _15b_knobs eager-validation rule)
     args = parser.parse_args()
@@ -486,6 +603,10 @@ def main():
 
     if args.offload_pipeline is not None:
         _offload_pipeline_ab(jax, args.offload_pipeline)
+        return
+
+    if args.prefetch is not None:
+        _prefetch_ab(jax, args.prefetch)
         return
 
     if not on_tpu:  # CPU smoke (driver runs the real thing on TPU)

@@ -29,6 +29,7 @@ from .parallel.distributed import init_distributed
 from .runtime.engine import DeepSpeedEngine
 from .runtime.module import TrainModule, FunctionalModule, FlaxModule
 from .runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from .runtime.prefetch import DevicePlacedBatch, DevicePrefetcher
 from .runtime.lr_schedules import add_tuning_arguments
 from .runtime.activation_checkpointing import checkpointing
 from .utils.logging import log_dist

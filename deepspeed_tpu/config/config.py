@@ -263,6 +263,26 @@ class DeepSpeedTelemetryConfig:
                 f"got {self.recompile_storm_threshold!r}")
 
 
+class DeepSpeedDataPrefetchConfig:
+    """Asynchronous input pipeline block (docs/observability.md): a
+    daemon worker prefetches + device-places batches through a bounded
+    queue so the step loop never pays collate/H2D inline.  Default ON;
+    ``DS_PREFETCH=0`` is the no-config escape hatch (resolved by the
+    engine, not here — config objects stay env-independent)."""
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        pf = param_dict.get(C.DATA_PREFETCH) or {}
+        self.enabled = get_scalar_param(
+            pf, C.DATA_PREFETCH_ENABLED, C.DATA_PREFETCH_ENABLED_DEFAULT)
+        self.depth = get_scalar_param(
+            pf, C.DATA_PREFETCH_DEPTH, C.DATA_PREFETCH_DEPTH_DEFAULT)
+        if (not isinstance(self.depth, int)
+                or isinstance(self.depth, bool) or self.depth < 1):
+            raise DeepSpeedConfigError(
+                f"{C.DATA_PREFETCH_DEPTH} must be an int >= 1, "
+                f"got {self.depth!r}")
+
+
 class DeepSpeedPipelineConfig:
     def __init__(self, param_dict: Dict[str, Any]):
         pipe = param_dict.get(C.PIPELINE) or {}
@@ -385,6 +405,7 @@ class DeepSpeedConfig:
         self.tensorboard_config = DeepSpeedTensorboardConfig(pd)
         self.profiler_config = DeepSpeedProfilerConfig(pd)
         self.telemetry_config = DeepSpeedTelemetryConfig(pd)
+        self.data_prefetch_config = DeepSpeedDataPrefetchConfig(pd)
         self.pipeline_config = DeepSpeedPipelineConfig(pd)
 
         self._solve_batch_triangle()

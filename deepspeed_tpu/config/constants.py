@@ -331,6 +331,20 @@ TELEMETRY_MEMORY_DEFAULT = True
 TELEMETRY_STORM_THRESHOLD = "recompile_storm_threshold"
 TELEMETRY_STORM_THRESHOLD_DEFAULT = 3
 
+# Asynchronous input pipeline (TPU extension; docs/observability.md):
+# a single daemon worker prefetches batches through a bounded queue and
+# runs collate + batch sharding (H2D placement) OFF the step loop's
+# thread, so train_batch receives already-device-resident pytrees — the
+# input-feeding half of the ZeRO-Offload overlap story.  Default ON;
+# set enabled:false (or DS_PREFETCH=0, the no-config escape hatch) to
+# restore the inline collate+placement.  ``depth`` is the queue bound
+# (2 = double buffering: one batch consumed, one staged ahead).
+DATA_PREFETCH = "data_prefetch"
+DATA_PREFETCH_ENABLED = "enabled"
+DATA_PREFETCH_ENABLED_DEFAULT = True
+DATA_PREFETCH_DEPTH = "depth"
+DATA_PREFETCH_DEPTH_DEFAULT = 2
+
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 PLD_ENABLED = "enabled"
 PLD_ENABLED_DEFAULT = False

@@ -60,6 +60,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..parallel.mesh import DATA_AXIS, PIPE_AXIS, mesh_axis_size
 from ..runtime.engine import DeepSpeedEngine
 from ..runtime.module import TrainModule
+from ..runtime.prefetch import DevicePlacedBatch
 from ..utils.logging import log_dist
 from .module import PipelineModule
 
@@ -1011,6 +1012,16 @@ class PipelineEngine(DeepSpeedEngine):
                     "fall back to the training iterator (that would consume "
                     "and advance the training data stream)")
             batch = next(data_iter)
+        if isinstance(batch, DevicePlacedBatch):
+            # a prefetched eval batch (engine.prefetch(..., for_eval=True))
+            # carries the already-converted tree; unwrap it so the
+            # divisibility check below sees the leaves, not the tag
+            if batch.kind != "eval":
+                raise ValueError(
+                    f"eval_batch received a {batch.kind!r}-placed batch; "
+                    "build the prefetcher with engine.prefetch(it, "
+                    "for_eval=True)")
+            batch = batch.tree
 
         def check(x):
             x = np.asarray(x)

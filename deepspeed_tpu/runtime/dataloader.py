@@ -12,6 +12,8 @@ from typing import Any, Callable, Iterable, Optional
 
 import numpy as np
 
+from ..utils.logging import logger
+
 
 class RepeatingLoader:
     """Wrap an iterable so it restarts instead of raising StopIteration."""
@@ -49,6 +51,22 @@ class DeepSpeedDataLoader:
         self.len = len(dataset) // batch_size
         if not self.drop_last and len(dataset) % batch_size:
             self.len += 1
+            # every distinct leading shape compiles a SEPARATE XLA
+            # program (the jaxlint JL005 hazard class): the short tail
+            # batch silently retraces eval/model steps once per shape —
+            # visible as a recompiles_total{program=...} bump — and the
+            # engine's train_batch rejects it outright (batch-dim
+            # validation).  Loud at construction, once, because the
+            # per-epoch recompile itself is silent.
+            logger.warning(
+                "DeepSpeedDataLoader: drop_last=False with len(dataset)="
+                "%d %% batch_size=%d != 0 — the final batch of each "
+                "epoch has %d rows instead of %d. A different leading "
+                "shape recompiles the step it feeds every epoch (jaxlint "
+                "JL005; watch recompiles_total). Pad the tail to a full "
+                "batch or drop it (drop_last=True).",
+                len(dataset), batch_size, len(dataset) % batch_size,
+                batch_size)
 
     def __len__(self):
         return self.len

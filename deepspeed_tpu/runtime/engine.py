@@ -44,6 +44,7 @@ from ..utils.logging import log_dist, logger
 from . import precision
 from .lr_schedules import get_lr_schedule
 from .module import TrainModule
+from .prefetch import DevicePlacedBatch, DevicePrefetcher
 from .precision import LossScaleState
 from .utils import clip_by_global_norm, global_norm
 from .zero import ZeroShardingPlan, constrain_grads
@@ -695,6 +696,25 @@ class DeepSpeedEngine:
         self.training_dataloader = (
             self.deepspeed_io(training_data, collate_fn=collate_fn)
             if training_data is not None else None)
+        # async input pipeline (docs/observability.md): _training_iter
+        # wraps its loader in a DevicePrefetcher so collate + batch
+        # sharding run off the step loop's thread.  DS_PREFETCH=0 is the
+        # no-config escape hatch back to inline placement.
+        pfc = config.data_prefetch_config
+        self._prefetch_enabled = (bool(pfc.enabled)
+                                  and os.environ.get("DS_PREFETCH", "1")
+                                  != "0")
+        self._prefetch_depth = int(pfc.depth)
+        self._train_prefetcher: Optional[DevicePrefetcher] = None
+        self._prefetch_prev_stats = None
+        # every prefetcher this engine builds (train AND eval): close()
+        # must drain them all — an abandoned worker would park forever
+        # holding `depth` device-resident batches.  The finalizer covers
+        # engines dropped without close(); it holds only the LIST (the
+        # prefetchers hold the engine weakly — see prefetch()), so the
+        # engine itself stays collectable.
+        self._prefetchers: list = []
+        weakref.finalize(self, _close_prefetchers, self._prefetchers)
 
         # ---- aux subsystems driven by config ----
         # progressive layer drop (reference engine.py:189-190,787-788)
@@ -2569,11 +2589,14 @@ class DeepSpeedEngine:
                 x if isinstance(x, jax.Array) else np.asarray(x)), batch)
         nproc = jax.process_count()
 
-        def shard(x):
+        def sharding_of(x):
             spec = [None] * x.ndim
             spec[1] = DATA_AXIS
-            sharding = NamedSharding(self.mesh, P(*spec))
-            if nproc > 1:
+            return NamedSharding(self.mesh, P(*spec))
+
+        if nproc > 1:
+            def shard(x):
+                sharding = sharding_of(x)
                 if isinstance(x, jax.Array):
                     if x.sharding == sharding:
                         return x  # already assembled for this mesh
@@ -2584,9 +2607,34 @@ class DeepSpeedEngine:
                         " — pass the local slice instead")
                 return jax.make_array_from_process_local_data(
                     sharding, np.asarray(x))
-            return jax.device_put(x, sharding)
 
-        return jax.tree.map(shard, batch)
+            return jax.tree.map(shard, batch)
+
+        # single-process: ONE batched list-form jax.device_put for all
+        # numpy leaves (mirrors offload._batched_device_put_pairs) —
+        # a multi-leaf batch must not pay one client round trip per
+        # leaf on a remote platform.  jax.Array leaves pass through a
+        # per-leaf put (a no-op for a correctly-placed array).
+        leaves, treedef = jax.tree.flatten(batch)
+        out = [None] * len(leaves)
+        np_idx, np_arrs, np_shs = [], [], []
+        for i, x in enumerate(leaves):
+            sharding = sharding_of(x)
+            if isinstance(x, jax.Array):
+                out[i] = jax.device_put(x, sharding)
+            else:
+                np_idx.append(i)
+                np_arrs.append(x)
+                np_shs.append(sharding)
+        if np_arrs:
+            # shardings are valid device_put destinations, so the
+            # offload tier's one-batched-call-with-fallback helper is
+            # the single implementation here too
+            from .offload import _batched_device_put_pairs
+            for i, p in zip(np_idx,
+                            _batched_device_put_pairs(np_arrs, np_shs)):
+                out[i] = p
+        return jax.tree.unflatten(treedef, out)
 
     # ------------------------------------------------------------------
     # public training API
@@ -2600,17 +2648,38 @@ class DeepSpeedEngine:
             it = data_iter or self._training_iter()
             if it is None:
                 raise ValueError("train_batch needs a batch or a data_iter")
+            if isinstance(it, DevicePrefetcher) \
+                    and self._train_prefetcher is not it:
+                # transparently adopt a caller-built prefetcher: its
+                # stats feed the periodic telemetry sync and engine
+                # close() shuts its worker down
+                self._bind_train_prefetcher(it)
+            # a DevicePrefetcher stamps its data/prefetch_wait span here
             batch = next(it)
         t0 = time.time()
-        if self.progressive_layer_drop is not None and isinstance(batch, dict):
-            # inject PLD state as batch leaves (the reference injects model
-            # kwargs, engine.py:787-788); the theta array updates per step
-            # without retracing
-            self.progressive_layer_drop.update_state(self.global_steps)
-            batch = dict(batch)
-            batch["pld_theta"] = np.full(
-                (len(next(iter(batch.values()))),),
-                self.progressive_layer_drop.get_theta(), np.float32)
+        placed = batch if isinstance(batch, DevicePlacedBatch) else None
+        if placed is not None and placed.kind != "train":
+            raise ValueError(
+                f"train_batch received a {placed.kind!r}-placed batch "
+                "(flat micro-batch layout); it needs the train placement "
+                "— build the prefetcher with engine.prefetch(it) (not "
+                "for_eval=True)")
+        if self.progressive_layer_drop is not None:
+            if placed is not None:
+                # prefetched batches carry a PLACEHOLDER theta leaf (they
+                # were placed ahead of time, before global_steps advanced
+                # to this step) — overwrite it at consumption so the
+                # schedule reads the CURRENT step
+                placed = self._pld_theta_overwrite(placed)
+            elif isinstance(batch, dict):
+                # inject PLD state as batch leaves (the reference injects
+                # model kwargs, engine.py:787-788); the theta array
+                # updates per step without retracing
+                self.progressive_layer_drop.update_state(self.global_steps)
+                batch = dict(batch)
+                batch["pld_theta"] = np.full(
+                    (len(next(iter(batch.values()))),),
+                    self.progressive_layer_drop.get_theta(), np.float32)
         if self.timers is not None:
             self.timers("train_batch_data").start()
         self._profiler_window_tick()
@@ -2619,8 +2688,10 @@ class DeepSpeedEngine:
         # periodic on_sync below emits the synced ground truth — zero
         # device syncs are added per step (the acceptance contract
         # tests/test_telemetry.py::test_train_batch_adds_zero_device_syncs)
-        with self._tel_span("train/shard_batch", cat="data"):
-            sharded = self._shard_batch(batch)
+        with self._tel_span("train/shard_batch", cat="data",
+                            prefetched=placed is not None):
+            sharded = (placed.tree if placed is not None
+                       else self._shard_batch(batch))
         if self._pg_check_pending:
             # first-step sweep, before any update mutates the state
             self._pg_check_pending = False
@@ -2717,6 +2788,31 @@ class DeepSpeedEngine:
             scalars["offload_h2d_s"] = acc["h2d"] / acc["steps"]
             scalars["offload_cpu_adam_s"] = acc["cpu_adam"] / acc["steps"]
             acc.update(h2d=0.0, hidden=0.0, cpu_adam=0.0, steps=0)
+        pf = getattr(self, "_train_prefetcher", None)
+        if pf is not None:
+            # interval delta over the prefetcher's cumulative stats: the
+            # hit ratio (batch already resident when the step asked) and
+            # the mean blocked wait per consumed batch — the input
+            # pipeline's hidden-vs-exposed numbers (docs/observability.md)
+            s = pf.stats()
+            prev = self._prefetch_prev_stats or {
+                "hits": 0, "misses": 0, "wait_s": 0.0}
+            self._prefetch_prev_stats = s
+            n = (s["hits"] - prev["hits"]) + (s["misses"] - prev["misses"])
+            if n > 0:
+                hit_ratio = (s["hits"] - prev["hits"]) / n
+                scalars["prefetch_hit_ratio"] = hit_ratio
+                scalars["prefetch_wait_s"] = (
+                    (s["wait_s"] - prev["wait_s"]) / n)
+                self.telemetry.registry.gauge(
+                    "data_prefetch_hit_ratio",
+                    "fraction of consumed batches already device-"
+                    "resident when requested (async input pipeline)",
+                ).set(hit_ratio)
+            self.telemetry.registry.gauge(
+                "data_prefetch_queue_depth",
+                "batches staged ahead in the input-prefetch queue",
+            ).set(pf.qsize())
         self.telemetry.on_sync(
             self.global_steps,
             interval_s=interval,
@@ -2733,14 +2829,117 @@ class DeepSpeedEngine:
 
     def _training_iter(self):
         """Persistent iterator over the training dataloader (a fresh
-        ``iter()`` per call would replay batch 0 forever)."""
+        ``iter()`` per call would replay batch 0 forever).  When the
+        ``data_prefetch`` block is enabled (the default) the iterator is
+        wrapped in a :class:`DevicePrefetcher`, so collate + batch
+        sharding run on a daemon worker ahead of consumption and
+        ``train_batch`` receives already-device-resident pytrees."""
         if self.training_dataloader is None:
             return None
         if getattr(self, "_train_data_iter", None) is None:
             loader = self.training_dataloader
-            self._train_data_iter = (loader if hasattr(loader, "__next__")
-                                     else iter(loader))
+            it = (loader if hasattr(loader, "__next__")
+                  else iter(loader))
+            if self._prefetch_enabled:
+                it = self.prefetch(it)
+                self._bind_train_prefetcher(it)
+            self._train_data_iter = it
         return self._train_data_iter
+
+    def _bind_train_prefetcher(self, pf: DevicePrefetcher):
+        """Make ``pf`` the training prefetcher whose stats feed the
+        periodic telemetry sync.  A previously bound one (e.g. an
+        adopted caller-built iterator replaced by the engine's own) is
+        kept in ``_prefetchers`` so close()/the finalizer still drain
+        it, and the stats baseline resets — interval deltas must never
+        mix two prefetchers' cumulative counters."""
+        if pf not in self._prefetchers:
+            self._prefetchers.append(pf)
+        self._train_prefetcher = pf
+        self._prefetch_prev_stats = None
+
+    def prefetch(self, data_iter, depth: Optional[int] = None,
+                 for_eval: bool = False) -> DevicePrefetcher:
+        """Wrap ``data_iter`` in a :class:`DevicePrefetcher` bound to
+        this engine's batch placement: the worker collates and
+        device-places batches ahead of consumption, and
+        ``train_batch(data_iter=...)`` / ``eval_batch(data_iter=...)``
+        transparently adopt the placed pytrees.  ``for_eval`` batches
+        skip the train reshape/sharding (eval consumes flat
+        micro-batches) — only the host collate/conversion moves off the
+        hot path there."""
+        # the worker thread is a GC root: bound methods here would pin
+        # the engine (full param/optimizer state) for process lifetime
+        # when it is dropped without close(), and its flush finalizer
+        # would never fire.  Weak closures keep the engine collectable;
+        # the _close_prefetchers finalizer then drains the worker.
+        eng_ref = weakref.ref(self)
+
+        def place(batch, _eval=for_eval):
+            eng = eng_ref()
+            if eng is None:
+                raise RuntimeError(
+                    "engine was dropped; prefetcher is orphaned")
+            return (eng._place_eval_batch(batch) if _eval
+                    else eng._place_train_batch(batch))
+
+        def span(name, cat="runtime", **args):
+            eng = eng_ref()
+            if eng is None:
+                return contextlib.nullcontext()
+            return eng._tel_span(name, cat=cat, **args)
+
+        pf = DevicePrefetcher(
+            data_iter, place_fn=place,
+            depth=depth if depth is not None else self._prefetch_depth,
+            span_fn=span,
+            name="eval" if for_eval else "train")
+        # prune already-closed entries IN PLACE (the GC finalizer holds
+        # this same list object): a per-eval prefetcher pattern must not
+        # grow the list — and retain every source iterator — forever
+        self._prefetchers[:] = [p for p in self._prefetchers
+                                if not p.closed]
+        self._prefetchers.append(pf)
+        return pf
+
+    def _place_train_batch(self, batch) -> DevicePlacedBatch:
+        """Worker-side half of the prefetch pipeline: the exact
+        placement ``train_batch`` would do inline.  PLD runs get a
+        PLACEHOLDER theta leaf so the batch's structure (and therefore
+        the compiled step's signature) matches the inline path — the
+        real theta is overwritten at consumption time
+        (``_pld_theta_overwrite``), keeping prefetched batches valid
+        across ``global_steps`` changes."""
+        rows = None
+        if self.progressive_layer_drop is not None \
+                and isinstance(batch, dict):
+            batch = dict(batch)
+            rows = len(next(iter(batch.values())))
+            batch["pld_theta"] = np.zeros((rows,), np.float32)
+        return DevicePlacedBatch(self._shard_batch(batch), rows=rows,
+                                 kind="train")
+
+    def _place_eval_batch(self, batch) -> DevicePlacedBatch:
+        """Eval placement: the same host conversion ``eval_batch`` does
+        inline (flat micro-batch, no train reshape)."""
+        return DevicePlacedBatch(jax.tree.map(np.asarray, batch),
+                                 kind="eval")
+
+    def _pld_theta_overwrite(self, placed: DevicePlacedBatch):
+        """Consumption-time PLD theta: rebuild the theta leaf for the
+        CURRENT ``global_steps`` with the same placement the prefetched
+        placeholder got — one tiny per-step put, instead of invalidating
+        every queued batch whenever the schedule advances."""
+        if not (isinstance(placed.tree, dict)
+                and "pld_theta" in placed.tree):
+            return placed
+        self.progressive_layer_drop.update_state(self.global_steps)
+        theta = self._shard_batch({"pld_theta": np.full(
+            (placed.rows,), self.progressive_layer_drop.get_theta(),
+            np.float32)})["pld_theta"]
+        tree = dict(placed.tree)
+        tree["pld_theta"] = theta
+        return DevicePlacedBatch(tree, rows=placed.rows, kind=placed.kind)
 
     def eval_batch(self, batch=None, data_iter=None):
         """Forward-only loss on one batch; like ``train_batch`` it also
@@ -2760,7 +2959,16 @@ class DeepSpeedEngine:
                     "fall back to the training iterator (that would consume "
                     "and advance the training data stream)")
             batch = next(data_iter)
-        micro = jax.tree.map(np.asarray, batch)
+        if isinstance(batch, DevicePlacedBatch):
+            if batch.kind != "eval":
+                raise ValueError(
+                    f"eval_batch received a {batch.kind!r}-placed batch "
+                    "(the train accumulation layout); it needs the flat "
+                    "eval placement — build the prefetcher with "
+                    "engine.prefetch(it, for_eval=True)")
+            micro = batch.tree
+        else:
+            micro = jax.tree.map(np.asarray, batch)
         rng = jax.random.fold_in(self._data_rng, self.micro_steps)
         with self._pallas_scope():
             if self._offload_host:
@@ -2875,6 +3083,13 @@ class DeepSpeedEngine:
             self.stop_profiler()  # no-op unless a window is open
         except Exception:
             pass
+        # drain the input pipeline: releases each parked worker and the
+        # device-resident batches it staged ahead (idempotent).  Covers
+        # every engine-built prefetcher (train and eval) AND an adopted
+        # caller-built training prefetcher — _bind_train_prefetcher puts
+        # all of them in this list.
+        for pf in getattr(self, "_prefetchers", []):
+            pf.close()
         self._flush_tensorboard()
         tel = getattr(self, "telemetry", None)
         if tel is not None:
@@ -3091,6 +3306,18 @@ def _close_quietly(objs, tb_pending=None, writer=None, tracer=None):
     for obj in objs:
         try:
             obj.close()
+        except Exception:
+            pass
+
+
+def _close_prefetchers(prefetchers):
+    """GC-finalizer body for a dropped engine's input pipeline: release
+    each parked prefetch worker (and the device-resident batches it
+    staged).  Holds only the list object — the prefetchers reference the
+    engine weakly, so this finalizer can actually fire.  Never raises."""
+    for pf in list(prefetchers):
+        try:
+            pf.close()
         except Exception:
             pass
 

@@ -392,9 +392,11 @@ def _batched_device_put_pairs(blks, devices):
     """ONE batched transfer call placing ``blks[i]`` on ``devices[i]``
     (the list form of ``jax.device_put`` dispatches them together) —
     replicated small leaves must not pay one client round-trip per
-    replica device.  Falls back to the per-pair loop on jax versions
-    without the list form.  The single fallback implementation: both
-    the serial ``_assemble`` and the streamed ``upload_block`` route
+    replica device.  ``devices`` entries may be Devices OR Shardings
+    (both are valid ``device_put`` destinations).  Falls back to the
+    per-pair loop on jax versions without the list form.  The single
+    fallback implementation: the serial ``_assemble``, the streamed
+    ``upload_block``, and the engine's ``_shard_batch`` all route
     through here."""
     if not blks:
         return []

@@ -1,0 +1,254 @@
+"""Asynchronous input pipeline — prefetched host collate + device placement.
+
+The engine's step loop is sync-free on the device side (``train_batch``
+dispatches and returns), but every step still paid host-side work
+serially BEFORE dispatch: ``next(data_iter)`` → collate →
+``_shard_batch`` (reshape + ``jax.device_put``) all ran on the caller's
+thread while the devices sat idle waiting for the next program's
+arguments.  ``DevicePrefetcher`` moves that whole chain off the hot
+path: ONE daemon worker pulls batches ahead of consumption through a
+bounded queue (default depth 2 — double buffering), runs the collate
+and device placement there, and the step loop receives already
+device-resident sharded pytrees.  The input-feeding half of the
+ZeRO-Offload overlap story: the same streaming-worker shape as the
+optimizer pipeline in ``runtime/offload.py`` (bounded queue,
+drain-inside-span, poison-on-failure), applied to the data path —
+where remote-platform H2D latency (BENCH_NOTES.md's tunnel round
+trips) is entirely hideable behind the previous step's compute.
+
+Contracts:
+
+  - the worker drains each placed batch with ``jax.block_until_ready``
+    INSIDE its ``data/prefetch_place`` span, so a queued batch is
+    actually device-resident (not merely dispatched — the JL006 bug
+    class) and an async transfer failure poisons the iterator instead
+    of escaping into the consuming step;
+  - ``StopIteration`` from the source propagates cleanly at the epoch
+    boundary AFTER every already-produced batch is consumed, and the
+    iterator stays exhausted (a persistent training iterator must not
+    resurrect);
+  - any other worker failure poisons the queue: the consumer re-raises
+    the ORIGINAL exception (again on every later ``next``), after
+    first draining batches produced before the failure;
+  - ``close()`` is idempotent and releases the worker (the engine's
+    ``close()`` calls it); a closed prefetcher refuses further pulls.
+
+Knobs: the ``data_prefetch`` config block (enabled/depth; default ON),
+``DS_PREFETCH=0`` — the no-config escape hatch back to inline
+collate+placement, and ``DS_PREFETCH_DELAY_S`` — fault injection
+(tests/bench only): the worker sleeps this long inside each placement
+span, emulating a slow collate/H2D link so a CPU-only run can prove
+the overlap from tracer timestamps (``tests/test_prefetch.py``).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import jax
+
+__all__ = ["DevicePlacedBatch", "DevicePrefetcher"]
+
+
+class DevicePlacedBatch:
+    """Tag for a batch that has ALREADY been collated and device-placed
+    (the prefetcher's product).  The engine detects it and skips its own
+    ``_shard_batch``; ``rows`` is the pre-reshape local batch length —
+    what a consumption-time leaf overwrite (PLD theta) needs to rebuild
+    a leaf for the same placement.  ``kind`` records WHICH placement
+    produced it ("train": reshaped+sharded accumulation layout; "eval":
+    flat micro-batch) so the consumption sites can reject a batch placed
+    for the other path with a descriptive error instead of a deep shape
+    failure.  An explicit tag, never sniffed from leaf types: a user
+    batch that happens to contain jax Arrays must still go through the
+    engine's reshape/validation."""
+
+    __slots__ = ("tree", "rows", "kind")
+
+    def __init__(self, tree: Any, rows: Optional[int] = None,
+                 kind: str = "train"):
+        self.tree = tree
+        self.rows = rows
+        self.kind = kind
+
+
+class _End:
+    """Queue sentinel: the source raised StopIteration."""
+
+    __slots__ = ()
+
+
+_END = _End()
+
+
+class DevicePrefetcher:
+    """Wrap a batch iterator with a single daemon worker and a bounded
+    queue, pulling batches ahead of consumption.
+
+    ``place_fn(batch)`` runs ON THE WORKER (collate output → device
+    placement); it may return a :class:`DevicePlacedBatch` (the engine's
+    placement closures do) or a plain pytree.  ``span_fn`` (optional,
+    the engine passes ``_tel_span``) receives two host-side spans:
+    ``data/prefetch_place`` around each worker-side placement (transfer
+    drained inside — see the module docstring) and ``data/prefetch_wait``
+    around each consumer-side queue wait — the time the step actually
+    blocked on input, the pipeline's "hidden vs. exposed" number
+    (steady state ≈ 0 when production hides under the previous step).
+
+    ``stats()`` exposes cumulative ``hits`` (batch already queued when
+    requested), ``misses``, ``wait_s``, and ``consumed`` — the engine
+    turns interval deltas into the ``prefetch_hit_ratio`` sync scalar.
+    """
+
+    def __init__(self, source, place_fn: Optional[Callable] = None,
+                 depth: int = 2, span_fn: Optional[Callable] = None,
+                 name: str = "train"):
+        if not isinstance(depth, int) or isinstance(depth, bool) \
+                or depth < 1:
+            raise ValueError(f"prefetch depth must be an int >= 1, "
+                             f"got {depth!r}")
+        self._src = source if hasattr(source, "__next__") else iter(source)
+        self._place = place_fn if place_fn is not None else (lambda b: b)
+        self._span = span_fn if span_fn is not None else (
+            lambda *a, **k: contextlib.nullcontext())
+        self.depth = depth
+        self.name = name
+        self._delay = float(os.environ.get("DS_PREFETCH_DELAY_S", "0"))
+        self._cond = threading.Condition()
+        self._q: list = []
+        self._err: Optional[BaseException] = None
+        self._closed = False
+        self._ended = False
+        # cumulative stats (guarded by _cond's lock)
+        self._hits = 0
+        self._misses = 0
+        self._wait_s = 0.0
+        self._consumed = 0
+        self._thread = threading.Thread(
+            target=self._work, daemon=True,
+            name=f"ds-data-prefetch-{name}")
+        self._thread.start()
+
+    # -- the worker -----------------------------------------------------
+    def _work(self):
+        batch_idx = 0
+        while True:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self._closed or len(self._q) < self.depth)
+                if self._closed:
+                    return
+            try:
+                item = next(self._src)
+            except StopIteration:
+                with self._cond:
+                    self._q.append(_END)  # after every produced batch
+                    self._cond.notify_all()
+                return
+            except BaseException as e:  # poison: consumer re-raises it
+                with self._cond:
+                    self._err = e
+                    self._cond.notify_all()
+                return
+            try:
+                with self._span("data/prefetch_place", cat="data",
+                                batch=batch_idx):
+                    if self._delay > 0:
+                        time.sleep(self._delay)
+                    placed = self._place(item)
+                    # drain INSIDE the span: device_put only dispatches,
+                    # so without this a queued batch would not actually
+                    # be resident (the JL006 dispatch-only class) and an
+                    # async transfer failure would surface in the
+                    # consuming step instead of the poison path
+                    tree = (placed.tree
+                            if isinstance(placed, DevicePlacedBatch)
+                            else placed)
+                    jax.block_until_ready(tree)
+            except BaseException as e:
+                with self._cond:
+                    self._err = e
+                    self._cond.notify_all()
+                return
+            batch_idx += 1
+            with self._cond:
+                if self._closed:
+                    return  # dropped: close() already released consumers
+                self._q.append(placed)
+                self._cond.notify_all()
+
+    # -- the consumer side ----------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        with self._span("data/prefetch_wait", cat="data"):
+            with self._cond:
+                # exhausted BEFORE closed: consuming the epoch-end
+                # sentinel self-closes below (the worker has already
+                # exited), and an exhausted iterator must keep raising
+                # StopIteration, not a closed error
+                if self._ended:
+                    raise StopIteration
+                if self._closed:
+                    raise RuntimeError(
+                        "DevicePrefetcher is closed (engine.close() shut "
+                        "it down)")
+                hit = bool(self._q)
+                self._cond.wait_for(
+                    lambda: self._q or self._err is not None
+                    or self._closed)
+                if self._closed:
+                    raise RuntimeError(
+                        "DevicePrefetcher closed while waiting for a "
+                        "batch")
+                if self._q:
+                    # batches produced before an end/failure drain first
+                    item = self._q.pop(0)
+                    self._cond.notify_all()  # a slot freed
+                    if isinstance(item, _End):
+                        # the worker already exited; self-close so an
+                        # exhausted prefetcher counts as drained (the
+                        # engine prunes closed ones from its list)
+                        self._ended = True
+                        self._closed = True
+                        raise StopIteration
+                    self._hits += 1 if hit else 0
+                    self._misses += 0 if hit else 1
+                    self._wait_s += time.perf_counter() - t0
+                    self._consumed += 1
+                    return item
+                # queue empty, worker dead: surface the original error
+                raise self._err
+
+    # -- introspection ---------------------------------------------------
+    def qsize(self) -> int:
+        """Batches ready for consumption right now (the queue-depth
+        gauge; the epoch-end sentinel does not count)."""
+        with self._cond:
+            return len([x for x in self._q if not isinstance(x, _End)])
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {"hits": self._hits, "misses": self._misses,
+                    "wait_s": self._wait_s, "consumed": self._consumed}
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- shutdown --------------------------------------------------------
+    def close(self):
+        """Release the worker and drop queued batches.  Idempotent; a
+        parked worker (queue full) would otherwise wait forever holding
+        references to ``depth`` device-resident batches."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.clear()
+            self._cond.notify_all()

@@ -55,6 +55,8 @@ def summarize(path: str, out=None) -> dict:
     synced: List[float] = []
     sps: List[float] = []
     overlap: List[float] = []
+    pf_hits: List[float] = []
+    pf_wait: List[float] = []
     peak_hbm: Optional[float] = None
     host_rss: Optional[float] = None
     bad_lines = 0
@@ -81,13 +83,22 @@ def summarize(path: str, out=None) -> dict:
                     synced.extend([float(rec["step_avg_s"])] * n)
                 if rec.get("samples_per_sec") is not None:
                     sps.append(float(rec["samples_per_sec"]))
-                ov = (rec.get("scalars") or {}).get(
-                    "offload_overlap_ratio")
+                scalars = rec.get("scalars") or {}
+                ov = scalars.get("offload_overlap_ratio")
                 if ov is not None:
                     # weight by the interval's step count, same as the
                     # step-time percentiles — a 1-step straggler interval
                     # must not count like a full one
                     overlap.extend([float(ov)]
+                                   * int(rec.get("steps") or 1))
+                ph = scalars.get("prefetch_hit_ratio")
+                if ph is not None:
+                    # async input pipeline: same step-count weighting
+                    pf_hits.extend([float(ph)]
+                                   * int(rec.get("steps") or 1))
+                pw = scalars.get("prefetch_wait_s")
+                if pw is not None:
+                    pf_wait.extend([float(pw)]
                                    * int(rec.get("steps") or 1))
             elif kind == "memory":
                 stats = rec.get("stats") or {}
@@ -113,6 +124,8 @@ def summarize(path: str, out=None) -> dict:
     avg_sps = sum(sps) / len(sps) if sps else None
 
     avg_overlap = sum(overlap) / len(overlap) if overlap else None
+    avg_pf_hit = sum(pf_hits) / len(pf_hits) if pf_hits else None
+    avg_pf_wait = sum(pf_wait) / len(pf_wait) if pf_wait else None
 
     report = {
         "steps": steps,
@@ -120,6 +133,8 @@ def summarize(path: str, out=None) -> dict:
         "p50_s": p50, "p95_s": p95, "p99_s": p99,
         "samples_per_sec": avg_sps,
         "offload_overlap_ratio": avg_overlap,
+        "prefetch_hit_ratio": avg_pf_hit,
+        "prefetch_wait_s": avg_pf_wait,
         "peak_hbm_bytes": peak_hbm,
         "host_rss_bytes": host_rss,
         "bad_lines": bad_lines,
@@ -136,6 +151,13 @@ def summarize(path: str, out=None) -> dict:
         # fully hidden under the host Adam; 0 = serial (all tail)
         print(f"  offload H2D overlap {avg_overlap * 100:.0f}% hidden "
               "under host Adam", file=out)
+    if avg_pf_hit is not None:
+        # async input pipeline: hit = batch already device-resident
+        # when the step asked; wait = the exposed input stall per step
+        wait_txt = (f"  wait {_fmt_s(avg_pf_wait)}/step"
+                    if avg_pf_wait is not None else "")
+        print(f"  input prefetch     hit {avg_pf_hit * 100:.0f}%"
+              f"{wait_txt}", file=out)
     print(f"  peak HBM           {_fmt_bytes(peak_hbm)}", file=out)
     if host_rss is not None:
         print(f"  peak host RSS      {_fmt_bytes(host_rss)}", file=out)

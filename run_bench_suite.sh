@@ -14,6 +14,7 @@ run() {
 # and killing a TPU client mid-native-call can wedge the tunnel for
 # everything after it (BENCH_NOTES.md round 3)
 run r03 python bench.py
+run prefetch python bench.py --prefetch=ab
 run bert python bench_bert.py
 run sparse python bench_sparse.py
 run flash python bench_flash.py

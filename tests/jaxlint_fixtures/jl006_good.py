@@ -28,3 +28,23 @@ def timed_pure_python(values):
     t0 = time.time()
     total = sum(values)          # no device work timed
     return total, time.time() - t0
+
+
+def prefetcher_queue_wait(q, cond):
+    """DevicePrefetcher-shaped (runtime/prefetch.py): the timed window
+    brackets a REAL block — a condition wait on the bounded queue — not
+    an async jax dispatch.  JL006 must stay silent."""
+    t0 = time.perf_counter()
+    with cond:
+        cond.wait_for(lambda: q)
+        batch = q.pop(0)
+    return batch, time.perf_counter() - t0
+
+
+def prefetcher_place_window(x):
+    """Worker-side placement window: the device_put dispatch is drained
+    by block_until_ready INSIDE the timed window (transfer-real)."""
+    t0 = time.perf_counter()
+    y = jax.device_put(x)
+    jax.block_until_ready(y)
+    return y, time.perf_counter() - t0

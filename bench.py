@@ -501,6 +501,132 @@ def _prefetch_ab(jax, mode: str):
     print(json.dumps(rec), flush=True)
 
 
+def bench_ckpt(jax, use_async: bool, steps: int = None,
+               interval: int = None):
+    """A/B one leg of the fault-tolerant checkpoint pipeline: the same
+    training loop with a checkpoint interval active, saving sync vs
+    async.  Reports steps/sec, the exposed per-save stall (the stall the
+    step loop actually paid — async pays only the snapshot D2H), and the
+    background write time the async writer hid, PROVEN from tracer
+    timestamps: hidden = how far each ``checkpoint/write`` span ran past
+    its originating ``checkpoint/save`` span's end.
+
+    Size is platform-scaled like the other A/B benches: tiny on CPU with
+    ``DS_CKPT_DELAY_S`` injected write latency (the tier-1 smoke's
+    overlap proof), mid-size on TPU via BENCH_CKPT_* knobs."""
+    import tempfile
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.config import DeepSpeedConfig
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        d_model = int(os.environ.get("BENCH_CKPT_D_MODEL", "1024"))
+        n_layer = int(os.environ.get("BENCH_CKPT_LAYERS", "12"))
+        micro = int(os.environ.get("BENCH_CKPT_MICRO", "4"))
+        seq, vocab = 1024, 50257
+        steps = steps or int(os.environ.get("BENCH_CKPT_STEPS", "8"))
+        interval = interval or int(os.environ.get("BENCH_CKPT_INTERVAL",
+                                                  "4"))
+    else:
+        d_model, n_layer, micro = 64, 2, 2
+        seq, vocab = 64, 256
+        steps = steps or 6
+        interval = interval or 2
+        # injected write latency: the thing the async leg hides (both
+        # legs pay it; operators can override/disable)
+        os.environ.setdefault("DS_CKPT_DELAY_S", "0.15")
+    cfg_model = GPT2Config(d_model=d_model, n_layer=n_layer,
+                           n_head=max(2, d_model // 64), vocab_size=vocab,
+                           n_positions=seq, remat=None)
+    mesh = build_mesh(devices=jax.devices()[:1])
+    save_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    tel_dir = tempfile.mkdtemp(prefix="bench_ckpt_tel_")
+    ds_cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "telemetry": {"enabled": True, "output_path": tel_dir,
+                      "compile_events": False, "memory": False},
+        "checkpoint": {"keep_last_n": 2},
+    }, world_size=1)
+    mode = "async" if use_async else "sync"
+    _mark(f"ckpt[{mode}]: constructing engine")
+    engine = DeepSpeedEngine(GPT2Model(cfg_model), ds_cfg, mesh=mesh)
+    tokens = np.random.default_rng(0).integers(
+        0, vocab, (micro, seq + 1), dtype=np.int32)
+    tokens = _device_resident(engine, tokens)
+    np.asarray(engine.train_batch(tokens))  # warmup/compile
+    save_stall = 0.0
+    saves = 0
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss = engine.train_batch(tokens)
+        if (i + 1) % interval == 0:
+            s0 = time.perf_counter()
+            engine.save_checkpoint(save_dir, async_write=use_async)
+            save_stall += time.perf_counter() - s0
+            saves += 1
+    loss = float(np.asarray(loss))
+    dt = (time.perf_counter() - t0) / steps
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+    engine._ckpt_writer.drain()  # async leg: land the last write
+    # overlap proof from tracer timestamps: each checkpoint/async_write
+    # span's originating save is the LATEST checkpoint/save span that
+    # started before the write did (coalescing can drop intermediate
+    # saves, so a positional zip would misalign); hidden time = how far
+    # the write ran past that save call's return, averaged over WRITTEN
+    # checkpoints (submissions that coalesced away never wrote)
+    hidden = 0.0
+    ev = [e for e in engine.telemetry.tracer.events() if e.get("ph") == "X"]
+    save_spans = [e for e in ev if e["name"] == "checkpoint/save"]
+    write_spans = [e for e in ev if e["name"] == "checkpoint/async_write"]
+    for w in write_spans:
+        cands = [s for s in save_spans if s["ts"] <= w["ts"]]
+        if not cands:
+            continue
+        s = max(cands, key=lambda e: e["ts"])
+        hidden += max(0.0, (w["ts"] + w["dur"]) - (s["ts"] + s["dur"])) / 1e6
+    engine.close()
+    out = {"ckpt": mode,
+           "step_s": round(dt, 6),
+           "saves": saves,
+           "writes": len(write_spans) if use_async else saves,
+           "save_exposed_s": round(save_stall / max(saves, 1), 6),
+           "ckpt_hidden_s": round(hidden / max(len(write_spans), 1), 6),
+           "delay_s": float(os.environ.get("DS_CKPT_DELAY_S", "0") or 0)}
+    _mark(f"ckpt[{mode}]: {dt:.3f}s/step, exposed "
+          f"{out['save_exposed_s']:.3f}s/save, hidden "
+          f"{out['ckpt_hidden_s']:.3f}s/save")
+    return out
+
+
+def _ckpt_ab(jax, mode: str):
+    """``--ckpt={sync,async,ab}``: steps/sec with a checkpoint interval
+    active; the A/B records the exposed-stall comparison and speedup."""
+    legs = {"async": [True], "sync": [False],
+            "ab": [True, False]}[mode]
+    results = [bench_ckpt(jax, leg) for leg in legs]
+    rec = {"metric": "ckpt_step_breakdown",
+           "unit": "s/step",
+           "legs": results}
+    if len(results) == 2:
+        sync_t, async_t = results[1]["step_s"], results[0]["step_s"]
+        rec["speedup"] = round(sync_t / async_t, 4) if async_t > 0 else 0.0
+        rec["exposed_stall_ratio"] = round(
+            results[0]["save_exposed_s"]
+            / max(results[1]["save_exposed_s"], 1e-9), 4)
+    try:
+        with open("BENCH_ckpt.json", "w") as f:
+            json.dump(rec, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(rec), flush=True)
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache shared across bench runs.  The
     1.5B program (48-layer scan + offload staging) is compile-heavy and
@@ -593,6 +719,13 @@ def main():
                              "collate + H2D batch placement): step time "
                              "+ prefetch wait/hit breakdown instead of "
                              "the north-star bench")
+    parser.add_argument("--ckpt", choices=("sync", "async", "ab"),
+                        default=None,
+                        help="A/B fault-tolerant checkpointing: steps/sec "
+                             "with a checkpoint interval active, sync vs "
+                             "async saves (exposed-stall comparison + "
+                             "tracer-proven hidden write time) instead "
+                             "of the north-star bench")
     # strict parse: a typo'd flag must fail loudly, not silently launch
     # the multi-hour north-star run (the _15b_knobs eager-validation rule)
     args = parser.parse_args()
@@ -607,6 +740,10 @@ def main():
 
     if args.prefetch is not None:
         _prefetch_ab(jax, args.prefetch)
+        return
+
+    if args.ckpt is not None:
+        _ckpt_ab(jax, args.ckpt)
         return
 
     if not on_tpu:  # CPU smoke (driver runs the real thing on TPU)

@@ -283,6 +283,61 @@ class DeepSpeedDataPrefetchConfig:
                 f"got {self.depth!r}")
 
 
+class DeepSpeedCheckpointConfig:
+    """Fault-tolerant checkpointing block (docs/checkpointing.md): async
+    background saves, ``keep_last_n`` retention, the corrupt-latest
+    ``load_fallback`` chain, transient-I/O retry, and the opt-in SIGTERM
+    preemption save.  All knobs validate eagerly — a typo'd retention
+    policy must fail at config parse, not at the 40-hour mark when the
+    first GC runs."""
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        ck = param_dict.get(C.CHECKPOINT) or {}
+        self.async_save = get_scalar_param(
+            ck, C.CKPT_ASYNC_SAVE, C.CKPT_ASYNC_SAVE_DEFAULT)
+        self.keep_last_n = get_scalar_param(
+            ck, C.CKPT_KEEP_LAST_N, C.CKPT_KEEP_LAST_N_DEFAULT)
+        self.load_fallback = get_scalar_param(
+            ck, C.CKPT_LOAD_FALLBACK, C.CKPT_LOAD_FALLBACK_DEFAULT)
+        self.io_retry_attempts = get_scalar_param(
+            ck, C.CKPT_IO_RETRY_ATTEMPTS, C.CKPT_IO_RETRY_ATTEMPTS_DEFAULT)
+        self.io_retry_base_s = get_scalar_param(
+            ck, C.CKPT_IO_RETRY_BASE_S, C.CKPT_IO_RETRY_BASE_S_DEFAULT)
+        self.sigterm_save = get_scalar_param(
+            ck, C.CKPT_SIGTERM_SAVE, C.CKPT_SIGTERM_SAVE_DEFAULT)
+        self.save_dir = get_scalar_param(
+            ck, C.CKPT_SAVE_DIR, C.CKPT_SAVE_DIR_DEFAULT)
+        for name, v in ((C.CKPT_KEEP_LAST_N, self.keep_last_n),
+                        (C.CKPT_LOAD_FALLBACK, self.load_fallback)):
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                raise DeepSpeedConfigError(
+                    f"checkpoint.{name} must be an int >= 0, got {v!r}")
+        if (not isinstance(self.io_retry_attempts, int)
+                or isinstance(self.io_retry_attempts, bool)
+                or self.io_retry_attempts < 1):
+            raise DeepSpeedConfigError(
+                f"checkpoint.{C.CKPT_IO_RETRY_ATTEMPTS} must be an int "
+                f">= 1 (1 = no retry), got {self.io_retry_attempts!r}")
+        if (not isinstance(self.io_retry_base_s, (int, float))
+                or isinstance(self.io_retry_base_s, bool)
+                or self.io_retry_base_s < 0):
+            raise DeepSpeedConfigError(
+                f"checkpoint.{C.CKPT_IO_RETRY_BASE_S} must be a number "
+                f">= 0, got {self.io_retry_base_s!r}")
+        if not isinstance(self.save_dir, str):
+            raise DeepSpeedConfigError(
+                f"checkpoint.{C.CKPT_SAVE_DIR} must be a string path, "
+                f"got {self.save_dir!r}")
+        for name, v in ((C.CKPT_ASYNC_SAVE, self.async_save),
+                        (C.CKPT_SIGTERM_SAVE, self.sigterm_save)):
+            # a JSON string like "false" is truthy — silently flipping
+            # every save async (or installing the SIGTERM hook) is the
+            # opposite of what was configured
+            if not isinstance(v, bool):
+                raise DeepSpeedConfigError(
+                    f"checkpoint.{name} must be a bool, got {v!r}")
+
+
 class DeepSpeedPipelineConfig:
     def __init__(self, param_dict: Dict[str, Any]):
         pipe = param_dict.get(C.PIPELINE) or {}
@@ -406,6 +461,7 @@ class DeepSpeedConfig:
         self.profiler_config = DeepSpeedProfilerConfig(pd)
         self.telemetry_config = DeepSpeedTelemetryConfig(pd)
         self.data_prefetch_config = DeepSpeedDataPrefetchConfig(pd)
+        self.checkpoint_config = DeepSpeedCheckpointConfig(pd)
         self.pipeline_config = DeepSpeedPipelineConfig(pd)
 
         self._solve_batch_triangle()

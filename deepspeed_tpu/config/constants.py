@@ -345,6 +345,47 @@ DATA_PREFETCH_ENABLED_DEFAULT = True
 DATA_PREFETCH_DEPTH = "depth"
 DATA_PREFETCH_DEPTH_DEFAULT = 2
 
+#############################################
+# Fault-tolerant checkpointing (TPU extension; docs/checkpointing.md)
+#############################################
+# One block for the save/load resilience plane: async background writes,
+# integrity verification (per-leaf CRC32 + manifest digests), the
+# corrupt-latest fallback chain, retention GC, transient-I/O retry, and
+# the SIGTERM preemption hook.  The reference writes synchronously and
+# trusts the filesystem (reference engine.py:1211-1290).
+CHECKPOINT = "checkpoint"
+# true = every save_checkpoint call defaults to the async path (snapshot
+# to host, daemon writer serializes off the hot path); per-call
+# async_write= overrides.  Single-controller only (multi-host saves need
+# the cross-process barriers and stay synchronous).
+CKPT_ASYNC_SAVE = "async_save"
+CKPT_ASYNC_SAVE_DEFAULT = False
+# retention: keep the newest N tags, GC older ones (and orphaned *.tmp
+# dirs) strictly AFTER a new save verifies.  0 = unlimited (never
+# delete) — the reference behavior, and the safe default.
+CKPT_KEEP_LAST_N = "keep_last_n"
+CKPT_KEEP_LAST_N_DEFAULT = 0
+# corrupt-latest fallback: how many OLDER on-disk tags
+# load_checkpoint(tag=None) tries (deep CRC verify) after the tag
+# `latest` names fails verification or is gone.  0 disables walking back.
+CKPT_LOAD_FALLBACK = "load_fallback"
+CKPT_LOAD_FALLBACK_DEFAULT = 2
+# transient-I/O retry: TOTAL attempts per read/write (1 = no retry) and
+# the exponential-backoff base (full jitter; capped at 2s per wait)
+CKPT_IO_RETRY_ATTEMPTS = "io_retry_attempts"
+CKPT_IO_RETRY_ATTEMPTS_DEFAULT = 3
+CKPT_IO_RETRY_BASE_S = "io_retry_base_s"
+CKPT_IO_RETRY_BASE_S_DEFAULT = 0.05
+# opt-in preemption hook: on SIGTERM, one final SYNCHRONOUS save + clean
+# engine.close() so a preempted pod resumes at the last step instead of
+# the last checkpoint-interval boundary.  Single-controller only.
+CKPT_SIGTERM_SAVE = "sigterm_save"
+CKPT_SIGTERM_SAVE_DEFAULT = False
+# where the SIGTERM save lands when no save_checkpoint has run yet this
+# process ("" = use the directory of the most recent save)
+CKPT_SAVE_DIR = "save_dir"
+CKPT_SAVE_DIR_DEFAULT = ""
+
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 PLD_ENABLED = "enabled"
 PLD_ENABLED_DEFAULT = False

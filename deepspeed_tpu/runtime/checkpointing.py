@@ -14,25 +14,59 @@ mesh change (DP resize, ZeRO stage change, TP change) then falls out of
 ``jax.device_put`` — the elastic-restore feature costs nothing.
 
 Layout of ``<save_dir>/<tag>/``:
-  - ``meta.json``                       counters, world info, client_state
+  - ``meta.json``                       counters, world info, client_state,
+                                        ``format_version`` + manifest digests
   - ``model/manifest.json  + *.npy``    module weights in compute dtype
   - ``optim/manifest.json  + *.npy``    fp32 master + optimizer state + scaler
 
 ``<save_dir>/latest`` holds the most recent tag (reference engine.py:1406).
 Non-numpy-native dtypes (bfloat16) are stored as bit-pattern views with the
 logical dtype recorded in the manifest.
+
+Fault tolerance (docs/checkpointing.md; primitives in ``resilience.py``):
+
+  - **Integrity plane** — every manifest entry records a per-leaf CRC32 and
+    byte length; ``meta.json`` records a ``format_version`` and the SHA-256
+    of each plane's manifest.  ``load_tree`` verifies lazily per leaf read
+    and raises a typed ``CheckpointCorruptError`` naming the leaf/file.
+  - **Async saves** — ``save_checkpoint(..., async_write=True)`` snapshots
+    device state to host (D2H drained inside a ``checkpoint/snapshot``
+    span), then the engine's daemon writer serializes + fsyncs + atomically
+    renames off the hot path.  Async and sync saves share ONE write path,
+    so their bytes are identical.
+  - **Fallback chain** — ``load_checkpoint(tag=None)`` distinguishes
+    MISSING / CORRUPT / OK; a corrupt or vanished latest walks back to the
+    newest tag that verifies (bounded by ``checkpoint.load_fallback``).
+    An EXPLICIT ``tag=`` that doesn't verify raises instead of masquerading
+    as "nothing to load".
+  - **Retention** — ``checkpoint.keep_last_n`` GCs old tags and orphaned
+    ``*.tmp`` dirs only AFTER a new save verifies.
+  - **Retry** — every read/write retries with exponential backoff + jitter
+    (``checkpoint.io_retry_*``); ``DS_CKPT_FAULT`` injects failures for
+    tests and ``DS_CKPT_DELAY_S`` injects write latency for overlap proofs.
 """
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import threading
+import time
+import weakref
+import zlib
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
 
-from ..utils.logging import log_dist
+from ..utils.logging import log_dist, logger
+from .resilience import (AsyncCheckpointWriter, CheckpointCorruptError,
+                         CheckpointError, CheckpointJob,
+                         CheckpointMissingError, CKPT_CORRUPT,
+                         CKPT_FORMAT_VERSION, CKPT_MISSING, CKPT_OK,
+                         DEFAULT_RETRY, RetryPolicy, fault_point,
+                         io_retry, retention_gc, list_tags, sweep_tmp)
 
 LATEST_FILE = "latest"
 
@@ -45,6 +79,57 @@ def _tel_span(engine, name: str, **args):
     if span is None:
         return contextlib.nullcontext()
     return span(name, cat="checkpoint", **args)
+
+
+# ---------------------------------------------------------------------------
+# telemetry sink (counters reachable from helpers + the writer thread)
+# ---------------------------------------------------------------------------
+_TEL = threading.local()
+
+
+@contextlib.contextmanager
+def _tel_sink(engine):
+    """Bind the engine's metrics registry for this thread so the deep
+    write/read helpers (and retention GC) can bump counters without
+    threading a handle through every call."""
+    reg = getattr(getattr(engine, "telemetry", None), "registry", None)
+    prev = getattr(_TEL, "reg", None)
+    _TEL.reg = reg
+    try:
+        yield
+    finally:
+        _TEL.reg = prev
+
+
+def _count(name: str, help: str, n: float = 1):
+    reg = getattr(_TEL, "reg", None)
+    if reg is not None and n:
+        reg.counter(name, help).inc(n)
+
+
+def _on_retry(_attempt, _exc):
+    _count("ckpt_retries_total",
+           "checkpoint I/O retries (transient OSError, backed off)")
+
+
+# ---------------------------------------------------------------------------
+# resolved checkpoint config (engine-shaped ducks get defaults)
+# ---------------------------------------------------------------------------
+class _CkptCfg(NamedTuple):
+    retry: RetryPolicy = DEFAULT_RETRY
+    keep_last_n: int = 0
+    load_fallback: int = 2
+
+
+def _ckpt_config(engine) -> _CkptCfg:
+    cc = getattr(getattr(engine, "config", None), "checkpoint_config", None)
+    if cc is None:
+        return _CkptCfg()
+    return _CkptCfg(
+        retry=RetryPolicy(attempts=int(cc.io_retry_attempts),
+                          base_s=float(cc.io_retry_base_s)),
+        keep_last_n=int(cc.keep_last_n),
+        load_fallback=int(cc.load_fallback))
 
 
 # ---------------------------------------------------------------------------
@@ -66,6 +151,129 @@ def _from_storage(arr: np.ndarray, logical: str) -> np.ndarray:
         import ml_dtypes
         return arr.view(np.dtype(getattr(ml_dtypes, logical)))
     return arr
+
+
+def _crc32_arr(arr: np.ndarray) -> int:
+    """CRC32 of the array's raw data bytes (the integrity record every
+    manifest entry carries).  Computed on the STORAGE array, so it matches
+    what ``np.load`` returns before any logical-dtype view."""
+    a = np.ascontiguousarray(arr)
+    try:
+        buf = memoryview(a).cast("B")
+    except (TypeError, ValueError):
+        buf = a.tobytes()
+    return zlib.crc32(buf) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# fsync'd, retried, fault-injectable file primitives
+# ---------------------------------------------------------------------------
+def _fsync_enabled() -> bool:
+    """Per-file fsync before the atomic rename (power-loss durability).
+    Default ON.  ``DS_CKPT_FSYNC=0`` is the test/CI escape hatch: unit
+    tests simulate process death, which the page cache survives, and on
+    slow test filesystems (9p, overlay) each fsync costs tens of ms per
+    file.  Even with fsync off, a power loss that corrupts the newest
+    checkpoint is caught by the CRC plane and recovered via the
+    fallback chain — fsync narrows the window, the integrity plane
+    closes it."""
+    return os.environ.get("DS_CKPT_FSYNC", "1") != "0"
+
+
+def _write_npy(path: str, store: np.ndarray,
+               retry: RetryPolicy, point: str = "leaf") -> None:
+    def write():
+        fault_point(point, path)
+        with open(path, "wb") as f:
+            np.save(f, store, allow_pickle=False)
+            f.flush()
+            if _fsync_enabled():
+                os.fsync(f.fileno())
+    io_retry(write, f"write {path}", retry, on_retry=_on_retry)
+
+
+def _write_bytes(path: str, data: bytes, retry: RetryPolicy,
+                 point: str) -> None:
+    def write():
+        fault_point(point, path)
+        with open(path, "wb") as f:
+            f.write(data)
+            f.flush()
+            if _fsync_enabled():
+                os.fsync(f.fileno())
+    io_retry(write, f"write {path}", retry, on_retry=_on_retry)
+
+
+def _read_npy(path: str, retry: RetryPolicy, key: str) -> np.ndarray:
+    def read():
+        fault_point("read", path)
+        return np.load(path, allow_pickle=False)
+    try:
+        return io_retry(read, f"read {path}", retry, on_retry=_on_retry)
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            f"checkpoint leaf {key!r}: file {path} is missing")
+    except (ValueError, EOFError, OSError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint leaf {key!r}: file {path} is unreadable "
+            f"({type(e).__name__}: {e})")
+
+
+def _read_json(path: str, what: str, retry: RetryPolicy) -> Any:
+    def read():
+        fault_point("read", path)
+        with open(path, "rb") as f:
+            return f.read()
+    try:
+        data = io_retry(read, f"read {path}", retry, on_retry=_on_retry)
+    except OSError as e:
+        # same typed contract as _read_npy: a missing/unreadable piece of
+        # a checkpoint IS corruption — the fallback chain catches this
+        # and walks back instead of crashing the resume
+        raise CheckpointCorruptError(
+            f"checkpoint {what} at {path} is unreadable "
+            f"({type(e).__name__}: {e})")
+    try:
+        return json.loads(data)
+    except ValueError as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {what} at {path} is unparseable: {e}")
+
+
+def _fsync_dir(path: str) -> None:
+    """POSIX durability for the atomic rename itself."""
+    if not _fsync_enabled():
+        return
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _verify_leaf(arr: np.ndarray, entry: Dict[str, Any], key: str,
+                 path: str) -> None:
+    """Lazy per-leaf integrity check (manifest entries without a CRC are
+    pre-integrity-plane checkpoints — loaded on trust, like the reference)."""
+    want_crc = entry.get("crc32")
+    if want_crc is None:
+        return
+    want_bytes = entry.get("nbytes")
+    if want_bytes is not None and int(arr.nbytes) != int(want_bytes):
+        raise CheckpointCorruptError(
+            f"checkpoint leaf {key!r}: file {path} has {arr.nbytes} data "
+            f"bytes, manifest records {want_bytes} (truncated write?)")
+    got = _crc32_arr(arr)
+    if got != int(want_crc):
+        raise CheckpointCorruptError(
+            f"checkpoint leaf {key!r}: file {path} CRC32 mismatch "
+            f"(stored {int(want_crc):#010x}, computed {got:#010x}) — "
+            "bit corruption or partial write")
 
 
 def _split_merge_compatible(src: tuple, dst: tuple) -> bool:
@@ -120,9 +328,12 @@ def _is_fully_addressable(leaf) -> bool:
     return bool(getattr(leaf, "is_fully_addressable", True))
 
 
-def save_tree(dirpath: str, tree: Any) -> None:
+def save_tree(dirpath: str, tree: Any,
+              retry: RetryPolicy = DEFAULT_RETRY) -> str:
     """Write every leaf of ``tree`` as .npy files plus a manifest mapping
-    pytree key-paths to files.
+    pytree key-paths to files (with per-leaf CRC32 + byte length).
+    Returns the SHA-256 hex digest of the manifest file as written
+    (process 0; "" elsewhere) so ``meta.json`` can pin it.
 
     Multi-host: a leaf that is NOT fully addressable (its shards live on
     several processes) is written as per-process shard files — each
@@ -144,12 +355,13 @@ def save_tree(dirpath: str, tree: Any) -> None:
                 arr = np.asarray(jax.device_get(leaf))
                 store, logical = _to_storage(arr)
                 fname = f"leaf_{i:05d}.npy"
-                np.save(os.path.join(dirpath, fname), store,
-                        allow_pickle=False)
+                _write_npy(os.path.join(dirpath, fname), store, retry)
                 manifest[_keystr(path)] = {
                     "file": fname,
                     "dtype": logical,
                     "shape": list(arr.shape),
+                    "crc32": _crc32_arr(store),
+                    "nbytes": int(store.nbytes),
                 }
             continue
         # process-local shards (multi-host)
@@ -163,11 +375,13 @@ def save_tree(dirpath: str, tree: Any) -> None:
             store, logical = _to_storage(arr)
             store_dtype = store.dtype.name
             fname = f"leaf_{i:05d}.proc{pid}_{k}.npy"
-            np.save(os.path.join(dirpath, fname), store, allow_pickle=False)
+            _write_npy(os.path.join(dirpath, fname), store, retry)
             indices.append({
                 "file": fname,
                 "index": [[s.start, s.stop] for s in
                           _normalize_index(shard.index, leaf.shape)],
+                "crc32": _crc32_arr(store),
+                "nbytes": int(store.nbytes),
             })
         if pid == 0:
             manifest[_keystr(path)] = {
@@ -178,12 +392,15 @@ def save_tree(dirpath: str, tree: Any) -> None:
                 "shape": list(leaf.shape),
             }
         # every process records its own shard index file
-        with open(os.path.join(
-                dirpath, f"leaf_{i:05d}.proc{pid}.json"), "w") as f:
-            json.dump(indices, f)
+        _write_bytes(
+            os.path.join(dirpath, f"leaf_{i:05d}.proc{pid}.json"),
+            json.dumps(indices).encode(), retry, point="shard_index")
     if pid == 0:
-        with open(os.path.join(dirpath, "manifest.json"), "w") as f:
-            json.dump(manifest, f, indent=1)
+        data = json.dumps(manifest, indent=1).encode()
+        _write_bytes(os.path.join(dirpath, "manifest.json"), data, retry,
+                     point="manifest")
+        return hashlib.sha256(data).hexdigest()
+    return ""
 
 
 def _normalize_index(index, shape):
@@ -227,13 +444,19 @@ def _ranges_intersect(shard_index, boxes) -> bool:
     return False
 
 
-def load_tree(dirpath: str, target: Any, strict: bool = True) -> Any:
+def load_tree(dirpath: str, target: Any, strict: bool = True,
+              retry: RetryPolicy = DEFAULT_RETRY) -> Any:
     """Load leaves by key-path into the structure of ``target``.  Each loaded
     array is placed with the corresponding target leaf's sharding — this is
     the reshard-on-load that makes DP-resize restore work (reference
-    stage2.py:1712-1778 does this with explicit merge/repartition)."""
-    with open(os.path.join(dirpath, "manifest.json")) as f:
-        manifest = json.load(f)
+    stage2.py:1712-1778 does this with explicit merge/repartition).
+
+    Integrity: each leaf read is verified lazily against the manifest's
+    CRC32/byte-length record (when present — pre-integrity checkpoints
+    load on trust); a mismatch raises ``CheckpointCorruptError`` naming
+    the leaf and file, BEFORE any engine state is touched."""
+    manifest = _read_json(os.path.join(dirpath, "manifest.json"),
+                          "manifest", retry)
     flat, treedef = jax.tree_util.tree_flatten_with_path(target)
     out = []
     for path, tleaf in flat:
@@ -268,25 +491,26 @@ def load_tree(dirpath: str, target: Any, strict: bool = True) -> Any:
             idx_files = sorted(_glob.glob(os.path.join(
                 dirpath, f"leaf_{entry['leaf']:05d}.proc*.json")))
             if not idx_files:
-                raise FileNotFoundError(
+                raise CheckpointCorruptError(
                     f"sharded checkpoint leaf {key!r}: no shard index "
                     f"files in {dirpath} (were all processes' files "
                     "copied to a shared location?)")
             need = _addressable_ranges(tleaf)
             for jf in idx_files:
-                with open(jf) as jfh:
-                    for shard in json.load(jfh):
-                        if need is not None and not _ranges_intersect(
-                                shard["index"], need):
-                            continue  # another host's slice — skip the I/O
-                        data = np.load(os.path.join(
-                            dirpath, shard["file"]), allow_pickle=False)
-                        sl = tuple(slice(a, b) for a, b in shard["index"])
-                        arr[sl] = data
+                for shard in _read_json(jf, "shard index", retry):
+                    if need is not None and not _ranges_intersect(
+                            shard["index"], need):
+                        continue  # another host's slice — skip the I/O
+                    spath = os.path.join(dirpath, shard["file"])
+                    data = _read_npy(spath, retry, key)
+                    _verify_leaf(data, shard, key, spath)
+                    sl = tuple(slice(a, b) for a, b in shard["index"])
+                    arr[sl] = data
             arr = _from_storage(arr, entry["dtype"])
         else:
-            arr = np.load(os.path.join(dirpath, entry["file"]),
-                          allow_pickle=False)
+            fpath = os.path.join(dirpath, entry["file"])
+            arr = _read_npy(fpath, retry, key)
+            _verify_leaf(arr, entry, key, fpath)
             arr = _from_storage(arr, entry["dtype"])
         tshape = tuple(getattr(tleaf, "shape", ()))
         if tuple(arr.shape) != tshape:
@@ -348,16 +572,337 @@ def load_tree(dirpath: str, target: Any, strict: bool = True) -> Any:
 
 
 # ---------------------------------------------------------------------------
-# engine-level save / load
+# verification (status without loading)
 # ---------------------------------------------------------------------------
+def _manifest_digest_error(ckpt_dir: str, plane: str, want: str,
+                           retry: RetryPolicy = DEFAULT_RETRY
+                           ) -> Tuple[Optional[str], Optional[dict]]:
+    """ONE implementation of the manifest-digest check (used by both
+    checkpoint_status and the load path, so they can never disagree on
+    what counts as corrupt): returns (error, parsed_manifest)."""
+    mpath = os.path.join(ckpt_dir, plane, "manifest.json")
+
+    def read():
+        fault_point("read", mpath)
+        with open(mpath, "rb") as f:
+            return f.read()
+    try:
+        # retried like every other checkpoint read: a transient blip
+        # here would otherwise condemn a good checkpoint as corrupt
+        data = io_retry(read, f"read {mpath}", retry, on_retry=_on_retry)
+    except OSError as e:
+        return f"{mpath}: {e}", None
+    if hashlib.sha256(data).hexdigest() != want:
+        return (f"{mpath}: manifest digest mismatch — the manifest was "
+                "modified or truncated after the save"), None
+    try:
+        return None, json.loads(data)
+    except ValueError as e:
+        return f"{mpath}: unparseable ({e})", None
+
+
+def checkpoint_status(ckpt_dir: str, deep: bool = False,
+                      retry: RetryPolicy = DEFAULT_RETRY
+                      ) -> Tuple[str, str]:
+    """Classify a checkpoint directory: ``(CKPT_OK | CKPT_CORRUPT |
+    CKPT_MISSING, detail)``.  Structural check: meta parses, manifest
+    digests match, every referenced file exists with a plausible size.
+    ``deep=True`` additionally re-reads every leaf and verifies its CRC
+    (what the fallback chain uses before committing to a candidate)."""
+    if not os.path.isdir(ckpt_dir):
+        return CKPT_MISSING, f"no directory at {ckpt_dir}"
+    meta_path = os.path.join(ckpt_dir, "meta.json")
+    if not os.path.isfile(meta_path):
+        return CKPT_CORRUPT, (f"{ckpt_dir} has no meta.json "
+                              "(crashed or partial save)")
+    try:
+        meta = _read_json(meta_path, "meta.json", retry)
+    except (CheckpointCorruptError, OSError) as e:
+        return CKPT_CORRUPT, str(e)
+    digests = meta.get("manifest_digests") or {}
+    for plane, want in sorted(digests.items()):
+        err, manifest = _manifest_digest_error(ckpt_dir, plane, want,
+                                               retry)
+        if err:
+            return CKPT_CORRUPT, err
+        plane_dir = os.path.join(ckpt_dir, plane)
+        err = _verify_manifest_files(plane_dir, manifest, deep, retry)
+        if err:
+            return CKPT_CORRUPT, err
+    return CKPT_OK, ""
+
+
+def _verify_manifest_files(plane_dir: str, manifest: dict, deep: bool,
+                           retry: RetryPolicy) -> Optional[str]:
+    import glob as _glob
+    for key, entry in manifest.items():
+        if entry.get("sharded"):
+            idx_files = sorted(_glob.glob(os.path.join(
+                plane_dir, f"leaf_{entry['leaf']:05d}.proc*.json")))
+            if not idx_files:
+                return f"{key!r}: no shard index files in {plane_dir}"
+            try:
+                shards = [s for jf in idx_files
+                          for s in _read_json(jf, "shard index", retry)]
+            except CheckpointCorruptError as e:
+                return str(e)
+        else:
+            shards = [entry]
+        for shard in shards:
+            fpath = os.path.join(plane_dir, shard["file"])
+            if not os.path.isfile(fpath):
+                return f"{key!r}: file {fpath} is missing"
+            nbytes = shard.get("nbytes")
+            if nbytes is not None and os.path.getsize(fpath) < int(nbytes):
+                return (f"{key!r}: file {fpath} is "
+                        f"{os.path.getsize(fpath)} bytes, smaller than "
+                        f"its {nbytes} recorded data bytes (truncated)")
+            if deep and shard.get("crc32") is not None:
+                try:
+                    arr = _read_npy(fpath, retry, key)
+                    _verify_leaf(arr, shard, key, fpath)
+                except CheckpointCorruptError as e:
+                    return str(e)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# engine-level save
+# ---------------------------------------------------------------------------
+def _host_snapshot(tree: Any) -> Any:
+    """Materialize a tree fully on host, COPYING numpy leaves: the host
+    offload tier's master/moments alias live staging buffers the next
+    step's CPU Adam mutates in place, so an async writer must own its
+    bytes.  ``device_get`` already copies device arrays (and is the D2H
+    drain the ``checkpoint/snapshot`` span measures)."""
+    def snap(x):
+        if isinstance(x, np.ndarray):
+            return np.array(x, copy=True)
+        return np.asarray(jax.device_get(x))
+    return jax.tree.map(snap, tree)
+
+
+def _surface_writer_error(engine, err):
+    if err is None:
+        return
+    logger.error(
+        "previous async checkpoint save failed (that save was lost; "
+        "this save proceeds from the current state): %s", err)
+    # the training thread's advertised surface must record it too —
+    # draining here would otherwise swallow the error before the
+    # pre-step tick could pop it
+    engine.last_ckpt_error = err
+    with _tel_sink(engine):
+        _count("ckpt_save_failures_total",
+               "checkpoint saves that failed (async writer or sync)")
+
+
+def _write_checkpoint_files(save_dir: str, tag: str, ckpt_dir: str,
+                            tmp_dir: str, model_plane: Any,
+                            optim_plane: Any, meta: dict,
+                            save_latest: bool, keep_last_n: int,
+                            retry: RetryPolicy, span=None) -> str:
+    """The single serialization path both sync and async saves share
+    (which is what makes async==sync bitwise): tmp-dir staging, per-plane
+    manifests with CRCs, meta with manifest digests, fsync, verification
+    of the STAGED dir, swap-rename, ``latest`` update, then retention GC
+    — destruction strictly AFTER the new save verifies.  ``span`` is an
+    optional ``name -> context`` factory for the per-plane telemetry
+    spans (the writer thread stamps its own tid)."""
+    span = span or (lambda name: contextlib.nullcontext())
+    delay = float(os.environ.get("DS_CKPT_DELAY_S", "0") or 0.0)
+    if delay > 0:
+        time.sleep(delay)
+    if os.path.isdir(tmp_dir):
+        import shutil
+        io_retry(lambda: shutil.rmtree(tmp_dir), f"clear {tmp_dir}", retry,
+                 on_retry=_on_retry)
+    os.makedirs(tmp_dir, exist_ok=True)
+    with span("checkpoint/save_model_plane"):
+        model_digest = save_tree(os.path.join(tmp_dir, "model"),
+                                 model_plane, retry=retry)
+    with span("checkpoint/save_optim_plane"):
+        optim_digest = save_tree(os.path.join(tmp_dir, "optim"),
+                                 optim_plane, retry=retry)
+    meta = dict(meta)
+    meta["format_version"] = CKPT_FORMAT_VERSION
+    meta["manifest_digests"] = {"model": model_digest,
+                                "optim": optim_digest}
+    _write_bytes(os.path.join(tmp_dir, "meta.json"),
+                 json.dumps(meta, indent=1).encode(), retry, point="meta")
+    # verify the STAGED dir before anything is destroyed or published: a
+    # failed verify leaves an existing same-tag checkpoint AND `latest`
+    # untouched (a load_fallback=0 resume keeps working) — the fallback
+    # chain must always have a verified checkpoint to land on
+    status, why = checkpoint_status(tmp_dir, deep=False, retry=retry)
+    if status != CKPT_OK:
+        raise CheckpointCorruptError(
+            f"freshly written checkpoint staging {tmp_dir} failed "
+            f"verification ({why}); `{LATEST_FILE}` untouched, retention "
+            "GC skipped, nothing was deleted")
+    _publish_staged(save_dir, tag, ckpt_dir, tmp_dir, save_latest,
+                    keep_last_n, retry)
+    log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+    return ckpt_dir
+
+
+def _publish_staged(save_dir: str, tag: str, ckpt_dir: str, tmp_dir: str,
+                    save_latest: bool, keep_last_n: int,
+                    retry: RetryPolicy) -> None:
+    """Publish a VERIFIED staged checkpoint: swap-rename (an existing
+    same-tag checkpoint is parked at ``<tag>.replaced.tmp`` and restored
+    if the publish fails — a re-save can never destroy the only copy),
+    fsync the dir, move ``latest``, then retention GC.  ONE copy of this
+    sequence serves both the single-process and multi-host save paths."""
+    import shutil
+    old_dir = None
+    if os.path.isdir(ckpt_dir):
+        old_dir = ckpt_dir + ".replaced.tmp"
+        if os.path.isdir(old_dir):
+            io_retry(lambda: shutil.rmtree(old_dir),
+                     f"clear {old_dir}", retry, on_retry=_on_retry)
+        io_retry(lambda: os.rename(ckpt_dir, old_dir),
+                 f"park {ckpt_dir}", retry, on_retry=_on_retry)
+
+    def rename():
+        fault_point("rename", ckpt_dir)
+        os.rename(tmp_dir, ckpt_dir)
+    try:
+        io_retry(rename, f"rename {tmp_dir} -> {ckpt_dir}", retry,
+                 on_retry=_on_retry)
+    except Exception:
+        if old_dir is not None:
+            try:
+                os.rename(old_dir, ckpt_dir)  # restore the old good copy
+            except OSError as e:
+                logger.error("could not restore %s after failed publish: "
+                             "%s (parked at %s)", ckpt_dir, e, old_dir)
+        raise
+    if old_dir is not None:
+        try:
+            shutil.rmtree(old_dir)
+        except OSError:
+            pass  # orphan sweep reclaims it on the next save
+    _fsync_dir(save_dir)
+    _count("ckpt_saves_total", "checkpoints written and verified")
+    if save_latest:
+        def write_latest():
+            fault_point("latest", save_dir)
+            latest_tmp = os.path.join(save_dir, LATEST_FILE + ".tmp")
+            with open(latest_tmp, "w") as f:
+                f.write(tag)
+                f.flush()
+                if _fsync_enabled():
+                    os.fsync(f.fileno())
+            os.replace(latest_tmp, os.path.join(save_dir, LATEST_FILE))
+        io_retry(write_latest, f"update {save_dir}/{LATEST_FILE}", retry,
+                 on_retry=_on_retry)
+    if keep_last_n > 0:
+        # protect the tag `latest` names too: with save_latest=False side
+        # tags, the latest-named checkpoint can fall outside the
+        # newest-N window and must never be GC'd
+        protect = {tag}
+        latest_path = os.path.join(save_dir, LATEST_FILE)
+        try:
+            with open(latest_path) as f:
+                protect.add(f.read().strip())
+        except OSError:
+            pass
+        removed = retention_gc(save_dir, keep_last_n, protect=protect,
+                               retry=retry)
+        _count("ckpt_gc_removed_total",
+               "old checkpoint tags + orphaned tmp dirs reclaimed",
+               removed)
+
+
+def _build_save_job(engine, save_dir: str, tag: str, ckpt_dir: str,
+                    tmp_dir: str, client_state: Optional[dict],
+                    save_latest: bool, cfg: _CkptCfg,
+                    async_write: bool) -> CheckpointJob:
+    """Snapshot device state to host NOW (D2H drained inside the
+    ``checkpoint/snapshot`` span — the only step-loop-exposed cost of an
+    async save), and return a fully host-resident write job."""
+    from . import precision
+
+    state = engine.state
+    with _tel_span(engine, "checkpoint/snapshot", tag=tag):
+        master_tree, opt_tree = engine._canonical_state()
+        module_params = precision.cast_to_compute(
+            master_tree, engine.compute_dtype)
+        model_plane = {"module": module_params}
+        optim_plane = {
+            "master_params": master_tree,
+            "opt_state": opt_tree,
+            "scaler": state.scaler,
+            "rng": state.rng,
+            "data_rng": engine._data_rng,
+        }
+        if async_write:
+            # the host COPY is what makes the job immune to the training
+            # that continues while the writer serializes (the host-offload
+            # staging buffers are mutated in place by the next step's CPU
+            # Adam).  A sync save runs the job before returning, so it
+            # streams the live leaves straight into np.save instead of
+            # paying a full master+moments copy (18+ GB at 1.5B).
+            model_plane = _host_snapshot(model_plane)
+            optim_plane = _host_snapshot(optim_plane)
+    meta = {
+        "tag": tag,
+        "global_steps": int(engine.global_steps),
+        "micro_steps": int(engine.micro_steps),
+        "skipped_steps": int(state.skipped_steps),
+        "dp_world_size": int(engine.dp_world_size),
+        "zero_stage": int(engine.config.zero_optimization_stage),
+        "client_state": client_state or {},
+    }
+    eng_ref = weakref.ref(engine)
+
+    def run():
+        eng = eng_ref()
+        t0 = time.perf_counter()
+        span = (_tel_span(eng, "checkpoint/async_write", tag=tag)
+                if async_write and eng is not None
+                else contextlib.nullcontext())
+        with _tel_sink(eng), span:
+            _write_checkpoint_files(
+                save_dir, tag, ckpt_dir, tmp_dir, model_plane,
+                optim_plane, meta, save_latest, cfg.keep_last_n,
+                cfg.retry,
+                span=lambda name: _tel_span(eng, name, tag=tag))
+        if async_write and eng is not None:
+            acc = getattr(eng, "_ckpt_interval_acc", None)
+            if acc is not None:
+                # write wall time hidden behind training (the
+                # ckpt_async_overlap_s telemetry scalar); under the
+                # engine's acc lock — the telemetry sync's read-and-reset
+                # runs on the training thread
+                with getattr(eng, "_ckpt_acc_lock", contextlib.nullcontext()):
+                    acc["overlap_s"] += time.perf_counter() - t0
+                    # written saves, not submissions: coalesced-away
+                    # saves never wrote, so dividing overlap by the
+                    # submission count would under-report hidden time
+                    acc["writes"] = acc.get("writes", 0) + 1
+        return ckpt_dir
+
+    return CheckpointJob(tag=tag, tmp_dir=tmp_dir, final_dir=ckpt_dir,
+                         run=run)
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None,
-                    save_latest: bool = True) -> str:
+                    save_latest: bool = True,
+                    async_write: bool = False) -> str:
     """Two-plane checkpoint write (reference engine.py:1211-1290).
 
     The write is atomic: everything lands in ``<tag>.tmp`` and is renamed
     into place only after ``meta.json`` (written last) is on disk, so a
     killed save can never leave a loadable-looking partial checkpoint.
+
+    ``async_write=True`` (single-controller only) snapshots device state
+    to host and hands serialization to the engine's daemon writer: the
+    step loop pays only the D2H drain.  A second async save while one is
+    in flight coalesces (latest wins); a sync save first drains the
+    writer (ordering); a writer failure poisons only that save.
 
     The model plane intentionally duplicates a down-cast of the fp32 master
     (~0.5× extra bytes): it keeps module-only loads (inference handoff, the
@@ -376,75 +921,143 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     engine.py:415-416 writes model files from DP rank 0 and ZeRO
     partitions from every rank, engine.py:1218-1229.)
     """
-    from .engine import TrainState  # local import to avoid cycle
-
-    state: TrainState = engine.state
     if tag is None:
         tag = f"global_step{engine.global_steps}"
-    ckpt_dir = os.path.join(save_dir, str(tag))
+    tag = str(tag)
+    ckpt_dir = os.path.join(save_dir, tag)
+    tmp_dir = ckpt_dir + ".tmp"
     multiproc = jax.process_count() > 1
     proc0 = jax.process_index() == 0
-    tmp_dir = ckpt_dir + ".tmp"
-    if proc0 and os.path.isdir(tmp_dir):
-        import shutil
-        shutil.rmtree(tmp_dir)
+    cfg = _ckpt_config(engine)
+    writer: Optional[AsyncCheckpointWriter] = getattr(
+        engine, "_ckpt_writer", None)
+    if async_write and multiproc:
+        log_dist("async checkpoint save is single-controller only; "
+                 "writing synchronously", ranks=[0])
+        async_write = False
+    if not async_write and writer is not None and writer.in_flight():
+        # ordering: a pending async save must land (or fail) before a
+        # synchronous one renames over it / moves `latest` past it
+        _surface_writer_error(engine, writer.drain())
+
+    with _tel_sink(engine):
+        if proc0:
+            # hygiene: reclaim orphaned <*>.tmp dirs from crashed saves
+            # (NOT just this tag's — the old code leaked every other
+            # tag's debris forever), skipping the live writer's dirs
+            keep = writer.active_tmp() if writer is not None else set()
+            removed = sweep_tmp(save_dir, keep=keep, retry=cfg.retry)
+            _count("ckpt_gc_removed_total",
+                   "old checkpoint tags + orphaned tmp dirs reclaimed",
+                   removed)
     if multiproc:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("ds_ckpt_clean")
-    os.makedirs(tmp_dir, exist_ok=True)
+        return _save_multiproc(engine, save_dir, tag, ckpt_dir, tmp_dir,
+                               client_state, save_latest, cfg)
 
+    job = _build_save_job(engine, save_dir, tag, ckpt_dir, tmp_dir,
+                          client_state, save_latest, cfg, async_write)
+    if async_write:
+        if writer is None:
+            writer = engine._ckpt_writer = AsyncCheckpointWriter()
+        writer.submit(job)
+        return ckpt_dir
+    with _tel_sink(engine):
+        try:
+            job.run()
+        except OSError as e:
+            # exhausted-retry I/O failure: surface with the same typed
+            # vocabulary the load side uses
+            _count("ckpt_save_failures_total",
+                   "checkpoint saves that failed (async writer or sync)")
+            raise CheckpointError(
+                f"checkpoint save to {ckpt_dir} failed after "
+                f"{cfg.retry.attempts} attempts: {e}") from e
+        except CheckpointError:
+            # already typed (e.g. the fresh save failed its own verify);
+            # count it the same way the writer path does
+            _count("ckpt_save_failures_total",
+                   "checkpoint saves that failed (async writer or sync)")
+            raise
+    return ckpt_dir
+
+
+def _save_multiproc(engine, save_dir, tag, ckpt_dir, tmp_dir,
+                    client_state, save_latest, cfg: _CkptCfg) -> str:
+    """Multi-controller save: every process writes its shard files into
+    the shared tmp dir; process 0 writes manifests + meta and performs
+    the atomic rename behind a barrier (the pre-existing flow, now with
+    the integrity plane + retry + retention)."""
     from . import precision
-    # canonical (per-parameter tree) form: the XLA offload tier stores flat
-    # host vectors internally, but the checkpoint keeps the logical tree so
-    # offload <-> non-offload restores compose (reference merge/re-partition
-    # analogue, stage2.py:1712-1778)
+    from jax.experimental import multihost_utils
+
+    state = engine.state
+    proc0 = jax.process_index() == 0
+    retry = cfg.retry
+    os.makedirs(tmp_dir, exist_ok=True)
     master_tree, opt_tree = engine._canonical_state()
     module_params = precision.cast_to_compute(
         master_tree, engine.compute_dtype)
-    with _tel_span(engine, "checkpoint/save_model_plane"):
-        save_tree(os.path.join(tmp_dir, "model"),
-                  {"module": module_params})
-    with _tel_span(engine, "checkpoint/save_optim_plane"):
-        save_tree(os.path.join(tmp_dir, "optim"), {
-            "master_params": master_tree,
-            "opt_state": opt_tree,
-            "scaler": state.scaler,
-            "rng": state.rng,
-            "data_rng": engine._data_rng,
-        })
-
-    if multiproc:
+    with _tel_sink(engine):
+        with _tel_span(engine, "checkpoint/save_model_plane"):
+            model_digest = save_tree(os.path.join(tmp_dir, "model"),
+                                     {"module": module_params}, retry=retry)
+        with _tel_span(engine, "checkpoint/save_optim_plane"):
+            optim_digest = save_tree(os.path.join(tmp_dir, "optim"), {
+                "master_params": master_tree,
+                "opt_state": opt_tree,
+                "scaler": state.scaler,
+                "rng": state.rng,
+                "data_rng": engine._data_rng,
+            }, retry=retry)
         # every process's shard files must be on disk before the rename
-        from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("ds_ckpt_written")
-    if proc0:
-        meta = {
-            "tag": str(tag),
-            "global_steps": int(engine.global_steps),
-            "micro_steps": int(engine.micro_steps),
-            "skipped_steps": int(state.skipped_steps),
-            "dp_world_size": int(engine.dp_world_size),
-            "zero_stage": int(engine.config.zero_optimization_stage),
-            "client_state": client_state or {},
-        }
-        with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=1)
-        if os.path.isdir(ckpt_dir):
-            import shutil
-            shutil.rmtree(ckpt_dir)
-        os.rename(tmp_dir, ckpt_dir)
-        if save_latest:
-            latest_tmp = os.path.join(save_dir, LATEST_FILE + ".tmp")
-            with open(latest_tmp, "w") as f:
-                f.write(str(tag))
-            os.replace(latest_tmp, os.path.join(save_dir, LATEST_FILE))
-    if multiproc:
-        from jax.experimental import multihost_utils
+        if proc0:
+            meta = {
+                "tag": tag,
+                "global_steps": int(engine.global_steps),
+                "micro_steps": int(engine.micro_steps),
+                "skipped_steps": int(state.skipped_steps),
+                "dp_world_size": int(engine.dp_world_size),
+                "zero_stage": int(engine.config.zero_optimization_stage),
+                "client_state": client_state or {},
+                "format_version": CKPT_FORMAT_VERSION,
+                "manifest_digests": {"model": model_digest,
+                                     "optim": optim_digest},
+            }
+            _write_bytes(os.path.join(tmp_dir, "meta.json"),
+                         json.dumps(meta, indent=1).encode(), retry,
+                         point="meta")
+            # same invariants as the single-process path: verify the
+            # STAGED dir before anything is destroyed or published, and
+            # replace a same-tag checkpoint by SWAP so the old copy
+            # survives a failed publish.  On verify failure the raise is
+            # DEFERRED past the final barrier so the other processes
+            # don't hang at sync_global_devices while rank 0 unwinds.
+            verify_err = None
+            status, why = checkpoint_status(tmp_dir, deep=False,
+                                            retry=retry)
+            if status != CKPT_OK:
+                verify_err = (
+                    f"freshly written checkpoint staging {tmp_dir} "
+                    f"failed verification ({why}); `{LATEST_FILE}` "
+                    "untouched, retention GC skipped, nothing was "
+                    "deleted")
+                logger.error(verify_err)
+            else:
+                _publish_staged(save_dir, tag, ckpt_dir, tmp_dir,
+                                save_latest, cfg.keep_last_n, retry)
         multihost_utils.sync_global_devices("ds_ckpt_done")
+        if proc0 and verify_err is not None:
+            raise CheckpointCorruptError(verify_err)
     log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
     return ckpt_dir
 
 
+# ---------------------------------------------------------------------------
+# engine-level load
+# ---------------------------------------------------------------------------
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
                     load_lr_scheduler_states: bool = True,
@@ -453,30 +1066,119 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     reference (engine.py:1292-1324).  Handles a different current DP size /
     ZeRO stage / mesh than the one that saved (elastic restore).
 
+    Availability semantics (the MISSING / CORRUPT / OK distinction the
+    old code collapsed into ``(None, None)``):
+
+      - ``tag=None`` with no ``latest`` file and no tag dirs → a fresh
+        run: ``(None, None)``.
+      - ``tag=None`` where ``latest`` names a missing or corrupt tag →
+        logs LOUDLY and walks back to the newest on-disk tag that loads
+        with every per-leaf CRC verified, bounded by
+        ``checkpoint.load_fallback`` older candidates; raises
+        ``CheckpointCorruptError`` if none do.  A resume never silently trains from scratch because one
+        file rotted.
+      - an EXPLICIT ``tag=`` that is absent raises
+        ``CheckpointMissingError``; one that fails verification raises
+        ``CheckpointCorruptError`` — both name the path.  An explicit
+        request can never masquerade as "nothing to load".
+
     ``load_lr_scheduler_states`` is accepted for API parity but has no
     distinct effect: all lr schedules here are pure functions of the
     restored step count, so there is no separate scheduler state to load.
     """
+    cfg = _ckpt_config(engine)
+    retry = cfg.retry
+    with _tel_sink(engine):
+        if tag is not None:
+            ckpt_dir = os.path.join(load_dir, str(tag))
+            if not os.path.isdir(ckpt_dir):
+                raise CheckpointMissingError(
+                    f"checkpoint tag {str(tag)!r} was explicitly "
+                    f"requested but {ckpt_dir} does not exist")
+            if not os.path.isfile(os.path.join(ckpt_dir, "meta.json")):
+                _count("ckpt_corrupt_total",
+                       "checkpoints that failed integrity verification")
+                raise CheckpointCorruptError(
+                    f"checkpoint tag {str(tag)!r} at {ckpt_dir} has no "
+                    "meta.json — a crashed or partial save, not a "
+                    "loadable checkpoint")
+            return _load_into_engine(
+                engine, ckpt_dir, load_optimizer_states,
+                load_module_only, retry)
+
+        # tag=None: resolve `latest`, then walk the fallback chain
+        latest_path = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.isfile(latest_path):
+            hint = ""
+            tags = list_tags(load_dir)
+            if tags:
+                hint = (f" ({len(tags)} tag dir(s) exist but no "
+                        f"'{LATEST_FILE}' file names one — pass tag= "
+                        "explicitly to load them)")
+            log_dist(f"no 'latest' file in {load_dir}; nothing to "
+                     f"load{hint}", ranks=[0])
+            return None, None
+        with open(latest_path) as f:
+            latest_tag = f.read().strip()
+        candidates = [latest_tag] + [t for t in list_tags(load_dir)
+                                     if t != latest_tag]
+        limit = 1 + max(int(cfg.load_fallback), 0)
+        errors = []
+        for i, t in enumerate(candidates[:limit]):
+            d = os.path.join(load_dir, t)
+            if not os.path.isfile(os.path.join(d, "meta.json")):
+                _count("ckpt_corrupt_total",
+                       "checkpoints that failed integrity verification")
+                logger.error(
+                    "checkpoint fallback: tag %r at %s is %s — trying "
+                    "the next newest on-disk tag",
+                    t, d, "missing" if not os.path.isdir(d)
+                    else "missing its meta.json")
+                errors.append(f"{t}: missing or no meta.json")
+                continue
+            # no deep pre-verify here: every leaf read inside the load
+            # is CRC-checked lazily and a corrupt candidate raises
+            # BEFORE any engine state is touched, so the except below
+            # walks on — a pre-pass would just read multi-GB planes
+            # twice per candidate
+            try:
+                return _load_into_engine(
+                    engine, d, load_optimizer_states, load_module_only,
+                    retry)
+            except CheckpointCorruptError as e:
+                _count("ckpt_corrupt_total",
+                       "checkpoints that failed integrity verification")
+                logger.error(
+                    "checkpoint tag %r is CORRUPT (%s) — falling back to "
+                    "the next newest tag that verifies", t, e)
+                errors.append(f"{t}: {e}")
+        raise CheckpointCorruptError(
+            f"no loadable checkpoint under {load_dir}: tried "
+            f"{min(len(candidates), limit)} candidate(s) "
+            f"(checkpoint.load_fallback={cfg.load_fallback}); "
+            + "; ".join(errors))
+
+
+def _load_into_engine(engine, ckpt_dir: str, load_optimizer_states: bool,
+                      load_module_only: bool, retry: RetryPolicy):
+    """Restore from one verified-enough candidate dir.  All reads are
+    integrity-checked lazily (manifest digest first, then per-leaf CRC
+    inside load_tree); any corruption raises BEFORE engine state is
+    replaced, so a caller can walk to an older tag safely."""
     from .engine import TrainState
     import jax.numpy as jnp
 
-    if tag is None:
-        latest = os.path.join(load_dir, LATEST_FILE)
-        if not os.path.isfile(latest):
-            log_dist(f"no 'latest' file in {load_dir}; nothing to load",
-                     ranks=[0])
-            return None, None
-        with open(latest) as f:
-            tag = f.read().strip()
-    ckpt_dir = os.path.join(load_dir, str(tag))
-    # meta.json is written last inside the atomic rename; its absence means
-    # the checkpoint doesn't exist (or is a corrupt partial) — report
-    # missing rather than crash.
-    if not os.path.isfile(os.path.join(ckpt_dir, "meta.json")):
-        return None, None
+    meta = _read_json(os.path.join(ckpt_dir, "meta.json"), "meta.json",
+                      retry)
+    digests = meta.get("manifest_digests") or {}
 
-    with open(os.path.join(ckpt_dir, "meta.json")) as f:
-        meta = json.load(f)
+    def check_digest(plane):
+        want = digests.get(plane)
+        if want is None:
+            return  # pre-integrity checkpoint: load on trust
+        err, _ = _manifest_digest_error(ckpt_dir, plane, want, retry)
+        if err:
+            raise CheckpointCorruptError(f"checkpoint {err}")
 
     state: TrainState = engine.state
     optim_dir = os.path.join(ckpt_dir, "optim")
@@ -488,6 +1190,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         # fp32 master restore (reference 'load_from_fp32_weights',
         # stage2.py:1780-1835); rng restore keeps dropout masks identical
         # to an uninterrupted run.
+        check_digest("optim")
         with _tel_span(engine, "checkpoint/load_optim_plane"):
             loaded = load_tree(optim_dir, {
                 "master_params": tmpl_master,
@@ -495,7 +1198,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                 "scaler": state.scaler,
                 "rng": state.rng,
                 "data_rng": engine._data_rng,
-            })
+            }, retry=retry)
         master, opt_state = engine._adopt_loaded(
             loaded["master_params"], loaded["opt_state"])
         scaler = loaded["scaler"]
@@ -504,11 +1207,13 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     else:
         # fp16-cast restore: module weights promoted to a fresh fp32 master
         from . import precision
+        check_digest("model")
         module_tmpl = precision.cast_to_compute(
             tmpl_master, engine.compute_dtype)
         with _tel_span(engine, "checkpoint/load_model_plane"):
             loaded = load_tree(os.path.join(ckpt_dir, "model"),
-                               {"module": module_tmpl})
+                               {"module": module_tmpl}, retry=retry)
+
         def _promote(cur, new):
             sharding = getattr(cur, "sharding", None)  # numpy (offload): none
             from jax.sharding import NamedSharding

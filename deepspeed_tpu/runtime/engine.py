@@ -24,6 +24,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import os
+import threading
 import time
 import weakref
 from typing import Any, Callable, Dict, NamedTuple, Optional
@@ -789,12 +790,42 @@ class DeepSpeedEngine:
                 # hook: the last telemetry-enabled engine wins)
                 from .offload import set_transfer_tracer
                 set_transfer_tracer(self.telemetry.tracer)
+        # fault-tolerant checkpointing (docs/checkpointing.md): the async
+        # daemon writer (lazy thread; created eagerly so the GC finalizer
+        # below can drain a dropped engine's in-flight save), exposed-
+        # stall accounting for the telemetry sync, and the opt-in SIGTERM
+        # preemption hook
+        from .resilience import AsyncCheckpointWriter
+        self._ckpt_writer = AsyncCheckpointWriter()
+        self._ckpt_last_save_dir = None
+        self._ckpt_interval_acc = {"save_s": 0.0, "overlap_s": 0.0,
+                                   "saves": 0, "writes": 0}
+        # guards the acc against the writer thread's overlap_s updates
+        # racing the telemetry sync's read-and-reset
+        self._ckpt_acc_lock = threading.Lock()
+        self.last_ckpt_error = None
+        self._in_step = False          # SIGTERM-save deferral fence
+        self._deferred_preempt = None  # handler parked until step boundary
+        self._preemption_handler = None
+        ckc = config.checkpoint_config
+        if ckc.sigterm_save:
+            if jax.process_count() > 1:
+                logger.warning(
+                    "checkpoint.sigterm_save is single-controller only "
+                    "(a pod-wide preemption save needs coordinated "
+                    "barriers); NOT installing the SIGTERM hook")
+            else:
+                from .resilience import install_preemption_handler
+                self._preemption_handler = install_preemption_handler(
+                    self, ckc.save_dir or None)
         # GC/exit finalizer: buffered scalars and the trace file survive a
         # dropped engine even when close() is never called explicitly.
         # Holds only the output objects (not the engine — see the weakref
-        # wrappers above), so the engine itself stays collectable.
+        # wrappers above), so the engine itself stays collectable.  The
+        # checkpoint writer is closed FIRST so an in-flight async save
+        # lands before the telemetry exporters flush.
         self._finalizer = None
-        _closeables = tuple(
+        _closeables = (self._ckpt_writer,) + tuple(
             c for c in (self.summary_writer, self.telemetry)
             if c is not None)
         if _closeables:
@@ -2642,8 +2673,25 @@ class DeepSpeedEngine:
     def train_batch(self, batch=None, data_iter=None):
         """Run one full training step (grad-accum included) on a global
         batch of ``train_batch_size`` samples."""
+        # _in_step fences the SIGTERM preemption hook: a signal landing
+        # while the update is mid-flight (host-offload CPU-Adam loop,
+        # streaming uploads) must not snapshot a torn half-applied state
+        # — the handler defers to this step's boundary instead (the
+        # finally below runs the deferred save)
+        self._in_step = True
+        try:
+            return self._train_batch_inner(batch, data_iter)
+        finally:
+            self._in_step = False
+            h = self._deferred_preempt
+            if h is not None:
+                self._deferred_preempt = None
+                h.complete_deferred()
+
+    def _train_batch_inner(self, batch=None, data_iter=None):
         if self._fatal_state_error is not None:
             raise RuntimeError(self._fatal_state_error)
+        self._ckpt_writer_tick()
         if batch is None:
             it = data_iter or self._training_iter()
             if it is None:
@@ -2788,6 +2836,22 @@ class DeepSpeedEngine:
             scalars["offload_h2d_s"] = acc["h2d"] / acc["steps"]
             scalars["offload_cpu_adam_s"] = acc["cpu_adam"] / acc["steps"]
             acc.update(h2d=0.0, hidden=0.0, cpu_adam=0.0, steps=0)
+        ca = getattr(self, "_ckpt_interval_acc", None)
+        if ca is not None and ca["saves"]:
+            # exposed per-save stall (sync: the whole serialize; async:
+            # just the snapshot D2H) and the background write time the
+            # async path hid — the pair summarize reports as the
+            # checkpoint row (docs/checkpointing.md).  Read-and-reset
+            # under the acc lock: the writer thread adds overlap_s as
+            # its saves land
+            with self._ckpt_acc_lock:
+                scalars["ckpt_save_s"] = ca["save_s"] / ca["saves"]
+                if ca["overlap_s"] > 0:
+                    # per WRITTEN save (coalesced submissions never
+                    # wrote) — the same denominator bench.py uses
+                    scalars["ckpt_async_overlap_s"] = (
+                        ca["overlap_s"] / max(ca.get("writes", 0), 1))
+                ca.update(save_s=0.0, overlap_s=0.0, saves=0, writes=0)
         pf = getattr(self, "_train_prefetcher", None)
         if pf is not None:
             # interval delta over the prefetcher's cumulative stats: the
@@ -3034,19 +3098,57 @@ class DeepSpeedEngine:
     # checkpointing (reference engine.py:1211-1478)
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True):
+                        save_latest=True, async_write=None):
+        """``async_write=True`` snapshots device state to host (the DPU
+        flush below runs FIRST, so the snapshot sees fully-applied
+        params on every offload tier) and hands serialization to the
+        daemon writer — the step loop pays only the D2H drain.  ``None``
+        defaults to the ``checkpoint.async_save`` config."""
         if self._fatal_state_error is not None:
             raise RuntimeError(self._fatal_state_error)
+        if async_write is None:
+            async_write = bool(self.config.checkpoint_config.async_save)
         if self._offload_host:
             self._dpu_flush()  # the saved master must be fully applied
         elif self._offload_xla:
             self._xla_dpu_flush()
         from .checkpointing import save_checkpoint
+        t0 = time.perf_counter()
         with self._tel_span("checkpoint/save", cat="checkpoint",
-                            step=self.global_steps):
-            return save_checkpoint(self, save_dir, tag=tag,
-                                   client_state=client_state,
-                                   save_latest=save_latest)
+                            step=self.global_steps,
+                            **{"async": bool(async_write)}):
+            out = save_checkpoint(self, save_dir, tag=tag,
+                                  client_state=client_state,
+                                  save_latest=save_latest,
+                                  async_write=bool(async_write))
+        self._ckpt_last_save_dir = save_dir
+        # exposed stall only: an async save returns after the snapshot,
+        # so this is the number the ckpt_save_s telemetry scalar reports
+        # (the background write lands in overlap_s via the writer job)
+        with self._ckpt_acc_lock:
+            acc = self._ckpt_interval_acc
+            acc["save_s"] += time.perf_counter() - t0
+            acc["saves"] += 1
+        return out
+
+    def _ckpt_writer_tick(self):
+        """Pre-step surfacing of a completed async save's failure: the
+        failure poisoned only that save (the writer already logged it
+        loudly); here it lands in ``last_ckpt_error`` + the failure
+        counter so the training thread and dashboards see it promptly,
+        and training continues — the next save retries from a fresh
+        snapshot."""
+        w = getattr(self, "_ckpt_writer", None)
+        if w is None:
+            return
+        err = w.pop_error()
+        if err is not None:
+            self.last_ckpt_error = err
+            if self.telemetry is not None:
+                self.telemetry.registry.counter(
+                    "ckpt_save_failures_total",
+                    "checkpoint saves that failed (async writer or sync)",
+                ).inc()
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_optimizer_states=True,
@@ -3090,6 +3192,17 @@ class DeepSpeedEngine:
         # all of them in this list.
         for pf in getattr(self, "_prefetchers", []):
             pf.close()
+        # drain the checkpoint writer BEFORE telemetry closes: an
+        # in-flight async save must land (its spans/counters included),
+        # and a failure surfaces here rather than vanishing with the
+        # daemon thread
+        w = getattr(self, "_ckpt_writer", None)
+        if w is not None:
+            w.close()
+            self._ckpt_writer_tick()
+        ph = getattr(self, "_preemption_handler", None)
+        if ph is not None and not ph.fired:
+            ph.uninstall()
         self._flush_tensorboard()
         tel = getattr(self, "telemetry", None)
         if tel is not None:

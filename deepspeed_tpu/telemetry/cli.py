@@ -57,6 +57,8 @@ def summarize(path: str, out=None) -> dict:
     overlap: List[float] = []
     pf_hits: List[float] = []
     pf_wait: List[float] = []
+    ck_save: List[float] = []
+    ck_hidden: List[float] = []
     peak_hbm: Optional[float] = None
     host_rss: Optional[float] = None
     bad_lines = 0
@@ -100,6 +102,14 @@ def summarize(path: str, out=None) -> dict:
                 if pw is not None:
                     pf_wait.extend([float(pw)]
                                    * int(rec.get("steps") or 1))
+                cs = scalars.get("ckpt_save_s")
+                if cs is not None:
+                    # per-save figures (one mean per interval, unweighted
+                    # like samples_per_sec — saves, not steps, are the unit)
+                    ck_save.append(float(cs))
+                ch = scalars.get("ckpt_async_overlap_s")
+                if ch is not None:
+                    ck_hidden.append(float(ch))
             elif kind == "memory":
                 stats = rec.get("stats") or {}
                 for dev in stats.get("devices", []):
@@ -126,6 +136,8 @@ def summarize(path: str, out=None) -> dict:
     avg_overlap = sum(overlap) / len(overlap) if overlap else None
     avg_pf_hit = sum(pf_hits) / len(pf_hits) if pf_hits else None
     avg_pf_wait = sum(pf_wait) / len(pf_wait) if pf_wait else None
+    avg_ck_save = sum(ck_save) / len(ck_save) if ck_save else None
+    avg_ck_hidden = sum(ck_hidden) / len(ck_hidden) if ck_hidden else None
 
     report = {
         "steps": steps,
@@ -135,6 +147,8 @@ def summarize(path: str, out=None) -> dict:
         "offload_overlap_ratio": avg_overlap,
         "prefetch_hit_ratio": avg_pf_hit,
         "prefetch_wait_s": avg_pf_wait,
+        "ckpt_save_s": avg_ck_save,
+        "ckpt_async_overlap_s": avg_ck_hidden,
         "peak_hbm_bytes": peak_hbm,
         "host_rss_bytes": host_rss,
         "bad_lines": bad_lines,
@@ -158,6 +172,14 @@ def summarize(path: str, out=None) -> dict:
                     if avg_pf_wait is not None else "")
         print(f"  input prefetch     hit {avg_pf_hit * 100:.0f}%"
               f"{wait_txt}", file=out)
+    if avg_ck_save is not None:
+        # checkpointing: exposed = step-loop stall per save (sync: the
+        # whole serialize; async: just the snapshot D2H); hidden = the
+        # background write time the async writer kept off the hot path
+        hid_txt = (f"  hidden {_fmt_s(avg_ck_hidden)}/save (async)"
+                   if avg_ck_hidden is not None else "")
+        print(f"  checkpoint         exposed {_fmt_s(avg_ck_save)}/save"
+              f"{hid_txt}", file=out)
     print(f"  peak HBM           {_fmt_bytes(peak_hbm)}", file=out)
     if host_rss is not None:
         print(f"  peak host RSS      {_fmt_bytes(host_rss)}", file=out)

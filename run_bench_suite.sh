@@ -15,6 +15,7 @@ run() {
 # everything after it (BENCH_NOTES.md round 3)
 run r03 python bench.py
 run prefetch python bench.py --prefetch=ab
+run ckpt python bench.py --ckpt=ab
 run bert python bench_bert.py
 run sparse python bench_sparse.py
 run flash python bench_flash.py

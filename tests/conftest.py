@@ -19,6 +19,12 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Checkpoint fsync off for the suite: unit tests simulate process death
+# (which the page cache survives), and this image's 9p filesystem makes
+# each fsync cost ~50ms/file — ~1.3s per tiny save.  The production
+# default stays ON; tests/test_resilience.py pins that default.
+os.environ.setdefault("DS_CKPT_FSYNC", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

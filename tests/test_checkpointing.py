@@ -146,11 +146,23 @@ def test_latest_tag_and_client_state(tmp_path):
 
 
 def test_missing_checkpoint_returns_none(tmp_path):
+    """tag=None on an empty dir is a fresh run → (None, None).  An
+    EXPLICIT tag that doesn't exist or doesn't verify must RAISE with the
+    path, never masquerade as "nothing to load" (ISSUE 5 satellite)."""
+    from deepspeed_tpu.runtime.resilience import (
+        CheckpointCorruptError, CheckpointMissingError)
     eng = _engine()
     path, client = eng.load_checkpoint(str(tmp_path))
     assert path is None and client is None
-    path, client = eng.load_checkpoint(str(tmp_path), tag="nope")
-    assert path is None
+    # arm 1: the tag directory does not exist at all
+    with pytest.raises(CheckpointMissingError, match="nope"):
+        eng.load_checkpoint(str(tmp_path), tag="nope")
+    # arm 2: the tag directory exists but has no meta.json (a crashed or
+    # partial save) — previously indistinguishable from "fresh run"
+    (tmp_path / "half").mkdir()
+    (tmp_path / "half" / "junk.npy").write_bytes(b"x")
+    with pytest.raises(CheckpointCorruptError, match="half"):
+        eng.load_checkpoint(str(tmp_path), tag="half")
 
 
 @pytest.mark.slow

@@ -215,3 +215,55 @@ def test_zero_allow_untested_optimizer_key():
         "zero_allow_untested_optimizer": True,
     }, world_size=1)
     assert cfg.zero_allow_untested_optimizer is True
+
+
+def test_checkpoint_block_defaults():
+    cfg = DeepSpeedConfig({"train_batch_size": 1}, world_size=1)
+    ck = cfg.checkpoint_config
+    assert ck.async_save is False
+    assert ck.keep_last_n == 0          # unlimited — retention is opt-in
+    assert ck.load_fallback == 2
+    assert ck.io_retry_attempts == 3
+    assert ck.sigterm_save is False
+    assert ck.save_dir == ""
+
+
+def test_checkpoint_block_parses():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 1,
+        "checkpoint": {"async_save": True, "keep_last_n": 3,
+                       "load_fallback": 1, "io_retry_attempts": 5,
+                       "io_retry_base_s": 0.2, "sigterm_save": False,
+                       "save_dir": "/ckpt"},
+    }, world_size=1)
+    ck = cfg.checkpoint_config
+    assert ck.async_save and ck.keep_last_n == 3
+    assert ck.load_fallback == 1 and ck.io_retry_attempts == 5
+    assert ck.io_retry_base_s == 0.2 and ck.save_dir == "/ckpt"
+
+
+@pytest.mark.parametrize("bad", [
+    {"keep_last_n": -1}, {"keep_last_n": True}, {"keep_last_n": "3"},
+    {"load_fallback": -2},
+    {"io_retry_attempts": 0}, {"io_retry_attempts": 1.5},
+    {"io_retry_base_s": -0.1}, {"io_retry_base_s": "fast"},
+    {"save_dir": 7},
+])
+def test_checkpoint_block_validation(bad):
+    """A typo'd retention/retry knob must fail at config parse, not at
+    the 40-hour mark when the first GC or retry runs."""
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 1, "checkpoint": bad},
+                        world_size=1)
+
+
+@pytest.mark.parametrize("bad", [{"async_save": "false"},
+                                 {"sigterm_save": "no"},
+                                 {"async_save": 1}])
+def test_checkpoint_bool_knobs_reject_truthy_strings(bad):
+    """'\"false\"' is truthy: silently flipping every save async (or
+    installing the SIGTERM hook) would be the opposite of what was
+    configured — bools must BE bools."""
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 1, "checkpoint": bad},
+                        world_size=1)

@@ -627,6 +627,116 @@ def _ckpt_ab(jax, mode: str):
     print(json.dumps(rec), flush=True)
 
 
+def _elastic_smoke():
+    """``--elastic-smoke``: the elastic-training kill/resume proof as a
+    bench leg (docs/elastic.md).  Launches ``ds --elastic`` supervising
+    the tests/elastic_worker.py trainer on localhost at 4 slots, the
+    worker hard-kills itself after step 3 (prefetcher ON at depth 2 —
+    in-flight batches genuinely abandoned), the probe reports the host
+    shrunk to 2 slots, and the supervisor relaunches.  Asserts resume
+    at the REDUCED width with trajectory continuity against a
+    dp2-from-start reference given the same sample order, plus
+    sample-exactness (no replay, no skip).  CPU-only by design — it
+    proves supervisor/resume mechanics, not throughput — so it never
+    touches the TPU tunnel."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="bench_elastic_")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (repo + os.pathsep + os.path.join(repo, "tests")
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DS_CKPT_FSYNC"] = "0"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # the workers shard dp4 -> dp2 over virtual CPU devices
+        env["XLA_FLAGS"] = (flags
+                            + " --xla_force_host_platform_device_count=8")
+    for k in ("DS_ELASTIC_RESTART", "DS_ELASTIC_WORLD_SLOTS",
+              "DS_HEARTBEAT_DIR"):
+        env.pop(k, None)
+    worker = os.path.join(repo, "tests", "elastic_worker.py")
+
+    def lines(path):
+        with open(path) as f:
+            return [json.loads(l) for l in f]
+
+    try:
+        hf = os.path.join(work, "hostfile")
+        with open(hf, "w") as f:
+            f.write("localhost slots=4\n")
+        probe = os.path.join(work, "probe.sh")
+        with open(probe, "w") as f:
+            f.write("#!/bin/sh\necho slots=2\n")
+        os.chmod(probe, 0o755)
+        out = os.path.join(work, "out")
+        ckpt = os.path.join(work, "ckpt")
+        os.makedirs(out), os.makedirs(ckpt)
+        _mark("elastic-smoke: supervised run (kill after step 3 of 6)")
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "bin", "ds"),
+             "--hostfile", hf, "--launcher", "local", "--elastic",
+             "--max-restarts", "2", "--backoff-base", "0.1",
+             "--probe-cmd", f"{probe} {{host}}",
+             worker, out, ckpt, "6", "3"],
+            env=env, timeout=600, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"elastic supervised run failed rc={r.returncode}: "
+                f"{(r.stderr or r.stdout)[-1500:]}")
+        supervised_s = time.perf_counter() - t0
+        _mark("elastic-smoke: dp2-from-start reference run")
+        ref_out = os.path.join(work, "ref")
+        ref_ckpt = os.path.join(work, "refck")
+        os.makedirs(ref_out), os.makedirs(ref_ckpt)
+        e = dict(env)
+        e["DS_ELASTIC_WORLD_SLOTS"] = "2"
+        r = subprocess.run(
+            [sys.executable, worker, ref_out, ref_ckpt, "6", "0"],
+            env=e, timeout=600, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"reference run failed: {(r.stderr or r.stdout)[-1500:]}")
+
+        t1 = lines(os.path.join(out, "traj_r1.jsonl"))
+        ref = lines(os.path.join(ref_out, "traj_r0.jsonl"))
+        widths = sorted({rec["dp"] for rec in t1})
+        resumed_at_reduced = widths == [2]
+        steps = [rec["step"]
+                 for rec in lines(os.path.join(out, "traj_r0.jsonl"))] \
+            + [rec["step"] for rec in t1]
+        continuous = steps == list(range(6))
+        drift = max(abs(a["loss"] - b["loss"])
+                    for a, b in zip(t1, ref[3:]))
+        samples = (lines(os.path.join(out, "samples_r0.jsonl"))[:3]
+                   + lines(os.path.join(out, "samples_r1.jsonl")))[:6]
+        sample_exact = samples == lines(
+            os.path.join(ref_out, "samples_r0.jsonl"))[:6]
+        rec = {"metric": "elastic_kill_resume_smoke",
+               "unit": "bool",
+               "value": int(resumed_at_reduced and continuous
+                            and sample_exact and drift < 1e-4),
+               "resumed_at_dp": widths,
+               "trajectory_continuous": continuous,
+               "sample_exact": sample_exact,
+               "max_loss_drift_vs_dp2_from_start": round(drift, 9),
+               "supervised_wall_s": round(supervised_s, 3)}
+        try:
+            with open("BENCH_elastic.json", "w") as f:
+                json.dump(rec, f, indent=1)
+        except OSError:
+            pass
+        print(json.dumps(rec), flush=True)
+        if not rec["value"]:
+            raise RuntimeError(f"elastic smoke FAILED: {rec}")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache shared across bench runs.  The
     1.5B program (48-layer scan + offload staging) is compile-heavy and
@@ -726,9 +836,24 @@ def main():
                              "async saves (exposed-stall comparison + "
                              "tracer-proven hidden write time) instead "
                              "of the north-star bench")
+    parser.add_argument("--elastic-smoke", action="store_true",
+                        dest="elastic_smoke",
+                        help="kill/resume supervisor smoke: ds --elastic "
+                             "on localhost, worker hard-killed mid-run, "
+                             "assert resume at reduced width with "
+                             "trajectory continuity + sample-exactness "
+                             "(CPU subprocesses only; never probes the "
+                             "TPU tunnel)")
     # strict parse: a typo'd flag must fail loudly, not silently launch
     # the multi-hour north-star run (the _15b_knobs eager-validation rule)
     args = parser.parse_args()
+
+    if args.elastic_smoke:
+        # dispatched BEFORE device enumeration: the smoke is pure CPU
+        # subprocess supervision and must not touch (or wedge on) the
+        # TPU tunnel
+        _elastic_smoke()
+        return
 
     devices = guarded_devices()
     on_tpu = devices[0].platform != "cpu"

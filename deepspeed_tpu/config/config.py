@@ -261,6 +261,30 @@ class DeepSpeedTelemetryConfig:
             raise DeepSpeedConfigError(
                 f"{C.TELEMETRY_STORM_THRESHOLD} must be an int >= 1, "
                 f"got {self.recompile_storm_threshold!r}")
+        self.heartbeat = get_scalar_param(
+            tel, C.TELEMETRY_HEARTBEAT, C.TELEMETRY_HEARTBEAT_DEFAULT)
+        self.heartbeat_dir = get_scalar_param(
+            tel, C.TELEMETRY_HEARTBEAT_DIR,
+            C.TELEMETRY_HEARTBEAT_DIR_DEFAULT)
+        self.straggler_ratio = get_scalar_param(
+            tel, C.TELEMETRY_STRAGGLER_RATIO,
+            C.TELEMETRY_STRAGGLER_RATIO_DEFAULT)
+        if not isinstance(self.heartbeat, bool):
+            # the async_save lesson: a JSON string like "false" is truthy
+            raise DeepSpeedConfigError(
+                f"telemetry.{C.TELEMETRY_HEARTBEAT} must be a bool, "
+                f"got {self.heartbeat!r}")
+        if not isinstance(self.heartbeat_dir, str):
+            raise DeepSpeedConfigError(
+                f"telemetry.{C.TELEMETRY_HEARTBEAT_DIR} must be a string "
+                f"path, got {self.heartbeat_dir!r}")
+        if (not isinstance(self.straggler_ratio, (int, float))
+                or isinstance(self.straggler_ratio, bool)
+                or not self.straggler_ratio > 1.0):
+            raise DeepSpeedConfigError(
+                f"telemetry.{C.TELEMETRY_STRAGGLER_RATIO} must be a "
+                f"number > 1.0 (it multiplies the fleet median), got "
+                f"{self.straggler_ratio!r}")
 
 
 class DeepSpeedDataPrefetchConfig:

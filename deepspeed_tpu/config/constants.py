@@ -330,6 +330,20 @@ TELEMETRY_MEMORY_DEFAULT = True
 # recompile-storm warning
 TELEMETRY_STORM_THRESHOLD = "recompile_storm_threshold"
 TELEMETRY_STORM_THRESHOLD_DEFAULT = 3
+# Elastic-training liveness (docs/elastic.md): every process writes a
+# per-host heartbeat file each step (atomic JSON) into a shared dir —
+# the supervisor's liveness signal and the straggler monitor's input.
+# Enabled implicitly when the supervisor exports DS_HEARTBEAT_DIR;
+# `heartbeat: true` enables it without a supervisor (files land under
+# heartbeat_dir, default <telemetry output>/heartbeats).
+TELEMETRY_HEARTBEAT = "heartbeat"
+TELEMETRY_HEARTBEAT_DEFAULT = False
+TELEMETRY_HEARTBEAT_DIR = "heartbeat_dir"
+TELEMETRY_HEARTBEAT_DIR_DEFAULT = ""
+# a host whose per-step time exceeds this multiple of the fleet median
+# is flagged (straggler_detected_total + summarize row); must be > 1
+TELEMETRY_STRAGGLER_RATIO = "straggler_ratio"
+TELEMETRY_STRAGGLER_RATIO_DEFAULT = 2.0
 
 # Asynchronous input pipeline (TPU extension; docs/observability.md):
 # a single daemon worker prefetches batches through a bounded queue and

@@ -25,8 +25,11 @@ from typing import Dict, List, Optional
 from ..utils.logging import logger
 
 DLTS_HOSTFILE = "/job/hostfile"
+# DS_: the runtime's own knob/fault-injection family (DS_PREFETCH,
+# DS_CKPT_*, DS_HEARTBEAT_DIR, ...) — an operator's escape hatch must
+# reach every node, not just the launch host
 EXPORT_ENVS = ("JAX_", "XLA_", "TPU_", "LIBTPU", "PYTHON", "PATH",
-               "LD_LIBRARY_PATH", "DEEPSPEED_TPU_")
+               "LD_LIBRARY_PATH", "DEEPSPEED_TPU_", "DS_")
 DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
 
 
@@ -58,6 +61,47 @@ def parse_args(args=None):
                         help="multi-node transport")
     parser.add_argument("--force_multi", action="store_true",
                         help="treat a single node as a multi-node launch")
+    # ---- elastic training (docs/elastic.md) ----
+    parser.add_argument("--elastic", action="store_true",
+                        help="supervise the job: on worker failure or "
+                        "missed heartbeats, kill the remnants, re-probe "
+                        "the hosts, re-form the world from the survivors "
+                        "at the reduced width, and relaunch resuming "
+                        "from the newest verified checkpoint tag")
+    parser.add_argument("--max-restarts", type=int, default=3,
+                        dest="max_restarts",
+                        help="relaunch budget before the supervisor "
+                        "gives up with a typed error (0 = never restart)")
+    parser.add_argument("--backoff-base", type=float, default=1.0,
+                        dest="backoff_base",
+                        help="exponential-backoff base seconds between "
+                        "relaunches")
+    parser.add_argument("--backoff-max", type=float, default=60.0,
+                        dest="backoff_max",
+                        help="backoff cap in seconds")
+    parser.add_argument("--min-slots", type=int, default=1,
+                        dest="min_slots",
+                        help="smallest surviving chip count worth "
+                        "resuming at; below it the supervisor gives up")
+    parser.add_argument("--heartbeat-dir", type=str, default="",
+                        dest="heartbeat_dir",
+                        help="shared dir for per-host heartbeat files "
+                        "(exported to workers as DS_HEARTBEAT_DIR; "
+                        "default: a fresh temp dir — pass a shared-"
+                        "filesystem path for multi-host liveness)")
+    parser.add_argument("--heartbeat-timeout", type=float, default=0.0,
+                        dest="heartbeat_timeout",
+                        help="seconds without a heartbeat after which a "
+                        "host counts as hung and the attempt is killed "
+                        "and restarted (0 = exit-watching only)")
+    parser.add_argument("--probe-cmd", type=str, default="",
+                        dest="probe_cmd",
+                        help="shell command template probing one host "
+                        "between attempts, '{host}' substituted; exit "
+                        "!= 0 marks the host dead, and an optional "
+                        "'slots=N' on stdout resizes it (default: ssh "
+                        "-o ConnectTimeout=5 <host> true; localhost is "
+                        "always alive)")
     parser.add_argument("user_script", type=str,
                         help="training script to launch")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -112,27 +156,52 @@ def parse_resource_filter(host_info: Dict[str, List[int]],
         filtered = deepcopy(host_info)
         parse_str = exclude_str
 
+    which = "--include" if include_str else "--exclude"
+    known = ", ".join(host_info) or "<empty hostfile>"
     for node_config in parse_str.split("@"):
+        if not node_config:
+            raise ValueError(
+                f"{which} filter {parse_str!r} contains an empty "
+                "NODE_SPEC (stray '@'?); expected "
+                "NAME[:SLOT[,SLOT...]][@NAME...]")
         if ":" in node_config:
-            hostname, slot_str = node_config.split(":")
-            slots = [int(x) for x in slot_str.split(",")]
+            parts = node_config.split(":")
+            if len(parts) != 2:
+                raise ValueError(
+                    f"{which} NODE_SPEC {node_config!r} is malformed: "
+                    "expected NAME or NAME:SLOT[,SLOT...] (one colon)")
+            hostname, slot_str = parts
+            try:
+                slots = [int(x) for x in slot_str.split(",")]
+            except ValueError:
+                raise ValueError(
+                    f"{which} NODE_SPEC {node_config!r} is malformed: "
+                    f"slots must be comma-separated integers, got "
+                    f"{slot_str!r}")
             if hostname not in host_info:
                 raise ValueError(
-                    f"Hostname '{hostname}' not found in hostfile")
+                    f"{which} names hostname {hostname!r} which is not "
+                    f"in the hostfile (hosts: {known}) — refusing to "
+                    "silently ignore a filter that matches nothing")
             for s in slots:
                 if s not in host_info[hostname]:
                     raise ValueError(
-                        f"No slot '{s}' specified on host '{hostname}'")
+                        f"{which} names slot {s} on host {hostname!r}, "
+                        f"which only has slots "
+                        f"{host_info[hostname]}")
             if include_str:
                 filtered[hostname] = slots
             else:
                 for s in slots:
-                    filtered[hostname].remove(s)
+                    if s in filtered[hostname]:
+                        filtered[hostname].remove(s)
         else:
             hostname = node_config
             if hostname not in host_info:
                 raise ValueError(
-                    f"Hostname '{hostname}' not found in hostfile")
+                    f"{which} names hostname {hostname!r} which is not "
+                    f"in the hostfile (hosts: {known}) — refusing to "
+                    "silently ignore a filter that matches nothing")
             filtered[hostname] = host_info[hostname] if include_str else []
 
     for hostname in list(filtered):
@@ -186,7 +255,23 @@ def main(args=None):
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
 
+    if resource_pool is None and args.elastic:
+        # elastic without a hostfile: supervise a localhost world (the
+        # single-host exec below cannot be supervised — exec replaces
+        # the supervisor)
+        resource_pool = collections.OrderedDict(
+            [("localhost", max(args.num_gpus, 1))])
+
     if resource_pool is None:
+        if args.include or args.exclude:
+            # a filter against a pool that does not exist can only be a
+            # mistake (typo'd -H path is the common one) — silently
+            # ignoring it would launch on resources the operator
+            # explicitly tried to constrain
+            raise ValueError(
+                f"--include/--exclude were given but no hostfile exists "
+                f"at {args.hostfile!r}; resource filters need a "
+                "hostfile resource pool to filter")
         # single-host launch: exec in place with chip visibility
         env = os.environ.copy()
         if args.num_gpus > 0:
@@ -213,6 +298,9 @@ def main(args=None):
     world_info = encode_world_info(active)
     exports = _export_env_lines()
 
+    if args.elastic:
+        return _run_elastic(args, active, exports)
+
     if args.launcher in ("openmpi", "mvapich"):
         # MPI flavor: ONE mpirun command covers every node (reference
         # multinode_runner.py:78-189); ranks resolve node_rank from the
@@ -235,12 +323,8 @@ def main(args=None):
     # differ per host and pdsh's single-command broadcast doesn't apply —
     # both transports dispatch one remote command per host, built by the
     # shared runner classes (one copy of the launch-command grammar)
-    from .multinode_runner import PDSHRunner, SSHRunner
     args.master_addr = master_addr
-    pdsh = PDSHRunner(args, world_info)
-    fan_out = (pdsh if args.launcher == "pdsh" and pdsh.backend_exists()
-               else SSHRunner(args, world_info))
-    launch_cmds = fan_out.get_cmd(exports, active)
+    fan_out, launch_cmds = _fan_out_cmds(args, active, exports)
 
     if args.launcher == "local" or (len(active) == 1
                                     and not args.force_multi):
@@ -252,6 +336,119 @@ def main(args=None):
     procs = [subprocess.Popen(transport + [host, remote])
              for host, remote in launch_cmds]
     return max(p.wait() for p in procs)
+
+
+def _fan_out_cmds(args, active, exports):
+    """One (host, remote-command) pair per node via the shared runner
+    classes — the single copy of the launch-command grammar, used by
+    both the one-shot path and every elastic relaunch."""
+    from .multinode_runner import PDSHRunner, SSHRunner
+    world_info = encode_world_info(active)
+    pdsh = PDSHRunner(args, world_info)
+    fan_out = (pdsh if args.launcher == "pdsh" and pdsh.backend_exists()
+               else SSHRunner(args, world_info))
+    return fan_out, fan_out.get_cmd(exports, active)
+
+
+def _build_probe(args):
+    """Host-liveness probe for the elastic supervisor: --probe-cmd
+    template (exit != 0 = dead; 'slots=N' on stdout resizes), else ssh
+    (localhost / --launcher local always alive)."""
+    import re
+
+    if args.probe_cmd:
+        def probe(host):
+            r = subprocess.run(args.probe_cmd.format(host=host),
+                               shell=True, capture_output=True,
+                               text=True, timeout=60)
+            if r.returncode != 0:
+                return None
+            m = re.search(r"slots=(\d+)", r.stdout)
+            return list(range(int(m.group(1)))) if m else True
+        return probe
+
+    def probe(host):
+        if args.launcher == "local" or host in ("localhost", "127.0.0.1"):
+            return True
+        r = subprocess.run(["ssh", "-o", "BatchMode=yes",
+                            "-o", "ConnectTimeout=5", host, "true"],
+                           capture_output=True, timeout=60)
+        return True if r.returncode == 0 else None
+    return probe
+
+
+def _run_elastic(args, active, exports):
+    """``ds --elastic``: supervise the launch with the restart loop in
+    launcher/elastic.py — worker exits + missed heartbeats trigger
+    kill → host re-probe → world re-formation at the surviving width →
+    relaunch, with the resumed run walking the checkpoint fallback
+    chain to the newest verified tag (docs/elastic.md)."""
+    import tempfile
+
+    from .elastic import (ELASTIC_RESTART_ENV, ELASTIC_SLOTS_ENV,
+                          ElasticSupervisor, RestartPolicy)
+
+    if args.launcher in ("openmpi", "mvapich"):
+        raise ValueError(
+            "--elastic supports the pdsh/ssh/local launchers only: "
+            "mpirun owns process placement, so the supervisor cannot "
+            "re-form a shrunk world under it")
+    hb_dir = args.heartbeat_dir or tempfile.mkdtemp(prefix="ds_heartbeat_")
+    if not args.heartbeat_dir:
+        logger.info("elastic: heartbeat dir %s (pass --heartbeat-dir on "
+                    "a SHARED filesystem for multi-host liveness)",
+                    hb_dir)
+    user_master = args.master_addr  # explicit flag pins the coordinator
+
+    def launch(active_now, attempt):
+        # re-derive the coordinator each attempt: the previous rank-0
+        # host may be the one that died
+        args.master_addr = user_master or next(iter(active_now))
+        exp = dict(exports)
+        exp[ELASTIC_RESTART_ENV] = str(attempt)
+        exp[ELASTIC_SLOTS_ENV] = str(
+            sum(len(s) for s in active_now.values()))
+        exp["DS_HEARTBEAT_DIR"] = hb_dir
+        fan_out, cmds = _fan_out_cmds(args, active_now, exp)
+        host0 = cmds[0][0]
+        # in-process launch ONLY when the (single) host IS this machine:
+        # a remote world shrunk to one surviving host must still go over
+        # the transport — the survivor is not the supervisor's machine
+        if args.launcher == "local" or (
+                len(active_now) == 1 and not args.force_multi
+                and host0 in ("localhost", "127.0.0.1")):
+            host, remote = cmds[0]
+            logger.info("elastic: local launch on %s (attempt %d)",
+                        host, attempt)
+            return [(host, subprocess.Popen(remote, shell=True))]
+        transport = ["pdsh", "-w"] if fan_out.name == "pdsh" else ["ssh"]
+        return [(host, subprocess.Popen(transport + [host, remote]))
+                for host, remote in cmds]
+
+    def remote_kill(host):
+        # best-effort remnant cleanup: SIGTERMing the local ssh/pdsh
+        # client does not reach the remote worker (no pty, no signal
+        # forwarding), so a hung host would keep its chips and beat
+        # files — pkill the user script by path on the host itself
+        if host in ("localhost", "127.0.0.1"):
+            return
+        import shlex
+        subprocess.run(
+            ["ssh", "-o", "BatchMode=yes", "-o", "ConnectTimeout=5",
+             host, f"pkill -TERM -f {shlex.quote(args.user_script)}"],
+            capture_output=True, timeout=30)
+
+    supervisor = ElasticSupervisor(
+        active, launch, probe_fn=_build_probe(args),
+        policy=RestartPolicy(max_restarts=args.max_restarts,
+                             backoff_base_s=args.backoff_base,
+                             backoff_max_s=args.backoff_max,
+                             min_slots=args.min_slots),
+        heartbeat_dir=hb_dir,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        remote_kill_fn=(None if args.launcher == "local"
+                        else remote_kill))
+    return supervisor.run()
 
 
 if __name__ == "__main__":

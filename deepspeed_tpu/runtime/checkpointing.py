@@ -572,6 +572,46 @@ def load_tree(dirpath: str, target: Any, strict: bool = True,
 
 
 # ---------------------------------------------------------------------------
+# data-iterator plane codec (sample-exact resume; docs/elastic.md)
+# ---------------------------------------------------------------------------
+def _iter_state_plane(state: Any) -> Any:
+    """Encode a JSON-able iterator state as a one-leaf tree so the data
+    plane rides the SAME machinery as model/optim (save_tree → per-leaf
+    CRC32 + manifest + meta digest, every DS_CKPT_FAULT write point)."""
+    data = json.dumps(state).encode()
+    return {"state": np.frombuffer(data, np.uint8)}
+
+
+def _load_iter_state_plane(ckpt_dir: str, retry: RetryPolicy) -> Any:
+    """Decode the data-iterator plane: manifest-driven, CRC-verified per
+    leaf like the other planes (the manifest has exactly one entry)."""
+    ddir = os.path.join(ckpt_dir, "data")
+    manifest = _read_json(os.path.join(ddir, "manifest.json"),
+                          "data-iterator manifest", retry)
+    if len(manifest) != 1:
+        raise CheckpointCorruptError(
+            f"data-iterator plane at {ddir} has {len(manifest)} manifest "
+            "entries, expected exactly 1")
+    (key, entry), = manifest.items()
+    fpath = os.path.join(ddir, entry["file"])
+    arr = _read_npy(fpath, retry, key)
+    _verify_leaf(arr, entry, key, fpath)
+    try:
+        return json.loads(bytes(arr.tobytes()).decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"data-iterator plane at {fpath} is unparseable: {e}")
+
+
+def _capture_iter_state(engine) -> Optional[Any]:
+    """The engine's data-iterator state, or None (engine-shaped ducks in
+    tests / engines without a checkpointable loader save no data plane —
+    those checkpoints load exactly like legacy ones)."""
+    fn = getattr(engine, "data_iterator_state", None)
+    return fn() if callable(fn) else None
+
+
+# ---------------------------------------------------------------------------
 # verification (status without loading)
 # ---------------------------------------------------------------------------
 def _manifest_digest_error(ckpt_dir: str, plane: str, want: str,
@@ -701,14 +741,17 @@ def _write_checkpoint_files(save_dir: str, tag: str, ckpt_dir: str,
                             tmp_dir: str, model_plane: Any,
                             optim_plane: Any, meta: dict,
                             save_latest: bool, keep_last_n: int,
-                            retry: RetryPolicy, span=None) -> str:
+                            retry: RetryPolicy, span=None,
+                            data_plane: Any = None) -> str:
     """The single serialization path both sync and async saves share
     (which is what makes async==sync bitwise): tmp-dir staging, per-plane
     manifests with CRCs, meta with manifest digests, fsync, verification
     of the STAGED dir, swap-rename, ``latest`` update, then retention GC
     — destruction strictly AFTER the new save verifies.  ``span`` is an
     optional ``name -> context`` factory for the per-plane telemetry
-    spans (the writer thread stamps its own tid)."""
+    spans (the writer thread stamps its own tid).  ``data_plane`` is the
+    optional data-iterator plane (sample-exact resume) — same CRC +
+    digest discipline, absent when no checkpointable iterator is bound."""
     span = span or (lambda name: contextlib.nullcontext())
     delay = float(os.environ.get("DS_CKPT_DELAY_S", "0") or 0.0)
     if delay > 0:
@@ -728,6 +771,10 @@ def _write_checkpoint_files(save_dir: str, tag: str, ckpt_dir: str,
     meta["format_version"] = CKPT_FORMAT_VERSION
     meta["manifest_digests"] = {"model": model_digest,
                                 "optim": optim_digest}
+    if data_plane is not None:
+        with span("checkpoint/save_data_plane"):
+            meta["manifest_digests"]["data"] = save_tree(
+                os.path.join(tmp_dir, "data"), data_plane, retry=retry)
     _write_bytes(os.path.join(tmp_dir, "meta.json"),
                  json.dumps(meta, indent=1).encode(), retry, point="meta")
     # verify the STAGED dir before anything is destroyed or published: a
@@ -846,6 +893,13 @@ def _build_save_job(engine, save_dir: str, tag: str, ckpt_dir: str,
             # paying a full master+moments copy (18+ GB at 1.5B).
             model_plane = _host_snapshot(model_plane)
             optim_plane = _host_snapshot(optim_plane)
+        # data-iterator plane: captured NOW (at snapshot time, so an
+        # async save records the consumption point matching the model
+        # state) and already a private bytes copy — training that
+        # continues while the writer runs cannot bleed into it
+        iter_state = _capture_iter_state(engine)
+        data_plane = (_iter_state_plane(iter_state)
+                      if iter_state is not None else None)
     meta = {
         "tag": tag,
         "global_steps": int(engine.global_steps),
@@ -868,7 +922,8 @@ def _build_save_job(engine, save_dir: str, tag: str, ckpt_dir: str,
                 save_dir, tag, ckpt_dir, tmp_dir, model_plane,
                 optim_plane, meta, save_latest, cfg.keep_last_n,
                 cfg.retry,
-                span=lambda name: _tel_span(eng, name, tag=tag))
+                span=lambda name: _tel_span(eng, name, tag=tag),
+                data_plane=data_plane)
         if async_write and eng is not None:
             acc = getattr(eng, "_ckpt_interval_acc", None)
             if acc is not None:
@@ -1011,6 +1066,19 @@ def _save_multiproc(engine, save_dir, tag, ckpt_dir, tmp_dir,
                 "rng": state.rng,
                 "data_rng": engine._data_rng,
             }, retry=retry)
+        # data-iterator plane: ONE global state from process 0.  The
+        # loader contract already requires identical seeds/order on
+        # every process (each feeds its own slice of the same global
+        # batch sequence), so proc0's (epoch, batch_idx, rng) IS the
+        # global consumption point — and stays meaningful when an
+        # elastic restart resumes at a different process count.
+        data_digest = None
+        if proc0:
+            iter_state = _capture_iter_state(engine)
+            if iter_state is not None:
+                data_digest = save_tree(
+                    os.path.join(tmp_dir, "data"),
+                    _iter_state_plane(iter_state), retry=retry)
         # every process's shard files must be on disk before the rename
         multihost_utils.sync_global_devices("ds_ckpt_written")
         if proc0:
@@ -1023,8 +1091,9 @@ def _save_multiproc(engine, save_dir, tag, ckpt_dir, tmp_dir,
                 "zero_stage": int(engine.config.zero_optimization_stage),
                 "client_state": client_state or {},
                 "format_version": CKPT_FORMAT_VERSION,
-                "manifest_digests": {"model": model_digest,
-                                     "optim": optim_digest},
+                "manifest_digests": (
+                    {"model": model_digest, "optim": optim_digest}
+                    | ({"data": data_digest} if data_digest else {})),
             }
             _write_bytes(os.path.join(tmp_dir, "meta.json"),
                          json.dumps(meta, indent=1).encode(), retry,
@@ -1184,6 +1253,29 @@ def _load_into_engine(engine, ckpt_dir: str, load_optimizer_states: bool,
     optim_dir = os.path.join(ckpt_dir, "optim")
     use_optim = (load_optimizer_states and not load_module_only
                  and os.path.isdir(optim_dir))
+    # data-iterator plane (sample-exact resume): read + CRC/digest-verify
+    # it NOW, before any engine state is replaced — a corrupt plane must
+    # make the fallback chain walk to an older tag with the engine still
+    # intact, exactly like the model/optim planes.  APPLICATION to the
+    # loader happens at the end, after the state restore succeeds.
+    # Module-only loads (inference handoff / fine-tune warmstart) skip
+    # it: they are not a resume, so replaying data from the top is the
+    # intended behavior.
+    iter_state = None
+    has_data_plane = ("data" in digests
+                      or os.path.isdir(os.path.join(ckpt_dir, "data")))
+    if has_data_plane and use_optim:
+        check_digest("data")
+        with _tel_span(engine, "checkpoint/load_data_plane"):
+            iter_state = _load_iter_state_plane(ckpt_dir, retry)
+    elif (not has_data_plane and use_optim
+          and _capture_iter_state(engine) is not None):
+        logger.warning(
+            "checkpoint %s predates the data-iterator plane (or was "
+            "saved without a checkpointable loader): the training data "
+            "iterator starts FRESH — the resumed run will replay or "
+            "skip data relative to the interrupted one (model/optimizer "
+            "state restore exactly; see docs/elastic.md)", ckpt_dir)
     rng = state.rng
     tmpl_master, tmpl_opt = engine._canonical_templates()
     if use_optim:
@@ -1267,6 +1359,10 @@ def _load_into_engine(engine, ckpt_dir: str, load_optimizer_states: bool,
         # buffers here (not in the engine wrapper) so calling this public
         # function directly leaves the engine consistent too
         engine._sync_offload_from_state()
+    if iter_state is not None:
+        apply_fn = getattr(engine, "load_data_iterator_state", None)
+        if callable(apply_fn):
+            apply_fn(iter_state)
     log_dist(
         f"loaded checkpoint {ckpt_dir} (saved at dp={meta['dp_world_size']} "
         f"zero={meta['zero_stage']}; now dp={engine.dp_world_size} "

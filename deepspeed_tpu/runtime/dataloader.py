@@ -5,14 +5,33 @@ The reference auto-wraps datasets in a DistributedSampler keyed on DP rank
 SPMD there are no per-rank samplers: the loader yields *global* batches and
 the engine shards them over the ``data`` mesh axis with one device_put.
 ``RepeatingLoader`` (reference: dataloader.py:10-30) ports unchanged.
+
+Sample-exact resume (docs/elastic.md): both loaders are CHECKPOINTABLE —
+``state_dict()`` captures (epoch, step-in-epoch, the RNG state at epoch
+start) and ``load_state_dict()`` restores it so the next batch drawn is
+exactly the one an uninterrupted run would have drawn: the epoch-start
+RNG state re-derives the SAME shuffle permutation, and the batch index
+skips what was already consumed.  The engine persists this as the
+checkpoint's data-iterator plane; a resumed run neither replays nor
+skips data.  (The reference has no analogue — its resumed runs re-seed
+the sampler and replay the epoch.)
 """
 from __future__ import annotations
 
+import copy
 from typing import Any, Callable, Iterable, Optional
 
 import numpy as np
 
 from ..utils.logging import logger
+
+
+def supports_iter_state(obj) -> bool:
+    """True when ``obj`` carries the checkpointable-iterator protocol
+    (``state_dict``/``load_state_dict``) — what the engine probes before
+    writing the data-iterator checkpoint plane."""
+    return (callable(getattr(obj, "state_dict", None))
+            and callable(getattr(obj, "load_state_dict", None)))
 
 
 class RepeatingLoader:
@@ -31,6 +50,29 @@ class RepeatingLoader:
         except StopIteration:
             self.data_iter = iter(self.loader)
             return next(self.data_iter)
+
+    # -- sample-exact resume -------------------------------------------
+    # The repeater holds no position of its own: epoch wrap is derivable
+    # from the inner loader's (epoch, batch_idx), so its state IS the
+    # inner loader's state.
+    def state_dict(self) -> dict:
+        if not supports_iter_state(self.loader):
+            raise TypeError(
+                "RepeatingLoader.state_dict: the wrapped loader "
+                f"({type(self.loader).__name__}) has no state_dict/"
+                "load_state_dict — sample-exact resume needs a "
+                "checkpointable loader (e.g. DeepSpeedDataLoader)")
+        return {"loader": self.loader.state_dict()}
+
+    def load_state_dict(self, state: dict) -> None:
+        if not supports_iter_state(self.loader):
+            raise TypeError(
+                "RepeatingLoader.load_state_dict: the wrapped loader "
+                f"({type(self.loader).__name__}) is not checkpointable")
+        self.loader.load_state_dict(state["loader"])
+        # fresh iterator over the RESTORED position (the old one, if any,
+        # still points at the pre-restore epoch)
+        self.data_iter = iter(self.loader)
 
 
 class DeepSpeedDataLoader:
@@ -67,17 +109,90 @@ class DeepSpeedDataLoader:
                 "batch or drop it (drop_last=True).",
                 len(dataset), batch_size, len(dataset) % batch_size,
                 batch_size)
+        # -- iteration-position tracking (sample-exact resume) ----------
+        # epoch = index of the epoch currently being iterated (-1 before
+        # the first __iter__); batch_idx = batches PRODUCED so far in it
+        # (advanced BEFORE each yield, so a state captured between
+        # next() calls names the next batch to draw, not the last drawn);
+        # _epoch_rng_state = the RNG state at the current epoch's start,
+        # from which its shuffle permutation re-derives on resume.
+        self._epoch = -1
+        self._batch_idx = 0
+        self._epoch_rng_state = copy.deepcopy(self._rng.bit_generator.state)
+        self._resume_idx: Optional[int] = None
 
     def __len__(self):
         return self.len
 
     def __iter__(self):
+        if self._resume_idx is not None:
+            # resuming the epoch captured by load_state_dict: replay the
+            # epoch-start RNG state so the SAME permutation re-derives,
+            # then skip the batches the saved run already consumed
+            start = self._resume_idx
+            self._resume_idx = None
+            self._rng.bit_generator.state = copy.deepcopy(
+                self._epoch_rng_state)
+        else:
+            start = 0
+            self._epoch += 1
+            self._epoch_rng_state = copy.deepcopy(
+                self._rng.bit_generator.state)
         order = np.arange(len(self.dataset))
         if self.shuffle:
             self._rng.shuffle(order)
-        for i in range(self.len):
+        self._batch_idx = start
+        for i in range(start, self.len):
             idx = order[i * self.batch_size:(i + 1) * self.batch_size]
+            # position advances BEFORE the yield: a state_dict taken
+            # after this batch is consumed must not re-draw it
+            self._batch_idx = i + 1
             yield self.collate_fn([self.dataset[int(j)] for j in idx])
+
+    # -- sample-exact resume -------------------------------------------
+    def state_dict(self) -> dict:
+        """JSON-able iteration position: restoring it into a freshly
+        built loader (same dataset/batch_size/seed/shuffle) makes the
+        next batch drawn exactly the one this loader would draw next."""
+        return {
+            "version": 1,
+            "epoch": int(self._epoch),
+            "batch_idx": int(self._batch_idx),
+            # numpy Generator state is a plain dict of ints/strings —
+            # JSON-serializable as-is (PCG64 ints exceed 64 bits; JSON
+            # integers are arbitrary precision)
+            "rng_state": copy.deepcopy(self._epoch_rng_state),
+            "len": int(self.len),
+            "shuffle": bool(self.shuffle),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        if int(state.get("len", self.len)) != self.len:
+            logger.warning(
+                "DeepSpeedDataLoader.load_state_dict: checkpointed "
+                "batches/epoch %s != this loader's %s (dataset or batch "
+                "size changed) — resuming at the saved batch index "
+                "modulo the new epoch length",
+                state.get("len"), self.len)
+        if bool(state.get("shuffle", self.shuffle)) != self.shuffle:
+            logger.warning(
+                "DeepSpeedDataLoader.load_state_dict: checkpoint was "
+                "taken with shuffle=%s but this loader has shuffle=%s — "
+                "the resumed sample order will not match the saved run",
+                state.get("shuffle"), self.shuffle)
+        self._epoch = int(state["epoch"])
+        bi = int(state["batch_idx"])
+        if bi > self.len:
+            # epoch length changed under the checkpoint (warned above):
+            # clamp into this loader's epoch instead of yielding nothing
+            bi = bi % max(self.len, 1)
+        self._batch_idx = bi
+        self._epoch_rng_state = copy.deepcopy(state["rng_state"])
+        self._rng.bit_generator.state = copy.deepcopy(state["rng_state"])
+        # epoch -1 = the saved loader was never iterated: the next
+        # __iter__ must start epoch 0 fresh, not "resume" a non-epoch
+        self._resume_idx = (None if self._epoch < 0
+                            else int(self._batch_idx))
 
 
 def _default_collate(samples):

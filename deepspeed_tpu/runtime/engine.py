@@ -790,6 +790,28 @@ class DeepSpeedEngine:
                 # hook: the last telemetry-enabled engine wins)
                 from .offload import set_transfer_tracer
                 set_transfer_tracer(self.telemetry.tracer)
+        # elastic-training liveness (docs/elastic.md): EVERY process
+        # beats a per-host heartbeat file each step when the supervisor
+        # exported DS_HEARTBEAT_DIR (or telemetry.heartbeat is on); the
+        # proc-0 straggler monitor reads the fleet's files at the
+        # periodic telemetry sync.  Not gated on the telemetry hub — the
+        # supervisor needs liveness even with telemetry off.
+        self._heartbeat = None
+        self._straggler_monitor = None
+        tcfg = config.telemetry_config
+        hb_dir = os.environ.get("DS_HEARTBEAT_DIR", "")
+        if not hb_dir and tcfg.heartbeat:
+            hb_dir = tcfg.heartbeat_dir or os.path.join(
+                tcfg.output_path or os.path.join(os.getcwd(), "telemetry"),
+                "heartbeats")
+        if hb_dir:
+            from ..telemetry.heartbeat import (HeartbeatWriter,
+                                               StragglerMonitor)
+            self._heartbeat = HeartbeatWriter(
+                hb_dir, process_index=jax.process_index())
+            if jax.process_index() == 0:
+                self._straggler_monitor = StragglerMonitor(
+                    ratio=float(tcfg.straggler_ratio))
         # fault-tolerant checkpointing (docs/checkpointing.md): the async
         # daemon writer (lazy thread; created eagerly so the GC finalizer
         # below can drain a dropped engine's in-flight save), exposed-
@@ -2780,6 +2802,17 @@ class DeepSpeedEngine:
         # synced interval; see the baselined jaxlint JL006 finding
         dispatch_s = time.time() - t0
         self._step_times.append(dispatch_s)
+        if self._heartbeat is not None:
+            # per-host liveness beat (atomic small-file write; step_s is
+            # the wall delta between beats — the fleet-relative number
+            # the straggler monitor medians, so dispatch-only timing is
+            # fine here: every host's beats bracket the same queue)
+            self._heartbeat.beat(self.global_steps)
+            if self.telemetry is not None:
+                self.telemetry.registry.gauge(
+                    "heartbeat_step",
+                    "last step this process heartbeat for (elastic "
+                    "liveness)").set(self.global_steps)
         if self.telemetry is not None:
             self.telemetry.record_step(self.global_steps, dispatch_s,
                                        samples=int(self.train_batch_size))
@@ -2877,6 +2910,27 @@ class DeepSpeedEngine:
                 "data_prefetch_queue_depth",
                 "batches staged ahead in the input-prefetch queue",
             ).set(pf.qsize())
+        if self._straggler_monitor is not None \
+                and self._heartbeat is not None:
+            # fleet health from the shared heartbeat dir: flag hosts
+            # whose step time exceeds straggler_ratio × the fleet
+            # median; detections count ONCE per flagged episode
+            from ..telemetry.heartbeat import read_heartbeats
+            rep = self._straggler_monitor.update(
+                read_heartbeats(self._heartbeat.directory))
+            if rep["new_stragglers"]:
+                self.telemetry.registry.counter(
+                    "straggler_detected_total",
+                    "hosts flagged slower than straggler_ratio x the "
+                    "fleet median step time").inc(
+                    len(rep["new_stragglers"]))
+                logger.warning(
+                    "straggler(s) detected: %s (fleet median %.3fs/step, "
+                    "ratio %.1fx)", ", ".join(rep["new_stragglers"]),
+                    rep["median_step_s"] or 0.0,
+                    self._straggler_monitor.ratio)
+            scalars["straggler_detected_total"] = float(
+                self._straggler_monitor.flagged_total)
         self.telemetry.on_sync(
             self.global_steps,
             interval_s=interval,
@@ -2902,13 +2956,80 @@ class DeepSpeedEngine:
             return None
         if getattr(self, "_train_data_iter", None) is None:
             loader = self.training_dataloader
-            it = (loader if hasattr(loader, "__next__")
-                  else iter(loader))
             if self._prefetch_enabled:
-                it = self.prefetch(it)
+                # wrap the LOADER OBJECT, not a pre-made iterator: the
+                # prefetcher iterates it itself and keeps access to its
+                # state_dict for sample-exact resume (docs/elastic.md)
+                it = self.prefetch(loader)
                 self._bind_train_prefetcher(it)
+            else:
+                it = (loader if hasattr(loader, "__next__")
+                      else iter(loader))
             self._train_data_iter = it
         return self._train_data_iter
+
+    # ------------------------------------------------------------------
+    # data-iterator checkpoint plane (sample-exact resume; docs/elastic.md)
+    # ------------------------------------------------------------------
+    def data_iterator_state(self):
+        """JSON-able state of the training data iterator at the current
+        CONSUMPTION point, or None when no checkpointable iterator is
+        bound.  The prefetcher path accounts batches staged ahead in its
+        queue as not-yet-consumed (they re-produce on resume), so the
+        state always names the exact next sample ``train_batch`` would
+        see.  ``save_checkpoint`` persists this as the checkpoint's
+        data-iterator plane."""
+        from .dataloader import supports_iter_state
+        pf = getattr(self, "_train_prefetcher", None)
+        if pf is not None and not pf.closed:
+            try:
+                return pf.state_dict()
+            except TypeError:
+                # caller wrapped a raw iterator: the loader's own state
+                # would reflect PRODUCTION (in-flight prefetched batches
+                # counted as consumed) — refusing beats silently skipping
+                # up to `depth` batches on resume
+                return None
+        for cand in (getattr(self, "_train_data_iter", None),
+                     self.training_dataloader):
+            if cand is not None and supports_iter_state(cand) \
+                    and not isinstance(cand, DevicePrefetcher):
+                try:
+                    return cand.state_dict()
+                except TypeError:
+                    # RepeatingLoader over a raw iterable: quacks the
+                    # protocol but can't honor it — save no data plane
+                    # (the checkpoint stays loadable, resume replays)
+                    return None
+        return None
+
+    def load_data_iterator_state(self, state) -> bool:
+        """Apply a checkpointed iterator state to this engine's training
+        dataloader and drop the live iterator chain so the next
+        ``train_batch`` rebuilds it from the restored position.  The
+        raw state is always stashed as ``last_loaded_data_iter_state``
+        so callers driving their own ``data_iter`` chain can apply it to
+        their loader manually.  Returns True when auto-applied."""
+        from .dataloader import supports_iter_state
+        self.last_loaded_data_iter_state = state
+        loader = self.training_dataloader
+        if loader is None or not supports_iter_state(loader):
+            logger.warning(
+                "checkpoint has a data-iterator plane but this engine "
+                "has no checkpointable training dataloader to apply it "
+                "to (training_data not passed / custom iterator): the "
+                "state is stashed as engine.last_loaded_data_iter_state "
+                "— apply it to your loader with load_state_dict() or "
+                "the resumed run will replay/skip data")
+            return False
+        loader.load_state_dict(state)
+        pf = getattr(self, "_train_prefetcher", None)
+        if pf is not None:
+            pf.close()  # its queued batches predate the restored position
+        self._train_prefetcher = None
+        self._prefetch_prev_stats = None
+        self._train_data_iter = None
+        return True
 
     def _bind_train_prefetcher(self, pf: DevicePrefetcher):
         """Make ``pf`` the training prefetcher whose stats feed the

@@ -39,10 +39,20 @@ collate+placement, and ``DS_PREFETCH_DELAY_S`` — fault injection
 (tests/bench only): the worker sleeps this long inside each placement
 span, emulating a slow collate/H2D link so a CPU-only run can prove
 the overlap from tracer timestamps (``tests/test_prefetch.py``).
+
+Sample-exact resume (docs/elastic.md): when the source is a
+checkpointable loader (``state_dict``/``load_state_dict``), the worker
+captures the source's state right after producing each batch and the
+queue carries it alongside; ``state_dict()`` returns the state
+belonging to the last CONSUMED batch, so batches sitting prefetched in
+the queue (produced, not yet consumed) are accounted as not-yet-drawn
+— a resume from this state re-produces exactly them, no replay, no
+skip.
 """
 from __future__ import annotations
 
 import contextlib
+import copy
 import os
 import threading
 import time
@@ -110,6 +120,24 @@ class DevicePrefetcher:
                 or depth < 1:
             raise ValueError(f"prefetch depth must be an int >= 1, "
                              f"got {depth!r}")
+        # the stateful OBJECT (loader / RepeatingLoader) when the source
+        # is checkpointable: iterating it (below) advances its internal
+        # position, which state_dict() reads at the consumption point
+        from .dataloader import supports_iter_state
+        self._state_src = source if supports_iter_state(source) else None
+        # captured BEFORE the worker starts pulling (the thread below
+        # mutates the source immediately): the nothing-consumed state
+        self._consumed_state = None
+        if self._state_src is not None:
+            try:
+                self._consumed_state = copy.deepcopy(
+                    self._state_src.state_dict())
+            except TypeError:
+                # quacks the protocol but can't honor it (RepeatingLoader
+                # over a raw iterable): a stateless source, NOT an error —
+                # this configuration trained fine before sample-exact
+                # resume existed and must keep doing so
+                self._state_src = None
         self._src = source if hasattr(source, "__next__") else iter(source)
         self._place = place_fn if place_fn is not None else (lambda b: b)
         self._span = span_fn if span_fn is not None else (
@@ -143,9 +171,14 @@ class DevicePrefetcher:
                     return
             try:
                 item = next(self._src)
+                # source position AFTER drawing this batch: rides the
+                # queue so the consumer can mark it consumed (a failure
+                # here is a real loader bug — poison, same as next())
+                post_state = (copy.deepcopy(self._state_src.state_dict())
+                              if self._state_src is not None else None)
             except StopIteration:
                 with self._cond:
-                    self._q.append(_END)  # after every produced batch
+                    self._q.append((_END, None))  # after every batch
                     self._cond.notify_all()
                 return
             except BaseException as e:  # poison: consumer re-raises it
@@ -177,7 +210,7 @@ class DevicePrefetcher:
             with self._cond:
                 if self._closed:
                     return  # dropped: close() already released consumers
-                self._q.append(placed)
+                self._q.append((placed, post_state))
                 self._cond.notify_all()
 
     # -- the consumer side ----------------------------------------------
@@ -208,7 +241,7 @@ class DevicePrefetcher:
                         "batch")
                 if self._q:
                     # batches produced before an end/failure drain first
-                    item = self._q.pop(0)
+                    item, post_state = self._q.pop(0)
                     self._cond.notify_all()  # a slot freed
                     if isinstance(item, _End):
                         # the worker already exited; self-close so an
@@ -217,6 +250,10 @@ class DevicePrefetcher:
                         self._ended = True
                         self._closed = True
                         raise StopIteration
+                    if post_state is not None:
+                        # this batch is now CONSUMED: the resume point
+                        # advances past it
+                        self._consumed_state = post_state
                     self._hits += 1 if hit else 0
                     self._misses += 0 if hit else 1
                     self._wait_s += time.perf_counter() - t0
@@ -230,7 +267,28 @@ class DevicePrefetcher:
         """Batches ready for consumption right now (the queue-depth
         gauge; the epoch-end sentinel does not count)."""
         with self._cond:
-            return len([x for x in self._q if not isinstance(x, _End)])
+            return len([x for x, _ in self._q if not isinstance(x, _End)])
+
+    # -- sample-exact resume ---------------------------------------------
+    def state_dict(self) -> dict:
+        """The SOURCE loader's state at the consumption point: batches
+        already produced into the queue but not yet consumed count as
+        not-yet-drawn (a resume from this state re-produces them).
+        Raises TypeError when the source is not checkpointable — the
+        engine probes support before persisting the data-iterator
+        checkpoint plane."""
+        if self._state_src is None:
+            raise TypeError(
+                f"DevicePrefetcher({self.name}): source "
+                f"{type(self._src).__name__} has no state_dict/"
+                "load_state_dict — sample-exact resume needs a "
+                "checkpointable loader (DeepSpeedDataLoader or "
+                "RepeatingLoader over one), passed to prefetch() as the "
+                "loader object, not a raw iterator")
+        with self._cond:
+            if self._err is not None:
+                raise self._err
+            return copy.deepcopy(self._consumed_state)
 
     def stats(self) -> dict:
         with self._cond:

@@ -15,13 +15,17 @@ p50/p95/p99 step time, samples/sec, and peak HBM offline.
 from .compile_monitor import CompileMonitor
 from .exporters import (JsonlExporter, SummaryWriterBridge,
                         prometheus_text, write_prometheus)
+from .heartbeat import (HeartbeatWriter, StragglerMonitor,
+                        read_heartbeats)
 from .hub import TelemetryHub
 from .memory import MemorySampler
 from .registry import Counter, Gauge, Histogram, MetricsRegistry
 from .tracing import SpanHandle, TraceRecorder
 
 __all__ = [
-    "CompileMonitor", "Counter", "Gauge", "Histogram", "JsonlExporter",
-    "MemorySampler", "MetricsRegistry", "SpanHandle", "SummaryWriterBridge",
-    "TelemetryHub", "TraceRecorder", "prometheus_text", "write_prometheus",
+    "CompileMonitor", "Counter", "Gauge", "HeartbeatWriter", "Histogram",
+    "JsonlExporter", "MemorySampler", "MetricsRegistry", "SpanHandle",
+    "StragglerMonitor", "SummaryWriterBridge", "TelemetryHub",
+    "TraceRecorder", "prometheus_text", "read_heartbeats",
+    "write_prometheus",
 ]

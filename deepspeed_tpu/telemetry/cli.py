@@ -59,6 +59,7 @@ def summarize(path: str, out=None) -> dict:
     pf_wait: List[float] = []
     ck_save: List[float] = []
     ck_hidden: List[float] = []
+    stragglers: Optional[float] = None
     peak_hbm: Optional[float] = None
     host_rss: Optional[float] = None
     bad_lines = 0
@@ -110,6 +111,11 @@ def summarize(path: str, out=None) -> dict:
                 ch = scalars.get("ckpt_async_overlap_s")
                 if ch is not None:
                     ck_hidden.append(float(ch))
+                sg = scalars.get("straggler_detected_total")
+                if sg is not None:
+                    # cumulative counter: the last/maximum value is the
+                    # run's total detections
+                    stragglers = max(stragglers or 0.0, float(sg))
             elif kind == "memory":
                 stats = rec.get("stats") or {}
                 for dev in stats.get("devices", []):
@@ -149,6 +155,7 @@ def summarize(path: str, out=None) -> dict:
         "prefetch_wait_s": avg_pf_wait,
         "ckpt_save_s": avg_ck_save,
         "ckpt_async_overlap_s": avg_ck_hidden,
+        "straggler_detected_total": stragglers,
         "peak_hbm_bytes": peak_hbm,
         "host_rss_bytes": host_rss,
         "bad_lines": bad_lines,
@@ -180,6 +187,11 @@ def summarize(path: str, out=None) -> dict:
                    if avg_ck_hidden is not None else "")
         print(f"  checkpoint         exposed {_fmt_s(avg_ck_save)}/save"
               f"{hid_txt}", file=out)
+    if stragglers is not None:
+        # elastic fleet health: hosts flagged slower than the configured
+        # multiple of the fleet-median step time (docs/elastic.md)
+        print(f"  stragglers         {int(stragglers)} host(s) flagged "
+              "(step time > ratio x fleet median)", file=out)
     print(f"  peak HBM           {_fmt_bytes(peak_hbm)}", file=out)
     if host_rss is not None:
         print(f"  peak host RSS      {_fmt_bytes(host_rss)}", file=out)

@@ -16,6 +16,10 @@ run() {
 run r03 python bench.py
 run prefetch python bench.py --prefetch=ab
 run ckpt python bench.py --ckpt=ab
+# elastic smoke is pure-CPU subprocess supervision (never touches the
+# tunnel): kill one local worker mid-run, assert resume at reduced
+# width with trajectory continuity + sample-exactness
+run elastic python bench.py --elastic-smoke
 run bert python bench_bert.py
 run sparse python bench_sparse.py
 run flash python bench_flash.py

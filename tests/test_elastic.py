@@ -1,0 +1,747 @@
+"""Elastic training (ISSUE 6): sample-exact data-iterator resume (loader
+/ RepeatingLoader / DevicePrefetcher state, the checkpoint data plane +
+its CRC/torture coverage), the restart supervisor (bounded restarts,
+exponential backoff, typed give-up, host re-probe/world shrink,
+heartbeat liveness), straggler detection, the launcher filter
+satellites, and the end-to-end ``ds --elastic`` kill/resume +
+dp4→dp2 trajectory-equivalence runs."""
+import collections
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import jax
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfig
+from deepspeed_tpu.launcher.elastic import (ElasticGiveUpError,
+                                            ElasticSupervisor,
+                                            RestartPolicy)
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              RepeatingLoader)
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime.prefetch import DevicePrefetcher
+from deepspeed_tpu.runtime.resilience import (CheckpointCorruptError,
+                                              reset_fault_injection)
+from deepspeed_tpu.telemetry.heartbeat import (HeartbeatWriter,
+                                               StragglerMonitor,
+                                               read_heartbeats)
+
+from simple_model import SimpleModel, base_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HIDDEN = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("DS_CKPT_FAULT", raising=False)
+    monkeypatch.delenv("DS_HEARTBEAT_DIR", raising=False)
+    reset_fault_injection()
+    yield
+    reset_fault_injection()
+
+
+# ---------------------------------------------------------------------------
+# iterator state: loader / RepeatingLoader / prefetcher
+# ---------------------------------------------------------------------------
+def _indexed_dataset(n=16):
+    """Sample i is [i, noise...]: feature 0 is the identity channel."""
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((n, 4)).astype(np.float32)
+    xs[:, 0] = np.arange(n)
+    return [xs[i] for i in range(n)]
+
+
+def _mk_rep(seed=3):
+    return RepeatingLoader(DeepSpeedDataLoader(
+        _indexed_dataset(), batch_size=4, shuffle=True, seed=seed))
+
+
+def _ids(batch):
+    return [int(v) for v in np.asarray(batch)[:, 0]]
+
+
+def test_loader_state_resumes_exactly_at_any_point():
+    """Interrupt after k batches for every k across 2.5 epochs: the
+    restored loader continues with the identical remaining sequence
+    (same epoch permutation re-derived from the epoch-start RNG state,
+    consumed batches skipped, later epochs reshuffled identically)."""
+    rep = _mk_rep()
+    ref = [_ids(next(rep)) for _ in range(10)]
+    for k in range(10):
+        r1 = _mk_rep()
+        got = [_ids(next(r1)) for _ in range(k)]
+        # the plane round-trips through JSON — state must survive it
+        state = json.loads(json.dumps(r1.state_dict()))
+        r2 = _mk_rep()
+        r2.load_state_dict(state)
+        got += [_ids(next(r2)) for _ in range(10 - k)]
+        assert got == ref, f"diverged when interrupted at batch {k}"
+
+
+def test_loader_fresh_state_roundtrip():
+    """A never-iterated loader's state restores to a fresh start."""
+    l1 = DeepSpeedDataLoader(_indexed_dataset(), batch_size=4,
+                             shuffle=True, seed=7)
+    l2 = DeepSpeedDataLoader(_indexed_dataset(), batch_size=4,
+                             shuffle=True, seed=7)
+    l2.load_state_dict(l1.state_dict())
+    assert [_ids(b) for b in l2] == [
+        _ids(b) for b in DeepSpeedDataLoader(
+            _indexed_dataset(), batch_size=4, shuffle=True, seed=7)]
+
+
+def test_repeating_loader_state_requires_checkpointable_inner():
+    rep = RepeatingLoader(iter([1, 2, 3]))
+    with pytest.raises(TypeError, match="checkpointable"):
+        rep.state_dict()
+
+
+def test_prefetcher_accounts_inflight_batches_as_unconsumed():
+    """Consume 3 with depth-2 prefetch (the worker has produced ahead);
+    the captured state must resume at batch 3 — produced-but-unconsumed
+    batches re-produce, no skip."""
+    rep = _mk_rep()
+    ref = [_ids(next(rep)) for _ in range(10)]
+
+    pf = DevicePrefetcher(_mk_rep(), depth=2)
+    got = [_ids(next(pf)) for _ in range(3)]
+    deadline = time.time() + 5.0
+    while pf.qsize() == 0 and time.time() < deadline:
+        time.sleep(0.01)  # let the worker stage ahead
+    assert pf.qsize() > 0, "worker never prefetched ahead"
+    state = json.loads(json.dumps(pf.state_dict()))
+    pf.close()
+
+    l2 = _mk_rep()
+    l2.load_state_dict(state)
+    pf2 = DevicePrefetcher(l2, depth=2)
+    got += [_ids(next(pf2)) for _ in range(7)]
+    pf2.close()
+    assert got == ref
+
+
+def test_prefetcher_stateless_source_raises_typed():
+    pf = DevicePrefetcher(iter([np.zeros((2, 2))]), depth=1)
+    with pytest.raises(TypeError, match="checkpointable"):
+        pf.state_dict()
+    pf.close()
+
+
+# ---------------------------------------------------------------------------
+# the checkpoint data-iterator plane
+# ---------------------------------------------------------------------------
+def _data_engine(seed=0, **over):
+    mesh = build_mesh(devices=jax.devices()[:1])
+    cfg = DeepSpeedConfig(base_config(micro_bs=4, grad_acc=1, **over),
+                          world_size=1)
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((32, HIDDEN)).astype(np.float32)
+    ds = [(xs[i], 0.5 * xs[i]) for i in range(32)]
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=HIDDEN), cfg, mesh=mesh,
+                          seed=seed, training_data=ds)
+    eng.training_dataloader = RepeatingLoader(DeepSpeedDataLoader(
+        ds, batch_size=eng.train_batch_size, shuffle=True, seed=5))
+    return eng
+
+
+def test_checkpoint_carries_data_plane_and_resume_is_sample_exact(tmp_path):
+    """The checkpoint gains a CRC'd, digest-pinned ``data`` plane; a
+    resumed engine continues at the exact next sample — losses match an
+    uninterrupted run bitwise (prefetcher ON at depth 2 throughout)."""
+    ref = _data_engine()
+    ref_losses = [float(ref.train_batch()) for _ in range(8)]
+    ref.close()
+
+    e1 = _data_engine()
+    l1 = [float(e1.train_batch()) for _ in range(3)]
+    e1.save_checkpoint(str(tmp_path), tag="t")
+    e1.close()
+    meta = json.load(open(tmp_path / "t" / "meta.json"))
+    assert "data" in meta["manifest_digests"]
+    manifest = json.load(open(tmp_path / "t" / "data" / "manifest.json"))
+    (entry,) = manifest.values()
+    assert entry.get("crc32") is not None  # same integrity plane
+
+    e2 = _data_engine(seed=9)
+    path, _ = e2.load_checkpoint(str(tmp_path), tag="t")
+    assert path is not None
+    l2 = [float(e2.train_batch()) for _ in range(5)]
+    e2.close()
+    assert l1 + l2 == ref_losses
+
+
+def test_data_plane_crc_and_digest_tamper_detected(tmp_path):
+    eng = _data_engine()
+    _ = [float(eng.train_batch()) for _ in range(2)]
+    eng.save_checkpoint(str(tmp_path), tag="t")
+    eng.close()
+    # flip a payload byte in the data plane's leaf
+    manifest = json.load(open(tmp_path / "t" / "data" / "manifest.json"))
+    (entry,) = manifest.values()
+    fpath = tmp_path / "t" / "data" / entry["file"]
+    data = bytearray(open(fpath, "rb").read())
+    data[-2] ^= 0xFF
+    open(fpath, "wb").write(bytes(data))
+    e2 = _data_engine(seed=9)
+    with pytest.raises(CheckpointCorruptError):
+        e2.load_checkpoint(str(tmp_path), tag="t")
+    # restore the byte; tamper the manifest instead -> digest mismatch
+    data[-2] ^= 0xFF
+    open(fpath, "wb").write(bytes(data))
+    mpath = tmp_path / "t" / "data" / "manifest.json"
+    json.dump(manifest, open(mpath, "w"), indent=4)
+    with pytest.raises(CheckpointCorruptError, match="digest"):
+        e2.load_checkpoint(str(tmp_path), tag="t")
+    e2.close()
+
+
+def test_corrupt_data_plane_walks_fallback_chain_engine_intact(tmp_path):
+    """A rotten data plane is corruption like any other: tag=None walks
+    back to the previous verified tag (which restores its OWN iterator
+    state) instead of crashing or half-restoring."""
+    eng = _data_engine()
+    ref_losses = [float(eng.train_batch()) for _ in range(2)]
+    eng.save_checkpoint(str(tmp_path), tag="t1")
+    ref_losses += [float(eng.train_batch())]
+    eng.save_checkpoint(str(tmp_path), tag="t2")  # latest -> t2
+    ref_losses += [float(eng.train_batch()) for _ in range(2)]
+    eng.close()
+    manifest = json.load(open(tmp_path / "t2" / "data" / "manifest.json"))
+    (entry,) = manifest.values()
+    fpath = tmp_path / "t2" / "data" / entry["file"]
+    data = bytearray(open(fpath, "rb").read())
+    data[-2] ^= 0xFF
+    open(fpath, "wb").write(bytes(data))
+
+    e2 = _data_engine(seed=9)
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path.endswith("t1")
+    # resumed from t1's state: replays exactly from step 2's sample
+    got = [float(e2.train_batch()) for _ in range(3)]
+    e2.close()
+    assert got == ref_losses[2:]
+
+
+@pytest.mark.parametrize("point", ["leaf:1+", "manifest:3+", "meta:1+",
+                                   "rename:1+"])
+def test_data_plane_survives_torture_matrix(point, tmp_path):
+    """Kill-during-save at the write points (manifest:3 is the DATA
+    plane's manifest — model and optim wrote theirs first): the resumed
+    run restores the last GOOD tag's iterator state and continues with
+    the reference sample sequence — never a torn or half-new one."""
+    over = {"checkpoint": {"io_retry_attempts": 2,
+                           "io_retry_base_s": 0.001}}
+    ref = _data_engine(**over)
+    ref_losses = [float(ref.train_batch()) for _ in range(6)]
+    ref.close()
+
+    eng = _data_engine(**over)
+    l1 = [float(eng.train_batch()) for _ in range(2)]
+    eng.save_checkpoint(str(tmp_path), tag="good")
+    _ = [float(eng.train_batch())]
+    os.environ["DS_CKPT_FAULT"] = point
+    try:
+        with pytest.raises(Exception):
+            eng.save_checkpoint(str(tmp_path), tag="doomed")
+    finally:
+        os.environ.pop("DS_CKPT_FAULT", None)
+    reset_fault_injection()
+    eng.close()
+
+    e2 = _data_engine(seed=9, **over)
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path.endswith("good")
+    got = l1 + [float(e2.train_batch()) for _ in range(4)]
+    e2.close()
+    assert got == ref_losses
+
+
+def test_engine_without_checkpointable_loader_saves_no_data_plane(tmp_path):
+    """Batch-fed engines (no training_data) keep the two-plane layout —
+    nothing to resume, nothing saved, and their checkpoints load with
+    no data-plane warning noise."""
+    from simple_model import random_batches
+    mesh = build_mesh(devices=jax.devices()[:1])
+    cfg = DeepSpeedConfig(base_config(micro_bs=2, grad_acc=1),
+                          world_size=1)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=HIDDEN), cfg, mesh=mesh)
+    for b in random_batches(eng.train_batch_size, HIDDEN, num_batches=1):
+        eng.train_batch(b)
+    eng.save_checkpoint(str(tmp_path), tag="t")
+    eng.close()
+    meta = json.load(open(tmp_path / "t" / "meta.json"))
+    assert "data" not in meta["manifest_digests"]
+    assert not (tmp_path / "t" / "data").exists()
+
+
+# ---------------------------------------------------------------------------
+# straggler monitor + heartbeat policy
+# ---------------------------------------------------------------------------
+def test_straggler_monitor_flags_ratio_over_median():
+    m = StragglerMonitor(ratio=2.0, stale_after_s=30.0)
+    now = time.time()
+    fleet = {f"h{i}/0": {"time": now, "step_s": 1.0} for i in range(4)}
+    fleet["slow/0"] = {"time": now, "step_s": 2.5}
+    rep = m.update(fleet, now=now)
+    assert rep["stragglers"] == ["slow/0"]
+    assert rep["median_step_s"] == 1.0
+    assert m.flagged_total == 1
+    # still slow next interval: the episode is counted ONCE
+    m.update(fleet, now=now)
+    assert m.flagged_total == 1
+    # recovers, then relapses: a new episode counts again
+    fleet["slow/0"]["step_s"] = 1.0
+    m.update(fleet, now=now)
+    fleet["slow/0"]["step_s"] = 9.0
+    m.update(fleet, now=now)
+    assert m.flagged_total == 2
+
+
+def test_straggler_monitor_stale_and_small_fleet():
+    m = StragglerMonitor(ratio=2.0, stale_after_s=10.0, min_fleet=2)
+    now = time.time()
+    rep = m.update({"h/0": {"time": now - 60, "step_s": 50.0}}, now=now)
+    assert rep["stale"] == ["h/0"]
+    assert rep["stragglers"] == []  # a median of one is noise
+    with pytest.raises(ValueError, match="> 1.0"):
+        StragglerMonitor(ratio=1.0)
+
+
+def test_heartbeat_writer_and_reader_roundtrip(tmp_path):
+    w = HeartbeatWriter(str(tmp_path), process_index=2, host="hostA")
+    assert w.beat(5)
+    time.sleep(0.01)
+    assert w.beat(6)
+    beats = read_heartbeats(str(tmp_path))
+    assert list(beats) == ["hostA/2"]
+    rec = beats["hostA/2"]
+    assert rec["step"] == 6 and rec["step_s"] > 0
+
+
+def test_engine_emits_heartbeats_via_env(tmp_path, monkeypatch):
+    """DS_HEARTBEAT_DIR (the supervisor's export) turns on per-step
+    beats with no config — the liveness channel the supervisor reads."""
+    from simple_model import random_batches
+    monkeypatch.setenv("DS_HEARTBEAT_DIR", str(tmp_path))
+    mesh = build_mesh(devices=jax.devices()[:1])
+    cfg = DeepSpeedConfig(base_config(micro_bs=2, grad_acc=1),
+                          world_size=1)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=HIDDEN), cfg, mesh=mesh)
+    for b in random_batches(eng.train_batch_size, HIDDEN, num_batches=3):
+        eng.train_batch(b)
+    eng.close()
+    beats = read_heartbeats(str(tmp_path))
+    assert len(beats) == 1
+    (rec,) = beats.values()
+    assert rec["step"] == 3
+
+
+def test_straggler_counter_flows_to_summarize(tmp_path, monkeypatch):
+    """A straggling host planted in the heartbeat dir surfaces as
+    straggler_detected_total at the periodic sync and as the summarize
+    stragglers row."""
+    from deepspeed_tpu.telemetry.cli import summarize
+    from simple_model import random_batches
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    monkeypatch.setenv("DS_HEARTBEAT_DIR", str(hb))
+    over = {"steps_per_print": 2,
+            "telemetry": {"enabled": True,
+                          "output_path": str(tmp_path / "tel"),
+                          "compile_events": False, "memory": False}}
+    mesh = build_mesh(devices=jax.devices()[:1])
+    cfg = DeepSpeedConfig(base_config(micro_bs=2, grad_acc=1, **over),
+                          world_size=1)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=HIDDEN), cfg, mesh=mesh)
+    batches = list(random_batches(eng.train_batch_size, HIDDEN,
+                                  num_batches=4))
+    eng.train_batch(batches[0])
+    # plant a healthy twin and a limper (a 2-host fleet can never exceed
+    # 2x its own median — the median IS the midpoint of the pair)
+    json.dump({"host": "healthy", "process_index": 2, "step": 1,
+               "time": time.time(), "step_s": 0.001},
+              open(hb / "heartbeat_2.json", "w"))
+    json.dump({"host": "limper", "process_index": 1, "step": 1,
+               "time": time.time(), "step_s": 99.0},
+              open(hb / "heartbeat_1.json", "w"))
+    for b in batches[1:]:
+        eng.train_batch(b)
+    assert eng.telemetry.registry.counter(
+        "straggler_detected_total", "").value() >= 1
+    eng.close()
+    report = summarize(str(tmp_path / "tel" / "events.jsonl"),
+                       out=open(os.devnull, "w"))
+    assert report["straggler_detected_total"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# supervisor semantics (unit tier: stub workers)
+# ---------------------------------------------------------------------------
+def _proc(code="import sys; sys.exit(0)"):
+    return subprocess.Popen([sys.executable, "-c", code])
+
+
+def test_supervisor_restarts_and_shrinks_world():
+    calls = []
+
+    def launch(active, attempt):
+        calls.append((dict(active), attempt))
+        rc = 3 if attempt == 0 else 0
+        return [("a", _proc(f"import sys; sys.exit({3 if attempt == 0 else 0})"))]
+
+    slept = []
+    sup = ElasticSupervisor(
+        collections.OrderedDict([("a", [0, 1]), ("b", [0, 1])]),
+        launch, probe_fn=lambda h: None if h == "b" else True,
+        policy=RestartPolicy(max_restarts=2, backoff_base_s=0.5),
+        sleep_fn=slept.append)
+    assert sup.run() == 0
+    assert calls[0][0] == {"a": [0, 1], "b": [0, 1]}
+    assert calls[1][0] == {"a": [0, 1]}  # b dropped after its probe
+    assert calls[1][1] == 1              # DS_ELASTIC_RESTART advances
+    assert slept == [0.5]
+
+
+def test_supervisor_gives_up_typed_after_budget():
+    slept = []
+    sup = ElasticSupervisor(
+        {"a": [0]}, lambda active, attempt: [("a", _proc(
+            "import sys; sys.exit(1)"))],
+        policy=RestartPolicy(max_restarts=3, backoff_base_s=1.0,
+                             backoff_max_s=3.0),
+        sleep_fn=slept.append)
+    with pytest.raises(ElasticGiveUpError) as ei:
+        sup.run()
+    assert ei.value.restarts == 3
+    assert "rc=1" in ei.value.last_failure
+    assert slept == [1.0, 2.0, 3.0]  # exponential, capped at backoff_max
+
+
+def test_supervisor_gives_up_below_min_slots():
+    sup = ElasticSupervisor(
+        {"a": [0], "b": [0]},
+        lambda active, attempt: [("a", _proc("import sys; sys.exit(1)"))],
+        probe_fn=lambda h: None,  # everything dies
+        policy=RestartPolicy(max_restarts=5, min_slots=1,
+                             backoff_base_s=0.0),
+        sleep_fn=lambda s: None)
+    with pytest.raises(ElasticGiveUpError, match="min_slots"):
+        sup.run()
+
+
+def test_supervisor_probe_resize_changes_slots():
+    worlds = []
+
+    def launch(active, attempt):
+        worlds.append({h: len(s) for h, s in active.items()})
+        return [("a", _proc(f"import sys; sys.exit({1 if attempt == 0 else 0})"))]
+
+    sup = ElasticSupervisor(
+        {"a": [0, 1, 2, 3]}, launch,
+        probe_fn=lambda h: [0, 1],  # host survives with half its chips
+        policy=RestartPolicy(max_restarts=1, backoff_base_s=0.0),
+        sleep_fn=lambda s: None)
+    assert sup.run() == 0
+    assert worlds == [{"a": 4}, {"a": 2}]
+
+
+def test_supervisor_missed_heartbeats_kill_and_restart(tmp_path):
+    """A worker that beats once then hangs: the supervisor declares the
+    host hung after heartbeat_timeout, kills the attempt, and
+    relaunches — a wedged collective must not stall the job forever."""
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    attempts = []
+
+    def launch(active, attempt):
+        attempts.append(attempt)
+        if attempt == 0:
+            code = (
+                "import json, time\n"
+                f"rec = dict(host='h', process_index=0, step=1, "
+                "time=time.time(), step_s=0.1)\n"
+                f"json.dump(rec, open(r'{hb}/heartbeat_0.json', 'w'))\n"
+                "time.sleep(120)\n")
+        else:
+            code = "pass"
+        return [("h", _proc(code))]
+
+    sup = ElasticSupervisor(
+        {"h": [0]}, launch,
+        policy=RestartPolicy(max_restarts=1, backoff_base_s=0.0),
+        heartbeat_dir=str(hb), heartbeat_timeout_s=0.5,
+        poll_interval_s=0.05, term_grace_s=2.0, sleep_fn=lambda s: None)
+    t0 = time.time()
+    assert sup.run() == 0
+    assert attempts == [0, 1]
+    assert time.time() - t0 < 60  # killed on staleness, not sleep(120)
+    # attempt 0's beat file was swept before attempt 1 launched
+    assert read_heartbeats(str(hb)) == {}
+
+
+# ---------------------------------------------------------------------------
+# launcher filter satellites
+# ---------------------------------------------------------------------------
+def test_filters_unknown_host_and_slot_raise_descriptive():
+    from deepspeed_tpu.launcher.runner import parse_resource_filter
+    pool = {"nodeA": [0, 1], "nodeB": [0, 1]}
+    with pytest.raises(ValueError, match="'ghost'.*hosts: nodeA, nodeB"):
+        parse_resource_filter(pool, include_str="ghost")
+    with pytest.raises(ValueError, match="--exclude.*'ghost'"):
+        parse_resource_filter(pool, exclude_str="ghost")
+    with pytest.raises(ValueError, match="'ghost'"):
+        parse_resource_filter(pool, include_str="ghost:0")
+    with pytest.raises(ValueError, match="slot 7 on host 'nodeA'"):
+        parse_resource_filter(pool, include_str="nodeA:7")
+
+
+def test_filters_malformed_node_spec_raises_descriptive():
+    from deepspeed_tpu.launcher.runner import parse_resource_filter
+    pool = {"nodeA": [0, 1]}
+    with pytest.raises(ValueError, match="empty NODE_SPEC"):
+        parse_resource_filter(pool, include_str="nodeA@")
+    with pytest.raises(ValueError, match="one colon"):
+        parse_resource_filter(pool, include_str="nodeA:0:1")
+    with pytest.raises(ValueError, match="comma-separated integers"):
+        parse_resource_filter(pool, include_str="nodeA:x")
+    # well-formed filters still work, order preserved
+    out = parse_resource_filter({"a": [0, 1], "b": [0, 1]},
+                                exclude_str="b:1")
+    assert out == {"a": [0, 1], "b": [0]}
+
+
+def test_filters_without_hostfile_raise_instead_of_silently_ignoring(
+        tmp_path):
+    """--include/--exclude with a missing hostfile used to be silently
+    dropped (the single-host exec path ignored them); now it is a
+    descriptive error naming the hostfile path."""
+    from deepspeed_tpu.launcher.runner import main
+    with pytest.raises(ValueError, match="no hostfile exists"):
+        main(["--hostfile", str(tmp_path / "nope"), "--include",
+              "ghost", "train.py"])
+    with pytest.raises(ValueError, match="no hostfile exists"):
+        main(["--hostfile", str(tmp_path / "nope"), "--exclude",
+              "ghost", "train.py"])
+
+
+def test_elastic_rejects_mpi_launchers(tmp_path):
+    from deepspeed_tpu.launcher.runner import main
+    hf = tmp_path / "hostfile"
+    hf.write_text("localhost slots=1\n")
+    with pytest.raises(ValueError, match="mpirun owns"):
+        main(["--hostfile", str(hf), "--launcher", "openmpi",
+              "--elastic", "train.py"])
+
+
+# ---------------------------------------------------------------------------
+# end to end: ds --elastic on localhost (the tier-1 kill/resume bar)
+# ---------------------------------------------------------------------------
+def _worker_env(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (REPO + os.pathsep
+                         + os.path.join(REPO, "tests") + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DS_CKPT_FSYNC"] = "0"
+    for k in ("DS_ELASTIC_RESTART", "DS_ELASTIC_WORLD_SLOTS",
+              "DS_HEARTBEAT_DIR"):
+        env.pop(k, None)
+    return env
+
+
+def _worker_direct(tmp_path, out, ckpt, steps, crash_at, slots, env):
+    """One un-supervised worker run (the uninterrupted reference legs)."""
+    e = dict(env)
+    e["DS_ELASTIC_WORLD_SLOTS"] = str(slots)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "elastic_worker.py"),
+         str(out), str(ckpt), str(steps), str(crash_at)],
+        env=e, timeout=240, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def _lines(path):
+    return [json.loads(l) for l in open(path)]
+
+
+def test_ds_elastic_kill_resume_sample_exact(tmp_path):
+    """The CPU e2e bar: ``ds --elastic`` launches the worker, the worker
+    hard-kills itself mid-run (prefetcher ON, in-flight batches
+    abandoned), the supervisor relaunches, and the stitched run is
+    sample-exact AND loss-bitwise-identical to an uninterrupted one."""
+    env = _worker_env(tmp_path)
+    hf = tmp_path / "hostfile"
+    hf.write_text("localhost slots=4\n")
+    out, ckpt = tmp_path / "out", tmp_path / "ckpt"
+    out.mkdir(), ckpt.mkdir()
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds"),
+         "--hostfile", str(hf), "--launcher", "local", "--elastic",
+         "--max-restarts", "2", "--backoff-base", "0.1",
+         os.path.join(REPO, "tests", "elastic_worker.py"),
+         str(out), str(ckpt), "6", "3"],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+
+    ref_out, ref_ckpt = tmp_path / "ref", tmp_path / "refck"
+    ref_out.mkdir(), ref_ckpt.mkdir()
+    _worker_direct(tmp_path, ref_out, ref_ckpt, 6, 0, 4, env)
+
+    # trajectory continuity: the resumed run picks up at step 3 and the
+    # stitched loss curve is bitwise the uninterrupted one
+    t = _lines(out / "traj_r0.jsonl") + _lines(out / "traj_r1.jsonl")
+    ref_t = _lines(ref_out / "traj_r0.jsonl")
+    assert [r["step"] for r in t] == list(range(6))
+    assert [r["loss"] for r in t] == [r["loss"] for r in ref_t]
+
+    # sample-exactness: 3 consumed before the kill (prefetched extras in
+    # the production log are re-produced after resume, never skipped)
+    s = (_lines(out / "samples_r0.jsonl")[:3]
+         + _lines(out / "samples_r1.jsonl"))
+    ref_s = _lines(ref_out / "samples_r0.jsonl")
+    assert s[:6] == ref_s[:6]
+
+
+def test_ds_elastic_resize_matches_dp2_from_start(tmp_path):
+    """ROADMAP item 2's trajectory-equivalence bar: dp4 run → kill →
+    the probe reports the host shrunk to 2 slots → ``ds --elastic``
+    resumes at dp2, and the resumed curve matches a dp2-from-start run
+    given the same sample order (fp32; only psum reduction-order noise
+    differs)."""
+    env = _worker_env(tmp_path)
+    hf = tmp_path / "hostfile"
+    hf.write_text("localhost slots=4\n")
+    probe = tmp_path / "probe.sh"
+    probe.write_text("#!/bin/sh\necho slots=2\n")
+    probe.chmod(0o755)
+    out, ckpt = tmp_path / "out", tmp_path / "ckpt"
+    out.mkdir(), ckpt.mkdir()
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds"),
+         "--hostfile", str(hf), "--launcher", "local", "--elastic",
+         "--max-restarts", "2", "--backoff-base", "0.1",
+         "--probe-cmd", f"{probe} {{host}}",
+         os.path.join(REPO, "tests", "elastic_worker.py"),
+         str(out), str(ckpt), "6", "3"],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+
+    dp2_out, dp2_ckpt = tmp_path / "dp2", tmp_path / "dp2ck"
+    dp2_out.mkdir(), dp2_ckpt.mkdir()
+    _worker_direct(tmp_path, dp2_out, dp2_ckpt, 6, 0, 2, env)
+
+    t1 = _lines(out / "traj_r1.jsonl")
+    assert [r["dp"] for r in t1] == [2, 2, 2]  # resumed at reduced width
+    ref = _lines(dp2_out / "traj_r0.jsonl")
+    np.testing.assert_allclose(
+        [r["loss"] for r in t1], [r["loss"] for r in ref[3:]],
+        rtol=1e-5)
+    # identical sample order across the resize
+    s = (_lines(out / "samples_r0.jsonl")[:3]
+         + _lines(out / "samples_r1.jsonl"))
+    assert s[:6] == _lines(dp2_out / "samples_r0.jsonl")[:6]
+
+
+# ---------------------------------------------------------------------------
+# review-round regressions
+# ---------------------------------------------------------------------------
+def test_prefetcher_over_uncheckpointable_repeating_loader_still_runs():
+    """A RepeatingLoader over a raw iterable quacks the state protocol
+    but can't honor it: the prefetcher must construct and serve batches
+    (the pre-ISSUE-6 behavior), with only state_dict() raising typed."""
+    batches = [np.full((2, 2), float(i)) for i in range(3)]
+    pf = DevicePrefetcher(RepeatingLoader(batches), depth=2)
+    got = [float(np.asarray(next(pf))[0, 0]) for _ in range(5)]
+    assert got == [0.0, 1.0, 2.0, 0.0, 1.0]
+    with pytest.raises(TypeError, match="checkpointable"):
+        pf.state_dict()
+    pf.close()
+
+
+def test_save_skips_data_plane_for_uncheckpointable_loader(tmp_path):
+    """Engine whose training_dataloader is a RepeatingLoader over a raw
+    iterable (prefetch OFF, so the non-prefetch state probe runs):
+    save_checkpoint must omit the data plane, not crash."""
+    mesh = build_mesh(devices=jax.devices()[:1])
+    cfg = DeepSpeedConfig(base_config(
+        micro_bs=4, grad_acc=1,
+        **{"data_prefetch": {"enabled": False}}), world_size=1)
+    eng = DeepSpeedEngine(SimpleModel(hidden_dim=HIDDEN), cfg, mesh=mesh)
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((8, HIDDEN)).astype(np.float32)
+    eng.training_dataloader = RepeatingLoader(
+        [(xs[:4], 0.5 * xs[:4]), (xs[4:], 0.5 * xs[4:])])
+    float(eng.train_batch())
+    eng.save_checkpoint(str(tmp_path), tag="t")
+    eng.close()
+    meta = json.load(open(tmp_path / "t" / "meta.json"))
+    assert "data" not in meta["manifest_digests"]
+    assert not (tmp_path / "t" / "data").exists()
+
+
+def test_straggler_monitor_excludes_stale_hosts_from_median():
+    """A dead host's frozen last step_s must not skew the fleet median
+    or sit in the straggler set forever."""
+    m = StragglerMonitor(ratio=2.0, stale_after_s=10.0, min_fleet=2)
+    now = time.time()
+    fleet = {f"h{i}/0": {"time": now, "step_s": 1.0} for i in range(3)}
+    fleet["dead/0"] = {"time": now - 60, "step_s": 99.0}
+    rep = m.update(fleet, now=now)
+    assert rep["stale"] == ["dead/0"]
+    assert rep["stragglers"] == []          # dead, not slow
+    assert rep["median_step_s"] == 1.0      # median of the LIVE fleet
+
+
+def test_supervisor_exit_skew_stale_beats_do_not_kill(tmp_path):
+    """Shutdown skew: one worker exits 0 and stops beating while rank 0
+    finishes its final checkpoint — the finished worker's stale beat
+    must NOT be read as a hang (no kill, no burned restart)."""
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    attempts = []
+
+    def launch(active, attempt):
+        attempts.append(attempt)
+        # "a" beats once, exits clean almost immediately, and its beat
+        # then goes stale (past the 0.3s timeout) while "b" keeps
+        # working until 1.2s — the stale-after-clean-exit window
+        json.dump({"host": "a", "process_index": 0, "step": 5,
+                   "time": time.time(), "step_s": 0.1},
+                  open(hb / "heartbeat_0.json", "w"))
+        return [("a", _proc("pass")),
+                ("b", _proc("import time; time.sleep(1.2)"))]
+
+    sup = ElasticSupervisor(
+        collections.OrderedDict([("a", [0]), ("b", [0])]), launch,
+        policy=RestartPolicy(max_restarts=2, backoff_base_s=0.0),
+        heartbeat_dir=str(hb), heartbeat_timeout_s=0.3,
+        poll_interval_s=0.05, sleep_fn=lambda s: None)
+    assert sup.run() == 0
+    assert attempts == [0]  # completed on the first attempt
+
+
+def test_supervisor_remote_kill_fn_called_for_live_hosts(tmp_path):
+    """ssh-transport remnant cleanup: _kill must invoke remote_kill_fn
+    for hosts whose handle was still live (the local ssh client does
+    not forward SIGTERM to the remote worker)."""
+    cleaned = []
+
+    def launch(active, attempt):
+        if attempt == 0:
+            return [("a", _proc("import sys; sys.exit(1)")),
+                    ("b", _proc("import time; time.sleep(60)"))]
+        return [("a", _proc("pass")), ("b", _proc("pass"))]
+
+    sup = ElasticSupervisor(
+        collections.OrderedDict([("a", [0]), ("b", [0])]), launch,
+        policy=RestartPolicy(max_restarts=1, backoff_base_s=0.0),
+        poll_interval_s=0.05, term_grace_s=2.0,
+        sleep_fn=lambda s: None, remote_kill_fn=cleaned.append)
+    assert sup.run() == 0
+    assert cleaned == ["b"]  # only the live remnant, not the dead "a"

@@ -897,3 +897,114 @@ def test_sync_save_surfaces_drained_async_failure(tmp_path):
     eng.save_checkpoint(str(tmp_path), tag="ok", async_write=False)
     assert isinstance(eng.last_ckpt_error, OSError)
     assert (tmp_path / "latest").read_text().strip() == "ok"
+
+
+# ---------------------------------------------------------------------------
+# elastic-supervisor interplay (ISSUE 6 satellites)
+# ---------------------------------------------------------------------------
+def test_sigterm_during_elastic_restart_window_no_double_save(tmp_path):
+    """The elastic supervisor's kill discipline is SIGTERM (the
+    preemption save fires) then an escalated second SIGTERM when the
+    worker is slow to die.  The escalation landing in the restart
+    window must chain to the previous handler cleanly — exactly ONE
+    save on disk, no second save mutating the just-written tag, no
+    torn handler chain."""
+    chained = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+    try:
+        eng = _engine(seed=0)
+        handler = resilience.install_preemption_handler(
+            eng, str(tmp_path), exit_after=False)
+        _train(eng, steps=2)
+        os.kill(os.getpid(), signal.SIGTERM)   # supervisor's TERM
+        assert handler.fired
+        assert chained == [signal.SIGTERM]     # saved, THEN chained prev
+        latest = (tmp_path / "latest").read_text().strip()
+        saved = sorted(os.listdir(tmp_path))
+        meta = tmp_path / latest / "meta.json"
+        mtime = os.stat(meta).st_mtime_ns
+        os.kill(os.getpid(), signal.SIGTERM)   # escalation in the window
+        assert chained == [signal.SIGTERM] * 2  # chained, never swallowed
+        assert sorted(os.listdir(tmp_path)) == saved  # no new tag/tmp
+        assert os.stat(meta).st_mtime_ns == mtime     # no re-save either
+        handler.uninstall()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_sigterm_escalation_mid_step_defers_one_save(tmp_path):
+    """Both the supervisor's TERM and its escalation landing while
+    train_batch is mid-update (``_in_step``): the handler parks twice,
+    saves NOTHING mid-step (a torn half-applied state would have valid
+    CRCs), and the step boundary completes exactly one save."""
+    eng = _engine(seed=0)
+    handler = resilience.install_preemption_handler(
+        eng, str(tmp_path), exit_after=False)
+    _train(eng, steps=1)
+    eng._in_step = True
+    handler._handle(signal.SIGTERM, None)
+    handler._handle(signal.SIGTERM, None)  # escalation, still mid-step
+    assert not handler.fired
+    assert not (tmp_path / "latest").exists()  # nothing saved mid-step
+    eng._in_step = False
+    _train(eng, steps=1, seed=3)  # finally-block completes ONE save
+    assert handler.fired
+    eng2 = _engine(seed=9)
+    path, _ = eng2.load_checkpoint(str(tmp_path))
+    assert path is not None and eng2.global_steps == 2
+    handler.uninstall()
+
+
+def test_legacy_checkpoint_without_data_plane_loads_fresh_iter(tmp_path):
+    """Checkpoints from before the data-iterator plane existed (ISSUE 6)
+    still load: model/optimizer restore exactly, the iterator starts
+    FRESH with one loud warning — pinned alongside the no-CRC legacy
+    test above (format evolution must not orphan old runs)."""
+    import logging
+
+    from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                                  RepeatingLoader)
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    def mk(seed):
+        eng = _engine(seed=seed)
+        xs = np.random.default_rng(0).standard_normal(
+            (32, HIDDEN)).astype(np.float32)
+        eng.training_dataloader = RepeatingLoader(DeepSpeedDataLoader(
+            [(xs[i], 0.5 * xs[i]) for i in range(32)],
+            batch_size=eng.train_batch_size, shuffle=True, seed=5))
+        return eng
+
+    eng = mk(0)
+    losses = [float(eng.train_batch()) for _ in range(2)]
+    eng.save_checkpoint(str(tmp_path), tag="t")
+    eng.close()
+    # strip the data plane + its digest: the pre-ISSUE-6 on-disk layout
+    import shutil
+    shutil.rmtree(tmp_path / "t" / "data")
+    meta = json.load(open(tmp_path / "t" / "meta.json"))
+    del meta["manifest_digests"]["data"]
+    json.dump(meta, open(tmp_path / "t" / "meta.json", "w"))
+
+    eng2 = mk(9)
+    records = []
+
+    class Rec(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    h = Rec(level=logging.WARNING)
+    ds_logger.addHandler(h)
+    try:
+        path, _ = eng2.load_checkpoint(str(tmp_path), tag="t")
+    finally:
+        ds_logger.removeHandler(h)
+    assert path is not None and eng2.global_steps == 2
+    assert any("predates the data-iterator plane" in r.getMessage()
+               for r in records)
+    _state_equal(eng.state.master_params, eng2.state.master_params)
+    # fresh iterator: draws epoch 0's first batch (a replay, loudly
+    # warned about — NOT a crash)
+    float(eng2.train_batch())
+    assert losses  # reference leg really trained
+    eng2.close()

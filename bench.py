@@ -627,6 +627,140 @@ def _ckpt_ab(jax, mode: str):
     print(json.dumps(rec), flush=True)
 
 
+def bench_stage_chaos_leg(jax, chaos: bool, steps: int = 6):
+    """One leg of ``--stage-chaos`` (docs/stages.md).  A tiny host-
+    offload GPT-2 engine with every async plane active — input
+    prefetch, the streamed offload update pipeline, an async save
+    submitted every step.  ``chaos=True`` arms a STICKY injected fault
+    at every stage boundary (``DS_STAGE_FAULT``), so each stage
+    exhausts its failure budget and degrades to its inline/serial
+    equivalent mid-run; ``chaos=False`` is the serial/inline/sync
+    reference the degraded run must match bitwise."""
+    import shutil
+    import tempfile
+
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.config import DeepSpeedConfig
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+    from deepspeed_tpu.runtime.stages import reset_fault_injection
+
+    d_model, n_layer, micro, seq, vocab = 64, 2, 2, 64, 256
+    cfg_model = GPT2Config(d_model=d_model, n_layer=n_layer, n_head=2,
+                           vocab_size=vocab, n_positions=seq, remat=None)
+    mesh = build_mesh(devices=jax.devices()[:1])
+    ds_cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "offload_impl": "host",
+                              # the reference leg IS the serial update
+                              "offload_pipeline": chaos},
+        "data_prefetch": {"enabled": chaos},
+    }, world_size=1)
+    leg = "chaos" if chaos else "reference"
+    reset_fault_injection()
+    # pop the FULL chaos env set: a stray DS_CKPT_FAULT / delay knob in
+    # the operator's shell must not leak into either leg of the proof
+    saved_env = {k: os.environ.pop(k, None)
+                 for k in ("DS_STAGE_FAULT", "DS_STAGE_DELAY_S",
+                           "DS_CKPT_FAULT", "DS_CKPT_DELAY_S",
+                           "DS_PREFETCH_DELAY_S",
+                           "DS_OFFLOAD_H2D_DELAY_S", "DS_PREFETCH",
+                           "DS_OFFLOAD_PIPELINE")}
+    if chaos:
+        # sticky: every hit of every async boundary fails until the
+        # stage's budget (default 3) is exhausted and it degrades
+        os.environ["DS_STAGE_FAULT"] = ("prefetch:place:1+,"
+                                        "offload_h2d:put:1+,"
+                                        "ckpt_writer:job:1+")
+    save_dir = tempfile.mkdtemp(prefix="bench_stage_chaos_")
+    try:
+        rng = np.random.default_rng(0)
+        dataset = [rng.integers(0, vocab, (seq + 1,), dtype=np.int32)
+                   for _ in range(micro * 4)]
+        _mark(f"stage-chaos[{leg}]: constructing engine")
+        engine = DeepSpeedEngine(GPT2Model(cfg_model), ds_cfg, mesh=mesh,
+                                 training_data=dataset)
+        try:
+            engine.training_dataloader = RepeatingLoader(
+                engine.training_dataloader)
+            losses, failed_saves = [], 0
+            t0 = time.perf_counter()
+            for i in range(steps):
+                losses.append(float(np.asarray(engine.train_batch())))
+                # chaos leg: async until the writer degrades to sync
+                engine.save_checkpoint(save_dir, tag=f"s{i}",
+                                       async_write=chaos)
+                err = engine._ckpt_writer.drain()
+                if err is not None:
+                    failed_saves += 1
+            wall = time.perf_counter() - t0
+            degraded = sorted(n for n, st in engine._stage_records.items()
+                              if st.degraded)
+            # the post-degradation save must have LANDED (sync fallback)
+            final_saved = os.path.isdir(
+                os.path.join(save_dir, f"s{steps - 1}"))
+        finally:
+            # an exception mid-leg must not leave the degraded engine's
+            # daemon workers alive into the next leg (GC-finalizer luck)
+            engine.close()
+        out = {"leg": leg, "losses": losses,
+               "steps_per_s": round(steps / wall, 4),
+               "degraded_stages": degraded,
+               "failed_async_saves": failed_saves,
+               "final_save_landed": bool(final_saved)}
+        _mark(f"stage-chaos[{leg}]: {steps / wall:.2f} steps/s, "
+              f"degraded={degraded}, failed saves={failed_saves}")
+        return out
+    finally:
+        shutil.rmtree(save_dir, ignore_errors=True)
+        os.environ.pop("DS_STAGE_FAULT", None)
+        for k, v in saved_env.items():
+            if v is not None:
+                os.environ[k] = v
+        reset_fault_injection()
+
+
+def _stage_chaos(jax):
+    """``--stage-chaos``: the graceful-degradation CI proof — repeated
+    sticky faults on every async stage; training must complete DEGRADED
+    (all three stages fell back, the post-degradation save landed) with
+    throughput > 0 and the final loss bitwise-equal to the serial/
+    inline/sync reference leg."""
+    from deepspeed_tpu.runtime.stages import DEFAULT_MAX_STAGE_FAILURES
+    chaos = bench_stage_chaos_leg(jax, chaos=True)
+    ref = bench_stage_chaos_leg(jax, chaos=False)
+    ok = (chaos["degraded_stages"] == ["ckpt_writer", "offload_h2d",
+                                       "prefetch"]
+          # the writer fails one save per budget unit before degrading
+          and chaos["failed_async_saves"] == DEFAULT_MAX_STAGE_FAILURES
+          and chaos["final_save_landed"]
+          and chaos["steps_per_s"] > 0
+          and chaos["losses"] == ref["losses"])
+    rec = {"metric": "stage_chaos_degraded_run",
+           "unit": "bool",
+           "value": int(ok),
+           "steps_per_s_degraded": chaos["steps_per_s"],
+           "degraded_stages": chaos["degraded_stages"],
+           "failed_async_saves": chaos["failed_async_saves"],
+           "final_save_landed": chaos["final_save_landed"],
+           "loss_bitwise_equal_serial": chaos["losses"] == ref["losses"],
+           "final_loss": chaos["losses"][-1]}
+    try:
+        with open("BENCH_stage_chaos.json", "w") as f:
+            json.dump(rec, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(rec), flush=True)
+    if not ok:
+        raise RuntimeError(f"stage chaos smoke FAILED: {rec}")
+
+
 def _elastic_smoke():
     """``--elastic-smoke``: the elastic-training kill/resume proof as a
     bench leg (docs/elastic.md).  Launches ``ds --elastic`` supervising
@@ -836,6 +970,15 @@ def main():
                              "async saves (exposed-stall comparison + "
                              "tracer-proven hidden write time) instead "
                              "of the north-star bench")
+    parser.add_argument("--stage-chaos", action="store_true",
+                        dest="stage_chaos",
+                        help="graceful-degradation smoke: sticky "
+                             "injected faults at every async stage "
+                             "boundary (prefetch/offload-upload/async "
+                             "save); asserts training completes "
+                             "degraded, throughput > 0, final loss "
+                             "bitwise-equal to the serial reference "
+                             "(docs/stages.md)")
     parser.add_argument("--elastic-smoke", action="store_true",
                         dest="elastic_smoke",
                         help="kill/resume supervisor smoke: ds --elastic "
@@ -869,6 +1012,10 @@ def main():
 
     if args.ckpt is not None:
         _ckpt_ab(jax, args.ckpt)
+        return
+
+    if args.stage_chaos:
+        _stage_chaos(jax)
         return
 
     if not on_tpu:  # CPU smoke (driver runs the real thing on TPU)

@@ -362,6 +362,25 @@ class DeepSpeedCheckpointConfig:
                     f"checkpoint.{name} must be a bool, got {v!r}")
 
 
+class DeepSpeedStagesConfig:
+    """Shared async-stage runtime block (docs/stages.md): the
+    per-stage consecutive-failure budget before graceful degradation.
+    Validates eagerly — a typo'd budget must fail at config parse, not
+    at the first transient fault mid-run."""
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        sg = param_dict.get(C.STAGES) or {}
+        self.max_stage_failures = get_scalar_param(
+            sg, C.STAGES_MAX_FAILURES, C.STAGES_MAX_FAILURES_DEFAULT)
+        if (not isinstance(self.max_stage_failures, int)
+                or isinstance(self.max_stage_failures, bool)
+                or self.max_stage_failures < 1):
+            raise DeepSpeedConfigError(
+                f"stages.{C.STAGES_MAX_FAILURES} must be an int >= 1 "
+                f"(consecutive transient failures before a stage "
+                f"degrades), got {self.max_stage_failures!r}")
+
+
 class DeepSpeedPipelineConfig:
     def __init__(self, param_dict: Dict[str, Any]):
         pipe = param_dict.get(C.PIPELINE) or {}
@@ -486,6 +505,7 @@ class DeepSpeedConfig:
         self.telemetry_config = DeepSpeedTelemetryConfig(pd)
         self.data_prefetch_config = DeepSpeedDataPrefetchConfig(pd)
         self.checkpoint_config = DeepSpeedCheckpointConfig(pd)
+        self.stages_config = DeepSpeedStagesConfig(pd)
         self.pipeline_config = DeepSpeedPipelineConfig(pd)
 
         self._solve_batch_triangle()

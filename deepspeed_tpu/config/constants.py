@@ -400,6 +400,24 @@ CKPT_SIGTERM_SAVE_DEFAULT = False
 CKPT_SAVE_DIR = "save_dir"
 CKPT_SAVE_DIR_DEFAULT = ""
 
+#############################################
+# Shared async-stage runtime (TPU extension; docs/stages.md)
+#############################################
+# One fault plane for every async subsystem (input prefetch, streamed
+# offload uploads, the async checkpoint writer): shared worker/queue/
+# poison/drain primitives in runtime/stages.py, a single documented
+# drain order, and graceful degradation — a stage that keeps failing
+# with a TRANSIENT error falls back to its inline/serial equivalent
+# (prefetch -> inline iteration, streamed offload -> serial update,
+# async save -> sync save) with one loud warning and a
+# ``stage_degraded_total`` counter instead of killing the run.
+STAGES = "stages"
+# consecutive transient failures a stage absorbs (retrying) before it
+# degrades.  1 = degrade on the first failure; the budget resets on
+# every success.
+STAGES_MAX_FAILURES = "max_stage_failures"
+STAGES_MAX_FAILURES_DEFAULT = 3
+
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 PLD_ENABLED = "enabled"
 PLD_ENABLED_DEFAULT = False

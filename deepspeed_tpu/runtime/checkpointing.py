@@ -753,7 +753,10 @@ def _write_checkpoint_files(save_dir: str, tag: str, ckpt_dir: str,
     optional data-iterator plane (sample-exact resume) — same CRC +
     digest discipline, absent when no checkpointable iterator is bound."""
     span = span or (lambda name: contextlib.nullcontext())
-    delay = float(os.environ.get("DS_CKPT_DELAY_S", "0") or 0.0)
+    # injected write latency (CPU overlap proofs): the unified
+    # DS_STAGE_DELAY_S=ckpt:sec spec, or its legacy DS_CKPT_DELAY_S alias
+    from .stages import injected_delay
+    delay = injected_delay("ckpt")
     if delay > 0:
         time.sleep(delay)
     if os.path.isdir(tmp_dir):
@@ -992,8 +995,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         async_write = False
     if not async_write and writer is not None and writer.in_flight():
         # ordering: a pending async save must land (or fail) before a
-        # synchronous one renames over it / moves `latest` past it
-        _surface_writer_error(engine, writer.drain())
+        # synchronous one renames over it / moves `latest` past it —
+        # through the stage graph's own ckpt entry, so sync-save and
+        # engine.close() share ONE drain code path (docs/stages.md)
+        from .engine_stages import drain_ckpt_stage
+        drain_ckpt_stage(engine)
 
     with _tel_sink(engine):
         if proc0:
@@ -1015,7 +1021,9 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                           client_state, save_latest, cfg, async_write)
     if async_write:
         if writer is None:
-            writer = engine._ckpt_writer = AsyncCheckpointWriter()
+            writer = engine._ckpt_writer = AsyncCheckpointWriter(
+                stage=getattr(engine, "_stage_records",
+                              {}).get("ckpt_writer"))
         writer.submit(job)
         return ckpt_dir
     with _tel_sink(engine):

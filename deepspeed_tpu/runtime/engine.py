@@ -43,6 +43,8 @@ from ..ops.lamb import fused_lamb
 from ..parallel.mesh import DATA_AXIS, build_mesh, mesh_axis_size
 from ..utils.logging import log_dist, logger
 from . import precision
+from .engine_stages import (finish_close, pop_stage_errors,
+                            stage_degraded, wire_stage_plane)
 from .lr_schedules import get_lr_schedule
 from .module import TrainModule
 from .prefetch import DevicePlacedBatch, DevicePrefetcher
@@ -812,13 +814,16 @@ class DeepSpeedEngine:
             if jax.process_index() == 0:
                 self._straggler_monitor = StragglerMonitor(
                     ratio=float(tcfg.straggler_ratio))
+        # one fault plane (docs/stages.md): stage records + drain graph
+        wire_stage_plane(self)
         # fault-tolerant checkpointing (docs/checkpointing.md): the async
         # daemon writer (lazy thread; created eagerly so the GC finalizer
         # below can drain a dropped engine's in-flight save), exposed-
         # stall accounting for the telemetry sync, and the opt-in SIGTERM
         # preemption hook
         from .resilience import AsyncCheckpointWriter
-        self._ckpt_writer = AsyncCheckpointWriter()
+        self._ckpt_writer = AsyncCheckpointWriter(
+            stage=self._stage_records["ckpt_writer"])
         self._ckpt_last_save_dir = None
         self._ckpt_interval_acc = {"save_s": 0.0, "overlap_s": 0.0,
                                    "saves": 0, "writes": 0}
@@ -2204,8 +2209,10 @@ class DeepSpeedEngine:
         Sharded (multi-host) tier: grads are first pinned to the master's
         dp-sharding (a no-op when the ZeRO plan already placed them
         there), each host Adams only its shards, and the updated lowp
-        shards all-gather to the compute sharding on device."""
-        if getattr(self, "_offload_pipeline", False):
+        shards all-gather to the compute sharding on device.  A DEGRADED
+        ``offload_h2d`` stage pins this path serial (docs/stages.md)."""
+        if getattr(self, "_offload_pipeline", False) \
+                and not stage_degraded(self, "offload_h2d"):
             return self._apply_host_update_pipelined(grads)
         t0 = time.perf_counter()
         if getattr(self, "_offload_sharded", False):
@@ -2258,7 +2265,6 @@ class DeepSpeedEngine:
         dispatch window streams its uploads under the already-running
         device fwd/bwd as well."""
         from . import offload as offload_mod
-        from .offload import StreamingUploader
         sharded = getattr(self, "_offload_sharded", False)
         if sharded:
             put = self._host_opt.upload_block
@@ -2266,40 +2272,49 @@ class DeepSpeedEngine:
             shard_leaves = self._compute_shard_leaves
             put = lambda i, a: offload_mod.device_put_leaf(  # noqa: E731
                 a, shard_leaves[i])
-        up = StreamingUploader(put)
+        # stashed on the engine mid-step so the stage graph's close()
+        # entry can abort the in-flight uploads (cleared on every exit)
+        up = self._active_uploader = offload_mod.StreamingUploader(
+            put, stage=getattr(self, "_stage_records",
+                               {}).get("offload_h2d"))
         t0 = time.perf_counter()
         try:
-            with self._tel_span("offload/host_adam", cat="offload",
-                                pipelined=True):
-                if sharded:
-                    if isinstance(grads, _HostBlockStash):
-                        # DPU stash — tagged, never sniffed (see the
-                        # serial path)
-                        self._host_opt.step_local(grads.blocks,
-                                                  on_leaf=up.submit)
+            try:
+                with self._tel_span("offload/host_adam", cat="offload",
+                                    pipelined=True):
+                    if sharded:
+                        if isinstance(grads, _HostBlockStash):
+                            # DPU stash — tagged, never sniffed (see the
+                            # serial path)
+                            self._host_opt.step_local(grads.blocks,
+                                                      on_leaf=up.submit)
+                        else:
+                            self._host_opt.step(
+                                self._reshard_to_master(grads),
+                                on_leaf=up.submit)
                     else:
-                        self._host_opt.step(self._reshard_to_master(grads),
-                                            on_leaf=up.submit)
-                else:
-                    self._host_opt.step(grads, on_leaf=up.submit)
-        except BaseException:
-            # Adam-side failure: the optimizer poisoned itself; release
-            # the upload worker without waiting on queued transfers
-            up.abort()
-            raise
-        t1 = time.perf_counter()
-        try:
-            # the exposed tail: whatever transfer time did NOT hide
-            # under the Adam loop above
-            with self._tel_span("offload/h2d_tail", cat="offload"):
-                results, timings = up.finish()
-        except BaseException as e:
-            # Adam completed but an upload failed: host master carries
-            # step t while the device would keep step t-1 params —
-            # poison so the mismatch can neither train nor serialize.
-            # _compute_params was never touched (still the old tree).
-            self._host_opt.poison(e)
-            raise
+                        self._host_opt.step(grads, on_leaf=up.submit)
+            except BaseException:
+                # Adam-side failure: the optimizer poisoned itself;
+                # release the worker without waiting on queued transfers
+                up.abort()
+                raise
+            t1 = time.perf_counter()
+            try:
+                # the exposed tail: whatever transfer time did NOT hide
+                # under the Adam loop above
+                with self._tel_span("offload/h2d_tail", cat="offload"):
+                    results, timings = up.finish()
+            except BaseException as e:
+                # Adam done but an upload failed (or a concurrent close
+                # aborted it — UploadAborted): master carries step t,
+                # device would keep t-1 — poison so the mismatch can
+                # neither train nor serialize.  _compute_params was
+                # never touched (still the old tree).
+                self._host_opt.poison(e)
+                raise
+        finally:
+            self._active_uploader = None
         if sharded:
             n = len(self._host_opt._flat_groups)
             assert len(results) == n, (len(results), n)
@@ -3078,7 +3093,8 @@ class DeepSpeedEngine:
             data_iter, place_fn=place,
             depth=depth if depth is not None else self._prefetch_depth,
             span_fn=span,
-            name="eval" if for_eval else "train")
+            name="eval" if for_eval else "train",
+            stage=self._stage_records["prefetch"])
         # prune already-closed entries IN PLACE (the GC finalizer holds
         # this same list object): a per-eval prefetcher pattern must not
         # grow the list — and retain every source iterator — forever
@@ -3229,6 +3245,9 @@ class DeepSpeedEngine:
             raise RuntimeError(self._fatal_state_error)
         if async_write is None:
             async_write = bool(self.config.checkpoint_config.async_save)
+        if async_write:
+            # a degraded writer saves synchronously (docs/stages.md)
+            async_write = not stage_degraded(self, "ckpt_writer")
         if self._offload_host:
             self._dpu_flush()  # the saved master must be fully applied
         elif self._offload_xla:
@@ -3260,9 +3279,7 @@ class DeepSpeedEngine:
         and training continues — the next save retries from a fresh
         snapshot."""
         w = getattr(self, "_ckpt_writer", None)
-        if w is None:
-            return
-        err = w.pop_error()
+        err = w.pop_error() if w is not None else None
         if err is not None:
             self.last_ckpt_error = err
             if self.telemetry is not None:
@@ -3270,6 +3287,8 @@ class DeepSpeedEngine:
                     "ckpt_save_failures_total",
                     "checkpoint saves that failed (async writer or sync)",
                 ).inc()
+        # post-close/post-abort stage failures land in last_stage_error
+        pop_stage_errors(self)
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_optimizer_states=True,
@@ -3295,48 +3314,28 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # shutdown
     # ------------------------------------------------------------------
+    def drain_stages(self):
+        """Wait out in-flight async work in THE drain order without
+        tearing the stages down (the built-in sync save drains the
+        ckpt entry via the same graph).  Never raises."""
+        return self._stage_graph.drain_all()
+
     def close(self):
-        """Flush and close the engine's observability outputs: buffered
-        tensorboard scalars, the telemetry hub (exports the Chrome
-        trace), and an open xplane window.  Idempotent; the GC finalizer
-        registered at construction covers engines that are dropped
-        without an explicit close, so buffered ``_tb_pending`` scalars
-        are never lost either way."""
+        """Drain + stop every async stage in THE documented order
+        (prefetch -> offload uploads -> ckpt writer -> telemetry flush;
+        docs/stages.md), then release the preemption hook and the GC
+        finalizer (which covers engines dropped without a close, so
+        buffered ``_tb_pending`` scalars are never lost).  Idempotent.
+        A close-time failure never aborts the drain mid-order: every
+        stage still closes, the errors land in ``stage_errors``/
+        ``last_stage_error``, and the FIRST one re-raises so an explicit
+        caller sees the shutdown was not clean (the GC finalizer path
+        swallows it like any finalizer exception)."""
         try:
             self.stop_profiler()  # no-op unless a window is open
         except Exception:
             pass
-        # drain the input pipeline: releases each parked worker and the
-        # device-resident batches it staged ahead (idempotent).  Covers
-        # every engine-built prefetcher (train and eval) AND an adopted
-        # caller-built training prefetcher — _bind_train_prefetcher puts
-        # all of them in this list.
-        for pf in getattr(self, "_prefetchers", []):
-            pf.close()
-        # drain the checkpoint writer BEFORE telemetry closes: an
-        # in-flight async save must land (its spans/counters included),
-        # and a failure surfaces here rather than vanishing with the
-        # daemon thread
-        w = getattr(self, "_ckpt_writer", None)
-        if w is not None:
-            w.close()
-            self._ckpt_writer_tick()
-        ph = getattr(self, "_preemption_handler", None)
-        if ph is not None and not ph.fired:
-            ph.uninstall()
-        self._flush_tensorboard()
-        tel = getattr(self, "telemetry", None)
-        if tel is not None:
-            from . import offload
-            if tel.tracer is not None \
-                    and offload._TRANSFER_TRACER is tel.tracer:
-                offload.set_transfer_tracer(None)
-            tel.close()
-        if self.summary_writer is not None:
-            self.summary_writer.close()
-        if getattr(self, "_finalizer", None) is not None:
-            self._finalizer.detach()
-            self._finalizer = None
+        finish_close(self)
 
     # ------------------------------------------------------------------
     # introspection / logging

@@ -1,0 +1,190 @@
+"""The engine's stage plane (docs/stages.md): wiring, not policy.
+
+``runtime/stages.py`` owns the shared async-stage primitives (workers,
+channels, failure budgets, watchdogs, the ``StageGraph``); this module
+owns how ONE :class:`~.engine.DeepSpeedEngine` instantiates them — the
+persistent per-subsystem :class:`~.stages.Stage` records, the telemetry
+counter hook, and THE documented drain order with its four close/drain
+entries.  It lives outside engine.py so the stage plane is readable as
+one unit and the engine keeps only the two calls (``drain_stages()``,
+``close()``) that use it.
+
+THE drain order (rationale in docs/stages.md): stop producers of
+droppable work first, wait out durability consumers after, flush
+telemetry last so it still sees every stage's final spans/counters —
+
+    prefetch -> offload uploads -> ckpt writer -> telemetry flush
+
+Prefetched batches are droppable and uploads never outlive their step
+call; an in-flight checkpoint save is not droppable, so its stage
+drains (and surfaces failures) before anything flushes.
+"""
+from __future__ import annotations
+
+import weakref
+
+from .stages import Stage, StageGraph
+
+#: (stage name, inline/serial fallback named in the degradation warning)
+ENGINE_STAGES = (
+    ("prefetch", "inline iteration"),
+    ("offload_h2d", "the serial offload update"),
+    ("ckpt_writer", "synchronous saves"),
+)
+
+
+def wire_stage_plane(engine) -> None:
+    """Install the stage records and THE drain-order graph on ``engine``.
+
+    The counter hook holds the engine WEAKLY: stage records ride worker
+    threads (GC roots), and a strong capture would pin the engine for
+    process lifetime.  The graph's entries resolve engine attributes at
+    call time (``getattr``), so wiring happens before the checkpoint
+    writer exists and close stays correct on partially-built engines.
+    """
+    eng_ref = weakref.ref(engine)
+
+    def _stage_counter(name, help, n):
+        eng = eng_ref()
+        if eng is not None and eng.telemetry is not None:
+            eng.telemetry.registry.counter(name, help).inc(n)
+
+    engine._stage_records = {}
+    for sname, fallback in ENGINE_STAGES:
+        st = Stage(sname,
+                   max_failures=engine.config.stages_config
+                   .max_stage_failures,
+                   fallback=fallback)
+        st.counter_fn = _stage_counter
+        engine._stage_records[sname] = st
+    engine.last_stage_error = None
+    #: every surfaced stage error, oldest first (bounded) — one tick
+    #: can pop several stages' failures and ``last_stage_error`` only
+    #: carries the newest
+    engine.stage_errors = []
+    engine._active_uploader = None
+
+    graph = StageGraph()
+    graph.register("prefetch",
+                   close=lambda: close_prefetch_stage(engine),
+                   drain=lambda: None)  # queued batches are droppable
+    graph.register("offload_uploads",
+                   close=lambda: close_upload_stage(engine),
+                   drain=lambda: None)  # never outlives its step call
+    graph.register("ckpt_writer",
+                   close=lambda: close_ckpt_stage(engine),
+                   drain=lambda: drain_ckpt_stage(engine))
+    graph.register("telemetry",
+                   close=lambda: close_telemetry_stage(engine),
+                   drain=engine._flush_tensorboard)
+    engine._stage_graph = graph
+
+
+def stage_degraded(engine, name: str) -> bool:
+    """True when the named stage exhausted its failure budget — the
+    engine's hot paths pin their serial/inline equivalent on this."""
+    recs = getattr(engine, "_stage_records", None)
+    return bool(recs) and name in recs and recs[name].degraded
+
+
+def pop_stage_errors(engine) -> None:
+    """Land stage failures whose natural reporting path was gone (an
+    upload failing after close()/abort() began) in
+    ``engine.last_stage_error`` — the training thread's advertised
+    surface, ticked pre-step alongside the checkpoint writer's.  One
+    tick can pop several stages' failures; all of them are retained in
+    ``engine.stage_errors`` (bounded, oldest dropped) so an earlier
+    stage's error is never silently replaced by a later one."""
+    for st in getattr(engine, "_stage_records", {}).values():
+        err = st.pop_error()
+        if err is not None:
+            engine.last_stage_error = err
+            engine.stage_errors.append(err)
+            del engine.stage_errors[:-16]
+
+
+def finish_close(engine) -> None:
+    """The tail of ``engine.close()``: run THE drain order, release the
+    preemption hook and the GC finalizer, then surface any close-time
+    failures.  ``close_all`` never aborts mid-order, so every stage
+    still closed; the errors land in ``stage_errors``/
+    ``last_stage_error`` and the FIRST re-raises so an explicit caller
+    sees the shutdown was not clean (a GC finalizer swallows it like
+    any finalizer exception — the hook/finalizer release above already
+    happened, so a later explicit close stays idempotent)."""
+    errors = engine._stage_graph.close_all()
+    # a failure surfaced DURING the drain (an aborted upload dying mid-
+    # put) has no later pre-step tick to land it — pop it here
+    pop_stage_errors(engine)
+    ph = getattr(engine, "_preemption_handler", None)
+    if ph is not None and not ph.fired:
+        ph.uninstall()
+    if getattr(engine, "_finalizer", None) is not None:
+        engine._finalizer.detach()
+        engine._finalizer = None
+    if errors:
+        for _name, err in errors:
+            engine.last_stage_error = err
+            engine.stage_errors.append(err)
+        del engine.stage_errors[:-16]
+        raise errors[0][1]
+
+
+# ---------------------------------------------------------------------------
+# the four stage-graph entries, in THE drain order
+# ---------------------------------------------------------------------------
+def close_prefetch_stage(engine) -> None:
+    """Release the input pipeline: each parked worker and the
+    device-resident batches it staged ahead (idempotent).  Covers every
+    engine-built prefetcher (train and eval) AND an adopted caller-built
+    training prefetcher — ``_bind_train_prefetcher`` puts all of them in
+    this list."""
+    for pf in getattr(engine, "_prefetchers", []):
+        pf.close()
+
+
+def close_upload_stage(engine) -> None:
+    """Abort a mid-flight streamed-offload uploader (a close landing
+    inside a step from another thread/signal handler): queued uploads
+    are dropped — the master's step is not yet published, so the old
+    compute tree stays the consistent truth — and an in-flight failure
+    surfaces through the stage record."""
+    up = getattr(engine, "_active_uploader", None)
+    if up is not None:
+        up.abort()
+
+
+def drain_ckpt_stage(engine) -> None:
+    """Wait out an in-flight async save WITHOUT stopping the writer
+    (sync-save / elastic-restart ordering); its failure, if any,
+    surfaces exactly like the pre-step tick's."""
+    w = getattr(engine, "_ckpt_writer", None)
+    if w is not None:
+        from .checkpointing import _surface_writer_error
+        _surface_writer_error(engine, w.drain())
+
+
+def close_ckpt_stage(engine) -> None:
+    """Close the checkpoint writer BEFORE telemetry: an in-flight async
+    save must land (its spans/counters included), and a failure surfaces
+    here rather than vanishing with the daemon thread."""
+    w = getattr(engine, "_ckpt_writer", None)
+    if w is not None:
+        w.close()
+        engine._ckpt_writer_tick()
+
+
+def close_telemetry_stage(engine) -> None:
+    """Flush buffered scalars, release the module transfer tracer hook,
+    and close the hub + summary writer — LAST, after every stage that
+    emits telemetry has drained."""
+    engine._flush_tensorboard()
+    tel = getattr(engine, "telemetry", None)
+    if tel is not None:
+        from . import offload
+        if tel.tracer is not None \
+                and offload._TRANSFER_TRACER is tel.tracer:
+            offload.set_transfer_tracer(None)
+        tel.close()
+    if engine.summary_writer is not None:
+        engine.summary_writer.close()

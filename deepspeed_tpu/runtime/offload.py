@@ -42,6 +42,8 @@ import numpy as np
 
 from ..ops.cpu_adam import DeepSpeedCPUAdam, is_adam_float, lowp_np_dtype
 from ..utils.logging import logger
+from .stages import (Stage, WatchdogPool, fault_point, injected_delay,
+                     spawn)
 
 # ---------------------------------------------------------------------------
 # telemetry hook: per-pull transfer spans.  Module-level because the pull
@@ -68,124 +70,50 @@ def _transfer_span(name: str, cat: str = "transfer", **args):
     return tracer.span(name, cat=cat, **args)
 
 
-class _PullWorkerAbandoned(Exception):
-    """Internal: a job hit a worker that was already stopped (another
-    pull timed out and abandoned it).  ``_watchdog_get`` retries once on
-    a fresh worker — this must never surface as a user-facing error on a
-    healthy link."""
+class UploadAborted(RuntimeError):
+    """``StreamingUploader.finish()`` raced a concurrent ``abort()``:
+    the upload set is incomplete by design — the caller's poison path
+    (not a partial publish) is the only valid continuation."""
 
 
-class _PullWorker:
-    """ONE persistent daemon thread serving every watchdogged pull.
-
-    The old shape spawned a fresh daemon thread per pulled piece — ~100
-    spawns per step for a 6 GB master at 64 MB chunks, pure overhead on
-    the step path.  One long-lived worker drains a queue instead; the
-    watchdog semantics live in the CALLER (``_watchdog_get`` waits on
-    the per-job event with the timeout).  On a timeout the caller
-    abandons this worker — wedged inside one un-interruptible native
-    call — and the next pull lazily creates a replacement, so later
-    pulls never queue behind a stalled one.  ``stop()`` flags the
-    worker: jobs still queued (or submitted after — the sentinel race)
-    fail fast with ``_PullWorkerAbandoned`` instead of being stranded,
-    and the thread exits once its in-flight native call (if any) ever
-    returns.  Note one semantic shift vs the thread-per-pull design:
-    concurrent pulls serialize through this worker, so a piece's timeout
-    window includes queue wait behind other pulls — transfers share one
-    link anyway, and per-piece timeouts are generous (default 120 s for
-    <=64 MB), so only a genuinely non-progressing link trips it."""
-
-    def __init__(self):
-        self._cond = threading.Condition()
-        self._q: list = []
-        self._stopped = False
-        threading.Thread(target=self._run, daemon=True,
-                         name="ds-offload-pull").start()
-
-    def _run(self):
-        while True:
-            with self._cond:
-                self._cond.wait_for(lambda: self._q or self._stopped)
-                if self._stopped:
-                    for _fn, box, done in self._q:  # never strand a job
-                        box["e"] = _PullWorkerAbandoned()
-                        done.set()
-                    self._q.clear()
-                    return
-                fn, box, done = self._q.pop(0)
-            try:
-                box["v"] = fn()
-            except BaseException as e:  # surfaced to the waiting caller
-                box["e"] = e
-            finally:
-                done.set()
-
-    def submit(self, fn):
-        box: dict = {}
-        done = threading.Event()
-        with self._cond:
-            if self._stopped:
-                box["e"] = _PullWorkerAbandoned()
-                done.set()
-            else:
-                self._q.append((fn, box, done))
-                self._cond.notify_all()
-        return box, done
-
-    def stop(self):
-        with self._cond:
-            self._stopped = True
-            self._cond.notify_all()
-
-
-_PULL_WORKER_LOCK = threading.Lock()
-_PULL_WORKER: Optional[_PullWorker] = None
+#: the shared watchdog plane for every guarded D2H pull in this process
+#: (the PR 3 ``_PullWorker`` idiom, now the stage runtime's
+#: ``WatchdogPool`` — see runtime/stages.py / docs/stages.md).
+_PULL_POOL = WatchdogPool("ds-offload-pull")
 
 
 def _watchdog_get(x, timeout_s: float, what: str = "D2H transfer"):
-    """jax.device_get guarded by a persistent-worker watchdog.
+    """jax.device_get guarded by the shared watchdog pool.
 
     Bulk transfers on a tunneled dev platform can stall *inside one
     native call* — un-interruptible by signals (round-3 root cause,
-    BENCH_NOTES.md).  Running the pull on the shared ``_PullWorker``
+    BENCH_NOTES.md).  Running the pull on the pool's persistent worker
     converts the forever-stall into a RuntimeError after ``timeout_s``;
     the wedged worker is abandoned (replaced lazily on the next pull),
     which costs this process its device handle but keeps the failure
     clean and lets the caller fall back to another tier instead of
-    hanging the session.  A job that lands on a worker another pull just
-    abandoned retries once on a fresh one — that race must not
-    masquerade as a stall.
+    hanging the session.
     """
-    global _PULL_WORKER
-    for _attempt in range(2):
-        with _PULL_WORKER_LOCK:
-            worker = _PULL_WORKER
-            if worker is None:
-                worker = _PULL_WORKER = _PullWorker()
-        box, done = worker.submit(lambda: np.asarray(jax.device_get(x)))
-        if not done.wait(timeout=timeout_s):
-            with _PULL_WORKER_LOCK:
-                if _PULL_WORKER is worker:
-                    _PULL_WORKER = None  # abandoned: next pull starts fresh
-            worker.stop()
-            nbytes = getattr(x, "nbytes", 0)
-            raise RuntimeError(
-                f"{what} ({nbytes >> 20} MB) did not complete within "
-                f"{timeout_s:.0f}s: bulk D2H appears stalled on this "
-                "platform (tunneled dev harness?). Aborting the pull "
-                "piece-wise instead of wedging the session; use "
-                "offload_impl='xla' here.")
-        if "e" in box:
-            if isinstance(box["e"], _PullWorkerAbandoned):
-                with _PULL_WORKER_LOCK:
-                    if _PULL_WORKER is worker:
-                        _PULL_WORKER = None
-                continue  # fresh worker, one retry
-            raise box["e"]
-        return box["v"]
-    raise RuntimeError(
-        f"{what}: pull worker abandoned twice in a row — concurrent "
-        "timeouts on this link; treat as stalled.")
+    nbytes = getattr(x, "nbytes", 0)
+
+    def _pull():
+        # the ``offload_pull:pull`` chaos boundary (docs/stages.md) runs
+        # ON the pool's worker, so an injected delay exercises the real
+        # watchdog timeout/abandon path, not just the caller's wait
+        delay = injected_delay("offload_pull")
+        if delay > 0:
+            time.sleep(delay)
+        fault_point("offload_pull", "pull")
+        return np.asarray(jax.device_get(x))
+
+    return _PULL_POOL.call(
+        _pull, timeout_s, what,
+        timeout_msg=(
+            f"{what} ({nbytes >> 20} MB) did not complete within "
+            f"{timeout_s:.0f}s: bulk D2H appears stalled on this "
+            "platform (tunneled dev harness?). Aborting the pull "
+            "piece-wise instead of wedging the session; use "
+            "offload_impl='xla' here."))
 
 
 def pull_chunk_bytes() -> int:
@@ -334,7 +262,7 @@ class _PrefetchPuller:
                     return
                 ev.set()
 
-        threading.Thread(target=work, daemon=True).start()
+        spawn(work, name="ds-offload-grad-prefetch", restarts=0)
 
     def __call__(self, g):
         idx, ev, box = self._slots[id(g)].pop(0)
@@ -434,30 +362,54 @@ class StreamingUploader:
     Each upload also emits a per-leaf ``offload/h2d_params`` span on the
     module transfer tracer.
 
-    On failure the worker stops touching the device and ``finish()``
-    raises; the caller must then POISON the optimizer and leave its old
-    compute-param tree in place (the master already carries step t, the
-    device would keep step t-1 — the half-swapped state the pipeline
-    contract forbids).
+    On a NON-TRANSIENT failure the worker stops touching the device and
+    ``finish()`` raises; the caller must then POISON the optimizer and
+    leave its old compute-param tree in place (the master already
+    carries step t, the device would keep step t-1 — the half-swapped
+    state the pipeline contract forbids).  TRANSIENT failures (OSError —
+    the stage runtime's retryable class) are retried against the same
+    leaf up to the ``offload_h2d`` stage's failure budget; exhausting it
+    DEGRADES the stage: this upload still completes (the inline
+    equivalent, outside the injection plane) and the engine takes the
+    serial update path from the next step on.
 
-    DS_OFFLOAD_H2D_DELAY_S: fault-injection knob (tests/bench smoke
-    only) — each upload sleeps this long INSIDE its span/timing window,
+    Fault injection rides the unified spec (docs/stages.md):
+    ``DS_STAGE_FAULT=offload_h2d:put:n[+]`` injects put failures and
+    ``DS_STAGE_DELAY_S=offload_h2d:sec`` (alias: the legacy
+    ``DS_OFFLOAD_H2D_DELAY_S``) sleeps INSIDE each span/timing window,
     emulating a slow PCIe link so a CPU run can measure real overlap.
     """
 
-    def __init__(self, put_fn, what: str = "offload/h2d_params"):
+    def __init__(self, put_fn, what: str = "offload/h2d_params",
+                 stage: Optional[Stage] = None):
         self._put = put_fn
         self._what = what
-        self._delay = float(os.environ.get("DS_OFFLOAD_H2D_DELAY_S", "0"))
+        # the engine threads its persistent ``offload_h2d`` Stage record
+        # through so the failure budget counts across steps; standalone
+        # constructions get a private one
+        self._stage = stage if stage is not None else Stage("offload_h2d")
         self._q: list = []
         self._cond = threading.Condition()
         self._closed = False
+        self._aborted = False
         self._err: Optional[BaseException] = None
+        self._err_surfaced = False  # guarded by _cond: surface() once
+        self._finish_owns_err = False  # finish() claimed it for re-raise
         self._done = threading.Event()
         self.results: dict = {}
         self.timings: list = []
-        threading.Thread(target=self._work, daemon=True,
-                         name="ds-offload-h2d").start()
+        spawn(self._work, name="ds-offload-h2d", restarts=0)
+
+    def _put_and_drain(self, idx: int, arr):
+        out = self._put(idx, arr)
+        # drain the transfer INSIDE the span/timing window: device_put
+        # only dispatches, so without this the timings (and
+        # overlap_ratio) would measure enqueue latency (the JL006 bug
+        # class) — and an async transfer failure would escape the poison
+        # contract by surfacing after finish() already succeeded.
+        # Off-thread, so the Adam loop still overlaps.
+        jax.block_until_ready(out)
+        return out
 
     def _work(self):
         while True:
@@ -472,19 +424,26 @@ class StreamingUploader:
             t0 = time.perf_counter()
             try:
                 with _transfer_span(self._what, leaf=idx, bytes=nbytes):
-                    if self._delay > 0:
-                        time.sleep(self._delay)
-                    out = self._put(idx, arr)
-                    # drain the transfer INSIDE the span/timing window:
-                    # device_put only dispatches, so without this the
-                    # timings (and overlap_ratio) would measure enqueue
-                    # latency (the JL006 bug class) — and an async
-                    # transfer failure would escape the poison contract
-                    # by surfacing after finish() already succeeded.
-                    # Off-thread, so the Adam loop still overlaps.
-                    jax.block_until_ready(out)
+                    # the stage boundary: injected delay + fault,
+                    # transient retry up to the budget, then degradation
+                    # (the put still completes; the engine checks
+                    # stage.degraded before the NEXT step)
+                    out = self._stage.call(
+                        "put", lambda: self._put_and_drain(idx, arr))
             except BaseException as e:  # re-raised from finish()
-                self._err = e
+                with self._cond:
+                    self._err = e
+                    # exactly-once vs a concurrent abort(): whoever
+                    # claims the flag under the lock does the surfacing
+                    surface = self._aborted and not self._err_surfaced
+                    if surface:
+                        self._err_surfaced = True
+                if surface:
+                    # abort() already ran: nobody will call finish(), so
+                    # without this the failure would vanish with the
+                    # daemon thread — route it through the shared
+                    # surfaced-error path (engine tick -> last_stage_error)
+                    self._stage.surface(e)
                 continue
             self.results[idx] = out
             self.timings.append((idx, t0, time.perf_counter(), nbytes))
@@ -501,23 +460,61 @@ class StreamingUploader:
         """Close the queue, wait for every upload, raise the first
         failure.  NOT watchdogged: the upload direction shares the probe
         warning's contract (a stalled H2D hangs — see
-        ``_probe_transfer_path``)."""
+        ``_probe_transfer_path``).  A concurrent ``abort()`` (a close
+        landing mid-step from another thread/signal handler) raises
+        :class:`UploadAborted` instead of returning partial results —
+        the caller's except path must poison, never publish a
+        half-uploaded step."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
         self._done.wait()
-        if self._err is not None:
-            raise self._err
+        with self._cond:
+            err = self._err
+            # claim the error under the exactly-once flag: a concurrent
+            # abort() must not ALSO surface it through the stage record
+            # (one failure, one report).  Ownership is remembered so a
+            # REPEATED finish() keeps raising (poison invariant: never
+            # return partial results while an error is recorded).
+            if err is not None and not self._err_surfaced:
+                self._err_surfaced = True
+                self._finish_owns_err = True
+            owns = self._finish_owns_err
+            aborted = self._aborted
+        if err is not None and owns:
+            raise err
+        # err set but surfaced by abort()/the worker before finish()
+        # could claim it: the real error is on the stage record; the
+        # step still must poison — fall through to the abort raise
+        # (aborted is necessarily True on that arm)
+        if aborted:
+            raise UploadAborted(
+                "streamed offload upload aborted mid-step (engine close/"
+                "abort): queued uploads were dropped; the step must "
+                "poison, not publish")
         return self.results, self.timings
 
     def abort(self):
-        """Release the worker without waiting (the Adam side failed: its
-        exception is the one that matters; queued uploads are dropped).
-        The in-flight put, if any, finishes in the background."""
+        """Release the worker without waiting (the Adam side failed, or
+        the engine is closing mid-flight: the caller's exception is the
+        one that matters; queued uploads are dropped).  The in-flight
+        put, if any, finishes in the background — a failure there (or
+        one already recorded that no ``finish()`` has claimed for
+        re-raise) is surfaced through the stage record instead of being
+        dropped on the floor; the ``_err_surfaced`` flag keeps the
+        worker/abort/finish triple exactly-once."""
         with self._cond:
             self._closed = True
+            self._aborted = True
             self._q.clear()
+            err = self._err
+            # exactly-once vs the worker's own post-abort surfacing
+            surface = err is not None and not self._err_surfaced
+            if surface:
+                self._err_surfaced = True
             self._cond.notify_all()
+        if surface:
+            self._stage.surface(err)
 
 
 class HostOffloadOptimizer:

@@ -6,15 +6,13 @@ serially BEFORE dispatch: ``next(data_iter)`` → collate →
 ``_shard_batch`` (reshape + ``jax.device_put``) all ran on the caller's
 thread while the devices sat idle waiting for the next program's
 arguments.  ``DevicePrefetcher`` moves that whole chain off the hot
-path: ONE daemon worker pulls batches ahead of consumption through a
-bounded queue (default depth 2 — double buffering), runs the collate
+path: ONE stage worker pulls batches ahead of consumption through a
+bounded channel (default depth 2 — double buffering), runs the collate
 and device placement there, and the step loop receives already
-device-resident sharded pytrees.  The input-feeding half of the
-ZeRO-Offload overlap story: the same streaming-worker shape as the
-optimizer pipeline in ``runtime/offload.py`` (bounded queue,
-drain-inside-span, poison-on-failure), applied to the data path —
-where remote-platform H2D latency (BENCH_NOTES.md's tunnel round
-trips) is entirely hideable behind the previous step's compute.
+device-resident sharded pytrees.  Built on the shared async-stage
+runtime (``runtime/stages.py``, docs/stages.md): the worker, bounded
+queue, poison propagation, failure budget, and fault-injection plane
+are the same primitives the offload and checkpoint stages use.
 
 Contracts:
 
@@ -27,18 +25,28 @@ Contracts:
     boundary AFTER every already-produced batch is consumed, and the
     iterator stays exhausted (a persistent training iterator must not
     resurrect);
-  - any other worker failure poisons the queue: the consumer re-raises
-    the ORIGINAL exception (again on every later ``next``), after
-    first draining batches produced before the failure;
+  - any non-transient worker failure poisons the channel: the consumer
+    re-raises the ORIGINAL exception (again on every later ``next``),
+    after first draining batches produced before the failure;
+  - TRANSIENT failures (``OSError`` — the stage runtime's retryable
+    class, which includes injected ``DS_STAGE_FAULT`` faults) are
+    retried against the same drawn batch up to the stage's failure
+    budget; exhausting it DEGRADES the stage (one loud warning +
+    ``stage_degraded_total``): the worker hands the source to the
+    consumer and iteration continues INLINE — every batch still
+    arrives, in order, outside the injection plane;
   - ``close()`` is idempotent and releases the worker (the engine's
-    ``close()`` calls it); a closed prefetcher refuses further pulls.
+    ``close()`` drains it via the stage graph); a closed prefetcher
+    refuses further pulls.
 
 Knobs: the ``data_prefetch`` config block (enabled/depth; default ON),
 ``DS_PREFETCH=0`` — the no-config escape hatch back to inline
-collate+placement, and ``DS_PREFETCH_DELAY_S`` — fault injection
-(tests/bench only): the worker sleeps this long inside each placement
-span, emulating a slow collate/H2D link so a CPU-only run can prove
-the overlap from tracer timestamps (``tests/test_prefetch.py``).
+collate+placement, and the unified chaos spec (docs/stages.md):
+``DS_STAGE_FAULT=prefetch:place:n[+]`` injects placement faults,
+``DS_STAGE_DELAY_S=prefetch:sec`` (alias: the legacy
+``DS_PREFETCH_DELAY_S``) sleeps inside each placement span, emulating
+a slow collate/H2D link so a CPU-only run can prove the overlap from
+tracer timestamps (``tests/test_prefetch.py``).
 
 Sample-exact resume (docs/elastic.md): when the source is a
 checkpointable loader (``state_dict``/``load_state_dict``), the worker
@@ -47,18 +55,19 @@ queue carries it alongside; ``state_dict()`` returns the state
 belonging to the last CONSUMED batch, so batches sitting prefetched in
 the queue (produced, not yet consumed) are accounted as not-yet-drawn
 — a resume from this state re-produces exactly them, no replay, no
-skip.
+skip.  The degraded inline path keeps the same accounting.
 """
 from __future__ import annotations
 
 import contextlib
 import copy
-import os
 import threading
 import time
 from typing import Any, Callable, Optional
 
 import jax
+
+from .stages import Channel, Stage, spawn
 
 __all__ = ["DevicePlacedBatch", "DevicePrefetcher"]
 
@@ -95,8 +104,8 @@ _END = _End()
 
 
 class DevicePrefetcher:
-    """Wrap a batch iterator with a single daemon worker and a bounded
-    queue, pulling batches ahead of consumption.
+    """Wrap a batch iterator with a single stage worker and a bounded
+    channel, pulling batches ahead of consumption.
 
     ``place_fn(batch)`` runs ON THE WORKER (collate output → device
     placement); it may return a :class:`DevicePlacedBatch` (the engine's
@@ -108,6 +117,11 @@ class DevicePrefetcher:
     blocked on input, the pipeline's "hidden vs. exposed" number
     (steady state ≈ 0 when production hides under the previous step).
 
+    ``stage`` (optional) is the engine's persistent ``prefetch``
+    :class:`~.stages.Stage` record, so the failure budget and a
+    degradation stick across the prefetchers an engine builds; standalone
+    constructions get a private one.
+
     ``stats()`` exposes cumulative ``hits`` (batch already queued when
     requested), ``misses``, ``wait_s``, and ``consumed`` — the engine
     turns interval deltas into the ``prefetch_hit_ratio`` sync scalar.
@@ -115,7 +129,7 @@ class DevicePrefetcher:
 
     def __init__(self, source, place_fn: Optional[Callable] = None,
                  depth: int = 2, span_fn: Optional[Callable] = None,
-                 name: str = "train"):
+                 name: str = "train", stage: Optional[Stage] = None):
         if not isinstance(depth, int) or isinstance(depth, bool) \
                 or depth < 1:
             raise ValueError(f"prefetch depth must be an int >= 1, "
@@ -144,31 +158,59 @@ class DevicePrefetcher:
             lambda *a, **k: contextlib.nullcontext())
         self.depth = depth
         self.name = name
-        self._delay = float(os.environ.get("DS_PREFETCH_DELAY_S", "0"))
-        self._cond = threading.Condition()
-        self._q: list = []
-        self._err: Optional[BaseException] = None
-        self._closed = False
+        self.stage = stage if stage is not None else Stage("prefetch")
+        self._chan = Channel(depth)
         self._ended = False
-        # cumulative stats (guarded by _cond's lock)
+        # degraded hand-off: the worker stopped and the source belongs
+        # to the consumer now (inline iteration); serialized by this lock
+        self._worker_inline = False
+        self._inline_lock = threading.Lock()
+        # cumulative stats (guarded by the channel's lock)
         self._hits = 0
         self._misses = 0
         self._wait_s = 0.0
         self._consumed = 0
-        self._thread = threading.Thread(
-            target=self._work, daemon=True,
-            name=f"ds-data-prefetch-{name}")
-        self._thread.start()
+        # restarts=0 like every other subsystem worker: _work is not
+        # reentrant (a restart would re-draw and silently drop the
+        # in-flight batch); an escaping exception takes the poison path
+        self._worker = spawn(self._work,
+                             name=f"ds-data-prefetch-{name}", restarts=0)
 
     # -- the worker -----------------------------------------------------
+    def _place_and_drain(self, item):
+        placed = self._place(item)
+        # drain INSIDE the span: device_put only dispatches, so without
+        # this a queued batch would not actually be resident (the JL006
+        # dispatch-only class) and an async transfer failure would
+        # surface in the consuming step instead of the poison path
+        tree = (placed.tree if isinstance(placed, DevicePlacedBatch)
+                else placed)
+        jax.block_until_ready(tree)
+        return placed
+
     def _work(self):
+        # anything escaping the produce loop (channel-op failure — the
+        # draw/place sites poison for themselves below) must poison too:
+        # with restarts=0 a silently dead worker would strand consumers
+        # waiting on the channel forever
+        try:
+            self._produce()
+        except BaseException as e:
+            self._chan.poison(e)
+            raise
+
+    def _produce(self):
         batch_idx = 0
         while True:
-            with self._cond:
-                self._cond.wait_for(
-                    lambda: self._closed or len(self._q) < self.depth)
-                if self._closed:
-                    return
+            if not self._chan.wait_space():
+                return  # closed
+            if self.stage.degraded:
+                # budget exhausted: hand the source to the consumer —
+                # iteration continues INLINE (docs/stages.md)
+                with self._chan.cond:
+                    self._worker_inline = True
+                    self._chan.cond.notify_all()
+                return
             try:
                 item = next(self._src)
                 # source position AFTER drawing this batch: rides the
@@ -177,41 +219,25 @@ class DevicePrefetcher:
                 post_state = (copy.deepcopy(self._state_src.state_dict())
                               if self._state_src is not None else None)
             except StopIteration:
-                with self._cond:
-                    self._q.append((_END, None))  # after every batch
-                    self._cond.notify_all()
+                self._chan.put((_END, None), force=True)  # after every batch
                 return
             except BaseException as e:  # poison: consumer re-raises it
-                with self._cond:
-                    self._err = e
-                    self._cond.notify_all()
+                self._chan.poison(e)
                 return
             try:
                 with self._span("data/prefetch_place", cat="data",
                                 batch=batch_idx):
-                    if self._delay > 0:
-                        time.sleep(self._delay)
-                    placed = self._place(item)
-                    # drain INSIDE the span: device_put only dispatches,
-                    # so without this a queued batch would not actually
-                    # be resident (the JL006 dispatch-only class) and an
-                    # async transfer failure would surface in the
-                    # consuming step instead of the poison path
-                    tree = (placed.tree
-                            if isinstance(placed, DevicePlacedBatch)
-                            else placed)
-                    jax.block_until_ready(tree)
+                    # the stage boundary: injected delay/fault, transient
+                    # retry against the SAME drawn batch (sample order is
+                    # preserved), degradation on budget exhaustion
+                    placed = self.stage.call(
+                        "place", lambda: self._place_and_drain(item))
             except BaseException as e:
-                with self._cond:
-                    self._err = e
-                    self._cond.notify_all()
+                self._chan.poison(e)
                 return
             batch_idx += 1
-            with self._cond:
-                if self._closed:
-                    return  # dropped: close() already released consumers
-                self._q.append((placed, post_state))
-                self._cond.notify_all()
+            if not self._chan.put((placed, post_state)):
+                return  # closed while parked: consumers already released
 
     # -- the consumer side ----------------------------------------------
     def __iter__(self):
@@ -220,35 +246,36 @@ class DevicePrefetcher:
     def __next__(self):
         t0 = time.perf_counter()
         with self._span("data/prefetch_wait", cat="data"):
-            with self._cond:
+            with self._chan.cond:
                 # exhausted BEFORE closed: consuming the epoch-end
                 # sentinel self-closes below (the worker has already
                 # exited), and an exhausted iterator must keep raising
                 # StopIteration, not a closed error
                 if self._ended:
                     raise StopIteration
-                if self._closed:
+                if self._chan.closed:
                     raise RuntimeError(
                         "DevicePrefetcher is closed (engine.close() shut "
                         "it down)")
-                hit = bool(self._q)
-                self._cond.wait_for(
-                    lambda: self._q or self._err is not None
-                    or self._closed)
-                if self._closed:
+                hit = bool(self._chan.items)
+                self._chan.cond.wait_for(
+                    lambda: self._chan.items or self._chan.err is not None
+                    or self._chan.closed or self._worker_inline)
+                if self._chan.closed:
                     raise RuntimeError(
                         "DevicePrefetcher closed while waiting for a "
                         "batch")
-                if self._q:
-                    # batches produced before an end/failure drain first
-                    item, post_state = self._q.pop(0)
-                    self._cond.notify_all()  # a slot freed
+                if self._chan.items:
+                    # batches produced before an end/failure/degradation
+                    # drain first
+                    item, post_state = self._chan.items.pop(0)
+                    self._chan.cond.notify_all()  # a slot freed
                     if isinstance(item, _End):
                         # the worker already exited; self-close so an
                         # exhausted prefetcher counts as drained (the
                         # engine prunes closed ones from its list)
                         self._ended = True
-                        self._closed = True
+                        self._chan.closed = True
                         raise StopIteration
                     if post_state is not None:
                         # this batch is now CONSUMED: the resume point
@@ -259,15 +286,65 @@ class DevicePrefetcher:
                     self._wait_s += time.perf_counter() - t0
                     self._consumed += 1
                     return item
-                # queue empty, worker dead: surface the original error
-                raise self._err
+                if self._chan.err is not None:
+                    # queue empty, worker dead: the original error
+                    raise self._chan.err
+            # queue empty and the worker handed the source over
+            return self._next_inline(t0)
+
+    def _next_inline(self, t0: float):
+        """Degraded mode: the async stage is gone; pull, place, and
+        drain on the consumer's thread — the inline-iteration fallback,
+        OUTSIDE the injection plane (same batches, same order, same
+        resume accounting)."""
+        with self._inline_lock:
+            with self._chan.cond:
+                if self._ended:
+                    raise StopIteration
+                if self._chan.err is not None:
+                    # same poison contract as the async path: a prior
+                    # inline failure re-raises on every later next — a
+                    # retrying caller must not silently skip the batch
+                    # the failure consumed
+                    raise self._chan.err
+                if self._chan.closed:
+                    raise RuntimeError(
+                        "DevicePrefetcher is closed (engine.close() shut "
+                        "it down)")
+            try:
+                item = next(self._src)
+                post_state = (copy.deepcopy(self._state_src.state_dict())
+                              if self._state_src is not None else None)
+            except StopIteration:
+                with self._chan.cond:
+                    self._ended = True
+                    self._chan.closed = True
+                raise
+            except BaseException as e:
+                self._chan.poison(e)
+                raise
+            try:
+                with self._span("data/prefetch_place", cat="data",
+                                inline=True):
+                    placed = self._place_and_drain(item)
+            except BaseException as e:
+                self._chan.poison(e)
+                raise
+            with self._chan.cond:
+                if post_state is not None:
+                    self._consumed_state = post_state
+                self._misses += 1
+                self._wait_s += time.perf_counter() - t0
+                self._consumed += 1
+            return placed
 
     # -- introspection ---------------------------------------------------
     def qsize(self) -> int:
         """Batches ready for consumption right now (the queue-depth
         gauge; the epoch-end sentinel does not count)."""
-        with self._cond:
-            return len([x for x, _ in self._q if not isinstance(x, _End)])
+        with self._chan.cond:
+            return len([x for x, _ in self._chan.items
+                        if not isinstance(x, _End)])
 
     # -- sample-exact resume ---------------------------------------------
     def state_dict(self) -> dict:
@@ -285,28 +362,23 @@ class DevicePrefetcher:
                 "checkpointable loader (DeepSpeedDataLoader or "
                 "RepeatingLoader over one), passed to prefetch() as the "
                 "loader object, not a raw iterator")
-        with self._cond:
-            if self._err is not None:
-                raise self._err
+        with self._chan.cond:
+            if self._chan.err is not None:
+                raise self._chan.err
             return copy.deepcopy(self._consumed_state)
 
     def stats(self) -> dict:
-        with self._cond:
+        with self._chan.cond:
             return {"hits": self._hits, "misses": self._misses,
                     "wait_s": self._wait_s, "consumed": self._consumed}
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        return self._chan.closed
 
     # -- shutdown --------------------------------------------------------
     def close(self):
         """Release the worker and drop queued batches.  Idempotent; a
         parked worker (queue full) would otherwise wait forever holding
         references to ``depth`` device-resident batches."""
-        with self._cond:
-            if self._closed:
-                return
-            self._closed = True
-            self._q.clear()
-            self._cond.notify_all()
+        self._chan.close()

@@ -70,63 +70,23 @@ class CheckpointCorruptError(CheckpointError):
 
 
 # ---------------------------------------------------------------------------
-# fault injection (tests + CPU overlap proofs)
+# fault injection (tests + CPU overlaps proofs) — the unified stage plane
 # ---------------------------------------------------------------------------
-# DS_CKPT_FAULT="<point>:<n>[+][,<point>:<n>[+]...]" — the n-th hit
-# (1-based, process-wide) of the named write/read point raises a transient
-# OSError; a trailing "+" makes the failure STICKY (every hit >= n fails,
-# simulating a dead disk / a kill during save rather than a transient
-# blip).  Points: leaf, shard_index, manifest, meta, rename, latest, read.
-_FAULT_ENV = "DS_CKPT_FAULT"
-_fault_lock = threading.Lock()
-_fault_hits: dict = {}
-
-
-def reset_fault_injection() -> None:
-    """Clear the per-point hit counters (tests call this between cases;
-    the env var itself is the test's to manage)."""
-    with _fault_lock:
-        _fault_hits.clear()
-
-
-def _fault_spec():
-    env = os.environ.get(_FAULT_ENV, "")
-    if not env:
-        return {}
-    spec = {}
-    for part in env.split(","):
-        part = part.strip()
-        if not part or ":" not in part:
-            continue
-        point, n = part.split(":", 1)
-        sticky = n.endswith("+")
-        if sticky:
-            n = n[:-1]
-        try:
-            spec[point.strip()] = (int(n), sticky)
-        except ValueError:
-            logger.warning("%s: unparseable spec %r ignored",
-                           _FAULT_ENV, part)
-    return spec
+# The checkpoint write/read points (leaf, shard_index, manifest, meta,
+# rename, latest, read) are stage ``ckpt`` in the unified chaos spec
+# (runtime/stages.py, docs/stages.md): arm them with
+# ``DS_STAGE_FAULT=ckpt:<point>:<n>[+]`` — or the legacy alias
+# ``DS_CKPT_FAULT=<point>:<n>[+]``, kept and tested.  The thin wrappers
+# below preserve this module's historical API.
+from .stages import (fault_point as _stage_fault_point,
+                     reset_fault_injection, spawn)  # noqa: F401 (re-export)
 
 
 def fault_point(point: str, path: str = "") -> None:
-    """Raise an injected transient OSError when ``DS_CKPT_FAULT`` arms
-    this point's current hit number.  No-op (one dict lookup) when the
-    env var is unset."""
-    spec = _fault_spec()
-    arm = spec.get(point)
-    if arm is None:
-        return
-    n, sticky = arm
-    with _fault_lock:
-        hits = _fault_hits.get(point, 0) + 1
-        _fault_hits[point] = hits
-    if hits == n or (sticky and hits >= n):
-        raise OSError(
-            f"injected fault at checkpoint write point {point!r}"
-            f" (hit {hits}{'+' if sticky else ''})"
-            + (f": {path}" if path else ""))
+    """Raise an injected transient OSError when the unified spec (or the
+    ``DS_CKPT_FAULT`` alias) arms this checkpoint point's current hit
+    number.  No-op (one cached dict lookup) when nothing is armed."""
+    _stage_fault_point("ckpt", point, path)
 
 
 # ---------------------------------------------------------------------------
@@ -200,16 +160,26 @@ class AsyncCheckpointWriter:
       - ``drain`` blocks until the queue is empty and the writer idle,
         returning the last un-surfaced error (if any);
       - ``close`` drains and stops the thread (idempotent).
+
+    ``stage`` (optional) is the engine's persistent ``ckpt_writer``
+    :class:`~.stages.Stage` record: each job passes the ``job``
+    injection point (``DS_STAGE_FAULT=ckpt_writer:job:n[+]``), and a
+    FAILED save — after the ``ckpt`` write points' own io_retry plane
+    has given up — counts against the stage's failure budget.
+    Exhausting the budget degrades the stage; the ENGINE reads
+    ``stage.degraded`` at save time and falls back to synchronous saves
+    (async == sync bitwise, so degradation costs latency, never bytes).
     """
 
-    def __init__(self, name: str = "ds-ckpt-writer"):
+    def __init__(self, name: str = "ds-ckpt-writer", stage=None):
         self._name = name
+        self._stage = stage
         self._cv = threading.Condition()
         self._pending: Optional[CheckpointJob] = None
         self._busy: Optional[CheckpointJob] = None
         self._last_error: Optional[BaseException] = None
         self._closed = False
-        self._thread: Optional[threading.Thread] = None
+        self._thread = None
         # stats (read under _cv)
         self.completed = 0
         self.failed = 0
@@ -229,9 +199,8 @@ class AsyncCheckpointWriter:
                     "(latest wins)", ranks=[0])
             self._pending = job
             if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._run, name=self._name, daemon=True)
-                self._thread.start()
+                self._thread = spawn(self._run, name=self._name,
+                                     restarts=0)
             self._cv.notify_all()
 
     # -- introspection --------------------------------------------------
@@ -298,10 +267,16 @@ class AsyncCheckpointWriter:
                 job = self._busy
             t0 = time.perf_counter()
             try:
+                if self._stage is not None:
+                    # the writer's own stage boundary (the ckpt write
+                    # points inside job.run() are stage "ckpt")
+                    self._stage.check("job", job.tag)
                 job.run()
                 with self._cv:
                     self.completed += 1
                     self.last_write_s = time.perf_counter() - t0
+                if self._stage is not None:
+                    self._stage.note_ok()
             except BaseException as e:  # poison THIS save only
                 logger.error(
                     "async checkpoint save %r FAILED (training continues; "
@@ -310,6 +285,12 @@ class AsyncCheckpointWriter:
                 with self._cv:
                     self.failed += 1
                     self._last_error = e
+                if self._stage is not None and self._stage.is_transient(e):
+                    # a failed SAVE (io_retry already exhausted inside)
+                    # counts against the budget; exhausting it degrades
+                    # the stage and the engine saves synchronously from
+                    # then on
+                    self._stage.note_failure(e)
             finally:
                 with self._cv:
                     self._busy = None

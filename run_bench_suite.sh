@@ -16,6 +16,9 @@ run() {
 run r03 python bench.py
 run prefetch python bench.py --prefetch=ab
 run ckpt python bench.py --ckpt=ab
+# stage chaos: sticky injected faults at every async stage boundary;
+# training must complete degraded, bitwise-equal to the serial legs
+run stage_chaos python bench.py --stage-chaos
 # elastic smoke is pure-CPU subprocess supervision (never touches the
 # tunnel): kill one local worker mid-run, assert resume at reduced
 # width with trajectory continuity + sample-exactness

@@ -1,0 +1,16 @@
+"""JL007 positive fixture: raw daemon-thread construction — the
+hand-rolled async-worker shape the stage runtime replaced."""
+import threading
+import threading as _renamed
+from threading import Thread
+
+
+def hand_rolled_worker(q):
+    def work():
+        while True:
+            q.get()()
+
+    threading.Thread(target=work, daemon=True).start()          # flagged
+    t = Thread(target=work, daemon=True, name="ds-rogue")       # flagged
+    t.start()
+    _renamed.Thread(target=work, daemon=True).start()           # flagged

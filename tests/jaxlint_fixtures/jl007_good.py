@@ -1,0 +1,21 @@
+"""JL007 negative fixture: workers built from the stage runtime, plus
+the non-daemon shapes the rule leaves alone."""
+import threading
+
+from deepspeed_tpu.runtime.stages import spawn
+
+
+def sanctioned_worker(q):
+    def work():
+        while True:
+            q.get()()
+
+    spawn(work, name="ds-sanctioned")  # the stage runtime's constructor
+
+
+def foreground_thread(fn):
+    # non-daemon: a deliberate blocking join-at-exit thread is not the
+    # hand-rolled-async-subsystem shape JL007 polices
+    t = threading.Thread(target=fn)
+    t.start()
+    return t

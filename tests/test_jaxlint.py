@@ -41,6 +41,7 @@ def _fixture(name):
     ("JL004", "jl004_bad.py", "jl004_good.py"),
     ("JL005", "jl005_bad.py", "jl005_good.py"),
     ("JL006", "jl006_bad.py", "jl006_good.py"),
+    ("JL007", "jl007_bad.py", "jl007_good.py"),
     ("JL101", os.path.join("jl101", "config_bad.py"),
      os.path.join("jl101", "config_good.py")),
 ])
@@ -257,11 +258,30 @@ def test_cli_reports_findings_in_github_format(tmp_path):
     assert "JL001" in proc.stdout
 
 
+def test_jl007_exemption_is_runtime_stages_only():
+    """The JL007 exemption matches the FULL package path suffix
+    deepspeed_tpu/runtime/stages.py — a future serving/stages.py, a
+    nested .../runtime/stages.py, or any other stages.py basename does
+    NOT inherit the right to construct raw daemon threads."""
+    src = ("import threading\n"
+           "threading.Thread(target=print, daemon=True).start()\n")
+    exempt = os.path.join("deepspeed_tpu", "runtime", "stages.py")
+    assert not [f for f in lint_source(src, path=exempt)
+                if f.rule == "JL007"]
+    for path in (os.path.join("deepspeed_tpu", "serving", "stages.py"),
+                 "stages.py",
+                 os.path.join("deepspeed_tpu", "runtime", "other.py"),
+                 os.path.join("deepspeed_tpu", "serving", "runtime",
+                              "stages.py")):
+        assert [f for f in lint_source(src, path=path)
+                if f.rule == "JL007"], path
+
+
 def test_cli_list_rules_covers_all_ids():
     proc = subprocess.run(
         [sys.executable, "-m", "tools.jaxlint", "--list-rules"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
     for rule_id in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
-                    "JL101"):
+                    "JL007", "JL101"):
         assert rule_id in proc.stdout

@@ -340,14 +340,14 @@ def test_watchdog_reuses_one_persistent_worker():
     # warm: create the worker
     chunked_device_get(jnp.ones((64, 64)), chunk_mb=0.001,
                        piece_timeout=30)
-    worker = offload._PULL_WORKER
+    worker = offload._PULL_POOL.worker
     assert worker is not None
     before = set(_pull_threads())
     assert before, "no pull worker thread observed"
     for _ in range(3):
         chunked_device_get(jnp.ones((100, 128)), chunk_mb=0.01,
                            piece_timeout=30)  # ~13 pieces each
-    assert offload._PULL_WORKER is worker, "worker was replaced"
+    assert offload._PULL_POOL.worker is worker, "worker was replaced"
     # no NEW pull threads across ~40 pieces (an abandoned predecessor
     # from an earlier stall test may still be draining out of `before`,
     # which is why this is a no-new-threads check, not a count of 1)
@@ -360,7 +360,7 @@ def test_watchdog_timeout_abandons_worker(monkeypatch):
     queue behind its stalled native call) and the next pull lazily gets
     a fresh one — the per-spawn semantics, paid only on failure."""
     chunked_device_get(jnp.ones((4, 4)), piece_timeout=10)  # ensure one
-    wedged = offload._PULL_WORKER
+    wedged = offload._PULL_POOL.worker
     release = threading.Event()
     real_get = jax.device_get
 
@@ -376,11 +376,11 @@ def test_watchdog_timeout_abandons_worker(monkeypatch):
     finally:
         release.set()  # let the abandoned worker drain and exit
     monkeypatch.undo()
-    assert offload._PULL_WORKER is not wedged  # abandoned
+    assert offload._PULL_POOL.worker is not wedged  # abandoned
     got = chunked_device_get(jnp.ones((4, 4)), piece_timeout=10)
     np.testing.assert_array_equal(got, np.ones((4, 4), np.float32))
-    assert offload._PULL_WORKER is not None
-    assert offload._PULL_WORKER is not wedged
+    assert offload._PULL_POOL.worker is not None
+    assert offload._PULL_POOL.worker is not wedged
 
 
 def test_watchdog_retries_after_abandoned_worker():
@@ -388,12 +388,12 @@ def test_watchdog_retries_after_abandoned_worker():
     timeout just stopped must retry transparently on a fresh worker —
     never surface a spurious 'stalled' error on a healthy link."""
     chunked_device_get(jnp.ones((4, 4)), piece_timeout=10)  # ensure one
-    worker = offload._PULL_WORKER
+    worker = offload._PULL_POOL.worker
     worker.stop()  # simulate the concurrent-timeout abandonment
     got = chunked_device_get(jnp.ones((4, 4)), piece_timeout=10)
     np.testing.assert_array_equal(got, np.ones((4, 4), np.float32))
-    assert offload._PULL_WORKER is not None
-    assert offload._PULL_WORKER is not worker
+    assert offload._PULL_POOL.worker is not None
+    assert offload._PULL_POOL.worker is not worker
 
 
 def test_fast_probe_passes(monkeypatch):

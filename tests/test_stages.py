@@ -1,0 +1,1013 @@
+"""One fault plane (ISSUE 7): the shared async-stage runtime.
+
+``runtime/stages.py`` is the abstraction the four hand-rolled async
+subsystems (input prefetch, streamed offload uploads, the offload pull
+watchdog, the async checkpoint writer) were ported onto.  Contracts
+these tests pin:
+
+  - the unified chaos spec: ``DS_STAGE_FAULT=stage:point:n[+]`` /
+    ``DS_STAGE_DELAY_S=stage:sec`` arm every stage boundary, and the
+    legacy per-subsystem env vars (``DS_CKPT_FAULT``,
+    ``DS_PREFETCH_DELAY_S``, ``DS_OFFLOAD_H2D_DELAY_S``,
+    ``DS_CKPT_DELAY_S``) keep working as aliases;
+  - the chaos matrix: a TRANSIENT fault (n) at any stage is retried
+    and training stays BITWISE identical to the fault-free run; a
+    STICKY fault (n+) exhausts the stage's failure budget and the
+    stage DEGRADES to its inline/serial equivalent — training runs to
+    completion bitwise-equal to the serial leg, with exactly ONE loud
+    warning and one ``stage_degraded_total`` tick per degraded stage;
+  - THE drain order: ``engine.close()`` drains prefetch -> offload
+    uploads -> ckpt writer -> telemetry flush, idempotently, with
+    everything mid-flight at once (satellite 1);
+  - a StreamingUploader failure after ``close()``/``abort()`` began is
+    surfaced through the stage record into ``engine.last_stage_error``
+    instead of vanishing with the daemon thread (satellite 2);
+  - primitives: Channel poison carries the ORIGINAL exception and
+    queued items drain first; StageWorker restarts a crashed loop;
+    WatchdogPool abandons a wedged worker and replaces it lazily;
+    StageGraph never aborts mid-order and never raises.
+
+Every potentially-blocking wait in this file is bounded by an explicit
+watchdog (``_wait_until`` / timeouts), never by pytest's clock.
+"""
+import logging
+import sys
+import threading
+import time
+
+import numpy as np
+import jax
+import pytest
+
+sys.path.insert(0, "tests")
+
+from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime import offload as offload_mod
+from deepspeed_tpu.runtime import resilience
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime.offload import StreamingUploader
+from deepspeed_tpu.runtime.prefetch import DevicePrefetcher
+from deepspeed_tpu.runtime.stages import (
+    Channel, InjectedStageFault, Stage, StageGraph, WatchdogPool,
+    fault_point, injected_delay, reset_fault_injection, spawn)
+from deepspeed_tpu.utils.logging import logger as ds_logger
+
+from simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 16
+#: bound for every blocking wait in this file (generous; CI is slow)
+WATCHDOG_S = 30.0
+
+_CHAOS_ENVS = ("DS_STAGE_FAULT", "DS_STAGE_DELAY_S", "DS_CKPT_FAULT",
+               "DS_CKPT_DELAY_S", "DS_PREFETCH_DELAY_S",
+               "DS_OFFLOAD_H2D_DELAY_S")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    for env in _CHAOS_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    reset_fault_injection()
+    yield
+    reset_fault_injection()
+
+
+@pytest.fixture
+def ds_caplog(caplog, monkeypatch):
+    """The project logger does not propagate; flip it so caplog sees
+    stage warnings (same idiom as tests/test_offload_xla.py)."""
+    monkeypatch.setattr(ds_logger, "propagate", True)
+    with caplog.at_level(logging.WARNING, logger="DeepSpeedTPU"):
+        yield caplog
+
+
+def _wait_until(pred, timeout=WATCHDOG_S, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"watchdog: {msg} not satisfied in {timeout}s")
+
+
+def _degrade_warnings(caplog, stage_name):
+    return [r for r in caplog.records
+            if r.levelno == logging.WARNING
+            and "DEGRADING" in r.getMessage()
+            and f"stage '{stage_name}'" in r.getMessage()]
+
+
+# ---------------------------------------------------------------------
+# engine helpers (mirrors test_prefetch.py / test_offload_pipeline.py)
+# ---------------------------------------------------------------------
+def _dataset(n, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.standard_normal((n, HIDDEN)).astype(np.float32)
+    return [(xs[i], 0.5 * xs[i]) for i in range(n)]
+
+
+def _plain_engine(cfg_over=None, n_batches=4, seed=3):
+    cfg = base_config(micro_bs=2, grad_acc=1)
+    cfg.update(cfg_over or {})
+    dscfg = DeepSpeedConfig(cfg, world_size=8)
+    mesh = build_mesh()
+    return DeepSpeedEngine(
+        SimpleModel(hidden_dim=HIDDEN), dscfg, mesh=mesh, seed=seed,
+        training_data=_dataset(dscfg.train_batch_size * n_batches))
+
+
+def _offload_engine(cfg_over=None, pipeline=None, seed=0):
+    cfg = base_config(micro_bs=4, grad_acc=1, stage=2)
+    cfg["zero_optimization"].update({"cpu_offload": True,
+                                     "offload_impl": "host"})
+    if pipeline is not None:
+        cfg["zero_optimization"]["offload_pipeline"] = pipeline
+    cfg["steps_per_print"] = 10 ** 9
+    cfg.update(cfg_over or {})
+    dscfg = DeepSpeedConfig(cfg, world_size=1)
+    mesh = build_mesh(dp=1, devices=jax.devices()[:1])
+    return DeepSpeedEngine(SimpleModel(hidden_dim=HIDDEN), dscfg,
+                           mesh=mesh, seed=seed)
+
+
+def _train_loader(engine, steps):
+    return [float(np.asarray(engine.train_batch())) for _ in range(steps)]
+
+
+def _train_batches(engine, steps=4, seed=11):
+    losses = []
+    for b in random_batches(engine.train_batch_size, HIDDEN,
+                            num_batches=steps, seed=seed):
+        losses.append(float(np.asarray(engine.train_batch(b))))
+    return losses
+
+
+def _assert_state_bitwise(e_a, e_b):
+    la, lb = jax.tree.leaves(e_a.state), jax.tree.leaves(e_b.state)
+    assert len(la) == len(lb)
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"state leaf {i}")
+
+
+def _assert_offload_state_bitwise(e_a, e_b):
+    for name, (la, lb) in (
+            ("master", (jax.tree.leaves(e_a.state.master_params),
+                        jax.tree.leaves(e_b.state.master_params))),
+            ("mu", (jax.tree.leaves(e_a.state.opt_state["mu"]),
+                    jax.tree.leaves(e_b.state.opt_state["mu"]))),
+            ("nu", (jax.tree.leaves(e_a.state.opt_state["nu"]),
+                    jax.tree.leaves(e_b.state.opt_state["nu"]))),
+            ("compute", (jax.tree.leaves(e_a._compute_params),
+                         jax.tree.leaves(e_b._compute_params)))):
+        assert len(la) == len(lb)
+        for i, (x, y) in enumerate(zip(la, lb)):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y), err_msg=f"{name}[{i}]")
+
+
+# ---------------------------------------------------------------------
+# unified fault spec + back-compat aliases
+# ---------------------------------------------------------------------
+def test_fault_spec_nth_hit_transient(monkeypatch):
+    monkeypatch.setenv("DS_STAGE_FAULT", "prefetch:place:2")
+    fault_point("prefetch", "place")  # hit 1: armed for 2
+    with pytest.raises(InjectedStageFault):
+        fault_point("prefetch", "place")  # hit 2
+    fault_point("prefetch", "place")  # hit 3: transient, re-armed never
+
+
+def test_fault_spec_sticky(monkeypatch):
+    monkeypatch.setenv("DS_STAGE_FAULT", "s:p:2+")
+    fault_point("s", "p")
+    for _ in range(3):
+        with pytest.raises(InjectedStageFault):
+            fault_point("s", "p")
+
+
+def test_fault_spec_multi_and_malformed(monkeypatch):
+    monkeypatch.setenv("DS_STAGE_FAULT", "a:b:1, garbage, c:d:1+,x:y")
+    with pytest.raises(InjectedStageFault):
+        fault_point("a", "b")
+    with pytest.raises(InjectedStageFault):
+        fault_point("c", "d")
+    fault_point("x", "y")  # malformed entry ignored, never armed
+
+
+def test_fault_injection_is_transient_class(monkeypatch):
+    """The injected fault IS an OSError — the class every retry plane
+    (io_retry, the stage budget) already treats as transient."""
+    monkeypatch.setenv("DS_STAGE_FAULT", "s:p:1")
+    with pytest.raises(OSError):
+        fault_point("s", "p")
+
+
+def test_reset_fault_injection(monkeypatch):
+    monkeypatch.setenv("DS_STAGE_FAULT", "s:p:2")
+    fault_point("s", "p")
+    reset_fault_injection()
+    fault_point("s", "p")  # counting restarted: this is hit 1 again
+    with pytest.raises(InjectedStageFault):
+        fault_point("s", "p")
+
+
+def test_ckpt_fault_alias(monkeypatch):
+    """DS_CKPT_FAULT=<point>:<n>[+] == stage ``ckpt`` in the unified
+    spec, through BOTH the stages API and resilience's historical
+    ``fault_point(point)`` wrapper."""
+    monkeypatch.setenv("DS_CKPT_FAULT", "meta:1+")
+    with pytest.raises(InjectedStageFault):
+        fault_point("ckpt", "meta")
+    with pytest.raises(OSError):
+        resilience.fault_point("meta")
+
+
+def test_unified_spec_wins_over_ckpt_alias(monkeypatch):
+    monkeypatch.setenv("DS_STAGE_FAULT", "ckpt:meta:3")
+    monkeypatch.setenv("DS_CKPT_FAULT", "meta:1+")
+    fault_point("ckpt", "meta")  # unified n=3 wins: hits 1-2 pass
+    fault_point("ckpt", "meta")
+    with pytest.raises(InjectedStageFault):
+        fault_point("ckpt", "meta")
+
+
+def test_delay_aliases(monkeypatch):
+    monkeypatch.setenv("DS_PREFETCH_DELAY_S", "0.25")
+    monkeypatch.setenv("DS_OFFLOAD_H2D_DELAY_S", "0.5")
+    monkeypatch.setenv("DS_CKPT_DELAY_S", "0.75")
+    assert injected_delay("prefetch") == 0.25
+    assert injected_delay("offload_h2d") == 0.5
+    assert injected_delay("ckpt") == 0.75
+    assert injected_delay("other") == 0.0
+    # the unified spec wins over a legacy alias for the same stage
+    monkeypatch.setenv("DS_STAGE_DELAY_S", "prefetch:0.1,offload_pull:1.5")
+    assert injected_delay("prefetch") == 0.1
+    assert injected_delay("offload_pull") == 1.5
+    assert injected_delay("offload_h2d") == 0.5
+
+
+# ---------------------------------------------------------------------
+# Channel
+# ---------------------------------------------------------------------
+def test_channel_fifo_and_bound():
+    ch = Channel(2)
+    assert ch.put(1) and ch.put(2)
+    third_in = threading.Event()
+
+    def producer():
+        ch.put(3)
+        third_in.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not third_in.is_set()  # bounded: parked at capacity
+    assert ch.get(timeout=WATCHDOG_S) == 1
+    _wait_until(third_in.is_set, msg="bounded put released by a get")
+    assert ch.get(timeout=WATCHDOG_S) == 2
+    assert ch.get(timeout=WATCHDOG_S) == 3
+    t.join(WATCHDOG_S)
+
+
+def test_channel_poison_drains_queued_first():
+    ch = Channel(4)
+    ch.put("before")
+    err = ValueError("original")
+    ch.poison(err)
+    assert ch.get(timeout=WATCHDOG_S) == "before"
+    for _ in range(2):  # re-raises the ORIGINAL object, repeatedly
+        with pytest.raises(ValueError) as ei:
+            ch.get(timeout=WATCHDOG_S)
+        assert ei.value is err
+
+
+def test_channel_close_drops_and_releases():
+    ch = Channel(2)
+    ch.put(1)
+    ch.close()
+    assert ch.qsize() == 0  # queued items dropped
+    assert ch.put(2) is False  # producer told to stop
+    with pytest.raises(RuntimeError):
+        ch.get(timeout=WATCHDOG_S)
+    with pytest.raises(TimeoutError):
+        Channel(1).get(timeout=0.05)
+
+
+def test_channel_poison_releases_parked_producer():
+    """The producer side of the documented poison contract: a consumer-
+    side poison must release a producer parked on a full channel (nobody
+    will ever drain it again) and put() must report stop."""
+    ch = Channel(1)
+    assert ch.put(1)
+    stopped, result = threading.Event(), {}
+
+    def producer():
+        result["ok"] = ch.put(2)
+        stopped.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not stopped.is_set()  # parked at capacity
+    ch.poison(ValueError("downstream died"))
+    _wait_until(stopped.is_set, msg="poison released the parked producer")
+    assert result["ok"] is False
+    assert ch.wait_space() is False  # and wait_space agrees
+    t.join(WATCHDOG_S)
+
+
+# ---------------------------------------------------------------------
+# StageWorker: restart-on-crash
+# ---------------------------------------------------------------------
+def test_stage_worker_restarts_crashed_loop():
+    done = threading.Event()
+    attempts = []
+
+    def loop():
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise RuntimeError("boom")
+        done.set()
+
+    spawn(loop, name="t-restart", restarts=1)
+    _wait_until(done.is_set, msg="restarted loop ran")
+    assert len(attempts) == 2
+
+
+def test_stage_worker_dies_after_budget():
+    def loop():
+        raise RuntimeError("always")
+
+    w = spawn(loop, name="t-dies", restarts=1)
+    _wait_until(lambda: not w.is_alive(), msg="worker died")
+
+
+# ---------------------------------------------------------------------
+# Stage: budget, degradation, surfaced errors
+# ---------------------------------------------------------------------
+def test_stage_call_retries_transient_then_succeeds():
+    st = Stage("s", max_failures=3)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient blip")
+        return 7
+
+    assert st.call("pt", fn) == 7
+    assert len(calls) == 3
+    assert st.failures == 2 and not st.degraded
+    # the budget is CONSECUTIVE: the success above reset it
+    calls.clear()
+    assert st.call("pt", fn) == 7
+    assert not st.degraded
+
+
+def test_stage_retry_is_backed_off():
+    """Transient retries are SPACED (doubling from
+    RETRY_BACKOFF_BASE_S): a real blip microseconds long must not burn
+    the whole budget inside its own window and permanently degrade the
+    stage."""
+    from deepspeed_tpu.runtime.stages import RETRY_BACKOFF_BASE_S
+    st = Stage("s", max_failures=3)
+    calls = []
+
+    def fn():
+        calls.append(time.monotonic())
+        if len(calls) < 3:
+            raise OSError("blip")
+        return "ok"
+
+    t0 = time.monotonic()
+    assert st.call("pt", fn) == "ok"
+    # two retries -> base + 2*base of sleep between the three attempts
+    assert time.monotonic() - t0 >= 3 * RETRY_BACKOFF_BASE_S - 0.01
+    assert calls[1] - calls[0] >= RETRY_BACKOFF_BASE_S - 0.01
+    assert calls[2] - calls[1] >= 2 * RETRY_BACKOFF_BASE_S - 0.01
+
+
+def test_shared_stage_sibling_success_cannot_starve_budget():
+    """Two workers share one Stage record (the engine threads ONE
+    'prefetch' Stage through the train AND eval prefetchers): a
+    sibling's interleaved successes reset the shared consecutive
+    counter, but a persistently failing call-site must still exhaust
+    the budget from its OWN attempt count — never retry unbounded
+    (an unbounded watchdog-free wait for its consumer)."""
+    st = Stage("prefetch", max_failures=3)
+    calls = {"n": 0}
+
+    def failing():
+        calls["n"] += 1
+        st.note_ok()  # the sibling worker's interleaved success
+        raise OSError("persistent")
+
+    with pytest.raises(OSError):
+        # 3 in-budget attempts, then the degraded-inline run fails too
+        # and the real error propagates (poison contract)
+        st.call("place", failing)
+    assert st.degraded
+    assert calls["n"] == 4  # bounded: budget + one inline run
+
+
+def test_stage_degrades_after_budget_one_warning(monkeypatch, ds_caplog):
+    monkeypatch.setenv("DS_STAGE_FAULT", "s:pt:1+")
+    counts = {}
+    st = Stage("s", max_failures=3, fallback="the inline path")
+    st.counter_fn = lambda name, help, n: counts.__setitem__(
+        name, counts.get(name, 0) + n)
+    # sticky injection: 3 transient hits exhaust the budget, then the
+    # work runs OUTSIDE the injection plane and succeeds
+    assert st.call("pt", lambda: "ok") == "ok"
+    assert st.degraded
+    assert counts["stage_failures_total"] == 3
+    assert counts["stage_degraded_total"] == 1
+    assert len(_degrade_warnings(ds_caplog, "s")) == 1
+    # degraded: later calls bypass injection entirely, no new warnings
+    assert st.call("pt", lambda: "again") == "again"
+    assert counts["stage_degraded_total"] == 1
+    assert len(_degrade_warnings(ds_caplog, "s")) == 1
+
+
+def test_stage_degraded_still_surfaces_real_errors(monkeypatch):
+    """A genuinely broken resource must not be masked by degradation:
+    the fallback call runs outside the injection plane but its REAL
+    exception propagates."""
+    monkeypatch.setenv("DS_STAGE_FAULT", "s:pt:1+")
+    st = Stage("s", max_failures=1)
+
+    def broken():
+        raise OSError("the disk is really gone")
+
+    with pytest.raises(OSError, match="really gone"):
+        st.call("pt", broken)
+    assert st.degraded
+
+
+def test_stage_non_transient_propagates_untouched():
+    st = Stage("s", max_failures=3)
+    err = ValueError("subsystem poison path")
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise err
+
+    with pytest.raises(ValueError) as ei:
+        st.call("pt", fn)
+    assert ei.value is err
+    assert len(calls) == 1  # no retry: not the runtime's to absorb
+    assert st.failures == 0 and not st.degraded
+
+
+def test_stage_degradation_disabled_raises(monkeypatch):
+    monkeypatch.setenv("DS_STAGE_FAULT", "s:pt:1+")
+    st = Stage("s", max_failures=2, allow_degraded=False)
+    with pytest.raises(InjectedStageFault):
+        st.call("pt", lambda: "never")
+    assert not st.degraded and st.failures == 2
+
+
+def test_stage_surface_and_pop():
+    counts = {}
+    st = Stage("s")
+    st.counter_fn = lambda name, help, n: counts.__setitem__(
+        name, counts.get(name, 0) + n)
+    err = OSError("post-close failure")
+    st.surface(err)
+    assert st.pop_error() is err
+    assert st.pop_error() is None
+    assert counts["stage_errors_total"] == 1
+
+
+def test_stage_broken_counter_hook_never_breaks_stage():
+    st = Stage("s")
+    st.counter_fn = lambda *a: (_ for _ in ()).throw(RuntimeError("hook"))
+    st.surface(OSError("x"))  # must not raise
+    assert isinstance(st.pop_error(), OSError)
+
+
+# ---------------------------------------------------------------------
+# WatchdogPool: abandon-and-replace
+# ---------------------------------------------------------------------
+def test_watchdog_pool_roundtrip_and_persistence():
+    pool = WatchdogPool("t-pool")
+    assert pool.call(lambda: 42, timeout_s=WATCHDOG_S, what="job") == 42
+    first = pool.worker
+    assert pool.call(lambda: 43, timeout_s=WATCHDOG_S, what="job") == 43
+    assert pool.worker is first  # ONE persistent worker across calls
+    pool.stop()
+
+
+def test_watchdog_pool_timeout_abandons_and_replaces():
+    pool = WatchdogPool("t-pool")
+    wedge = threading.Event()
+    with pytest.raises(RuntimeError, match="wedged"):
+        pool.call(lambda: wedge.wait(WATCHDOG_S), timeout_s=0.1,
+                  what="stalled pull")
+    wedged_worker = pool.worker
+    assert wedged_worker is None  # abandoned: next call starts fresh
+    assert pool.call(lambda: 1, timeout_s=WATCHDOG_S, what="job") == 1
+    wedge.set()  # let the abandoned worker's thread exit
+    pool.stop()
+
+
+def test_watchdog_pool_custom_timeout_message():
+    pool = WatchdogPool("t-pool")
+    ev = threading.Event()
+    with pytest.raises(RuntimeError, match="custom diagnosis"):
+        pool.call(lambda: ev.wait(WATCHDOG_S), timeout_s=0.1, what="x",
+                  timeout_msg="custom diagnosis")
+    ev.set()
+    pool.stop()
+
+
+def test_watchdog_pool_error_propagates():
+    pool = WatchdogPool("t-pool")
+    with pytest.raises(ValueError, match="inner"):
+        pool.call(lambda: (_ for _ in ()).throw(ValueError("inner")),
+                  timeout_s=WATCHDOG_S, what="job")
+    pool.stop()
+
+
+def test_offload_pull_chaos_boundary(monkeypatch):
+    """The D2H pull watchdog rides the unified spec: an injected fault
+    surfaces as the transient OSError class; an injected delay trips the
+    real watchdog timeout (abandon-and-replace), not a hang."""
+    x = jax.device_put(np.arange(8, dtype=np.float32))
+    monkeypatch.setenv("DS_STAGE_FAULT", "offload_pull:pull:1")
+    with pytest.raises(InjectedStageFault):
+        offload_mod._watchdog_get(x, timeout_s=WATCHDOG_S)
+    out = offload_mod._watchdog_get(x, timeout_s=WATCHDOG_S)  # recovered
+    np.testing.assert_array_equal(out, np.arange(8, dtype=np.float32))
+    monkeypatch.delenv("DS_STAGE_FAULT")
+    monkeypatch.setenv("DS_STAGE_DELAY_S", "offload_pull:5")
+    with pytest.raises(RuntimeError, match="did not complete"):
+        offload_mod._watchdog_get(x, timeout_s=0.2)
+
+
+# ---------------------------------------------------------------------
+# StageGraph
+# ---------------------------------------------------------------------
+def test_stage_graph_order_and_error_collection():
+    g = StageGraph()
+    ran = []
+    g.register("a", close=lambda: ran.append("a"))
+    g.register("b", close=lambda: (_ for _ in ()).throw(OSError("mid")))
+    g.register("c", close=lambda: ran.append("c"))
+    errors = g.close_all()
+    assert ran == ["a", "c"]  # never aborts mid-order
+    assert [(n, type(e)) for n, e in errors] == [("b", OSError)]
+    assert g.order == ["a", "b", "c"]
+
+
+def test_stage_graph_drain_prefers_drain_fn():
+    g = StageGraph()
+    ran = []
+    g.register("a", close=lambda: ran.append("a-close"),
+               drain=lambda: ran.append("a-drain"))
+    g.register("b", close=lambda: ran.append("b-close"))
+    assert g.drain_all() == []
+    assert ran == ["a-drain", "b-close"]  # drain falls back to close
+
+
+def test_engine_graph_registers_the_documented_order():
+    eng = _plain_engine()
+    try:
+        assert eng._stage_graph.order == [
+            "prefetch", "offload_uploads", "ckpt_writer", "telemetry"]
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------
+# chaos matrix: prefetch
+# ---------------------------------------------------------------------
+def test_prefetch_transient_fault_bitwise(monkeypatch):
+    """A transient placement fault is retried against the SAME drawn
+    batch: losses and state stay bitwise-identical to the fault-free
+    run, and the stage never degrades."""
+    e_ref = _plain_engine()
+    l_ref = _train_loader(e_ref, 4)
+    reset_fault_injection()
+    monkeypatch.setenv("DS_STAGE_FAULT", "prefetch:place:2")
+    e_chaos = _plain_engine()
+    l_chaos = _train_loader(e_chaos, 4)
+    assert l_chaos == l_ref
+    _assert_state_bitwise(e_chaos, e_ref)
+    st = e_chaos._stage_records["prefetch"]
+    assert st.failures == 1 and not st.degraded
+    e_ref.close()
+    e_chaos.close()
+
+
+def test_prefetch_sticky_fault_degrades_bitwise(monkeypatch, ds_caplog,
+                                                tmp_path):
+    """The degradation proof (acceptance): a sticky placement fault
+    exhausts the budget and prefetch falls back to inline iteration —
+    training completes bitwise-equal to the DS_PREFETCH=0 leg, with
+    exactly one warning and one stage_degraded_total tick."""
+    monkeypatch.setenv("DS_PREFETCH", "0")
+    e_ref = _plain_engine()
+    l_ref = _train_loader(e_ref, 4)
+    monkeypatch.delenv("DS_PREFETCH")
+    reset_fault_injection()
+    monkeypatch.setenv("DS_STAGE_FAULT", "prefetch:place:1+")
+    e_chaos = _plain_engine(
+        cfg_over={"telemetry": {"enabled": True,
+                                "output_path": str(tmp_path)}})
+    l_chaos = _train_loader(e_chaos, 4)
+    assert l_chaos == l_ref
+    _assert_state_bitwise(e_chaos, e_ref)
+    st = e_chaos._stage_records["prefetch"]
+    assert st.degraded and st.failures == 3
+    assert len(_degrade_warnings(ds_caplog, "prefetch")) == 1
+    assert e_chaos.telemetry.registry.counter(
+        "stage_degraded_total").value() == 1
+    assert e_chaos.telemetry.registry.counter(
+        "stage_failures_total").value() == 3
+    e_ref.close()
+    e_chaos.close()
+
+
+def test_degraded_inline_failure_keeps_poison_contract():
+    """Inline (degraded) iteration honors the SAME poison contract as
+    the async path: a placement failure re-raises on every later next —
+    a retrying caller must not silently skip the batch the failure
+    consumed (sample-exactness)."""
+    st = Stage("prefetch")
+    st.degraded = True  # pre-degraded: hand-off happens immediately
+
+    def place(b):
+        if b == 1:
+            raise ValueError("inline place died")
+        return b
+
+    pf = DevicePrefetcher(iter(range(3)), place_fn=place, stage=st)
+    assert next(pf) == 0
+    with pytest.raises(ValueError) as ei:
+        next(pf)
+    with pytest.raises(ValueError) as ei2:
+        next(pf)  # the ORIGINAL error again — batch 2 is never served
+    assert ei2.value is ei.value
+    pf.close()
+
+
+def test_worker_escape_poisons_instead_of_stranding(monkeypatch):
+    """An exception ESCAPING the produce loop (outside the draw/place
+    try blocks) poisons the channel: with restarts=0 a silently dead
+    worker would otherwise strand the consumer forever."""
+    monkeypatch.setattr(
+        DevicePrefetcher, "_produce",
+        lambda self: (_ for _ in ()).throw(MemoryError("worker oom")))
+    pf = DevicePrefetcher(iter(range(2)), place_fn=lambda b: b)
+    with pytest.raises(MemoryError, match="worker oom"):
+        next(pf)
+    pf.close()
+
+
+def test_prefetch_custom_budget(monkeypatch):
+    """stages.max_stage_failures=1 degrades on the FIRST transient
+    failure — the config knob reaches the engine's stage records."""
+    monkeypatch.setenv("DS_STAGE_FAULT", "prefetch:place:1+")
+    eng = _plain_engine(cfg_over={"stages": {"max_stage_failures": 1}})
+    assert eng._stage_records["prefetch"].max_failures == 1
+    _train_loader(eng, 2)
+    st = eng._stage_records["prefetch"]
+    assert st.degraded and st.failures == 1
+    eng.close()
+
+
+# ---------------------------------------------------------------------
+# chaos matrix: streamed offload uploads
+# ---------------------------------------------------------------------
+def test_offload_transient_fault_bitwise(monkeypatch):
+    e_ref = _offload_engine(pipeline=True)
+    l_ref = _train_batches(e_ref, 4)
+    reset_fault_injection()
+    monkeypatch.setenv("DS_STAGE_FAULT", "offload_h2d:put:2")
+    e_chaos = _offload_engine(pipeline=True)
+    l_chaos = _train_batches(e_chaos, 4)
+    assert l_chaos == l_ref
+    _assert_offload_state_bitwise(e_chaos, e_ref)
+    st = e_chaos._stage_records["offload_h2d"]
+    assert st.failures == 1 and not st.degraded
+    e_ref.close()
+    e_chaos.close()
+
+
+def test_offload_sticky_fault_degrades_bitwise(monkeypatch, ds_caplog,
+                                               tmp_path):
+    """Sticky upload faults degrade the offload_h2d stage: the step in
+    flight completes inline (no half-swapped tree), and every later
+    step takes the serial update path — bitwise-equal to the
+    offload_pipeline=False leg, one warning, one counter tick."""
+    e_ref = _offload_engine(pipeline=False)
+    l_ref = _train_batches(e_ref, 4)
+    reset_fault_injection()
+    monkeypatch.setenv("DS_STAGE_FAULT", "offload_h2d:put:1+")
+    e_chaos = _offload_engine(
+        pipeline=True,
+        cfg_over={"telemetry": {"enabled": True,
+                                "output_path": str(tmp_path)}})
+    l_chaos = _train_batches(e_chaos, 4)
+    assert l_chaos == l_ref
+    _assert_offload_state_bitwise(e_chaos, e_ref)
+    st = e_chaos._stage_records["offload_h2d"]
+    assert st.degraded and st.failures == 3
+    assert len(_degrade_warnings(ds_caplog, "offload_h2d")) == 1
+    assert e_chaos.telemetry.registry.counter(
+        "stage_degraded_total").value() == 1
+    e_ref.close()
+    e_chaos.close()
+
+
+# ---------------------------------------------------------------------
+# chaos matrix: async checkpoint writer
+# ---------------------------------------------------------------------
+def test_ckpt_writer_sticky_fault_degrades_to_sync(
+        monkeypatch, tmp_path, ds_caplog):
+    """Sticky writer faults fail each async save (surfaced, training
+    continues); exhausting the budget degrades the stage and a save
+    requested async runs SYNC — and succeeds, because the fallback is
+    the path that never had the async machinery."""
+    monkeypatch.setenv("DS_STAGE_FAULT", "ckpt_writer:job:1+")
+    eng = _offload_engine(
+        cfg_over={"telemetry": {"enabled": True,
+                                "output_path": str(tmp_path / "tel")}})
+    _train_batches(eng, 1)
+    for i in range(3):
+        eng.save_checkpoint(str(tmp_path), tag=f"doomed{i}",
+                            async_write=True)
+        err = eng._ckpt_writer.drain(timeout=WATCHDOG_S)
+        assert isinstance(err, InjectedStageFault)
+    st = eng._stage_records["ckpt_writer"]
+    assert st.degraded and st.failures == 3
+    assert len(_degrade_warnings(ds_caplog, "ckpt_writer")) == 1
+    assert eng.telemetry.registry.counter(
+        "stage_degraded_total").value() == 1
+    # degraded: async_write=True is honored as a sync save, which lands
+    eng.save_checkpoint(str(tmp_path), tag="ok", async_write=True)
+    assert eng._ckpt_writer.pop_error() is None
+    eng2 = _offload_engine()
+    path, _ = eng2.load_checkpoint(str(tmp_path), tag="ok")
+    assert path is not None
+    eng.close()
+    eng2.close()
+
+
+def test_ckpt_write_point_via_unified_spec(monkeypatch, tmp_path):
+    """The checkpoint write points answer to the unified spec
+    (DS_STAGE_FAULT=ckpt:<point>:<n>), and a transient hit rides the
+    existing io_retry plane — the save still lands."""
+    monkeypatch.setenv("DS_STAGE_FAULT", "ckpt:meta:1")
+    eng = _offload_engine()
+    _train_batches(eng, 1)
+    eng.save_checkpoint(str(tmp_path), tag="t", async_write=False)
+    eng2 = _offload_engine()
+    path, _ = eng2.load_checkpoint(str(tmp_path), tag="t")
+    assert path is not None
+    eng.close()
+    eng2.close()
+
+
+# ---------------------------------------------------------------------
+# satellite 1: THE drain order, mid-flight on everything at once
+# ---------------------------------------------------------------------
+def test_engine_close_drain_order_mid_flight(monkeypatch, tmp_path):
+    """One close() call drains all four subsystems in THE documented
+    order with everything in flight at once: a running prefetcher, a
+    submitted async save still writing (injected latency), live
+    telemetry.  The order is observed by wrapping the stage-graph
+    entries; the save must LAND (not be dropped), the prefetcher must
+    be closed, telemetry must flush, and a second close() is a no-op."""
+    from deepspeed_tpu.runtime import engine_stages
+
+    order = []
+    for fn_name in ("close_prefetch_stage", "close_upload_stage",
+                    "close_ckpt_stage", "close_telemetry_stage"):
+        real = getattr(engine_stages, fn_name)
+
+        def wrapped(engine, _real=real, _name=fn_name):
+            order.append(_name)
+            return _real(engine)
+
+        monkeypatch.setattr(engine_stages, fn_name, wrapped)
+
+    eng = _plain_engine(cfg_over={
+        "telemetry": {"enabled": True, "output_path": str(tmp_path)}})
+    it = eng._training_iter()
+    assert isinstance(it, DevicePrefetcher)
+    next(it)  # worker live, queue filling
+    monkeypatch.setenv("DS_CKPT_DELAY_S", "0.3")
+    eng.save_checkpoint(str(tmp_path / "ck"), tag="mid",
+                        async_write=True)  # in flight at close time
+    t0 = time.monotonic()
+    eng.close()
+    assert time.monotonic() - t0 < WATCHDOG_S  # drained, not hung
+    assert order == ["close_prefetch_stage", "close_upload_stage",
+                     "close_ckpt_stage", "close_telemetry_stage"]
+    assert it.closed
+    assert eng.last_ckpt_error is None
+    # the in-flight save landed before telemetry flushed
+    eng2 = _plain_engine()
+    path, _ = eng2.load_checkpoint(str(tmp_path / "ck"), tag="mid")
+    assert path is not None
+    eng2.close()
+    assert (tmp_path / "metrics.prom").exists()
+    order.clear()
+    eng.close()  # idempotent: runs the same order, nothing raises
+    assert order == ["close_prefetch_stage", "close_upload_stage",
+                     "close_ckpt_stage", "close_telemetry_stage"]
+
+
+def test_close_pops_errors_surfaced_during_drain(monkeypatch):
+    """A stage failure surfaced DURING the close drain (after the ckpt
+    tick already ran) still lands on the engine: finish_close pops the
+    records — there is no later pre-step tick to do it."""
+    eng = _plain_engine()
+    monkeypatch.setattr(eng, "_ckpt_writer_tick", lambda: None)
+    err = OSError("upload died while close was draining")
+    eng._stage_records["offload_h2d"].surface(err)
+    eng.close()
+    assert eng.last_stage_error is err
+    assert err in eng.stage_errors
+
+
+def test_close_failure_surfaces_and_still_drains(monkeypatch):
+    """A close-time failure (telemetry flush dying) never aborts the
+    drain mid-order: earlier stages still close, the error lands in
+    stage_errors/last_stage_error, and close() re-raises it so an
+    explicit caller sees the shutdown was not clean."""
+    eng = _plain_engine()
+    it = eng._training_iter()
+    next(it)
+    boom = OSError("disk full during trace export")
+    monkeypatch.setattr(eng, "_flush_tensorboard",
+                        lambda: (_ for _ in ()).throw(boom))
+    with pytest.raises(OSError) as ei:
+        eng.close()
+    assert ei.value is boom
+    assert it.closed  # the prefetch stage, earlier in THE order, drained
+    assert eng.last_stage_error is boom
+    assert boom in eng.stage_errors
+
+
+def test_drain_stages_is_a_barrier_not_a_teardown(monkeypatch, tmp_path):
+    """engine.drain_stages() waits out in-flight work (the sync-save /
+    elastic-restart barrier) WITHOUT closing anything: the writer takes
+    another save afterwards and the prefetcher keeps producing."""
+    eng = _plain_engine()
+    it = eng._training_iter()
+    next(it)
+    monkeypatch.setenv("DS_CKPT_DELAY_S", "0.2")
+    eng.save_checkpoint(str(tmp_path), tag="a", async_write=True)
+    assert eng.drain_stages() == []
+    assert not eng._ckpt_writer.in_flight()
+    monkeypatch.delenv("DS_CKPT_DELAY_S")
+    eng.save_checkpoint(str(tmp_path), tag="b", async_write=True)
+    assert eng._ckpt_writer.drain(timeout=WATCHDOG_S) is None
+    next(it)  # prefetcher survived the drain
+    eng.close()
+
+
+# ---------------------------------------------------------------------
+# satellite 2: upload failure after close()/abort() is surfaced
+# ---------------------------------------------------------------------
+def test_upload_failure_after_abort_surfaces():
+    """offload.py used to drop an upload failure on the floor when it
+    landed after abort() (nobody calls finish() then): now it routes
+    through the stage record like last_ckpt_error does."""
+    st = Stage("offload_h2d")
+    started, release = threading.Event(), threading.Event()
+
+    def put(idx, arr):
+        started.set()
+        release.wait(WATCHDOG_S)
+        raise ValueError("in-flight transfer died")  # non-transient
+
+    up = StreamingUploader(put, stage=st)
+    up.submit(0, np.zeros(4))
+    _wait_until(started.is_set, msg="worker entered the put")
+    up.abort()  # close began; finish() will never run
+    release.set()
+    _wait_until(lambda: st.pop_error() is not None,
+                msg="post-abort failure surfaced through the stage")
+    time.sleep(0.1)  # a racing abort-side surface() would re-arm it
+    assert st.pop_error() is None  # surfaced exactly once
+
+
+def test_upload_failure_recorded_before_abort_surfaces():
+    """The other arm: the worker already recorded the failure when
+    abort() arrives — abort surfaces it instead of clearing it, and the
+    worker/abort pair surfaces it exactly ONCE (the counter is the
+    surfaced-error metric; a race must not double it)."""
+    counts = {}
+    st = Stage("offload_h2d")
+    st.counter_fn = lambda name, help, n: counts.__setitem__(
+        name, counts.get(name, 0) + n)
+    failed = threading.Event()
+
+    def put(idx, arr):
+        failed.set()
+        raise ValueError("upload died before abort")
+
+    up = StreamingUploader(put, stage=st)
+    up.submit(0, np.zeros(4))
+    _wait_until(failed.is_set, msg="worker failed")
+    _wait_until(lambda: up._err is not None, msg="failure recorded")
+    up.abort()
+    err = st.pop_error()
+    assert isinstance(err, ValueError)
+    time.sleep(0.1)  # give a racing second surface() the chance to run
+    assert st.pop_error() is None
+    assert counts["stage_errors_total"] == 1
+
+
+def test_finish_claims_error_abort_does_not_double_report():
+    """finish() re-raising a recorded failure claims it under the
+    exactly-once flag: an abort() racing in afterwards (the engine's
+    close path following the step failure) must NOT also surface it
+    through the stage record — one failure, one report."""
+    st = Stage("offload_h2d")
+    failed = threading.Event()
+
+    def put(idx, arr):
+        failed.set()
+        raise ValueError("upload died")
+
+    up = StreamingUploader(put, stage=st)
+    up.submit(0, np.zeros(4))
+    _wait_until(failed.is_set, msg="worker failed")
+    with pytest.raises(ValueError):
+        up.finish()
+    up.abort()
+    time.sleep(0.1)  # a racing abort-side surface() would re-arm it
+    assert st.pop_error() is None  # finish()'s re-raise WAS the report
+
+
+def test_finish_after_concurrent_abort_raises_not_partial():
+    """finish() racing a concurrent abort() (engine.close() from another
+    thread/signal handler mid-step) must raise UploadAborted — NOT
+    return a partial results dict, which would escape the engine's
+    poison path through a bare assert and publish a half-uploaded
+    step."""
+    from deepspeed_tpu.runtime.offload import UploadAborted
+    st = Stage("offload_h2d")
+    started, release = threading.Event(), threading.Event()
+
+    def put(idx, arr):
+        started.set()
+        release.wait(WATCHDOG_S)
+        return arr
+
+    up = StreamingUploader(put, stage=st)
+    up.submit(0, np.zeros(4))
+    up.submit(1, np.zeros(4))  # queued behind the blocked put: dropped
+    _wait_until(started.is_set, msg="worker entered the put")
+    up.abort()  # the close landed mid-step
+    release.set()
+    with pytest.raises(UploadAborted):
+        up.finish()
+    assert st.pop_error() is None  # no failure — just an abort
+
+
+def test_surfaced_stage_error_lands_on_engine_tick():
+    """pop_stage_errors: the pre-step tick moves a surfaced stage
+    failure into engine.last_stage_error — the training thread's
+    advertised surface, like last_ckpt_error."""
+    eng = _plain_engine()
+    assert eng.last_stage_error is None
+    err = OSError("post-close upload failure")
+    eng._stage_records["offload_h2d"].surface(err)
+    eng._ckpt_writer_tick()
+    assert eng.last_stage_error is err
+    # several stages surfacing between two ticks must ALL be retained
+    # (last_stage_error carries the newest; stage_errors keeps every one)
+    err_a = OSError("prefetch post-close failure")
+    err_b = OSError("upload post-close failure")
+    eng._stage_records["prefetch"].surface(err_a)
+    eng._stage_records["offload_h2d"].surface(err_b)
+    eng._ckpt_writer_tick()
+    assert set(eng.stage_errors) >= {err, err_a, err_b}
+    assert eng.last_stage_error in (err_a, err_b)
+    eng.close()
+
+
+# ---------------------------------------------------------------------
+# config block
+# ---------------------------------------------------------------------
+def test_stages_config_default_and_custom():
+    cfg = DeepSpeedConfig(base_config(), world_size=8)
+    assert cfg.stages_config.max_stage_failures == 3
+    cfg = DeepSpeedConfig(
+        base_config(stages={"max_stage_failures": 5}), world_size=8)
+    assert cfg.stages_config.max_stage_failures == 5
+
+
+@pytest.mark.parametrize("bad", [0, -1, "3", True, 2.5, None])
+def test_stages_config_rejects_bad_budget(bad):
+    with pytest.raises(DeepSpeedConfigError, match="max_stage_failures"):
+        DeepSpeedConfig(base_config(stages={"max_stage_failures": bad}),
+                        world_size=8)

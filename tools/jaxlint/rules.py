@@ -543,6 +543,64 @@ class DispatchOnlyTimingRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# JL007 — raw daemon-thread construction outside the stage runtime
+# ---------------------------------------------------------------------------
+
+#: the one module allowed to construct threads — the shared async-stage
+#: runtime every runtime subsystem builds its workers from
+#: (docs/stages.md).  Matched as the FULL package path suffix, not a
+#: basename: neither a future serving/stages.py nor a nested
+#: .../something/runtime/stages.py inherits the exemption.
+_JL007_EXEMPT_SUFFIX = ("deepspeed_tpu", "runtime", "stages.py")
+
+
+@register
+class RawDaemonThreadRule(Rule):
+    id = "JL007"
+    summary = ("raw threading.Thread(daemon=True) outside the stage "
+               "runtime (runtime/stages.py)")
+
+    # Every hand-rolled daemon worker re-invents the same queue/poison/
+    # drain/watchdog semantics, and each copy drifts (the PR 3/PR 5
+    # half-swapped-tree and writer-drain bugs were both instances).
+    # stages.spawn() is the sanctioned constructor: restart-on-crash
+    # policy, JL007-visible, and one place to audit shutdown behavior.
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        parts = os.path.normpath(ctx.path).split(os.sep)
+        if tuple(parts[-3:]) == _JL007_EXEMPT_SUFFIX:
+            return
+        thread_aliases = {"threading.Thread"}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == "threading":
+                for alias in node.names:
+                    if alias.name == "Thread":
+                        thread_aliases.add(alias.asname or "Thread")
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "threading" and alias.asname:
+                        thread_aliases.add(f"{alias.asname}.Thread")
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_text(node) not in thread_aliases:
+                continue
+            daemon = next((kw for kw in node.keywords
+                           if kw.arg == "daemon"), None)
+            if daemon is None or not (
+                    isinstance(daemon.value, ast.Constant)
+                    and daemon.value.value is True):
+                continue
+            yield self.finding(
+                ctx, node,
+                "raw threading.Thread(daemon=True): build workers from "
+                "the shared stage runtime (deepspeed_tpu.runtime.stages."
+                "spawn) so poison/drain/restart semantics stay one "
+                "tested plane")
+
+
+# ---------------------------------------------------------------------------
 # JL101 — config keys cross-checked against constants.py
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Serving bench: continuous batching vs sequential per-request decode.
+
+The claim under test is a SCHEDULING claim, so it is CPU-provable with
+the repo's established fault-injection idiom: ``DS_STAGE_DELAY_S=
+serve:<s>`` charges every serving tick (admission prefill + masked
+decode step) a synthetic device time, the way the prefetch/offload
+benches inject collate/H2D latency.  A slot pool of size S then retires
+up to S tokens per paid tick while the sequential leg (slots=1 — one
+request decoded start-to-finish at a time) pays one tick per token:
+wall-clock speedup ≈ S at saturation, which is exactly the
+continuous-batching win Orca measured on real GPUs (PAPERS.md).
+
+Both legs drive a synthetic open-loop load (arrivals on a fixed
+schedule, independent of completions) through the telemetry hub;
+tokens/s and p50/p99 per-token latency come from the same
+``events.jsonl`` scalars the ``telemetry summarize`` serving row reads.
+
+Emits BENCH_serve.json:
+    {"metric": "serve_continuous_batching_speedup", "value": ...,
+     "batched": {...}, "sequential": {...}}
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _build_model():
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    cfg = GPT2Config(vocab_size=256, n_positions=64, d_model=64,
+                     n_layer=2, n_head=4, remat=None, attn_impl="dense")
+    return GPT2Model(cfg)
+
+
+def run_leg(model, params, *, slots, n_requests, prompt_len, gen_tokens,
+            tick_delay_s, arrival_s, tag):
+    """One leg: serve ``n_requests`` arriving open-loop every
+    ``arrival_s`` seconds, every tick charged ``tick_delay_s`` of
+    synthetic device time through the serve stage's delay knob."""
+    import numpy as np
+    from deepspeed_tpu.inference import ServeEngine
+    from deepspeed_tpu.telemetry.cli import summarize
+
+    import shutil
+    import tempfile
+    tel_dir = tempfile.mkdtemp(prefix=f"bench_serve_tel_{tag}_")
+    prev = os.environ.get("DS_STAGE_DELAY_S")
+    try:
+        eng = ServeEngine(model, {
+            "serving": {"slots": slots, "max_seq_len": 64,
+                        "prefill_len": max(prompt_len, 1),
+                        "flush_interval_ticks": 10},
+            "telemetry": {"enabled": True, "output_path": tel_dir,
+                          "memory": False},
+        }, params=params)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 256, (prompt_len,)).astype(np.int32)
+                   for _ in range(n_requests)]
+        # warm up (compile prefill + decode) BEFORE arming the delay and
+        # the clock: the A/B measures scheduling, not XLA compile time
+        eng.submit(prompts[0], max_new_tokens=2)
+        eng.run_until_idle()
+        os.environ["DS_STAGE_DELAY_S"] = f"serve:{tick_delay_s}"
+        t0 = time.perf_counter()
+        arrivals = [t0 + i * arrival_s for i in range(n_requests)]
+        reqs = []
+        nxt = 0
+        while nxt < n_requests or eng.scheduler.active or eng.queue.qsize():
+            now = time.perf_counter()
+            while nxt < n_requests and arrivals[nxt] <= now:
+                reqs.append(eng.submit(prompts[nxt],
+                                       max_new_tokens=gen_tokens))
+                nxt += 1
+            if not eng.scheduler.active and eng.queue.qsize() == 0:
+                time.sleep(min(0.002, arrival_s))
+                continue
+            eng.step()
+        wall = time.perf_counter() - t0
+        assert all(r.error is None for r in reqs)
+        tokens = sum(len(r.tokens) for r in reqs)
+        eng.close()
+    finally:
+        if prev is None:
+            os.environ.pop("DS_STAGE_DELAY_S", None)
+        else:
+            os.environ["DS_STAGE_DELAY_S"] = prev
+    with open(os.devnull, "w") as devnull:
+        report = summarize(os.path.join(tel_dir, "events.jsonl"),
+                           out=devnull)
+    shutil.rmtree(tel_dir, ignore_errors=True)
+    return {
+        "slots": slots,
+        "requests": n_requests,
+        "tokens": tokens,
+        "wall_s": wall,
+        "tokens_per_s": tokens / wall,
+        "token_p50_s": report.get("serve_token_p50_s"),
+        "token_p99_s": report.get("serve_token_p99_s"),
+    }
+
+
+def run_ab(slots=8, n_requests=16, prompt_len=8, gen_tokens=16,
+           tick_delay_s=0.02, arrival_s=0.0, out_dir="."):
+    """Batched (slot pool) vs sequential (slots=1) under the same load
+    and the same injected per-tick device time."""
+    import jax
+    model = _build_model()
+    params = model.init(jax.random.PRNGKey(0))
+    common = dict(n_requests=n_requests, prompt_len=prompt_len,
+                  gen_tokens=gen_tokens, tick_delay_s=tick_delay_s,
+                  arrival_s=arrival_s)
+    batched = run_leg(model, params, slots=slots, tag="batched", **common)
+    sequential = run_leg(model, params, slots=1, tag="sequential",
+                         **common)
+    rec = {
+        "metric": "serve_continuous_batching_speedup",
+        "value": batched["tokens_per_s"] / sequential["tokens_per_s"],
+        "tick_delay_s": tick_delay_s,
+        "batched": batched,
+        "sequential": sequential,
+    }
+    with open(os.path.join(out_dir, "BENCH_serve.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+    return rec
+
+
+def main():
+    import argparse
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--requests", type=int, default=16)
+    parser.add_argument("--prompt", type=int, default=8)
+    parser.add_argument("--gen", type=int, default=16)
+    parser.add_argument("--delay", type=float, default=0.02,
+                        help="injected per-tick device time (s)")
+    args = parser.parse_args()
+    rec = run_ab(slots=args.slots, n_requests=args.requests,
+                 prompt_len=args.prompt, gen_tokens=args.gen,
+                 tick_delay_s=args.delay)
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,7 @@ from .config import (
     DeepSpeedBF16Config,
     DeepSpeedActivationCheckpointingConfig,
     DeepSpeedSparseAttentionConfig,
+    DeepSpeedServingConfig,
     DeepSpeedPipelineConfig,
     DeepSpeedConfigWriter,
 )

@@ -381,6 +381,55 @@ class DeepSpeedStagesConfig:
                 f"degrades), got {self.max_stage_failures!r}")
 
 
+class DeepSpeedServingConfig:
+    """Serving block (docs/serving.md): the static slot pool the
+    KV-cached decode engine compiles ONE program against.  Everything
+    validates eagerly — a typo'd slot count must fail at config parse,
+    not as a silent recompile storm under production traffic."""
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        sv = param_dict.get(C.SERVING) or {}
+        self.slots = get_scalar_param(
+            sv, C.SERVING_SLOTS, C.SERVING_SLOTS_DEFAULT)
+        self.max_seq_len = get_scalar_param(
+            sv, C.SERVING_MAX_SEQ_LEN, C.SERVING_MAX_SEQ_LEN_DEFAULT)
+        self.prefill_len = get_scalar_param(
+            sv, C.SERVING_PREFILL_LEN, C.SERVING_PREFILL_LEN_DEFAULT)
+        self.decode_impl = get_scalar_param(
+            sv, C.SERVING_DECODE_IMPL, C.SERVING_DECODE_IMPL_DEFAULT)
+        self.queue_capacity = get_scalar_param(
+            sv, C.SERVING_QUEUE_CAPACITY, C.SERVING_QUEUE_CAPACITY_DEFAULT)
+        self.flush_interval_ticks = get_scalar_param(
+            sv, C.SERVING_FLUSH_INTERVAL, C.SERVING_FLUSH_INTERVAL_DEFAULT)
+        self.eos_id = get_scalar_param(
+            sv, C.SERVING_EOS_ID, C.SERVING_EOS_ID_DEFAULT)
+        for name, v, lo in ((C.SERVING_SLOTS, self.slots, 1),
+                            (C.SERVING_MAX_SEQ_LEN, self.max_seq_len, 0),
+                            (C.SERVING_PREFILL_LEN, self.prefill_len, 0),
+                            (C.SERVING_QUEUE_CAPACITY,
+                             self.queue_capacity, 1),
+                            (C.SERVING_FLUSH_INTERVAL,
+                             self.flush_interval_ticks, 1)):
+            if not isinstance(v, int) or isinstance(v, bool) or v < lo:
+                raise DeepSpeedConfigError(
+                    f"serving.{name} must be an int >= {lo}, got {v!r}")
+        if (self.max_seq_len and self.prefill_len
+                and self.prefill_len > self.max_seq_len):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_PREFILL_LEN}={self.prefill_len} "
+                f"exceeds serving.{C.SERVING_MAX_SEQ_LEN}="
+                f"{self.max_seq_len}: a prompt bucket longer than the KV "
+                "capacity can never be admitted")
+        if self.decode_impl not in ("auto", "pallas", "dense"):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_DECODE_IMPL} must be 'auto', "
+                f"'pallas', or 'dense', got {self.decode_impl!r}")
+        if not isinstance(self.eos_id, int) or isinstance(self.eos_id, bool):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_EOS_ID} must be an int token id "
+                f"(-1 = none), got {self.eos_id!r}")
+
+
 class DeepSpeedPipelineConfig:
     def __init__(self, param_dict: Dict[str, Any]):
         pipe = param_dict.get(C.PIPELINE) or {}
@@ -506,6 +555,7 @@ class DeepSpeedConfig:
         self.data_prefetch_config = DeepSpeedDataPrefetchConfig(pd)
         self.checkpoint_config = DeepSpeedCheckpointConfig(pd)
         self.stages_config = DeepSpeedStagesConfig(pd)
+        self.serving_config = DeepSpeedServingConfig(pd)
         self.pipeline_config = DeepSpeedPipelineConfig(pd)
 
         self._solve_batch_triangle()

@@ -418,6 +418,45 @@ STAGES = "stages"
 STAGES_MAX_FAILURES = "max_stage_failures"
 STAGES_MAX_FAILURES_DEFAULT = 3
 
+#############################################
+# Serving / inference engine (TPU extension; docs/serving.md)
+#############################################
+# The KV-cached decode engine with static-shape continuous batching
+# (deepspeed_tpu/inference/).  The reference v0.3.2 ships no inference
+# engine at all; this block configures the slot pool that one compiled
+# decode program serves for arbitrary request mixes.
+SERVING = "serving"
+# fixed number of concurrent request slots — THE static batch shape of
+# the decode program.  Admission/eviction are masked in-place KV
+# updates, never a shape change.
+SERVING_SLOTS = "slots"
+SERVING_SLOTS_DEFAULT = 8
+# per-slot KV capacity (prompt + generated tokens).  0 = the model's
+# n_positions.
+SERVING_MAX_SEQ_LEN = "max_seq_len"
+SERVING_MAX_SEQ_LEN_DEFAULT = 0
+# prompts are right-padded to this static bucket so prefill is ONE
+# compiled program too.  0 = max_seq_len.
+SERVING_PREFILL_LEN = "prefill_len"
+SERVING_PREFILL_LEN_DEFAULT = 0
+# decode attention kernel arm: 'pallas' (single-query flash kernel,
+# interpret mode off-TPU), 'dense' (the jnp reference — the CPU
+# fallback), or 'auto' (follow the model's attn_impl).
+SERVING_DECODE_IMPL = "decode_impl"
+SERVING_DECODE_IMPL_DEFAULT = "auto"
+# bound of the request Channel feeding the slot scheduler; submit()
+# blocks when full (open-loop backpressure).
+SERVING_QUEUE_CAPACITY = "queue_capacity"
+SERVING_QUEUE_CAPACITY_DEFAULT = 128
+# serving ticks between telemetry materializations (tokens/s +
+# per-token latency percentiles land as sync scalars each flush)
+SERVING_FLUSH_INTERVAL = "flush_interval_ticks"
+SERVING_FLUSH_INTERVAL_DEFAULT = 50
+# default end-of-sequence token id finishing a request early; -1 = none
+# (per-request eos_id overrides)
+SERVING_EOS_ID = "eos_id"
+SERVING_EOS_ID_DEFAULT = -1
+
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 PLD_ENABLED = "enabled"
 PLD_ENABLED_DEFAULT = False

@@ -1,0 +1,14 @@
+"""deepspeed_tpu.inference — KV-cached decode engine with static-shape
+continuous batching (docs/serving.md).
+
+The serving half of the framework: the reference v0.3.2 ships no
+inference engine; this package opens the "heavy traffic" workload over
+the existing models — slot-based KV cache (kv_cache.py), Orca-style
+iteration-level scheduling in the static-shape idiom (scheduler.py),
+and the ServeEngine (engine.py) whose ONE compiled decode program
+serves arbitrary request mixes with zero recompiles.
+"""
+from .engine import ServeEngine  # noqa: F401
+from .kv_cache import (KVCacheSpec, cache_partition_specs,  # noqa: F401
+                       cache_shardings, init_cache, shard_cache)
+from .scheduler import Request, SlotScheduler  # noqa: F401

@@ -1,0 +1,494 @@
+"""ServeEngine — the KV-cached decode engine (docs/serving.md).
+
+The serving half of the north star: requests stream through a bounded
+queue into a FIXED pool of decode slots, and two compiled programs
+serve every mix —
+
+  ``prefill``      one request's prompt (right-padded to the static
+                   ``serving.prefill_len`` bucket) → its K/V rows
+                   written into the assigned slot + the first greedy
+                   token.
+  ``decode_step``  ONE masked tick for ALL slots at once: each active
+                   slot's last token in, its next greedy token out, its
+                   K/V appended in place.  Free/finished slots ride
+                   along masked.  Static shapes by construction: the
+                   request mix NEVER changes a program shape, so
+                   ``recompiles_total{program=decode_step}`` stays 0
+                   (asserted by tests/test_inference.py).
+
+Admission/eviction are the continuous-batching moves (Orca, PAPERS.md):
+a finished slot is refilled on the very next tick instead of waiting
+for the batch to drain.  The KV cache pages through the slot layout of
+``kv_cache.py`` — TP-sharded heads, DP-sharded slots — via the
+ordinary mesh plumbing.
+
+Fault plane: the request queue is a stages.py :class:`Channel` and all
+serving work runs under one :class:`Stage` record ("serve", points
+``admit``/``step``), so poison/drain semantics, graceful degradation
+(budget-exhausted → chaos-free direct serving) and the unified
+``DS_STAGE_FAULT``/``DS_STAGE_DELAY_S`` spec apply unchanged — the
+bench's A/B leg injects its synthetic per-tick device time through
+exactly that knob.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config.config import (DeepSpeedConfig, DeepSpeedServingConfig,
+                             DeepSpeedStagesConfig,
+                             DeepSpeedTelemetryConfig)
+from ..parallel.mesh import build_mesh
+from ..runtime.stages import Channel, Stage, StageGraph
+from ..utils.logging import logger
+from .kv_cache import (KVCacheSpec, cache_shardings, init_cache,
+                       shard_cache, validate_cache_mesh)
+from .scheduler import Request, SlotScheduler
+
+
+class _ServeConfigView:
+    """The three config blocks serving needs, from a dict / json path /
+    full DeepSpeedConfig — without dragging in the training-only batch
+    triangle."""
+
+    def __init__(self, src):
+        if isinstance(src, DeepSpeedConfig):
+            self.serving = src.serving_config
+            self.telemetry = src.telemetry_config
+            self.stages = src.stages_config
+            return
+        if isinstance(src, str):
+            with open(src) as f:
+                src = json.load(f)
+        pd = dict(src or {})
+        self.serving = DeepSpeedServingConfig(pd)
+        self.telemetry = DeepSpeedTelemetryConfig(pd)
+        self.stages = DeepSpeedStagesConfig(pd)
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    from ..telemetry.cli import _percentile as p
+    return p(sorted_vals, q)
+
+
+class ServeEngine:
+    """Continuous-batching greedy decode over a GPT-2-family model.
+
+    ``model`` must expose the serving protocol (``GPT2Model`` and its
+    flavors do): ``prefill(params, tokens) -> (logits, k, v)`` and
+    ``decode_step(params, tokens, k, v, lengths, active, impl=...)``.
+    Any decoder exposing that pair serves unchanged; encoder scoring
+    (BERT) maps onto a prefill-only protocol adapter — noted as the
+    follow-up in docs/serving.md.
+    """
+
+    def __init__(self, model, config=None, mesh=None, params=None,
+                 seed: int = 0):
+        self.model = model
+        cfg = _ServeConfigView(config)
+        self.serving_config = cfg.serving
+        mcfg = model.config
+        if mesh is None:
+            # serving default: one replica on one device; pass a
+            # (data, model) mesh for DP/TP serving
+            mesh = build_mesh(pp=1, dp=1, tp=1,
+                              devices=jax.devices()[:1])
+        self.mesh = mesh
+
+        self.max_seq_len = (cfg.serving.max_seq_len
+                            or int(mcfg.n_positions))
+        self.prefill_len = cfg.serving.prefill_len or self.max_seq_len
+        if self.max_seq_len > mcfg.n_positions:
+            raise ValueError(
+                f"serving.max_seq_len={self.max_seq_len} exceeds the "
+                f"model's n_positions={mcfg.n_positions}")
+        if self.prefill_len > self.max_seq_len:
+            raise ValueError(
+                f"serving.prefill_len={self.prefill_len} exceeds "
+                f"max_seq_len={self.max_seq_len}")
+        self.slots = cfg.serving.slots
+        self.eos_id_default = (None if cfg.serving.eos_id < 0
+                               else cfg.serving.eos_id)
+        if cfg.serving.decode_impl == "auto":
+            from ..models.gpt2 import _decode_attn_impl
+            self.decode_impl = _decode_attn_impl(mcfg)
+        else:
+            self.decode_impl = cfg.serving.decode_impl
+
+        # -- params + cache, sharded over the mesh -----------------------
+        if params is None:
+            params = model.init(jax.random.PRNGKey(seed))
+        pspecs = model.param_partition_specs(params)
+        if pspecs is None:
+            pspecs = jax.tree.map(lambda _: P(), params)
+        self._param_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), pspecs,
+            is_leaf=lambda s: isinstance(s, P))
+        self.params = jax.tree.map(jax.device_put, params,
+                                   self._param_shardings)
+        wte = params["wte"] if isinstance(params, dict) else None
+        kv_dtype = wte.dtype if wte is not None else jnp.float32
+        self.cache_spec = KVCacheSpec(
+            layers=mcfg.n_layer, slots=self.slots, heads=mcfg.n_head,
+            max_len=self.max_seq_len, head_dim=mcfg.d_head,
+            dtype=kv_dtype)
+        validate_cache_mesh(mesh, self.cache_spec)
+        self._cache_shardings = cache_shardings(mesh)
+        self.cache = shard_cache(init_cache(self.cache_spec), mesh)
+
+        # -- pallas interpret + ambient mesh scope (the engine idiom) ----
+        from ..ops.pallas.runtime import (interpret_scope,
+                                          mesh_wants_interpret)
+        self._pallas_interpret = mesh_wants_interpret(mesh)
+
+        def _step_scope():
+            stack = contextlib.ExitStack()
+            stack.enter_context(interpret_scope(self._pallas_interpret))
+            if hasattr(jax, "set_mesh"):
+                stack.enter_context(jax.set_mesh(self.mesh))
+            else:
+                stack.enter_context(self.mesh)
+            return stack
+
+        self._pallas_scope = _step_scope
+
+        # -- compiled programs -------------------------------------------
+        rep = NamedSharding(mesh, P())
+
+        def prefill_fn(params, cache, tokens, length, slot):
+            logits, ks, vs = self.model.prefill(params, tokens)
+            new_k = ks[:, 0][:, None].astype(cache["k"].dtype)
+            new_v = vs[:, 0][:, None].astype(cache["v"].dtype)
+            start = (0, slot, 0, 0, 0)
+            k_cache = jax.lax.dynamic_update_slice(cache["k"], new_k,
+                                                   start)
+            v_cache = jax.lax.dynamic_update_slice(cache["v"], new_v,
+                                                   start)
+            lengths = jax.lax.dynamic_update_slice(
+                cache["lengths"], length[None].astype(jnp.int32),
+                (slot,))
+            last = jax.lax.dynamic_index_in_dim(
+                logits, length - 1, axis=1, keepdims=False)[0]
+            first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return ({"k": k_cache, "v": v_cache, "lengths": lengths},
+                    first_tok)
+
+        def decode_fn(params, cache, tokens, active):
+            logits, k, v, new_len = self.model.decode_step(
+                params, tokens, cache["k"], cache["v"],
+                cache["lengths"], active, impl=self.decode_impl)
+            next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return ({"k": k, "v": v, "lengths": new_len}, next_tok)
+
+        self._prefill_fn = jax.jit(
+            prefill_fn, donate_argnums=(1,),
+            out_shardings=(self._cache_shardings, rep))
+        self._decode_fn = jax.jit(
+            decode_fn, donate_argnums=(1,),
+            out_shardings=(self._cache_shardings, rep))
+
+        # -- fault plane: queue as a Channel, work under one Stage -------
+        self.queue = Channel(capacity=cfg.serving.queue_capacity)
+        self.scheduler = SlotScheduler(self.slots)
+        self.stage = Stage(
+            "serve", max_failures=cfg.stages.max_stage_failures,
+            fallback="chaos-free direct serving (injection plane "
+                     "bypassed)")
+        self._graph = StageGraph()
+        self._graph.register("serve_queue", close=self._close_queue,
+                             drain=lambda: None)
+        self._graph.register("telemetry", close=self._close_telemetry,
+                             drain=self._flush)
+
+        # -- telemetry ---------------------------------------------------
+        self.telemetry = None
+        if cfg.telemetry.enabled:
+            import os
+            from ..telemetry.hub import TelemetryHub
+            out = cfg.telemetry.output_path or os.path.join(
+                os.getcwd(), "telemetry")
+            self.telemetry = TelemetryHub(
+                out, trace=cfg.telemetry.trace,
+                compile_events=cfg.telemetry.compile_events,
+                memory=cfg.telemetry.memory,
+                storm_threshold=cfg.telemetry.recompile_storm_threshold)
+            self.telemetry.track_program("decode_step", self._decode_fn)
+            self.telemetry.track_program("prefill", self._prefill_fn)
+            reg = self.telemetry.registry
+            self._tokens_total = reg.counter(
+                "serve_tokens_total", "generated tokens")
+            self._requests_total = reg.counter(
+                "serve_requests_total", "finished requests")
+            self._requests_failed = reg.counter(
+                "serve_requests_failed_total",
+                "requests finished with an error")
+            self._token_seconds = reg.histogram(
+                "serve_token_seconds",
+                "per-token latency (first token = time to first token)")
+            self._active_gauge = reg.gauge(
+                "serve_active_slots", "slots decoding this tick")
+
+            def _stage_counter(name, help, n):
+                reg.counter(name, help).inc(n)
+
+            self.stage.counter_fn = _stage_counter
+
+        self._rid = 0
+        self._ticks = 0
+        self._closed = False
+        self._latencies: deque = deque(maxlen=8192)
+        self._flush_every = cfg.serving.flush_interval_ticks
+        self._last_flush_t = time.perf_counter()
+        self._last_flush_tokens = 0
+        self._tokens_seen = 0
+
+    # -- telemetry helpers ----------------------------------------------
+    def _span(self, name: str, **args):
+        if self.telemetry is None:
+            return contextlib.nullcontext()
+        return self.telemetry.span(name, cat="serve", **args)
+
+    def _count_token(self, latency_s: float):
+        self._tokens_seen += 1
+        self._latencies.append(latency_s)
+        if self.telemetry is not None:
+            self._tokens_total.inc()
+            self._token_seconds.observe(latency_s)
+
+    def _flush(self):
+        """Materialize serving scalars as a telemetry sync event (the
+        summarize CLI's 'serving' row reads exactly these)."""
+        if self.telemetry is None:
+            return
+        now = time.perf_counter()
+        dt = max(now - self._last_flush_t, 1e-9)
+        toks = self._tokens_seen - self._last_flush_tokens
+        lat = sorted(self._latencies)
+        scalars = {"serve_tokens_per_s": toks / dt}
+        p50 = _percentile(lat, 0.50)
+        p99 = _percentile(lat, 0.99)
+        if p50 is not None:
+            scalars["serve_token_p50_s"] = p50
+            scalars["serve_token_p99_s"] = p99
+        self.telemetry.on_sync(step=self._ticks, scalars=scalars)
+        self._last_flush_t = now
+        self._last_flush_tokens = self._tokens_seen
+
+    # -- request intake ---------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 16,
+               eos_id: Optional[int] = None) -> Request:
+        """Enqueue one generation request (blocks on a full queue — the
+        open-loop backpressure point).  Greedy decoding; the first
+        generated token comes from the prefill logits."""
+        if self._closed:
+            raise RuntimeError("ServeEngine is closed")
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.prefill_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the static "
+                f"serving.prefill_len bucket ({self.prefill_len}); "
+                "raise the bucket or truncate the prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self._rid += 1
+        req = Request(rid=self._rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      eos_id=(self.eos_id_default if eos_id is None
+                              else int(eos_id)),
+                      submit_t=time.perf_counter())
+        if not self.queue.put(req):
+            err = self.queue.err
+            raise RuntimeError(
+                "serve queue rejected the request (engine closed or "
+                f"poisoned){': ' + repr(err) if err else ''}")
+        return req
+
+    def _pop_request(self) -> Optional[Request]:
+        with self.queue.cond:
+            if self.queue.items:
+                item = self.queue.items.pop(0)
+                self.queue.cond.notify_all()
+                return item
+            if self.queue.err is not None:
+                raise self.queue.err
+            return None
+
+    # -- admission (prefill) ----------------------------------------------
+    def _admit_one(self, req: Request) -> None:
+        tokens = np.zeros((1, self.prefill_len), np.int32)
+        tokens[0, :len(req.prompt)] = req.prompt
+        length = np.int32(len(req.prompt))
+        with self._span("serve/prefill", rid=req.rid,
+                        prompt_len=len(req.prompt)):
+            with self._pallas_scope():
+                self.cache, first = self._prefill_fn(
+                    self.params, self.cache, tokens, length,
+                    np.int32(self.scheduler.free[0]))
+            first = int(np.asarray(jax.block_until_ready(first)))
+        now = time.perf_counter()
+        slot = self.scheduler.admit(req, now=now)
+        req.kv_len = len(req.prompt)
+        req.tokens.append(first)
+        req.token_times.append(now - req.submit_t)
+        req.last_token = first
+        self._count_token(now - req.submit_t)
+        reason = self.scheduler.finish_reason(req, first,
+                                              self.max_seq_len)
+        if reason is not None:
+            self._finish(slot, reason)
+
+    def _admit(self) -> None:
+        while self.scheduler.has_free():
+            req = self._pop_request()
+            if req is None:
+                return
+            try:
+                self.stage.call("admit", lambda r=req: self._admit_one(r),
+                                path=f"rid={req.rid}")
+            except BaseException as e:
+                req.error = e
+                req.done.set()
+                if not isinstance(e, Exception):
+                    # KeyboardInterrupt / SystemExit are not a
+                    # per-request failure: the cache may have been
+                    # donated into the interrupted call, so poison and
+                    # propagate instead of serving on
+                    self._poison(e)
+                    raise
+                # one bad request must not take the pool down: record
+                # its error and keep serving (Orca-style isolation) —
+                # unless the cache was donated into the failing call, in
+                # which case the engine is broken and must poison
+                if self.telemetry is not None:
+                    self._requests_failed.inc()
+                logger.error("serve: admission of rid=%d failed: %r",
+                             req.rid, e)
+                if not isinstance(self.cache.get("k"), jnp.ndarray) or \
+                        getattr(self.cache["k"], "is_deleted", lambda: False)():
+                    self._poison(e)
+                    raise
+
+    def _finish(self, slot: int, reason: str) -> None:
+        req = self.scheduler.release(slot, reason)
+        req.done.set()
+        if self.telemetry is not None:
+            self._requests_total.inc()
+
+    # -- the decode tick --------------------------------------------------
+    def _decode_tick(self) -> int:
+        active_map = dict(self.scheduler.active)
+        if not active_map:
+            return 0
+        tokens = np.zeros((self.slots,), np.int32)
+        active = np.zeros((self.slots,), bool)
+        for slot, req in active_map.items():
+            tokens[slot] = req.last_token
+            active[slot] = True
+        with self._span("serve/decode_step", active=len(active_map)):
+            with self._pallas_scope():
+                self.cache, next_tok = self._decode_fn(
+                    self.params, self.cache, tokens, active)
+            # the per-token latency point: the pull IS the device sync,
+            # inside the span (transfer-real, JL006-clean)
+            next_host = np.asarray(jax.block_until_ready(next_tok))
+        now = time.perf_counter()
+        produced = 0
+        for slot, req in active_map.items():
+            tok = int(next_host[slot])
+            req.kv_len += 1
+            req.tokens.append(tok)
+            req.token_times.append(now - req.last_t)
+            self._count_token(now - req.last_t)
+            req.last_t = now
+            req.last_token = tok
+            produced += 1
+            reason = self.scheduler.finish_reason(req, tok,
+                                                  self.max_seq_len)
+            if reason is not None:
+                self._finish(slot, reason)
+        return produced
+
+    def step(self) -> int:
+        """One serving tick: admit into free slots, then one masked
+        decode over the whole pool.  Returns tokens produced."""
+        if self._closed:
+            raise RuntimeError("ServeEngine is closed")
+        self._admit()
+        try:
+            n = self.stage.call("step", self._decode_tick)
+        except BaseException as e:
+            self._poison(e)
+            raise
+        if self.telemetry is not None:
+            self._active_gauge.set(len(self.scheduler.active))
+        self._ticks += 1
+        if self._ticks % self._flush_every == 0:
+            self._flush()
+        return n
+
+    def run_until_idle(self, max_ticks: int = 100_000) -> int:
+        """Serve until the queue and every slot are empty.  Returns
+        total tokens produced."""
+        total = 0
+        for _ in range(max_ticks):
+            if not self.scheduler.active and self.queue.qsize() == 0:
+                return total
+            total += self.step()
+        raise RuntimeError(
+            f"serve loop still busy after max_ticks={max_ticks} "
+            f"({len(self.scheduler.active)} active, "
+            f"{self.queue.qsize()} queued)")
+
+    # -- failure + shutdown ----------------------------------------------
+    def _poison(self, err: BaseException) -> None:
+        """A failed decode tick is fatal for every in-flight request:
+        donation means the cache is gone.  Typed propagation — requests
+        and submitters see the ORIGINAL exception."""
+        self.queue.poison(err)
+        for slot in list(self.scheduler.active):
+            req = self.scheduler.release(slot, "error")
+            req.error = err
+            req.done.set()
+            if self.telemetry is not None:
+                self._requests_failed.inc()
+
+    def _close_queue(self):
+        err = RuntimeError("ServeEngine closed")
+        # mark closed and capture the backlog under ONE lock hold: a
+        # submit() racing close() either sees put() return False
+        # (raises to its caller) or its item lands in `items` here and
+        # fails typed — never silently cleared with a hung waiter
+        with self.queue.cond:
+            self.queue.closed = True
+            items = list(self.queue.items)
+            self.queue.items.clear()
+            self.queue.cond.notify_all()
+        for req in items:
+            req.error = err
+            req.done.set()
+
+    def _close_telemetry(self):
+        if self.telemetry is not None:
+            self._flush()
+            self.telemetry.close()
+
+    def close(self):
+        """Idempotent: drain order is queue -> telemetry (docs/
+        serving.md); queued never-admitted requests fail with a typed
+        error instead of hanging their waiters."""
+        if self._closed:
+            return
+        self._closed = True
+        errors = self._graph.close_all()
+        if errors:
+            raise errors[0][1]

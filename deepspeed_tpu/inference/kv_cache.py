@@ -1,0 +1,100 @@
+"""Slot-based KV cache: the device state of the serving engine.
+
+One fixed-shape pytree holds every request's keys/values:
+
+    k, v     [L, S, H, T, Dh]   layer-major, slot-batched
+    lengths  [S] int32          per-slot LIVE length (0 = free slot)
+
+The shapes never change for the life of the engine — admission writes a
+prefilled request's K/V rows into its slot, decode appends one row per
+tick, eviction just zeroes the slot's ``lengths`` entry on the next
+admission (the stale rows are masked by length and never attended; the
+decode kernel hard-zeroes length-0 slots).  That static-shape contract
+is what lets ONE compiled decode program serve arbitrary request mixes
+(docs/serving.md).
+
+Sharding rides the existing mesh plumbing (parallel/mesh.py): heads on
+the ``model`` axis (the same Megatron split the qkv weights declare, so
+each TP shard caches exactly the heads it computes), slots on the
+``data`` axis (replica-parallel serving — the EP/DP batch dimension).
+``lengths`` is replicated: every shard runs the same masking.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheSpec:
+    layers: int
+    slots: int
+    heads: int
+    max_len: int
+    head_dim: int
+    dtype: Any = jnp.float32
+
+    @property
+    def bytes(self) -> int:
+        per = jnp.dtype(self.dtype).itemsize
+        return (2 * self.layers * self.slots * self.heads * self.max_len
+                * self.head_dim * per)
+
+
+def init_cache(spec: KVCacheSpec) -> Dict[str, jnp.ndarray]:
+    """Fresh all-free cache pytree (host zeros; shard with
+    :func:`shard_cache` before handing it to compiled programs)."""
+    shape = (spec.layers, spec.slots, spec.heads, spec.max_len,
+             spec.head_dim)
+    return {
+        "k": jnp.zeros(shape, spec.dtype),
+        "v": jnp.zeros(shape, spec.dtype),
+        "lengths": jnp.zeros((spec.slots,), jnp.int32),
+    }
+
+
+def cache_partition_specs() -> Dict[str, P]:
+    """PartitionSpecs for the cache pytree: slots on ``data``, heads on
+    ``model`` (matching the models' Megatron qkv column split)."""
+    kv = P(None, DATA_AXIS, MODEL_AXIS, None, None)
+    return {"k": kv, "v": kv, "lengths": P()}
+
+
+def cache_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
+    return {name: NamedSharding(mesh, spec)
+            for name, spec in cache_partition_specs().items()}
+
+
+def validate_cache_mesh(mesh: Mesh, spec: KVCacheSpec) -> None:
+    """The slot/head counts must divide their mesh axes — fail at build
+    time with the real story, not as a GSPMD sharding error mid-serve."""
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    if spec.slots % dp != 0:
+        raise ValueError(
+            f"serving.slots={spec.slots} must be divisible by the mesh's "
+            f"data axis ({dp}): slots are the replica-sharded batch "
+            "dimension of the decode program")
+    if spec.heads % tp != 0:
+        raise ValueError(
+            f"model heads={spec.heads} must be divisible by the mesh's "
+            f"model axis ({tp}) to TP-shard the KV cache")
+    for axis in ("pipe", "seq"):
+        if mesh.shape.get(axis, 1) != 1:
+            raise ValueError(
+                f"the serving engine does not shard over the {axis!r} "
+                f"axis (mesh has {axis}={mesh.shape[axis]}); serve on a "
+                "(data, model) mesh")
+
+
+def shard_cache(cache: Dict[str, jnp.ndarray],
+                mesh: Mesh) -> Dict[str, jnp.ndarray]:
+    sh = cache_shardings(mesh)
+    return {name: jax.device_put(leaf, sh[name])
+            for name, leaf in cache.items()}

@@ -1,0 +1,96 @@
+"""Static-shape continuous batching: the host-side slot scheduler.
+
+Orca-style iteration-level scheduling (PAPERS.md) re-expressed in the
+repo's static-shape idiom: the device never sees a batch-size change.
+A fixed pool of ``slots`` decodes every tick; requests are ADMITTED
+into free slots (a prefill writes their K/V rows in place) and EVICTED
+the moment they finish (EOS / max_new_tokens / KV capacity), so a new
+request starts decoding on the very next tick — no waiting for the
+batch to drain, which is the whole continuous-batching win
+(bench_serve.py measures it).
+
+Eviction is pure host bookkeeping: the slot's ``lengths`` entry is
+overwritten by the next admission and the decode program masks the
+stale rows meanwhile.  The device-side mirror of this file is the
+``active`` mask the engine passes into the one compiled decode program.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its lifecycle record."""
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    submit_t: float = 0.0
+    #: generated token ids (the first comes from the prefill logits)
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    #: wall seconds per generated token (first = time-to-first-token)
+    token_times: List[float] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None
+    error: Optional[BaseException] = None
+    slot: Optional[int] = None
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    #: host-side decode bookkeeping (engine-internal)
+    last_token: int = 0
+    last_t: float = 0.0
+    kv_len: int = 0
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the request finishes; raises its error if it
+        failed (typed propagation — the original exception)."""
+        if not self.done.wait(timeout=timeout):
+            raise TimeoutError(
+                f"request {self.rid} not finished after {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+
+class SlotScheduler:
+    """Free-list + active map over the fixed slot pool.  Host-only and
+    engine-thread-confined; the request queue in front of it (a stages
+    Channel) is the concurrent boundary."""
+
+    def __init__(self, slots: int):
+        self.slots = int(slots)
+        self.free: List[int] = list(range(self.slots))
+        self.active: Dict[int, Request] = {}
+
+    def has_free(self) -> bool:
+        return bool(self.free)
+
+    def admit(self, req: Request, now: Optional[float] = None) -> int:
+        slot = self.free.pop(0)
+        req.slot = slot
+        req.last_t = now if now is not None else time.perf_counter()
+        self.active[slot] = req
+        return slot
+
+    def release(self, slot: int, reason: str) -> Request:
+        req = self.active.pop(slot)
+        self.free.append(slot)
+        req.finish_reason = reason
+        req.slot = None
+        return req
+
+    def finish_reason(self, req: Request, token: int,
+                      max_len: int) -> Optional[str]:
+        """Why this just-emitted token ends the request (None = keep
+        decoding): EOS, the per-request generation budget, or the
+        slot's KV capacity (the static-shape hard stop)."""
+        if req.eos_id is not None and token == req.eos_id:
+            return "eos"
+        if len(req.tokens) >= req.max_new_tokens:
+            return "length"
+        if req.kv_len >= max_len:
+            return "kv_capacity"
+        return None

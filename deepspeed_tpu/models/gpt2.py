@@ -212,6 +212,19 @@ class GPT2Model(TrainModule):
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
         return jnp.mean(nll)
 
+    # ---------------- serving entry points ----------------
+    def prefill(self, params, tokens):
+        """Inference forward that also returns every layer's K/V (the
+        serving cache fill) — see ``gpt2_prefill``."""
+        return gpt2_prefill(self.config, params, tokens)
+
+    def decode_step(self, params, tokens, k_cache, v_cache, lengths,
+                    active, impl: Optional[str] = None):
+        """One masked decode tick over the slot KV cache — see
+        ``gpt2_decode_step``."""
+        return gpt2_decode_step(self.config, params, tokens, k_cache,
+                                v_cache, lengths, active, impl=impl)
+
     # ---------------- param-streaming declaration ----------------
     def streaming_param_spec(self, params):
         """The stacked block leaves stream (one layer per scan tick);
@@ -297,13 +310,13 @@ def gpt2_ffn(bp, h):
     return h @ bp["proj_w"].astype(h.dtype) + bp["proj_b"].astype(h.dtype)
 
 
-def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
-    """ln1 → attention → residual (the block minus its FFN sublayer)."""
+def gpt2_qkv_heads(cfg: GPT2Config, bp, x):
+    """ln1 → fused qkv → per-head split, [B, H, T, Dh] each — the
+    attention sublayer's input math, shared by the training sublayer and
+    the serving prefill/decode paths (they must stay bit-identical or
+    the decode cache silently diverges from the training forward)."""
     B, T, D = x.shape
     H, Dh = cfg.n_head, cfg.d_head
-    r1, r2 = jax.random.split(rng)
-    drop = cfg.dropout if train else 0.0
-
     h = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
     # contraction keeps q/k/v on a dedicated unsharded dim — slicing it is
     # local under TP (see the qkv_w layout note in GPT2Model.init)
@@ -314,13 +327,34 @@ def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
     def heads(t):
         return t.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
 
+    return heads(q), heads(k), heads(v)
+
+
+def gpt2_attn_project(bp, x, attn, drop: float, rng):
+    """heads → output projection → residual (the sublayer's tail,
+    shared with the serving paths; ``rng`` may be None when drop=0)."""
+    B, H, T, Dh = attn.shape
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
+    attn = attn @ bp["out_w"].astype(x.dtype) + bp["out_b"].astype(x.dtype)
+    return x + _dropout(attn, drop, rng)
+
+
+def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
+    """ln1 → attention → residual (the block minus its FFN sublayer)."""
+    B, T, D = x.shape
+    H, Dh = cfg.n_head, cfg.d_head
+    r1, r2 = jax.random.split(rng)
+    drop = cfg.dropout if train else 0.0
+
+    q, k, v = gpt2_qkv_heads(cfg, bp, x)
+
     if cfg.attn_impl == "flash":
         # Pallas flash kernel (prob-dropout fused in-kernel).
         from ..ops.pallas.flash_attention import mha
-        attn = mha(heads(q), heads(k), heads(v),
+        attn = mha(q, k, v,
                    dropout_rate=drop, dropout_rng=r1, causal=True)
     elif cfg.attn_impl == "dense":
-        attn = causal_attention(heads(q), heads(k), heads(v),
+        attn = causal_attention(q, k, v,
                                 dropout_rate=drop, dropout_rng=r1)
     elif cfg.attn_impl in ("ring", "ulysses"):
         # sequence-parallel attention over the mesh's 'seq' axis: manual
@@ -375,22 +409,166 @@ def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
                 in_specs=(spec, spec, spec, P(), P(SEQ_AXIS)),
                 out_specs=spec,
                 axis_names={SEQ_AXIS}, check_vma=False)
-            attn = fn(heads(q), heads(k), heads(v), seed,
-                      jnp.arange(sp, dtype=jnp.int32))
+            attn = fn(q, k, v, seed, jnp.arange(sp, dtype=jnp.int32))
         else:  # mesh has no seq shards: dense attention, same hash mask
             keep = None
             if drop > 0.0:
                 from ..ops.pallas.flash_attention import dense_keep_mask
                 keep = dense_keep_mask(B, H, T, T, seed, drop)
-            attn = causal_attention(heads(q), heads(k), heads(v),
+            attn = causal_attention(q, k, v,
                                     dropout_rate=drop, dropout_keep=keep)
     else:
         raise ValueError(
             f"attn_impl={cfg.attn_impl!r}: expected 'flash', 'dense', "
             "'ring', or 'ulysses'")
-    attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
-    attn = attn @ bp["out_w"].astype(h.dtype) + bp["out_b"].astype(h.dtype)
-    return x + _dropout(attn, drop, r2)
+    return gpt2_attn_project(bp, x, attn, drop, r2)
+
+
+# ---------------------------------------------------------------------------
+# serving paths: prefill + step-decode over a slot KV cache
+# (deepspeed_tpu/inference/ — docs/serving.md).  These REUSE the block
+# helpers above (gpt2_qkv_heads / gpt2_attn_project / gpt2_ffn /
+# _layer_norm) so a step-decoded token's logits match the training
+# forward's logits at the same position: the prefill==decode parity
+# tests (tests/test_inference.py) pin fp32 bitwise on the dense path.
+# ---------------------------------------------------------------------------
+
+
+def _decode_attn_impl(cfg: GPT2Config) -> str:
+    """Map the training attention impl onto the decode kernel arm."""
+    if cfg.attn_impl == "flash":
+        return "pallas"
+    if cfg.attn_impl == "dense":
+        return "dense"
+    raise NotImplementedError(
+        f"attn_impl={cfg.attn_impl!r} has no serving decode path; serve "
+        "with 'flash' or 'dense' (sequence-parallel attention shards the "
+        "time axis the decode cache does not have)")
+
+
+def gpt2_block_prefill(cfg: GPT2Config, bp, x):
+    """One block at inference (train=False — every dropout is a no-op),
+    additionally returning the per-head K/V for the serving cache."""
+    q, k, v = gpt2_qkv_heads(cfg, bp, x)
+    if cfg.attn_impl == "flash":
+        from ..ops.pallas.flash_attention import flash_attention
+        attn = flash_attention(q, k, v, causal=True)
+    elif cfg.attn_impl == "dense":
+        attn = causal_attention(q, k, v)
+    else:
+        _decode_attn_impl(cfg)  # raises with the real story
+    x = gpt2_attn_project(bp, x, attn, 0.0, None)
+    h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    return x + gpt2_ffn(bp, h), (k, v)
+
+
+def _cache_write(cache, new, pos, active):
+    """Masked in-place write of one token's K (or V) rows into the slot
+    cache: ``cache[s, :, pos[s]] = new[s]`` where ``active[s]``; inactive
+    slots write their OLD value back (a pure no-op), so one static-shape
+    program serves any admission/eviction mix.  cache [S, H, T, Dh],
+    new [S, H, Dh], pos [S] int32 (clipped), active [S] bool."""
+    S, H, T, Dh = cache.shape
+    s_idx = jnp.arange(S)
+    pos = jnp.clip(pos, 0, T - 1)
+    old = cache[s_idx, :, pos]                          # [S, H, Dh]
+    blended = jnp.where(active[:, None, None], new.astype(cache.dtype),
+                        old)
+    return cache.at[s_idx, :, pos].set(blended)
+
+
+def gpt2_block_decode(cfg: GPT2Config, bp, x, k_cache, v_cache,
+                      positions, att_len, active, impl: str):
+    """One block for a single decode tick: x [S, 1, D] (one new token
+    per slot); writes the token's K/V at ``positions`` (masked by
+    ``active``) then attends over ``att_len`` live keys per slot."""
+    q, k, v = gpt2_qkv_heads(cfg, bp, x)                # [S, H, 1, Dh]
+    k_cache = _cache_write(k_cache, k[:, :, 0], positions, active)
+    v_cache = _cache_write(v_cache, v[:, :, 0], positions, active)
+    from ..ops.pallas.decode_attention import decode_attention
+    attn = decode_attention(q[:, :, 0], k_cache, v_cache, att_len,
+                            impl=impl)                  # [S, H, Dh]
+    x = gpt2_attn_project(bp, x, attn[:, :, None, :], 0.0, None)
+    h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    return x + gpt2_ffn(bp, h), k_cache, v_cache
+
+
+def gpt2_prefill(cfg: GPT2Config, params, tokens):
+    """tokens [B, T] int32 → (logits [B, T, V], k, v [L, B, H, T, Dh]).
+
+    The inference forward (train=False numerics of ``GPT2Model.apply``)
+    that also materializes every layer's K/V for the serving cache.
+    Causal masking means positions beyond a prompt's live length only
+    contaminate THEIR OWN rows — the cache masks them by length."""
+    B, T = tokens.shape
+    if T > cfg.n_positions:
+        raise ValueError(
+            f"sequence length {T} exceeds n_positions={cfg.n_positions}")
+    x = params["wte"][tokens] + params["wpe"][:T][None]
+    block_params = params["blocks"]
+    if cfg.scan_layers:
+        def body(x, bp):
+            return gpt2_block_prefill(cfg, bp, x)
+        x, (ks, vs) = jax.lax.scan(body, x, block_params)
+    else:
+        ks_l, vs_l = [], []
+        for i in range(cfg.n_layer):
+            bp = jax.tree.map(lambda a, i=i: a[i], block_params)
+            x, (kk, vv) = gpt2_block_prefill(cfg, bp, x)
+            ks_l.append(kk)
+            vs_l.append(vv)
+        ks, vs = jnp.stack(ks_l), jnp.stack(vs_l)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    logits = x @ params["wte"].astype(x.dtype).T
+    return logits, ks, vs
+
+
+def gpt2_decode_step(cfg: GPT2Config, params, tokens, k_cache, v_cache,
+                     lengths, active, impl: Optional[str] = None):
+    """One decode tick for every slot at once (static shapes — the ONE
+    compiled decode program of docs/serving.md).
+
+    tokens [S] int32 — each slot's last emitted/prompt token;
+    k_cache/v_cache [L, S, H, T, Dh]; lengths [S] int32 — live KV length
+    BEFORE this token; active [S] bool — slots actually decoding this
+    tick (free/finished slots compute masked no-ops).
+
+    Returns (logits [S, V], k_cache, v_cache, new_lengths): logits for
+    the NEXT token of each active slot; inactive slots' logits are
+    garbage-but-finite and must be ignored by the caller."""
+    if impl is None:
+        impl = _decode_attn_impl(cfg)
+    T = k_cache.shape[3]
+    lengths = lengths.astype(jnp.int32)
+    positions = jnp.clip(lengths, 0, min(T, cfg.n_positions) - 1)
+    x = (params["wte"][tokens][:, None, :]
+         + params["wpe"][positions][:, None, :])
+    # live keys this tick INCLUDE the token being decoded; free slots
+    # attend nothing (exact-zero attention rows)
+    att_len = jnp.where(active, lengths + 1, 0).astype(jnp.int32)
+    block_params = params["blocks"]
+    if cfg.scan_layers:
+        def body(x, xs):
+            bp, kc, vc = xs
+            x, kc, vc = gpt2_block_decode(cfg, bp, x, kc, vc, positions,
+                                          att_len, active, impl)
+            return x, (kc, vc)
+        x, (k_cache, v_cache) = jax.lax.scan(
+            body, x, (block_params, k_cache, v_cache))
+    else:
+        kc_l, vc_l = [], []
+        for i in range(cfg.n_layer):
+            bp = jax.tree.map(lambda a, i=i: a[i], block_params)
+            x, kc, vc = gpt2_block_decode(cfg, bp, x, k_cache[i],
+                                          v_cache[i], positions,
+                                          att_len, active, impl)
+            kc_l.append(kc)
+            vc_l.append(vc)
+        k_cache, v_cache = jnp.stack(kc_l), jnp.stack(vc_l)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    logits = (x @ params["wte"].astype(x.dtype).T)[:, 0]
+    new_lengths = lengths + active.astype(jnp.int32)
+    return logits, k_cache, v_cache, new_lengths
 
 
 def _layer_norm(x, scale, bias, eps: float = 1e-5):

@@ -6,3 +6,5 @@ differential test, mirroring the reference's kernel-vs-HuggingFace test
 strategy (reference: tests/unit/test_cuda_forward.py).
 """
 from .flash_attention import flash_attention  # noqa: F401
+from .decode_attention import (decode_attention,  # noqa: F401
+                               decode_attention_reference)

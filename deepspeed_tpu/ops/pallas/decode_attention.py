@@ -1,0 +1,205 @@
+"""Single-query flash attention over a slot KV cache (the decode path).
+
+The training kernel (flash_attention.py) masks with STATIC lengths; the
+serving engine needs the opposite shape: one new query token per slot
+against that slot's cached keys, with PER-SLOT live lengths that change
+every tick and therefore must be TRACED — no static length may leak
+into the program or the one-compiled-decode-program contract
+(docs/serving.md, jaxlint JL005) is gone.
+
+Layout: the cache is slot-major ``[S, H, T, Dh]`` and the kernel runs a
+``(S·H, k_blocks)`` grid — each grid row streams one (slot, head)'s key
+blocks through VMEM with the same online-softmax accumulator as the
+training kernel.  The single query travels as an 8-row sublane
+broadcast (TPU block shapes need (8, 128k) tiles — the lse trick from
+the training kernel); the per-slot length travels the same way as a
+broadcast int32 tile, indexed per grid row.  Keys at or beyond a slot's
+live length are hard-masked with the validity floor, and a slot with
+length 0 (a free slot riding along in the static batch) outputs exact
+zeros — the mis-masking discipline the training kernel's kv_length arm
+enforces, here with traced lengths.
+
+Compute for blocks entirely beyond a slot's length is skipped
+(``pl.when``), but their HBM->VMEM streaming is not: block index maps
+are grid-index functions and cannot read traced lengths, so a short
+slot still pays full-cache bandwidth.  A scalar-prefetch grid (the
+paged-attention trick) can reclaim that; on the CPU/interpret tier this
+is irrelevant and the simple grid keeps the kernel in the family the
+round-3 hardware notes proved out.
+
+``impl='dense'`` is the interpretable reference fallback: the same
+masking semantics in plain jnp, the differential-test oracle and the
+serving engine's CPU path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF, _pad_seq
+
+
+def _use_interpret() -> bool:
+    from .runtime import use_interpret
+    return use_interpret()
+
+
+def decode_attention_reference(q, k, v, lengths, sm_scale=None):
+    """Dense jnp reference: q [S, H, Dh] against k/v [S, H, T, Dh]
+    masked to per-slot ``lengths`` [S] (int32).  Rows with length 0
+    return exact zeros.  Deliberately mirrors ``ops.attention.
+    causal_attention`` op for op (finfo.min mask fill, jax.nn.softmax,
+    probs cast to q.dtype before the value matmul) so a dense-path
+    decode step is fp32-BITWISE against the training forward — the
+    parity bar of tests/test_inference.py."""
+    S, H, T, Dh = k.shape
+    scale = _default_scale(Dh) if sm_scale is None else sm_scale
+    s = jnp.einsum("shd,shtd->sht", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    valid = (jnp.arange(T, dtype=jnp.int32)[None, None, :]
+             < lengths.astype(jnp.int32)[:, None, None])
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+    s = jnp.where(valid, s, neg)
+    probs = jax.nn.softmax(s, axis=-1)
+    # all-masked rows (free slots): softmax renormalizes over masked
+    # keys — hard-zero them instead of silently attending
+    probs = jnp.where(lengths[:, None, None] > 0, probs, 0.0)
+    probs = probs.astype(q.dtype)
+    return jnp.einsum("sht,shtd->shd", probs, v)
+
+
+def _default_scale(d: int) -> float:
+    """1/sqrt(d) computed in fp32 — the exact constant
+    ``causal_attention`` uses, so dense decode vs training forward stays
+    bitwise (the python-float ``d ** -0.5`` can differ by 1 ulp)."""
+    import numpy as np
+    return float(np.float32(1.0) / np.sqrt(np.float32(d)))
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref,
+                   m_scr, l_scr, acc_scr,
+                   *, sm_scale: float, block_k: int):
+    jk = pl.program_id(1)
+    nk = pl.num_programs(1)
+    length = len_ref[0][0, 0]                           # this row's slot
+
+    @pl.when(jk == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # whole k block at or beyond the live length: nothing to do
+    @pl.when(jk * block_k < length)
+    def _compute():
+        q = q_ref[0]                                    # [8, d] broadcast
+        k = k_ref[0]                                    # [bk, d]
+        v = v_ref[0]                                    # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [8, bk]
+        k_ids = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+            + jk * block_k
+        s = jnp.where(k_ids < length, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # at least one key of this block is live (the pl.when guard), so
+        # m_new is a real score and the masked keys' exp underflows to 0
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        # length 0 → no block ran → l == 0 → exact zeros (free slots)
+        o_ref[0] = jnp.where(l == 0.0, 0.0,
+                             acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _decode_pallas(q, k, v, lengths, *, sm_scale, block_k, interpret):
+    S, H, T, Dh = k.shape
+    block_k = min(block_k, max(T, 8))
+    kf = _pad_seq(k.reshape(S * H, T, Dh), block_k, 1)
+    vf = _pad_seq(v.reshape(S * H, T, Dh), block_k, 1)
+    nk = kf.shape[1] // block_k
+    # single query as an 8-row sublane broadcast (TPU tile rule)
+    qf = jnp.broadcast_to(q.reshape(S * H, 1, Dh), (S * H, 8, Dh))
+    # per-slot lengths as a broadcast (8, 128) int32 tile per slot —
+    # the same sublane-broadcast trick as the training kernel's key
+    # mask (_kmask_args); index map picks row g's slot with a static
+    # division (grid-index arithmetic only)
+    len_op = jnp.broadcast_to(
+        lengths.astype(jnp.int32).reshape(S, 1, 1), (S, 8, 128))
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=sm_scale,
+                          block_k=block_k),
+        grid=(S * H, nk),
+        in_specs=[
+            pl.BlockSpec((1, 8, Dh), lambda g, j: (g, 0, 0)),
+            pl.BlockSpec((1, block_k, Dh), lambda g, j: (g, j, 0)),
+            pl.BlockSpec((1, block_k, Dh), lambda g, j: (g, j, 0)),
+            pl.BlockSpec((1, 8, 128), lambda g, j: (g // H, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 8, Dh), lambda g, j: (g, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((S * H, 8, Dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, Dh), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, len_op)
+    return out[:, 0, :].reshape(S, H, Dh)
+
+
+def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     lengths: jnp.ndarray,
+                     sm_scale: Optional[float] = None,
+                     block_k: int = 256,
+                     impl: str = "pallas",
+                     interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Single-query attention over a slot KV cache (not differentiable —
+    the decode path never backprops).
+
+    q: [S, H, Dh] — one new query token per slot.
+    k, v: [S, H, T, Dh] — the slot cache; positions >= lengths[s] are
+        garbage (evicted requests, uninitialized tail) and are
+        hard-masked.
+    lengths: [S] int32, TRACED — per-slot live KV length including the
+        position this query's K/V was just written to.  0 = free slot →
+        exact-zero output.
+
+    ``impl``: 'pallas' (the kernel; interpret mode off-TPU) or 'dense'
+    (the jnp reference — the serving engine's CPU fallback and the
+    test oracle).
+    """
+    assert q.ndim == 3 and k.ndim == 4, (q.shape, k.shape)
+    S, H, T, Dh = k.shape
+    assert q.shape == (S, H, Dh), (q.shape, k.shape)
+    if sm_scale is None:
+        sm_scale = _default_scale(Dh)
+    if impl == "dense":
+        return decode_attention_reference(q, k, v, lengths,
+                                          sm_scale=sm_scale)
+    if impl != "pallas":
+        raise ValueError(
+            f"decode_attention impl={impl!r}: expected 'pallas' or "
+            "'dense'")
+    if interpret is None:
+        interpret = _use_interpret()
+    return _decode_pallas(q, k, v, lengths.astype(jnp.int32),
+                          sm_scale=sm_scale, block_k=block_k,
+                          interpret=interpret)

@@ -47,6 +47,17 @@ NEG_INF = -1e30
 # dropped probability underflows to 0 after the lse subtraction, finite
 # so masked-out score arithmetic never produces inf - inf = nan
 NEG_MASK = -1e9
+# a row whose running max never rose above this had NO genuinely valid
+# key (real scores are O(|q||k|/sqrt(d)) — nowhere near -5e8): every key
+# was dropped by the additive mask (<= NEG_MASK) or the validity floor
+# (NEG_INF).  Such rows are HARD-ZEROED at finalize instead of silently
+# renormalizing over masked keys (the mis-masking hazard: an all-masked
+# key_mask row, or kv_length=0, previously attended to the max-scoring
+# MASKED key / the mean of V).  Their lse is set to +DEAD_LSE so the
+# backward kernels' p = exp(s - lse) underflows to exactly 0 — zero
+# gradients, consistent with the zero output.
+DEAD_ROW_THRESH = NEG_MASK * 0.5
+DEAD_LSE = 1e30
 
 
 def _use_interpret() -> bool:
@@ -221,14 +232,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, bh_ref, kmask_ref,
     def _finalize():
         l = l_scr[:, 0:1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # dead rows (every key masked — all-masked key_mask row, or all
+        # keys beyond kv_length) hard-zero instead of renormalizing over
+        # masked keys; their lse goes to +DEAD_LSE so backward p
+        # underflows to 0 and the gradients are zero too
+        dead = m_scr[:, 0:1] <= DEAD_ROW_THRESH         # [bq, 1]
+        o_ref[0] = jnp.where(dead, 0.0,
+                             acc_scr[:] / l_safe).astype(o_ref.dtype)
         # lse output is q-blocked with a sublane-padded layout
         # [bh, nq, 8, block_q]: every store is a whole (8, block_q) tile at
         # lane offset 0.  Mosaic rejects dynamic lane offsets that are not
         # provably 128-aligned (iq*block_q is not, for block_q < 128), and
         # TPU block shapes need their last two dims (sublane, lane) to be
         # (8k, 128k) or the full array dims — the 8-row broadcast buys both.
-        lse = m_scr[:, 0] + jnp.log(l_safe[:, 0])       # [bq]
+        lse = jnp.where(dead[:, 0], DEAD_LSE,
+                        m_scr[:, 0] + jnp.log(l_safe[:, 0]))  # [bq]
         lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, block_q))
 
 
@@ -272,9 +290,14 @@ def _kmask_args(kmask, bh, tk_p, block_k, k_block_of):
 
 
 def _fwd(q, k, v, seed, bh_base, kmask, *, sm_scale, causal, block_q,
-         block_k, dropout_rate, bh_period, bh_stride, interpret):
+         block_k, dropout_rate, bh_period, bh_stride, interpret,
+         kv_length=None):
     bh, t, d = q.shape
     tk = k.shape[1]
+    # live-KV clamp: keys >= kv_length are hard-masked via the validity
+    # floor (the KV-cache decode hazard — a cache tail past the live
+    # length must never be attended); rows left with no valid key zero
+    seq_len = tk if kv_length is None else int(kv_length)
     block_q = min(block_q, max(t, 8))
     block_k = min(block_k, max(tk, 8))
     qp = _pad_seq(q, block_q, 1)
@@ -293,7 +316,7 @@ def _fwd(q, k, v, seed, bh_base, kmask, *, sm_scale, causal, block_q,
         kmask, bh, tk_p, block_k, k_block_of)
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_len=tk,
+        block_q=block_q, block_k=block_k, seq_len=seq_len,
         dropout_rate=dropout_rate, bh_period=bh_period,
         bh_stride=bh_stride, use_kmask=use_kmask)
     # clamp the K/V block index at the causal diagonal: skipped
@@ -449,9 +472,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd(q, k, v, out, lse, do, seed, bh_base, kmask, *, sm_scale,
          causal, block_q, block_k, dropout_rate, bh_period, bh_stride,
-         interpret):
+         interpret, kv_length=None):
     bh, t, d = q.shape
     tk = k.shape[1]
+    seq_len = tk if kv_length is None else int(kv_length)  # see _fwd
     block_q = min(block_q, max(t, 8))
     block_k = min(block_k, max(tk, 8))
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
@@ -491,7 +515,7 @@ def _bwd(q, k, v, out, lse, do, seed, bh_base, kmask, *, sm_scale,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=tk,
+                          block_q=block_q, block_k=block_k, seq_len=seq_len,
                           dropout_rate=dropout_rate,
                           bh_period=bh_period, bh_stride=bh_stride,
                           use_kmask=use_kmask),
@@ -528,7 +552,7 @@ def _bwd(q, k, v, out, lse, do, seed, bh_base, kmask, *, sm_scale,
         kmask, bh, tk_p, block_k, lambda b, i, j: i)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=tk,
+                          block_q=block_q, block_k=block_k, seq_len=seq_len,
                           dropout_rate=dropout_rate,
                           bh_period=bh_period, bh_stride=bh_stride,
                           use_kmask=use_kmask),
@@ -552,33 +576,37 @@ def _bwd(q, k, v, out, lse, do, seed, bh_base, kmask, *, sm_scale,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13))
+                   nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13, 14))
 def _flash(q, k, v, seed, bh_base, kmask, sm_scale, causal, block_q,
-           block_k, dropout_rate, bh_period, bh_stride, interpret):
+           block_k, dropout_rate, bh_period, bh_stride, interpret,
+           kv_length):
     out, _ = _fwd(q, k, v, seed, bh_base, kmask, sm_scale=sm_scale,
                   causal=causal, block_q=block_q, block_k=block_k,
                   dropout_rate=dropout_rate, bh_period=bh_period,
-                  bh_stride=bh_stride, interpret=interpret)
+                  bh_stride=bh_stride, interpret=interpret,
+                  kv_length=kv_length)
     return out
 
 
 def _flash_fwd(q, k, v, seed, bh_base, kmask, sm_scale, causal, block_q,
-               block_k, dropout_rate, bh_period, bh_stride, interpret):
+               block_k, dropout_rate, bh_period, bh_stride, interpret,
+               kv_length):
     out, lse = _fwd(q, k, v, seed, bh_base, kmask, sm_scale=sm_scale,
                     causal=causal, block_q=block_q, block_k=block_k,
                     dropout_rate=dropout_rate, bh_period=bh_period,
-                    bh_stride=bh_stride, interpret=interpret)
+                    bh_stride=bh_stride, interpret=interpret,
+                    kv_length=kv_length)
     return out, (q, k, v, seed, bh_base, kmask, out, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, dropout_rate,
-               bh_period, bh_stride, interpret, res, do):
+               bh_period, bh_stride, interpret, kv_length, res, do):
     q, k, v, seed, bh_base, kmask, out, lse = res
     dq, dk, dv = _bwd(q, k, v, out, lse, do, seed, bh_base, kmask,
                       sm_scale=sm_scale, causal=causal, block_q=block_q,
                       block_k=block_k, dropout_rate=dropout_rate,
                       bh_period=bh_period, bh_stride=bh_stride,
-                      interpret=interpret)
+                      interpret=interpret, kv_length=kv_length)
     # integer-dtype primals (seed, bh base) take float0 cotangents
     dseed = np.zeros(np.shape(seed), jax.dtypes.float0)
     dbh = np.zeros(np.shape(bh_base), jax.dtypes.float0)
@@ -601,6 +629,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     dropout_seed=None,
                     bh_affine=None,
                     key_mask=None,
+                    kv_length: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over [B, H, T, Dh] inputs (differentiable).
 
@@ -622,16 +651,34 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     keep / large-negative drop).  Applied identically in forward and
     both backward kernels; the mask rides as an 8-row sublane-broadcast
     operand so the TPU tile rules accept it (see _kmask_args).
+
+    ``kv_length``: static live length of the key/value tensors.  Keys at
+    positions >= kv_length are HARD-masked (validity floor) in forward
+    and both backward kernels — a KV buffer whose tail holds garbage
+    (the KV-cache decode case) is never silently attended.  Out-of-range
+    values raise.  Rows left with no valid key at all (kv_length=0, or a
+    key_mask dropping every key of a row) output exact zeros with zero
+    gradients instead of renormalizing over masked keys.  For PER-ROW
+    traced lengths use ``ops.pallas.decode_attention`` (the single-query
+    serving kernel).
     """
     assert q.ndim == 4, f"expected [B, H, T, D], got {q.shape}"
     b, h, t, d = q.shape
     tk = k.shape[2]
     # The causal mask is top-left-anchored (k_pos <= q_pos); with t != tk
     # that silently mis-masks (e.g. a KV-cache decode step would attend to
-    # key 0 only).  Cross-length callers must use causal=False.
+    # key 0 only).  Cross-length callers must use causal=False (and bound
+    # the live keys with kv_length when the KV tail is not real data).
     assert not causal or t == tk, (
         f"causal flash attention requires equal q/k lengths, got {t} vs "
         f"{tk}; pass causal=False for cross-attention")
+    if kv_length is not None:
+        kv_length = int(kv_length)
+        if not 0 <= kv_length <= tk:
+            raise ValueError(
+                f"kv_length={kv_length} is out of range for key length "
+                f"{tk}: the mask would silently cover the wrong keys "
+                f"(want 0 <= kv_length <= {tk})")
     if sm_scale is None:
         sm_scale = float(d) ** -0.5
     if interpret is None:
@@ -669,7 +716,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     vf = v.reshape(b * h, tk, d)
     out = _flash(qf, kf, vf, seed, jnp.asarray(bh_base, jnp.uint32),
                  kmask, sm_scale, causal, block_q, block_k,
-                 dropout_rate, int(bh_period), int(bh_stride), interpret)
+                 dropout_rate, int(bh_period), int(bh_stride), interpret,
+                 kv_length)
     return out.reshape(b, h, t, d)
 
 

@@ -59,6 +59,9 @@ def summarize(path: str, out=None) -> dict:
     pf_wait: List[float] = []
     ck_save: List[float] = []
     ck_hidden: List[float] = []
+    sv_tps: List[float] = []
+    sv_p50: List[float] = []
+    sv_p99: List[float] = []
     stragglers: Optional[float] = None
     peak_hbm: Optional[float] = None
     host_rss: Optional[float] = None
@@ -111,6 +114,17 @@ def summarize(path: str, out=None) -> dict:
                 ch = scalars.get("ckpt_async_overlap_s")
                 if ch is not None:
                     ck_hidden.append(float(ch))
+                tps = scalars.get("serve_tokens_per_s")
+                if tps is not None:
+                    # serving engine flushes (one rate per interval,
+                    # unweighted like samples_per_sec)
+                    sv_tps.append(float(tps))
+                sp50 = scalars.get("serve_token_p50_s")
+                if sp50 is not None:
+                    sv_p50.append(float(sp50))
+                sp99 = scalars.get("serve_token_p99_s")
+                if sp99 is not None:
+                    sv_p99.append(float(sp99))
                 sg = scalars.get("straggler_detected_total")
                 if sg is not None:
                     # cumulative counter: the last/maximum value is the
@@ -144,6 +158,11 @@ def summarize(path: str, out=None) -> dict:
     avg_pf_wait = sum(pf_wait) / len(pf_wait) if pf_wait else None
     avg_ck_save = sum(ck_save) / len(ck_save) if ck_save else None
     avg_ck_hidden = sum(ck_hidden) / len(ck_hidden) if ck_hidden else None
+    avg_sv_tps = sum(sv_tps) / len(sv_tps) if sv_tps else None
+    # latency percentiles: the LAST flush covers the whole run's bounded
+    # latency window (the engine computes them cumulatively)
+    last_sv_p50 = sv_p50[-1] if sv_p50 else None
+    last_sv_p99 = sv_p99[-1] if sv_p99 else None
 
     report = {
         "steps": steps,
@@ -155,6 +174,9 @@ def summarize(path: str, out=None) -> dict:
         "prefetch_wait_s": avg_pf_wait,
         "ckpt_save_s": avg_ck_save,
         "ckpt_async_overlap_s": avg_ck_hidden,
+        "serve_tokens_per_s": avg_sv_tps,
+        "serve_token_p50_s": last_sv_p50,
+        "serve_token_p99_s": last_sv_p99,
         "straggler_detected_total": stragglers,
         "peak_hbm_bytes": peak_hbm,
         "host_rss_bytes": host_rss,
@@ -187,6 +209,15 @@ def summarize(path: str, out=None) -> dict:
                    if avg_ck_hidden is not None else "")
         print(f"  checkpoint         exposed {_fmt_s(avg_ck_save)}/save"
               f"{hid_txt}", file=out)
+    if avg_sv_tps is not None:
+        # serving engine (docs/serving.md): throughput + per-token
+        # latency (first token of a request = its time to first token)
+        lat_txt = ""
+        if last_sv_p50 is not None:
+            lat_txt = (f"  token p50 {_fmt_s(last_sv_p50)}"
+                       f"  p99 {_fmt_s(last_sv_p99)}")
+        print(f"  serving            {avg_sv_tps:.1f} tok/s{lat_txt}",
+              file=out)
     if stragglers is not None:
         # elastic fleet health: hosts flagged slower than the configured
         # multiple of the fleet-median step time (docs/elastic.md)

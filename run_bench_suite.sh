@@ -10,6 +10,13 @@ run() {
   "$@" > "BENCH_${name}_raw.json" 2>> bench_suite.log
   echo "=== $name done rc=$? $(date -u +%H:%M:%S) ===" >> bench_suite.log
 }
+# --serve: just the serving A/B (pure CPU — bench_serve pins
+# JAX_PLATFORMS=cpu; the continuous-batching claim is a scheduling
+# claim proven with injected per-tick device time, never the tunnel)
+if [ "$1" = "--serve" ]; then
+  run serve python bench_serve.py
+  exit 0
+fi
 # capacity runs LAST: its probes are subprocesses killed on timeout,
 # and killing a TPU client mid-native-call can wedge the tunnel for
 # everything after it (BENCH_NOTES.md round 3)
@@ -23,6 +30,9 @@ run stage_chaos python bench.py --stage-chaos
 # tunnel): kill one local worker mid-run, assert resume at reduced
 # width with trajectory continuity + sample-exactness
 run elastic python bench.py --elastic-smoke
+# serving A/B: continuous batching vs sequential decode (pure CPU,
+# injected per-tick device time — see docs/serving.md)
+run serve python bench_serve.py
 run bert python bench_bert.py
 run sparse python bench_sparse.py
 run flash python bench_flash.py

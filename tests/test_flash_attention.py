@@ -275,3 +275,79 @@ def test_key_mask_per_head_shape():
     ref = jnp.einsum("bhqk,bhkd->bhqd",
                      jax.nn.softmax(s, -1).astype(q.dtype), v)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------
+# mis-masking hazard regressions (the KV-cache decode class): kv_length
+# hard-masks out-of-range keys, all-masked rows hard-zero with zero
+# gradients — never silently attend
+# ---------------------------------------------------------------------
+def test_kv_length_masks_garbage_tail():
+    """A KV buffer whose tail is garbage (the decode-cache shape) must
+    match the dense reference truncated to the live length — forward
+    AND gradients."""
+    q, k, v = _rand_qkv(2, 2, 96, 32, seed=8)
+    live = 60
+    k = k.at[:, :, live:].set(1e4)
+    v = v.at[:, :, live:].set(1e4)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=False,
+                                       kv_length=live) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense(q, k[:, :, :live], v[:, :, :live],
+                              causal=False) ** 2)
+
+    out = flash_attention(q, k, v, causal=False, kv_length=live)
+    ref = _dense(q, k[:, :, :live], v[:, :, :live], causal=False)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(gf[0], gd[0], atol=3e-4, rtol=3e-4)
+    np.testing.assert_allclose(gf[1][:, :, :live], gd[1][:, :, :live],
+                               atol=3e-4, rtol=3e-4)
+    # masked tail keys take ZERO gradient (they were never attended)
+    assert (np.asarray(gf[1][:, :, live:]) == 0).all()
+    assert (np.asarray(gf[2][:, :, live:]) == 0).all()
+
+
+def test_kv_length_out_of_range_raises():
+    q, k, v = _rand_qkv(1, 1, 32, 16, seed=9)
+    with pytest.raises(ValueError, match="out of range"):
+        flash_attention(q, k, v, causal=False, kv_length=33)
+    with pytest.raises(ValueError, match="out of range"):
+        flash_attention(q, k, v, causal=False, kv_length=-1)
+
+
+def test_kv_length_zero_hard_zeros():
+    """kv_length=0 (no live key at all) outputs exact zeros instead of
+    the mean of V (the silent-attend failure this satellite closes)."""
+    q, k, v = _rand_qkv(1, 2, 32, 16, seed=10)
+    out = flash_attention(q, k, v, causal=False, kv_length=0)
+    assert (np.asarray(out) == 0).all()
+
+
+def test_all_masked_key_rows_zero_output_and_grads():
+    """A key_mask dropping EVERY key of a batch row previously
+    renormalized over the masked keys (silently attending to the
+    max-scoring masked key); now: exact zeros, zero gradients, other
+    rows untouched."""
+    b, h, t = 2, 2, 64
+    q, k, v = _rand_qkv(b, h, t, 32, seed=11)
+    km = np.ones((b, t), bool)
+    km[0, :] = False
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=False,
+                                       key_mask=jnp.asarray(km)) ** 2)
+
+    out = flash_attention(q, k, v, causal=False, key_mask=jnp.asarray(km))
+    assert (np.asarray(out[0]) == 0).all()
+    ref = _dense(q[1:], k[1:], v[1:], causal=False)
+    np.testing.assert_allclose(out[1:], ref, atol=2e-5, rtol=2e-5)
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert (np.asarray(gq[0]) == 0).all()
+    assert (np.asarray(gk[0]) == 0).all()
+    assert (np.asarray(gv[0]) == 0).all()
+    assert np.abs(np.asarray(gq[1])).max() > 0

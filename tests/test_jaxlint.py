@@ -285,3 +285,16 @@ def test_cli_list_rules_covers_all_ids():
     for rule_id in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                     "JL007", "JL101"):
         assert rule_id in proc.stdout
+
+
+def test_serving_subsystem_is_clean_with_empty_baseline():
+    """The serving engine (deepspeed_tpu/inference/) is JL001-JL007
+    clean WITHOUT any baseline entries — the one-compiled-decode-
+    program contract (docs/serving.md) depends on staying JL005/JL006
+    clean by construction, so no finding there may ever be baselined."""
+    findings = lint_paths([os.path.join(REPO, "deepspeed_tpu",
+                                        "inference")])
+    assert not findings, "\n".join(f.render() for f in findings)
+    baseline = load_baseline()
+    inference_prefix = os.path.join("deepspeed_tpu", "inference")
+    assert not [k for k in baseline if inference_prefix in k]

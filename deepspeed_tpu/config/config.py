@@ -269,6 +269,17 @@ class DeepSpeedTelemetryConfig:
         self.straggler_ratio = get_scalar_param(
             tel, C.TELEMETRY_STRAGGLER_RATIO,
             C.TELEMETRY_STRAGGLER_RATIO_DEFAULT)
+        self.anomaly_ratio = get_scalar_param(
+            tel, C.TELEMETRY_ANOMALY_RATIO,
+            C.TELEMETRY_ANOMALY_RATIO_DEFAULT)
+        if (not isinstance(self.anomaly_ratio, (int, float))
+                or isinstance(self.anomaly_ratio, bool)
+                or not (self.anomaly_ratio == 0
+                        or self.anomaly_ratio > 1.0)):
+            raise DeepSpeedConfigError(
+                f"telemetry.{C.TELEMETRY_ANOMALY_RATIO} must be 0 "
+                f"(disabled) or a number > 1.0 (it multiplies the "
+                f"trailing median step time), got {self.anomaly_ratio!r}")
         if not isinstance(self.heartbeat, bool):
             # the async_save lesson: a JSON string like "false" is truthy
             raise DeepSpeedConfigError(

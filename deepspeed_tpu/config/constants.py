@@ -344,6 +344,15 @@ TELEMETRY_HEARTBEAT_DIR_DEFAULT = ""
 # is flagged (straggler_detected_total + summarize row); must be > 1
 TELEMETRY_STRAGGLER_RATIO = "straggler_ratio"
 TELEMETRY_STRAGGLER_RATIO_DEFAULT = 2.0
+# One-shot anomaly trigger (docs/observability.md): when a synced
+# interval's per-step time exceeds anomaly_ratio x the trailing median
+# of recent intervals — or the straggler monitor flags THIS host — the
+# engine fires ONE bounded jax.profiler capture (stopped at the next
+# sync) plus a flight-record dump, so the slow episode is captured
+# while it is still happening.  Opt-in: 0.0 (default) disables; when
+# set it must be > 1.0 (it multiplies the trailing median).
+TELEMETRY_ANOMALY_RATIO = "anomaly_ratio"
+TELEMETRY_ANOMALY_RATIO_DEFAULT = 0.0
 
 # Asynchronous input pipeline (TPU extension; docs/observability.md):
 # a single daemon worker prefetches batches through a bounded queue and

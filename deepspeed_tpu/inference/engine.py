@@ -202,6 +202,11 @@ class ServeEngine:
             "serve", max_failures=cfg.stages.max_stage_failures,
             fallback="chaos-free direct serving (injection plane "
                      "bypassed)")
+        # flight recorder: every stage event samples the request-queue
+        # depth, so a dump shows the backlog trajectory before a failure
+        self.stage.depth_fn = self.queue.qsize
+        self.stage.on_degrade = lambda st: self.dump_flight_record(
+            reason=f"stage {st.name!r} degraded to {st.fallback}")
         self._graph = StageGraph()
         self._graph.register("serve_queue", close=self._close_queue,
                              drain=lambda: None)
@@ -233,6 +238,14 @@ class ServeEngine:
             self._token_seconds = reg.histogram(
                 "serve_token_seconds",
                 "per-token latency (first token = time to first token)")
+            self._ttft_hist = reg.histogram(
+                "serve_ttft_seconds",
+                "time to first token: submit -> first generated token "
+                "(queue wait + prefill)")
+            self._queue_wait_hist = reg.histogram(
+                "serve_queue_wait_seconds",
+                "submit -> slot admission wait (the Orca iteration-"
+                "level scheduling number)")
             self._active_gauge = reg.gauge(
                 "serve_active_slots", "slots decoding this tick")
 
@@ -255,6 +268,103 @@ class ServeEngine:
         if self.telemetry is None:
             return contextlib.nullcontext()
         return self.telemetry.span(name, cat="serve", **args)
+
+    @property
+    def _tracer(self):
+        tel = self.telemetry
+        return tel.tracer if tel is not None else None
+
+    # -- per-request causal trace + completion record ---------------------
+    def _begin_request_trace(self, req: Request) -> None:
+        tr = self._tracer
+        if tr is None:
+            return
+        from ..telemetry.tracing import TraceContext
+        req.ctx = TraceContext.new()
+        # root covers submit -> finish; queue_wait ends at admission.
+        # ASYNC (b/e) events, not complete slices: concurrent requests
+        # overlap without nesting, which the X per-thread call-stack
+        # model mis-renders — async pairs match by (cat, id, name)
+        req.span = tr.async_begin("serve/request", req.ctx.trace_id,
+                                  cat="serve", rid=req.rid)
+        req.queue_span = tr.async_begin("serve/queue_wait",
+                                        req.ctx.trace_id, cat="serve",
+                                        rid=req.rid)
+
+    def _end_request_trace(self, req: Request, reason=None,
+                           error=None) -> None:
+        """Close the request's spans and terminate its flow — inside a
+        ``serve/finish`` (or ``serve/error``) span so the arrowhead
+        binds somewhere visible.  A failing request's trace ends with an
+        error span, never a leaked open flow."""
+        tr = self._tracer
+        args = {}
+        if reason is not None:
+            args["reason"] = reason
+        if error is not None:
+            args["error"] = repr(error)
+        if req.queue_span is not None:  # never admitted: close it now
+            req.queue_span.end(**args)
+            req.queue_span = None
+        if tr is not None and req.ctx is not None:
+            name = "serve/error" if error is not None else "serve/finish"
+            with tr.span(name, cat="serve", rid=req.rid, **args):
+                if req.admit_t:
+                    # the flow starts at admission — a queued request
+                    # failed before any flow existed to terminate
+                    tr.flow_end("serve/request", req.ctx, cat="serve",
+                                rid=req.rid)
+            req.ctx = None
+        if req.span is not None:
+            req.span.end(**args)
+            req.span = None
+
+    def _write_request_record(self, req: Request) -> None:
+        """One structured completion record per request in events.jsonl
+        (``kind: serve_request``) — the offline source for the summarize
+        queue/prefill/decode split and the diagnose post-mortem."""
+        if self.telemetry is None:
+            return
+        decode = [float(t) for t in req.token_times[1:]]
+        rec = {
+            "rid": req.rid,
+            "prompt_len": len(req.prompt),
+            "tokens": len(req.tokens),
+            "finish_reason": req.finish_reason,
+            "error": repr(req.error) if req.error is not None else None,
+            "total_s": time.perf_counter() - req.submit_t,
+            "queue_wait_s": (req.admit_t - req.submit_t
+                             if req.admit_t else None),
+            "ttft_s": (float(req.token_times[0])
+                       if req.token_times else None),
+            "prefill_s": req.prefill_s if req.prefill_s else None,
+            "decode_tokens": len(decode),
+            "decode_s_sum": sum(decode),
+            # bounded: a million-token request must not write a
+            # million-float record (decode_tokens keeps the true count)
+            "token_times_s": [round(t, 6) for t in decode[:512]],
+        }
+        if req.ctx is not None:
+            rec["trace_id"] = req.ctx.trace_id
+        self.telemetry.jsonl.write_event("serve_request", rec)
+
+    def dump_flight_record(self, reason: str = "manual",
+                           error=None):
+        """Serve-side flight recorder: dump the ``serve`` stage's event
+        ring (admissions, ticks, queue depths, failures) as
+        ``flightrec_<tick>.json``.  Fired on poison and degradation;
+        callable on demand.  Never raises."""
+        if self.telemetry is None:
+            return None
+        try:
+            return self.telemetry.dump_flight_record(
+                {"serve": self.stage}, self._ticks, reason, error=error,
+                extra={"active_slots": len(self.scheduler.active),
+                       "queued": self.queue.qsize()})
+        except Exception:
+            logger.exception("serve flight-record dump failed "
+                             "(reason=%r)", reason)
+            return None
 
     def _count_token(self, latency_s: float):
         self._tokens_seen += 1
@@ -306,11 +416,15 @@ class ServeEngine:
                       eos_id=(self.eos_id_default if eos_id is None
                               else int(eos_id)),
                       submit_t=time.perf_counter())
+        self._begin_request_trace(req)
         if not self.queue.put(req):
             err = self.queue.err
-            raise RuntimeError(
+            rej = RuntimeError(
                 "serve queue rejected the request (engine closed or "
                 f"poisoned){': ' + repr(err) if err else ''}")
+            req.error = rej
+            self._end_request_trace(req, error=rej)
+            raise rej
         return req
 
     def _pop_request(self) -> Optional[Request]:
@@ -328,20 +442,38 @@ class ServeEngine:
         tokens = np.zeros((1, self.prefill_len), np.int32)
         tokens[0, :len(req.prompt)] = req.prompt
         length = np.int32(len(req.prompt))
+        req.admit_t = time.perf_counter()
+        if req.queue_span is not None:
+            # the queue_wait child span ends the moment a slot is ours
+            req.queue_span.end()
+            req.queue_span = None
+        if self.telemetry is not None:
+            self._queue_wait_hist.observe(req.admit_t - req.submit_t)
         with self._span("serve/prefill", rid=req.rid,
                         prompt_len=len(req.prompt)):
+            tr = self._tracer
+            if tr is not None and req.ctx is not None:
+                # flow tail binds to this prefill span; each decode tick
+                # the request rides emits a flow step
+                tr.flow_start("serve/request", req.ctx, cat="serve",
+                              rid=req.rid)
             with self._pallas_scope():
                 self.cache, first = self._prefill_fn(
                     self.params, self.cache, tokens, length,
                     np.int32(self.scheduler.free[0]))
             first = int(np.asarray(jax.block_until_ready(first)))
         now = time.perf_counter()
+        req.prefill_s = now - req.admit_t
         slot = self.scheduler.admit(req, now=now)
         req.kv_len = len(req.prompt)
         req.tokens.append(first)
         req.token_times.append(now - req.submit_t)
         req.last_token = first
         self._count_token(now - req.submit_t)
+        if self.telemetry is not None:
+            # TTFT = queue wait + prefill (the first token comes out of
+            # the prefill logits)
+            self._ttft_hist.observe(now - req.submit_t)
         reason = self.scheduler.finish_reason(req, first,
                                               self.max_seq_len)
         if reason is not None:
@@ -357,6 +489,8 @@ class ServeEngine:
                                 path=f"rid={req.rid}")
             except BaseException as e:
                 req.error = e
+                self._write_request_record(req)
+                self._end_request_trace(req, error=e)
                 req.done.set()
                 if not isinstance(e, Exception):
                     # KeyboardInterrupt / SystemExit are not a
@@ -380,6 +514,10 @@ class ServeEngine:
 
     def _finish(self, slot: int, reason: str) -> None:
         req = self.scheduler.release(slot, reason)
+        # record + trace close BEFORE done.set(): a waiter released by
+        # result() must find the completed artifacts already written
+        self._write_request_record(req)
+        self._end_request_trace(req, reason=reason)
         req.done.set()
         if self.telemetry is not None:
             self._requests_total.inc()
@@ -395,6 +533,16 @@ class ServeEngine:
             tokens[slot] = req.last_token
             active[slot] = True
         with self._span("serve/decode_step", active=len(active_map)):
+            tr = self._tracer
+            if tr is not None:
+                # per-tick decode attribution: each active request's
+                # flow steps through this tick's span (host appends
+                # only — the in-span sync below is the existing pull)
+                for req in active_map.values():
+                    if req.ctx is not None:
+                        tr.flow_step("serve/request", req.ctx,
+                                     cat="serve", rid=req.rid,
+                                     tick=self._ticks)
             with self._pallas_scope():
                 self.cache, next_tok = self._decode_fn(
                     self.params, self.cache, tokens, active)
@@ -453,14 +601,20 @@ class ServeEngine:
     def _poison(self, err: BaseException) -> None:
         """A failed decode tick is fatal for every in-flight request:
         donation means the cache is gone.  Typed propagation — requests
-        and submitters see the ORIGINAL exception."""
+        and submitters see the ORIGINAL exception.  Every in-flight
+        request's trace ends with an error span (no leaked flows), and
+        the flight recorder dumps the pool's last moments."""
         self.queue.poison(err)
+        self.stage.record_event("poison", error=repr(err))
         for slot in list(self.scheduler.active):
             req = self.scheduler.release(slot, "error")
             req.error = err
+            self._write_request_record(req)
+            self._end_request_trace(req, error=err)
             req.done.set()
             if self.telemetry is not None:
                 self._requests_failed.inc()
+        self.dump_flight_record(reason="serve poison", error=err)
 
     def _close_queue(self):
         err = RuntimeError("ServeEngine closed")
@@ -475,7 +629,13 @@ class ServeEngine:
             self.queue.cond.notify_all()
         for req in items:
             req.error = err
+            self._write_request_record(req)
+            self._end_request_trace(req, error=err)
             req.done.set()
+            if self.telemetry is not None:
+                # keep the registry counter consistent with the failed
+                # serve_request records summarize derives its count from
+                self._requests_failed.inc()
 
     def _close_telemetry(self):
         if self.telemetry is not None:

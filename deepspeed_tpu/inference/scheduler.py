@@ -43,6 +43,16 @@ class Request:
     last_token: int = 0
     last_t: float = 0.0
     kv_len: int = 0
+    #: causal trace (docs/observability.md, engine-internal): the
+    #: request's TraceContext plus its open root/queue-wait span handles
+    ctx: Optional[object] = None
+    span: Optional[object] = None
+    queue_span: Optional[object] = None
+    #: latency attribution stamps: admission time (queue_wait ends) and
+    #: the prefill's wall time — queue/prefill/decode components of the
+    #: per-request completion record
+    admit_t: float = 0.0
+    prefill_s: float = 0.0
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until the request finishes; raises its error if it

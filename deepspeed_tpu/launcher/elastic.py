@@ -124,6 +124,48 @@ class ElasticSupervisor:
             ratio=straggler_ratio,
             stale_after_s=max(heartbeat_timeout_s, 1.0))
         self.restarts = 0  # relaunches performed so far
+        #: supervisor-side flight recorder (docs/observability.md):
+        #: bounded ring of launch/failure/probe events, dumped as
+        #: flightrec_supervisor.json on ElasticGiveUpError so the
+        #: post-mortem survives the dead fleet.  Kept jax-free (no
+        #: telemetry hub import) — same schema, written inline.
+        self.events: collections.deque = collections.deque(maxlen=256)
+
+    def _record(self, kind: str, **fields) -> None:
+        ev = {"t": time.time(), "kind": kind}
+        ev.update(fields)
+        self.events.append(ev)
+
+    def _dump_flight_record(self, reason: str, error: str) -> None:
+        """Best-effort give-up post-mortem next to the heartbeat files
+        (``python -m deepspeed_tpu.telemetry diagnose <dir>`` reads it);
+        a supervisor out of options must never die on a dump failure."""
+        if not self.heartbeat_dir:
+            return
+        import json
+        import os
+        try:
+            os.makedirs(self.heartbeat_dir, exist_ok=True)
+            path = os.path.join(self.heartbeat_dir,
+                                "flightrec_supervisor.json")
+            payload = {
+                "version": 1, "reason": reason, "step": None,
+                "time": time.time(), "error": error,
+                "stages": {"supervisor": {
+                    "degraded": False, "failures": self.restarts,
+                    "max_failures": self.policy.max_restarts,
+                    "fallback": "give up (typed ElasticGiveUpError)",
+                    "surfaced": error, "events": list(self.events)}},
+                "extra": {"active_world": {h: list(s) for h, s
+                                           in self.active.items()}},
+            }
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, default=repr)
+            os.replace(tmp, path)
+            logger.error("elastic: flight record dumped to %s", path)
+        except OSError as e:
+            logger.warning("elastic: flight-record dump failed: %s", e)
 
     # -- policy helpers -------------------------------------------------
     def total_slots(self) -> int:
@@ -132,13 +174,17 @@ class ElasticSupervisor:
     def _check_viable(self, last_failure: str) -> None:
         slots = self.total_slots()
         if not self.active or slots < self.policy.min_slots:
-            raise ElasticGiveUpError(
+            msg = (
                 f"elastic: surviving world has {slots} slot(s) across "
                 f"{len(self.active)} host(s), below min_slots="
                 f"{self.policy.min_slots} — giving up after "
                 f"{self.restarts} restart(s); last failure: "
-                f"{last_failure or 'n/a'}",
-                restarts=self.restarts, last_failure=last_failure)
+                f"{last_failure or 'n/a'}")
+            self._record("give_up", error=msg)
+            self._dump_flight_record("ElasticGiveUpError: world below "
+                                     "min_slots", msg)
+            raise ElasticGiveUpError(msg, restarts=self.restarts,
+                                     last_failure=last_failure)
 
     # -- the run loop ---------------------------------------------------
     def run(self) -> int:
@@ -154,6 +200,9 @@ class ElasticSupervisor:
                 self.total_slots(),
                 ", ".join(f"{h}:{len(s)}"
                           for h, s in self.active.items()))
+            self._record("launch", attempt=self.restarts,
+                         hosts=len(self.active),
+                         slots=self.total_slots())
             procs = self.launch_fn(self.active, self.restarts)
             rc, reason = self._watch(procs)
             if rc == 0:
@@ -161,15 +210,20 @@ class ElasticSupervisor:
                             "restart(s)", self.restarts)
                 return 0
             last_failure = reason
+            self._record("failure", attempt=self.restarts, rc=rc,
+                         error=reason)
             logger.warning("elastic: attempt %d FAILED: %s",
                            self.restarts, reason)
             if self.restarts >= self.policy.max_restarts:
-                raise ElasticGiveUpError(
-                    f"elastic: giving up after {self.restarts} "
-                    f"restart(s) (max_restarts="
-                    f"{self.policy.max_restarts}); last failure: "
-                    f"{reason}",
-                    restarts=self.restarts, last_failure=reason)
+                msg = (f"elastic: giving up after {self.restarts} "
+                       f"restart(s) (max_restarts="
+                       f"{self.policy.max_restarts}); last failure: "
+                       f"{reason}")
+                self._record("give_up", error=msg)
+                self._dump_flight_record(
+                    "ElasticGiveUpError: restart budget exhausted", msg)
+                raise ElasticGiveUpError(msg, restarts=self.restarts,
+                                         last_failure=reason)
             self.restarts += 1
             self._reprobe()
             self._check_viable(last_failure)

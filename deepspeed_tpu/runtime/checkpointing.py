@@ -875,7 +875,17 @@ def _build_save_job(engine, save_dir: str, tag: str, ckpt_dir: str,
     from . import precision
 
     state = engine.state
+    tracer = getattr(getattr(engine, "telemetry", None), "tracer", None)
+    ctx = None
     with _tel_span(engine, "checkpoint/snapshot", tag=tag):
+        if tracer is not None:
+            # causal arrow: flow opened inside the submitting step's
+            # save/snapshot span, terminated inside the writer's
+            # async_write span (host-side appends only)
+            from ..telemetry.tracing import TraceContext
+            ctx = TraceContext.new()
+            tracer.flow_start("checkpoint/job", ctx, cat="checkpoint",
+                              tag=tag)
         master_tree, opt_tree = engine._canonical_state()
         module_params = precision.cast_to_compute(
             master_tree, engine.compute_dtype)
@@ -921,6 +931,13 @@ def _build_save_job(engine, save_dir: str, tag: str, ckpt_dir: str,
                 if async_write and eng is not None
                 else contextlib.nullcontext())
         with _tel_sink(eng), span:
+            run_tracer = getattr(getattr(eng, "telemetry", None),
+                                 "tracer", None)
+            if ctx is not None and run_tracer is not None:
+                # inside the write span: sync saves close the flow in
+                # the save span itself, async saves on the writer thread
+                run_tracer.flow_end("checkpoint/job", ctx,
+                                    cat="checkpoint", tag=tag)
             _write_checkpoint_files(
                 save_dir, tag, ckpt_dir, tmp_dir, model_plane,
                 optim_plane, meta, save_latest, cfg.keep_last_n,
@@ -943,7 +960,7 @@ def _build_save_job(engine, save_dir: str, tag: str, ckpt_dir: str,
         return ckpt_dir
 
     return CheckpointJob(tag=tag, tmp_dir=tmp_dir, final_dir=ckpt_dir,
-                         run=run)
+                         run=run, ctx=ctx)
 
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,

@@ -24,6 +24,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import os
+import statistics
 import threading
 import time
 import weakref
@@ -814,6 +815,17 @@ class DeepSpeedEngine:
             if jax.process_index() == 0:
                 self._straggler_monitor = StragglerMonitor(
                     ratio=float(tcfg.straggler_ratio))
+        # one-shot anomaly trigger (docs/observability.md): opt-in via
+        # telemetry.anomaly_ratio — a slow interval (vs the trailing
+        # median) or a self-straggler flag fires ONE bounded profiler
+        # capture + a flight-record dump while the episode is live
+        self._anomaly_ratio = float(tcfg.anomaly_ratio)
+        self._anomaly_trail = collections.deque(maxlen=32)
+        self._anomaly_fired = False
+        self._anomaly_profiling = False
+        # flight recorder (docs/observability.md): one post-mortem dump
+        # per failure class so a repeated-crash loop can't spam dumps
+        self._flightrec_poison_dumped = False
         # one fault plane (docs/stages.md): stage records + drain graph
         wire_stage_plane(self)
         # fault-tolerant checkpointing (docs/checkpointing.md): the async
@@ -1093,6 +1105,7 @@ class DeepSpeedEngine:
                 < p.start_step + p.num_steps):
             # upper bound matters: a run resumed from a checkpoint past the
             # window must not open a stray one-step trace
+            self._anomaly_stop()  # defensive: one capture at a time
             jax.profiler.start_trace(p.output_path)
             self._profiler_active = True
         elif (self._profiler_active
@@ -2718,6 +2731,17 @@ class DeepSpeedEngine:
         self._in_step = True
         try:
             return self._train_batch_inner(batch, data_iter)
+        except BaseException as e:
+            # a failing step (a poisoned stage re-raising its original
+            # exception, a donation fault, ...) dumps the fault plane's
+            # recent history ONCE for post-mortem; StopIteration is
+            # ordinary epoch-end control flow, never a failure
+            if not isinstance(e, StopIteration) \
+                    and not self._flightrec_poison_dumped:
+                self._flightrec_poison_dumped = True
+                self.dump_flight_record(reason="train_batch failure",
+                                        error=e)
+            raise
         finally:
             self._in_step = False
             h = self._deferred_preempt
@@ -2788,6 +2812,17 @@ class DeepSpeedEngine:
         # with record_step / on_sync / the report line for the same batch
         with self._tel_span("train/dispatch", cat="train",
                             step=self.global_steps + 1):
+            # causal arrow: terminate the prefetched batch's flow INSIDE
+            # the consuming step's span — trace.json then links the
+            # worker's data/prefetch_place span to this train/step (a
+            # host-side append; the zero-added-device-syncs contract
+            # holds, test_train_batch_adds_zero_device_syncs)
+            if placed is not None and placed.ctx is not None \
+                    and self.telemetry is not None \
+                    and self.telemetry.tracer is not None:
+                self.telemetry.tracer.flow_end(
+                    "data/batch", placed.ctx, cat="data",
+                    step=self.global_steps + 1)
             if self._offload_host:
                 metrics = self._train_batch_offload(sharded)
                 self._last_metrics = metrics
@@ -2868,6 +2903,13 @@ class DeepSpeedEngine:
         steps = self.global_steps - prev_step
         interval = (self._last_report - prev_t) if prev_t is not None \
             else None
+        # anomaly check FIRST: it also closes a previous trigger's
+        # bounded capture, and it must run BEFORE the straggler block
+        # below — a straggler-arm _fire_anomaly later in THIS sync would
+        # otherwise open a capture this same sync immediately stops,
+        # recording an empty window (and the one-shot is then spent)
+        self._anomaly_check(interval / steps
+                            if interval is not None and steps else None)
         scalars = {}
         if m is not None:
             scalars = {"loss": float(m.loss),
@@ -2930,9 +2972,18 @@ class DeepSpeedEngine:
             # fleet health from the shared heartbeat dir: flag hosts
             # whose step time exceeds straggler_ratio × the fleet
             # median; detections count ONCE per flagged episode
-            from ..telemetry.heartbeat import read_heartbeats
-            rep = self._straggler_monitor.update(
-                read_heartbeats(self._heartbeat.directory))
+            from ..telemetry.heartbeat import beat_ages, read_heartbeats
+            beats = read_heartbeats(self._heartbeat.directory)
+            # supervisor-visible staleness, made operator-visible: one
+            # heartbeat_age_s gauge per host (the summarize liveness row
+            # reads these from the metrics snapshots)
+            age_gauge = self.telemetry.registry.gauge(
+                "heartbeat_age_s",
+                "seconds since each host's last heartbeat (elastic "
+                "liveness; stale = hung host)")
+            for key, age in beat_ages(beats).items():
+                age_gauge.set(age, host=key)
+            rep = self._straggler_monitor.update(beats)
             if rep["new_stragglers"]:
                 self.telemetry.registry.counter(
                     "straggler_detected_total",
@@ -2944,6 +2995,13 @@ class DeepSpeedEngine:
                     "ratio %.1fx)", ", ".join(rep["new_stragglers"]),
                     rep["median_step_s"] or 0.0,
                     self._straggler_monitor.ratio)
+                self_key = (f"{self._heartbeat.host}/"
+                            f"{self._heartbeat.process_index}")
+                if self_key in rep["new_stragglers"]:
+                    # the anomaly trigger's straggler arm: THIS host is
+                    # the slow one — capture it while it is still slow
+                    self._fire_anomaly(
+                        f"this host flagged as straggler ({self_key})")
             scalars["straggler_detected_total"] = float(
                 self._straggler_monitor.flagged_total)
         self.telemetry.on_sync(
@@ -3094,7 +3152,9 @@ class DeepSpeedEngine:
             depth=depth if depth is not None else self._prefetch_depth,
             span_fn=span,
             name="eval" if for_eval else "train",
-            stage=self._stage_records["prefetch"])
+            stage=self._stage_records["prefetch"],
+            tracer=(self.telemetry.tracer
+                    if self.telemetry is not None else None))
         # prune already-closed entries IN PLACE (the GC finalizer holds
         # this same list object): a per-eval prefetcher pattern must not
         # grow the list — and retain every source iterator — forever
@@ -3140,7 +3200,8 @@ class DeepSpeedEngine:
             np.float32)})["pld_theta"]
         tree = dict(placed.tree)
         tree["pld_theta"] = theta
-        return DevicePlacedBatch(tree, rows=placed.rows, kind=placed.kind)
+        return DevicePlacedBatch(tree, rows=placed.rows, kind=placed.kind,
+                                 ctx=placed.ctx)
 
     def eval_batch(self, batch=None, data_iter=None):
         """Forward-only loss on one batch; like ``train_batch`` it also
@@ -3168,6 +3229,15 @@ class DeepSpeedEngine:
                     "eval placement — build the prefetcher with "
                     "engine.prefetch(it, for_eval=True)")
             micro = batch.tree
+            if batch.ctx is not None and self.telemetry is not None \
+                    and self.telemetry.tracer is not None:
+                # terminate the prefetched batch's flow here too —
+                # eval-placed batches must not leak open flows (the
+                # recorder would grow one entry per eval batch and
+                # flush them all as synthetic terminators at export)
+                with self._tel_span("eval/dispatch", cat="eval"):
+                    self.telemetry.tracer.flow_end(
+                        "data/batch", batch.ctx, cat="data")
         else:
             micro = jax.tree.map(np.asarray, batch)
         rng = jax.random.fold_in(self._data_rng, self.micro_steps)
@@ -3271,6 +3341,105 @@ class DeepSpeedEngine:
             acc["saves"] += 1
         return out
 
+    # ------------------------------------------------------------------
+    # flight recorder + anomaly trigger (docs/observability.md)
+    # ------------------------------------------------------------------
+    def dump_flight_record(self, reason: str = "manual", error=None,
+                           directory: Optional[str] = None
+                           ) -> Optional[str]:
+        """Dump every stage's bounded event ring (call outcomes, queue
+        depths, failures, degradations) as ``flightrec_<step>.json`` for
+        post-mortem (``python -m deepspeed_tpu.telemetry diagnose``).
+        Fired automatically on a train_batch failure, a stage
+        degradation, the SIGTERM preemption hook, and the anomaly
+        trigger; callable on demand.  Never raises — it runs inside
+        failure paths and worker threads; returns the path, or None when
+        no telemetry output directory exists to hold it."""
+        try:
+            if directory is None:
+                if self.telemetry is None:
+                    logger.warning(
+                        "flight record NOT dumped (%s): telemetry is "
+                        "disabled and no directory was given", reason)
+                    return None
+                directory = self.telemetry.output_path
+            from ..telemetry.hub import write_flight_record
+            extra = {}
+            if self.last_ckpt_error is not None:
+                extra["last_ckpt_error"] = repr(self.last_ckpt_error)
+            if getattr(self, "last_stage_error", None) is not None:
+                extra["last_stage_error"] = repr(self.last_stage_error)
+            path = write_flight_record(
+                directory, getattr(self, "_stage_records", {}),
+                self.global_steps, reason, error=error,
+                extra=extra or None)
+            logger.warning("flight record dumped to %s (%s)", path,
+                           reason)
+            return path
+        except Exception:
+            logger.exception("flight-record dump failed (reason=%r)",
+                             reason)
+            return None
+
+    def _anomaly_stop(self):
+        """Close a trigger-opened profiler capture (bounded: the window
+        is one sync interval — or engine.close, whichever first)."""
+        if not self._anomaly_profiling:
+            return
+        self._anomaly_profiling = False
+        try:
+            jax.profiler.stop_trace()
+            log_dist("anomaly profiler capture closed", ranks=[0])
+        except Exception as e:
+            logger.warning("anomaly profiler capture stop failed: %s", e)
+
+    def _fire_anomaly(self, reason: str):
+        """One-shot (per run) anomaly response: flight-record dump + a
+        bounded ``jax.profiler`` capture.  Opt-in — inert unless
+        ``telemetry.anomaly_ratio`` is set."""
+        if self._anomaly_ratio <= 0 or self._anomaly_fired:
+            return
+        self._anomaly_fired = True
+        logger.warning(
+            "telemetry anomaly trigger: %s — dumping a flight record "
+            "and starting ONE bounded profiler capture", reason)
+        self.dump_flight_record(reason=f"anomaly: {reason}")
+        if self.telemetry is None or self._profiler_active \
+                or self._profiler is not None:
+            # never stack on a user-configured capture window — open OR
+            # still pending (a window opening at start_step while the
+            # anomaly capture runs would raise 'Profile has already
+            # been started' and kill train_batch)
+            return
+        try:
+            out = os.path.join(self.telemetry.output_path,
+                               "anomaly_profile")
+            jax.profiler.start_trace(out)
+            self._anomaly_profiling = True
+        except Exception as e:
+            logger.warning("anomaly profiler capture failed to "
+                           "start: %s", e)
+
+    def _anomaly_check(self, avg: Optional[float]):
+        """Step-time arm of the anomaly trigger, at the periodic sync:
+        fire when this interval's per-step time exceeds
+        ``telemetry.anomaly_ratio`` × the trailing median.  Also where a
+        previous trigger's capture closes (bounded to one interval)."""
+        self._anomaly_stop()
+        if avg is None:
+            return
+        if (self._anomaly_ratio > 0 and not self._anomaly_fired
+                and len(self._anomaly_trail) >= 4):
+            med = statistics.median(self._anomaly_trail)
+            if med > 0 and avg > self._anomaly_ratio * med:
+                self._fire_anomaly(
+                    f"interval step time {avg:.4f}s/step > "
+                    f"{self._anomaly_ratio:g}x trailing median "
+                    f"{med:.4f}s/step")
+        # appended AFTER the check: the anomalous interval must not
+        # dilute its own baseline
+        self._anomaly_trail.append(avg)
+
     def _ckpt_writer_tick(self):
         """Pre-step surfacing of a completed async save's failure: the
         failure poisoned only that save (the writer already logged it
@@ -3333,6 +3502,10 @@ class DeepSpeedEngine:
         swallows it like any finalizer exception)."""
         try:
             self.stop_profiler()  # no-op unless a window is open
+        except Exception:
+            pass
+        try:
+            self._anomaly_stop()  # a trigger-opened capture must land
         except Exception:
             pass
         finish_close(self)

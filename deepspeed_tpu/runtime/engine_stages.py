@@ -49,6 +49,16 @@ def wire_stage_plane(engine) -> None:
         if eng is not None and eng.telemetry is not None:
             eng.telemetry.registry.counter(name, help).inc(n)
 
+    def _stage_degrade_dump(st):
+        # flight recorder (docs/observability.md): a degradation is the
+        # moment the history explaining it is still in the rings — dump
+        # before it scrolls off.  Runs on the degrading worker's thread;
+        # dump_flight_record never raises.
+        eng = eng_ref()
+        if eng is not None:
+            eng.dump_flight_record(
+                reason=f"stage {st.name!r} degraded to {st.fallback}")
+
     engine._stage_records = {}
     for sname, fallback in ENGINE_STAGES:
         st = Stage(sname,
@@ -56,6 +66,7 @@ def wire_stage_plane(engine) -> None:
                    .max_stage_failures,
                    fallback=fallback)
         st.counter_fn = _stage_counter
+        st.on_degrade = _stage_degrade_dump
         engine._stage_records[sname] = st
     engine.last_stage_error = None
     #: every surfaced stage error, oldest first (bounded) — one tick

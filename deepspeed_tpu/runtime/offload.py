@@ -417,13 +417,18 @@ class StreamingUploader:
                 self._cond.wait_for(lambda: self._q or self._closed)
                 if not self._q:
                     break  # closed and drained
-                idx, arr = self._q.pop(0)
+                idx, arr, ctx = self._q.pop(0)
             if self._err is not None:
                 continue  # poisoned: drain submissions, touch nothing
             nbytes = int(getattr(arr, "nbytes", 0))
             t0 = time.perf_counter()
             try:
                 with _transfer_span(self._what, leaf=idx, bytes=nbytes):
+                    tracer = _TRANSFER_TRACER
+                    if ctx is not None and tracer is not None:
+                        # arrowhead inside this upload's span
+                        tracer.flow_end("offload/upload", ctx,
+                                        cat="offload", leaf=idx)
                     # the stage boundary: injected delay + fault,
                     # transient retry up to the budget, then degradation
                     # (the put still completes; the engine checks
@@ -451,9 +456,19 @@ class StreamingUploader:
 
     def submit(self, idx: int, arr):
         """Enqueue leaf ``idx``'s updated host block (called from the
-        Adam loop; never blocks on the transfer)."""
+        Adam loop; never blocks on the transfer).  Each upload carries a
+        TraceContext: the flow opened here (inside the Adam loop's leaf
+        span) terminates inside the worker's ``offload/h2d_params``
+        span, drawing the Adam->upload causal arrow in trace.json."""
+        ctx = None
+        tracer = _TRANSFER_TRACER
+        if tracer is not None and hasattr(tracer, "flow_start"):
+            from ..telemetry.tracing import TraceContext
+            ctx = TraceContext.new()
+            tracer.flow_start("offload/upload", ctx, cat="offload",
+                              leaf=idx)
         with self._cond:
-            self._q.append((idx, arr))
+            self._q.append((idx, arr, ctx))
             self._cond.notify_all()
 
     def finish(self):

@@ -67,6 +67,7 @@ from typing import Any, Callable, Optional
 
 import jax
 
+from ..telemetry.tracing import TraceContext
 from .stages import Channel, Stage, spawn
 
 __all__ = ["DevicePlacedBatch", "DevicePrefetcher"]
@@ -85,13 +86,18 @@ class DevicePlacedBatch:
     batch that happens to contain jax Arrays must still go through the
     engine's reshape/validation."""
 
-    __slots__ = ("tree", "rows", "kind")
+    __slots__ = ("tree", "rows", "kind", "ctx")
 
     def __init__(self, tree: Any, rows: Optional[int] = None,
-                 kind: str = "train"):
+                 kind: str = "train", ctx: Any = None):
         self.tree = tree
         self.rows = rows
         self.kind = kind
+        #: causal-trace identity (telemetry.tracing.TraceContext): the
+        #: producing worker opens a flow inside its place span; the
+        #: consuming step closes it inside its dispatch span, drawing
+        #: the producer->consumer arrow in trace.json
+        self.ctx = ctx
 
 
 class _End:
@@ -129,7 +135,8 @@ class DevicePrefetcher:
 
     def __init__(self, source, place_fn: Optional[Callable] = None,
                  depth: int = 2, span_fn: Optional[Callable] = None,
-                 name: str = "train", stage: Optional[Stage] = None):
+                 name: str = "train", stage: Optional[Stage] = None,
+                 tracer: Optional[Any] = None):
         if not isinstance(depth, int) or isinstance(depth, bool) \
                 or depth < 1:
             raise ValueError(f"prefetch depth must be an int >= 1, "
@@ -158,8 +165,17 @@ class DevicePrefetcher:
             lambda *a, **k: contextlib.nullcontext())
         self.depth = depth
         self.name = name
+        #: causal tracing (docs/observability.md): a TraceRecorder —
+        #: each placed batch gets a TraceContext + a flow opened inside
+        #: its place span; the engine closes it in the consuming step
+        self._tracer = tracer
         self.stage = stage if stage is not None else Stage("prefetch")
         self._chan = Channel(depth)
+        # flight recorder: this prefetcher's queue depth rides every
+        # stage event (a shared train/eval stage samples the
+        # last-constructed prefetcher's channel — close enough for a
+        # post-mortem trajectory)
+        self.stage.depth_fn = self.qsize
         self._ended = False
         # degraded hand-off: the worker stopped and the source belongs
         # to the consumer now (inline iteration); serialized by this lock
@@ -177,6 +193,17 @@ class DevicePrefetcher:
                              name=f"ds-data-prefetch-{name}", restarts=0)
 
     # -- the worker -----------------------------------------------------
+    def _open_flow(self, placed):
+        """Stamp a freshly placed batch with a TraceContext and open its
+        causal flow — called INSIDE the ``data/prefetch_place`` span so
+        the arrow's tail binds to it.  Host-side appends only (the
+        zero-added-device-syncs contract)."""
+        if self._tracer is not None \
+                and isinstance(placed, DevicePlacedBatch):
+            placed.ctx = TraceContext.new()
+            self._tracer.flow_start("data/batch", placed.ctx, cat="data")
+        return placed
+
     def _place_and_drain(self, item):
         placed = self._place(item)
         # drain INSIDE the span: device_put only dispatches, so without
@@ -232,6 +259,7 @@ class DevicePrefetcher:
                     # preserved), degradation on budget exhaustion
                     placed = self.stage.call(
                         "place", lambda: self._place_and_drain(item))
+                    placed = self._open_flow(placed)
             except BaseException as e:
                 self._chan.poison(e)
                 return
@@ -324,9 +352,12 @@ class DevicePrefetcher:
                 self._chan.poison(e)
                 raise
             try:
+                # same span name as the async path: a degraded run's
+                # trace stays readable with the same queries
                 with self._span("data/prefetch_place", cat="data",
                                 inline=True):
                     placed = self._place_and_drain(item)
+                    placed = self._open_flow(placed)
             except BaseException as e:
                 self._chan.poison(e)
                 raise
@@ -380,5 +411,11 @@ class DevicePrefetcher:
     def close(self):
         """Release the worker and drop queued batches.  Idempotent; a
         parked worker (queue full) would otherwise wait forever holding
-        references to ``depth`` device-resident batches."""
+        references to ``depth`` device-resident batches.  Also releases
+        the shared stage record's depth sampler when it is OURS — the
+        bound method would otherwise pin this prefetcher (and its
+        source iterator) for the stage's engine-long lifetime, and
+        later stage events would sample a dead channel's depth."""
+        if self.stage.depth_fn == self.qsize:
+            self.stage.depth_fn = None
         self._chan.close()

@@ -140,11 +140,15 @@ class CheckpointJob(NamedTuple):
     """One fully host-resident save: ``run()`` needs no device access, no
     engine state, and no locks — everything was snapshotted (COPIED) at
     submit time, so the step loop may donate/mutate freely while the
-    writer streams bytes."""
+    writer streams bytes.  ``ctx`` (optional) is the save's causal
+    TraceContext: the flow opened inside the ``checkpoint/save`` span is
+    terminated inside the writer's ``checkpoint/async_write`` span, so
+    trace.json links the submitting step to its background write."""
     tag: str
     tmp_dir: str
     final_dir: str
     run: Callable[[], str]
+    ctx: Optional[object] = None
 
 
 class AsyncCheckpointWriter:
@@ -174,6 +178,12 @@ class AsyncCheckpointWriter:
     def __init__(self, name: str = "ds-ckpt-writer", stage=None):
         self._name = name
         self._stage = stage
+        if stage is not None:
+            # flight recorder: the writer's "queue depth" is its
+            # in-flight job count (racy sample read is fine — it rides
+            # event records, not control flow)
+            stage.depth_fn = lambda: (int(self._pending is not None)
+                                      + int(self._busy is not None))
         self._cv = threading.Condition()
         self._pending: Optional[CheckpointJob] = None
         self._busy: Optional[CheckpointJob] = None
@@ -277,6 +287,11 @@ class AsyncCheckpointWriter:
                     self.last_write_s = time.perf_counter() - t0
                 if self._stage is not None:
                     self._stage.note_ok()
+                    # writer drives the stage record manually (no
+                    # Stage.call), so it records its own outcomes
+                    self._stage.record_event(
+                        "job_ok", tag=job.tag,
+                        dur_s=round(time.perf_counter() - t0, 6))
             except BaseException as e:  # poison THIS save only
                 logger.error(
                     "async checkpoint save %r FAILED (training continues; "
@@ -285,6 +300,10 @@ class AsyncCheckpointWriter:
                 with self._cv:
                     self.failed += 1
                     self._last_error = e
+                if self._stage is not None \
+                        and not self._stage.is_transient(e):
+                    self._stage.record_event("job_failed", tag=job.tag,
+                                             error=repr(e))
                 if self._stage is not None and self._stage.is_transient(e):
                     # a failed SAVE (io_retry already exhausted inside)
                     # counts against the budget; exhausting it degrades
@@ -476,6 +495,12 @@ class PreemptionHandler:
         self._fired = True
         eng = self._engine_ref()
         if eng is not None:
+            # post-mortem first: the preemption save below can itself
+            # fail, and the flight record explains the run's last
+            # moments either way (dump_flight_record never raises)
+            dump = getattr(eng, "dump_flight_record", None)
+            if dump is not None:
+                dump(reason=f"SIGTERM preemption (signal {signum})")
             save_dir = self.save_dir or getattr(
                 eng, "_ckpt_last_save_dir", None)
             if save_dir:

@@ -80,14 +80,16 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..utils.logging import logger
 
 __all__ = [
-    "DEFAULT_MAX_STAGE_FAILURES", "InjectedStageFault", "WorkerAbandoned",
-    "Channel", "Stage", "StageWorker", "StageGraph", "WatchdogPool",
-    "fault_point", "injected_delay", "reset_fault_injection", "spawn",
+    "DEFAULT_MAX_STAGE_FAILURES", "FLIGHT_RING_SIZE", "InjectedStageFault",
+    "WorkerAbandoned", "Channel", "Stage", "StageWorker", "StageGraph",
+    "WatchdogPool", "fault_point", "injected_delay",
+    "reset_fault_injection", "spawn",
 ]
 
 #: default per-stage consecutive-failure budget before degradation
@@ -101,6 +103,12 @@ DEFAULT_MAX_STAGE_FAILURES = 3
 #: ~0.35s+, the same order as ``checkpoint.io_retry``'s backoff.
 RETRY_BACKOFF_BASE_S = 0.05
 RETRY_BACKOFF_MAX_S = 1.0
+
+#: per-stage flight-recorder ring length (docs/observability.md): the
+#: recent structured events a ``flightrec_<step>.json`` dump preserves
+#: for post-mortem — bounded so a multi-day run's recorder costs O(1)
+#: memory per stage.
+FLIGHT_RING_SIZE = 256
 
 
 class InjectedStageFault(OSError):
@@ -416,6 +424,47 @@ class Stage:
         #: telemetry hook installed by the engine:
         #: counter_fn(name, help, amount) — None = log-only
         self.counter_fn: Optional[Callable[[str, str, float], None]] = None
+        #: flight recorder: bounded ring of recent structured events
+        #: (call outcomes, failures, degradation transitions, surfaced
+        #: errors), each stamped with the channel depth when ``depth_fn``
+        #: is installed.  deque.append is atomic; readers snapshot.
+        self.events: deque = deque(maxlen=FLIGHT_RING_SIZE)
+        #: optional queue-depth sampler (the owning subsystem installs
+        #: its channel's qsize) — sampled into every recorded event so a
+        #: dump shows the depth trajectory leading up to a failure
+        self.depth_fn: Optional[Callable[[], int]] = None
+        #: one-shot hook fired when the stage DEGRADES (the engine dumps
+        #: a flight record); called outside the stage lock
+        self.on_degrade: Optional[Callable[["Stage"], None]] = None
+
+    # -- flight recorder -------------------------------------------------
+    def record_event(self, kind: str, **fields) -> None:
+        """Append one structured event to the bounded flight-recorder
+        ring.  Host-only and cheap; the depth sample runs OUTSIDE the
+        stage lock (depth_fn takes its subsystem's own lock), the
+        append inside it so a concurrent ``flight_snapshot`` iteration
+        never races a mutation.  A broken depth sampler must never
+        break the stage."""
+        ev = {"t": time.time(), "kind": kind}
+        if self.depth_fn is not None:
+            try:
+                ev["depth"] = int(self.depth_fn())
+            except Exception:
+                pass
+        ev.update(fields)
+        with self._lock:
+            self.events.append(ev)
+
+    def flight_snapshot(self) -> dict:
+        """Plain-data view of this stage's fault record + event ring —
+        one entry of a ``flightrec_<step>.json`` dump."""
+        with self._lock:
+            return {"degraded": self.degraded, "failures": self.failures,
+                    "max_failures": self.max_failures,
+                    "fallback": self.fallback,
+                    "surfaced": (repr(self._surfaced)
+                                 if self._surfaced else None),
+                    "events": list(self.events)}
 
     # -- hooks ----------------------------------------------------------
     def _count(self, name: str, help: str, n: float = 1):
@@ -469,6 +518,7 @@ class Stage:
                      and not self.degraded)
             if newly:
                 self.degraded = True
+        self.record_event("failure", error=repr(err), consecutive=n)
         self._count("stage_failures_total",
                     "transient stage failures absorbed by the runtime")
         if newly:
@@ -478,9 +528,17 @@ class Stage:
                 "DEGRADING to %s for the rest of the run. Last error: %r",
                 self.name, n, self.max_failures,
                 self.fallback, err)
+            self.record_event("degraded", error=repr(err),
+                              fallback=self.fallback)
             self._count("stage_degraded_total",
                         "stages that fell back to their inline/serial "
                         "equivalent after exhausting the failure budget")
+            if self.on_degrade is not None:
+                try:  # a broken dump hook must never break the stage
+                    self.on_degrade(self)
+                except Exception:
+                    logger.exception(
+                        "stage %r on_degrade hook failed", self.name)
         return n
 
     # -- the policy wrapper ----------------------------------------------
@@ -496,9 +554,12 @@ class Stage:
         attempts = 0
         while True:
             try:
+                t0 = time.perf_counter()
                 self.check(point, path)
                 out = fn()
                 self.note_ok()
+                self.record_event("ok", point=point,
+                                  dur_s=round(time.perf_counter() - t0, 6))
                 return out
             except BaseException as e:
                 if not self.is_transient(e):
@@ -532,6 +593,7 @@ class Stage:
         instead of it vanishing with the daemon thread."""
         with self._lock:
             self._surfaced = err
+        self.record_event("surfaced", error=repr(err))
         self._count("stage_errors_total",
                     "stage failures surfaced outside their normal "
                     "reporting path (post-close/post-abort)")

@@ -15,17 +15,17 @@ p50/p95/p99 step time, samples/sec, and peak HBM offline.
 from .compile_monitor import CompileMonitor
 from .exporters import (JsonlExporter, SummaryWriterBridge,
                         prometheus_text, write_prometheus)
-from .heartbeat import (HeartbeatWriter, StragglerMonitor,
+from .heartbeat import (HeartbeatWriter, StragglerMonitor, beat_ages,
                         read_heartbeats)
-from .hub import TelemetryHub
+from .hub import TelemetryHub, write_flight_record
 from .memory import MemorySampler
 from .registry import Counter, Gauge, Histogram, MetricsRegistry
-from .tracing import SpanHandle, TraceRecorder
+from .tracing import SpanHandle, TraceContext, TraceRecorder
 
 __all__ = [
     "CompileMonitor", "Counter", "Gauge", "HeartbeatWriter", "Histogram",
     "JsonlExporter", "MemorySampler", "MetricsRegistry", "SpanHandle",
     "StragglerMonitor", "SummaryWriterBridge", "TelemetryHub",
-    "TraceRecorder", "prometheus_text", "read_heartbeats",
-    "write_prometheus",
+    "TraceContext", "TraceRecorder", "beat_ages", "prometheus_text",
+    "read_heartbeats", "write_flight_record", "write_prometheus",
 ]

@@ -1,17 +1,27 @@
-"""``python -m deepspeed_tpu.telemetry summarize events.jsonl``
+"""``python -m deepspeed_tpu.telemetry summarize events.jsonl`` and
+``python -m deepspeed_tpu.telemetry diagnose <dir>``
 
-Offline report over the JSONL event stream the hub writes: p50/p95/p99
-step time, samples/sec, peak HBM.  This module is pure stdlib, but the
-``-m`` entry point imports the ``deepspeed_tpu`` package (which imports
-jax) — on a box without the runtime stack, copy this one file and run
-it directly: ``python cli.py summarize events.jsonl``.
+Offline reports over the artifacts the hub writes: ``summarize`` turns
+an events.jsonl into p50/p95/p99 step time, samples/sec, serving
+latency attribution (queue/prefill/decode), liveness, and peak HBM;
+``diagnose`` correlates a flight-record dump (``flightrec_<step>.json``)
+with events.jsonl and trace.json into a post-mortem — which stage
+failed first, the queue-depth trajectory, and the original exception
+(docs/observability.md).  Both tolerate a torn final line (a killed
+run) and REPORT the skipped count instead of silently dropping it.
+This module is pure stdlib, but the ``-m`` entry point imports the
+``deepspeed_tpu`` package (which imports jax) — on a box without the
+runtime stack, copy this one file and run it directly:
+``python cli.py summarize events.jsonl``.
 """
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
@@ -62,7 +72,16 @@ def summarize(path: str, out=None) -> dict:
     sv_tps: List[float] = []
     sv_p50: List[float] = []
     sv_p99: List[float] = []
+    # per-request serving records (kind: serve_request) — the
+    # queue/prefill/decode latency attribution split
+    sv_requests = 0
+    sv_failed = 0
+    sv_queue_wait: List[float] = []
+    sv_ttft: List[float] = []
+    sv_decode: List[float] = []
     stragglers: Optional[float] = None
+    #: last metrics snapshot's heartbeat_age_s gauges (liveness row)
+    beat_ages: Dict[str, float] = {}
     peak_hbm: Optional[float] = None
     host_rss: Optional[float] = None
     bad_lines = 0
@@ -130,6 +149,24 @@ def summarize(path: str, out=None) -> dict:
                     # cumulative counter: the last/maximum value is the
                     # run's total detections
                     stragglers = max(stragglers or 0.0, float(sg))
+            elif kind == "serve_request":
+                sv_requests += 1
+                if rec.get("error"):
+                    sv_failed += 1
+                if rec.get("queue_wait_s") is not None:
+                    sv_queue_wait.append(float(rec["queue_wait_s"]))
+                if rec.get("ttft_s") is not None:
+                    sv_ttft.append(float(rec["ttft_s"]))
+                for t in rec.get("token_times_s") or []:
+                    sv_decode.append(float(t))
+            elif kind == "metrics":
+                # liveness: keep the LAST snapshot's per-host beat ages
+                ages = {m["labels"].get("host", "?"): float(m["value"])
+                        for m in rec.get("metrics") or []
+                        if m.get("name") == "heartbeat_age_s"
+                        and m.get("value") is not None}
+                if ages:
+                    beat_ages = ages
             elif kind == "memory":
                 stats = rec.get("stats") or {}
                 for dev in stats.get("devices", []):
@@ -163,6 +200,11 @@ def summarize(path: str, out=None) -> dict:
     # latency window (the engine computes them cumulatively)
     last_sv_p50 = sv_p50[-1] if sv_p50 else None
     last_sv_p99 = sv_p99[-1] if sv_p99 else None
+    # the per-request attribution split: same interpolation as the
+    # registry's reservoirs, so these reconstruct the histogram p50/p99
+    sv_queue_wait.sort()
+    sv_ttft.sort()
+    sv_decode.sort()
 
     report = {
         "steps": steps,
@@ -177,6 +219,17 @@ def summarize(path: str, out=None) -> dict:
         "serve_tokens_per_s": avg_sv_tps,
         "serve_token_p50_s": last_sv_p50,
         "serve_token_p99_s": last_sv_p99,
+        "serve_requests": sv_requests,
+        "serve_requests_failed": sv_failed,
+        "serve_queue_wait_p50_s": _percentile(sv_queue_wait, 0.50),
+        "serve_queue_wait_p99_s": _percentile(sv_queue_wait, 0.99),
+        "serve_ttft_p50_s": _percentile(sv_ttft, 0.50),
+        "serve_ttft_p99_s": _percentile(sv_ttft, 0.99),
+        "serve_decode_p50_s": _percentile(sv_decode, 0.50),
+        "serve_decode_p99_s": _percentile(sv_decode, 0.99),
+        "liveness_hosts": len(beat_ages) or None,
+        "liveness_max_age_s": (max(beat_ages.values())
+                               if beat_ages else None),
         "straggler_detected_total": stragglers,
         "peak_hbm_bytes": peak_hbm,
         "host_rss_bytes": host_rss,
@@ -218,6 +271,26 @@ def summarize(path: str, out=None) -> dict:
                        f"  p99 {_fmt_s(last_sv_p99)}")
         print(f"  serving            {avg_sv_tps:.1f} tok/s{lat_txt}",
               file=out)
+    if sv_requests:
+        # per-request latency attribution (docs/observability.md): the
+        # Orca-style split of where a request's time went — queue wait
+        # (scheduling pressure) vs prefill/TTFT vs per-token decode
+        fail_txt = f", {sv_failed} failed" if sv_failed else ""
+        print(f"  serve requests     {sv_requests}{fail_txt}", file=out)
+        print(f"    queue wait  p50 "
+              f"{_fmt_s(report['serve_queue_wait_p50_s'])}  p99 "
+              f"{_fmt_s(report['serve_queue_wait_p99_s'])}", file=out)
+        print(f"    ttft        p50 {_fmt_s(report['serve_ttft_p50_s'])}"
+              f"  p99 {_fmt_s(report['serve_ttft_p99_s'])}", file=out)
+        print(f"    decode/tok  p50 "
+              f"{_fmt_s(report['serve_decode_p50_s'])}  p99 "
+              f"{_fmt_s(report['serve_decode_p99_s'])}", file=out)
+    if beat_ages:
+        # liveness (docs/elastic.md): supervisor-visible staleness made
+        # operator-visible — last beat age per host at the final sync
+        print(f"  liveness           {len(beat_ages)} host(s), last "
+              f"beat age max {_fmt_s(max(beat_ages.values()))}",
+              file=out)
     if stragglers is not None:
         # elastic fleet health: hosts flagged slower than the configured
         # multiple of the fleet-median step time (docs/elastic.md)
@@ -231,6 +304,167 @@ def summarize(path: str, out=None) -> dict:
     return report
 
 
+def _read_jsonl_tolerant(path: str):
+    """(records, skipped) — a killed run's torn final line is counted,
+    never silently dropped."""
+    records: List[dict] = []
+    skipped = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                skipped += 1
+    return records, skipped
+
+
+def diagnose(directory: str, out=None) -> dict:
+    """Post-mortem over a telemetry output directory: correlate the
+    newest ``flightrec_<step>.json`` with events.jsonl and trace.json —
+    which stage failed first, whether/what degraded, the queue-depth
+    trajectory leading up to it, and the original exception.  Every
+    artifact is optional (a crash may have lost some); truncated files
+    are tolerated and the skip counts reported."""
+    out = out if out is not None else sys.stdout
+    report: dict = {"directory": directory, "skipped_lines": 0}
+    print(f"telemetry diagnose: {directory}", file=out)
+
+    # -- flight record (newest by step) ---------------------------------
+    recs = glob.glob(os.path.join(directory, "flightrec_*.json"))
+
+    def _step_of(p):
+        try:
+            return int(os.path.basename(p)[len("flightrec_"):-len(".json")])
+        except ValueError:
+            return -1
+    flight = None
+    if recs:
+        path = max(recs, key=_step_of)
+        try:
+            with open(path) as f:
+                flight = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"  flight record {os.path.basename(path)}: "
+                  f"UNREADABLE ({e})", file=out)
+    if flight is None:
+        print("  flight record      none found", file=out)
+    else:
+        report["flightrec_step"] = flight.get("step")
+        report["reason"] = flight.get("reason")
+        report["error"] = flight.get("error")
+        print(f"  flight record      step {flight.get('step')} — "
+              f"{flight.get('reason')}", file=out)
+        if flight.get("error"):
+            print(f"  original exception {flight['error']}", file=out)
+        first_failure = None
+        degraded = []
+        for sname, st in (flight.get("stages") or {}).items():
+            if st.get("degraded"):
+                degraded.append(sname)
+            for ev in st.get("events") or []:
+                if ev.get("kind") in ("failure", "surfaced", "poison",
+                                      "job_failed"):
+                    if first_failure is None or \
+                            ev.get("t", 0) < first_failure[1].get("t", 0):
+                        first_failure = (sname, ev)
+        report["degraded_stages"] = sorted(degraded)
+        if degraded:
+            print(f"  degraded stage(s)  {', '.join(sorted(degraded))}",
+                  file=out)
+        if first_failure is not None:
+            sname, ev = first_failure
+            report["first_failure_stage"] = sname
+            report["first_failure_error"] = ev.get("error")
+            print(f"  first failure      stage {sname!r}: "
+                  f"{ev.get('error')}", file=out)
+            if report.get("error") is None:
+                report["error"] = ev.get("error")
+        for sname, st in sorted((flight.get("stages") or {}).items()):
+            depths = [ev["depth"] for ev in st.get("events") or []
+                      if ev.get("depth") is not None]
+            evn = len(st.get("events") or [])
+            if depths:
+                print(f"  stage {sname:<12} {evn} events; queue depth "
+                      f"{depths[0]} -> {depths[-1]} "
+                      f"(min {min(depths)}, max {max(depths)})",
+                      file=out)
+                report.setdefault("depth_trajectory", {})[sname] = {
+                    "first": depths[0], "last": depths[-1],
+                    "min": min(depths), "max": max(depths),
+                    "samples": len(depths)}
+            else:
+                print(f"  stage {sname:<12} {evn} events", file=out)
+
+    # -- events.jsonl correlation ---------------------------------------
+    events_path = os.path.join(directory, "events.jsonl")
+    if os.path.isfile(events_path):
+        records, skipped = _read_jsonl_tolerant(events_path)
+        report["skipped_lines"] = skipped
+        steps = [r.get("step") for r in records
+                 if r.get("kind") == "step" and r.get("step") is not None]
+        failed_reqs = [r for r in records
+                       if r.get("kind") == "serve_request"
+                       and r.get("error")]
+        report["last_step"] = max(steps) if steps else None
+        report["failed_requests"] = len(failed_reqs)
+        print(f"  events.jsonl       {len(records)} records, last step "
+              f"{report['last_step']}", file=out)
+        if failed_reqs:
+            r0 = failed_reqs[0]
+            print(f"  failed requests    {len(failed_reqs)} (first: "
+                  f"rid={r0.get('rid')} {r0.get('error')})", file=out)
+        if skipped:
+            print(f"  (skipped {skipped} malformed/torn events.jsonl "
+                  "line(s) — truncated final write of a killed run)",
+                  file=out)
+    else:
+        print("  events.jsonl       not present", file=out)
+
+    # -- trace.json correlation -----------------------------------------
+    trace_path = os.path.join(directory, "trace.json")
+    if os.path.isfile(trace_path):
+        try:
+            with open(trace_path) as f:
+                doc = json.load(f)
+            evs = doc.get("traceEvents", [])
+            flows = [e for e in evs if e.get("ph") in ("s", "t", "f")]
+            starts = {e["id"] for e in flows if e["ph"] == "s"}
+            ends = {e["id"] for e in flows if e["ph"] == "f"}
+            dangling = len(starts - ends)
+            dropped = int((doc.get("otherData") or {})
+                          .get("dropped_events", 0))
+            report["trace_events"] = len(evs)
+            report["flow_events"] = len(flows)
+            report["dangling_flows"] = dangling
+            report["trace_dropped_events"] = dropped
+            note = ""
+            if dangling:
+                note = (f", {dangling} DANGLING flow(s) — work in "
+                        "flight at the failure")
+                if dropped:
+                    # a capped buffer can drop a flow's events; don't
+                    # let that masquerade as in-flight work
+                    note += (" (CAVEAT: trace buffer dropped "
+                             f"{dropped} events — dangling may be "
+                             "truncation, not in-flight work)")
+            elif dropped:
+                note = f" ({dropped} events dropped at the buffer cap)"
+            print(f"  trace.json         {len(evs)} events, "
+                  f"{len(flows)} flow events{note}", file=out)
+        except (OSError, ValueError) as e:
+            # a killed run can tear the trace mid-write; say so rather
+            # than crash the post-mortem
+            report["trace_unreadable"] = True
+            print(f"  trace.json         unreadable/truncated ({e})",
+                  file=out)
+    else:
+        print("  trace.json         not present", file=out)
+    return report
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.telemetry",
@@ -240,6 +474,14 @@ def main(argv=None) -> int:
                            help="p50/p95/p99 step time, samples/sec, "
                                 "peak HBM from an events.jsonl")
     p_sum.add_argument("events", help="path to events.jsonl")
+    p_diag = sub.add_parser(
+        "diagnose",
+        help="post-mortem over a telemetry output dir: correlate "
+             "flightrec_*.json + events.jsonl + trace.json")
+    p_diag.add_argument("directory",
+                        help="telemetry output directory (holds "
+                             "flightrec_*.json / events.jsonl / "
+                             "trace.json)")
     args = parser.parse_args(argv)
     if args.cmd == "summarize":
         try:
@@ -247,6 +489,13 @@ def main(argv=None) -> int:
         except OSError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+        return 0
+    if args.cmd == "diagnose":
+        if not os.path.isdir(args.directory):
+            print(f"error: {args.directory} is not a directory",
+                  file=sys.stderr)
+            return 2
+        diagnose(args.directory)
         return 0
     return 2
 

@@ -118,15 +118,24 @@ def _prom_value(v) -> str:
     return repr(float(v))
 
 
+def _prom_help(text: str) -> str:
+    """HELP-text escaping per the exposition format: backslash and
+    newline (label values additionally escape double quotes; HELP does
+    not).  A multi-line docstring-ish help must not tear the line-based
+    format."""
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
 def prometheus_text(registry: MetricsRegistry) -> str:
     """Prometheus text exposition format, one sample per line (every
     non-comment line is ``name{labels} value`` — the acceptance test
-    parses line-by-line)."""
+    parses line-by-line).  Every metric gets a ``# HELP`` line: metrics
+    registered without help text fall back to their own name, so a
+    scraper's metadata view never has silent gaps."""
     lines = []
     for m in registry.metrics():
         name = _prom_name(m.name)
-        if m.help:
-            lines.append(f"# HELP {name} {m.help}")
+        lines.append(f"# HELP {name} {_prom_help(m.help or m.name)}")
         if isinstance(m, Counter):
             lines.append(f"# TYPE {name} counter")
             for key, v in m.series():

@@ -106,6 +106,18 @@ def read_heartbeats(directory: str) -> Dict[str, dict]:
     return out
 
 
+def beat_ages(beats: Dict[str, dict],
+              now: Optional[float] = None) -> Dict[str, float]:
+    """Seconds since each host's last beat, keyed like
+    :func:`read_heartbeats` (``host/process_index``).  The engine
+    exports these as the ``heartbeat_age_s`` gauge so supervisor-visible
+    staleness is also operator-visible (the summarize liveness row);
+    ages clamp at 0 for clock skew between writer and reader."""
+    now = time.time() if now is None else now
+    return {key: max(0.0, now - float(rec.get("time", 0.0)))
+            for key, rec in beats.items()}
+
+
 class StragglerMonitor:
     """Pure fleet-health policy over a heartbeat snapshot.
 

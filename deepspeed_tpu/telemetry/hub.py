@@ -18,7 +18,9 @@ sampler + exporters together and exposes exactly two cadences:
 """
 from __future__ import annotations
 
+import json
 import os
+import time
 from typing import Optional
 
 from .compile_monitor import CompileMonitor
@@ -30,6 +32,37 @@ from .tracing import TraceRecorder
 EVENTS_FILE = "events.jsonl"
 TRACE_FILE = "trace.json"
 PROM_FILE = "metrics.prom"
+FLIGHTREC_PREFIX = "flightrec_"
+FLIGHTREC_VERSION = 1
+
+
+def write_flight_record(directory: str, stages, step: int, reason: str,
+                        error=None, extra: Optional[dict] = None) -> str:
+    """Dump the fault plane's recent history as ``flightrec_<step>.json``
+    (docs/observability.md: the flightrec schema).  ``stages`` maps
+    stage name -> an object exposing ``flight_snapshot()`` (the
+    :class:`~..runtime.stages.Stage` record).  tmp+rename so a reader
+    (or a second dump racing a crash) never sees a torn record; the
+    caller decides the trigger (poison, degradation, SIGTERM, anomaly,
+    on demand)."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"{FLIGHTREC_PREFIX}{int(step)}.json")
+    payload = {
+        "version": FLIGHTREC_VERSION,
+        "reason": reason,
+        "step": int(step),
+        "time": time.time(),
+        "error": repr(error) if error is not None else None,
+        "stages": {name: st.flight_snapshot()
+                   for name, st in dict(stages).items()},
+    }
+    if extra:
+        payload["extra"] = extra
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, default=repr)
+    os.replace(tmp, path)
+    return path
 
 
 class TelemetryHub:
@@ -147,6 +180,15 @@ class TelemetryHub:
             pass
         if self.bridge is not None:
             self.bridge.push(step)
+
+    def dump_flight_record(self, stages, step: int, reason: str,
+                           error=None,
+                           extra: Optional[dict] = None) -> str:
+        """Flight-record dump into this hub's output directory; see
+        :func:`write_flight_record`.  Safe to call after ``close()``
+        (post-mortems happen at shutdown)."""
+        return write_flight_record(self.output_path, stages, step,
+                                   reason, error=error, extra=extra)
 
     # -- shutdown -------------------------------------------------------
     def close(self):

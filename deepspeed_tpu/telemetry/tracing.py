@@ -14,14 +14,93 @@ per-step dispatch spans are read against (the same discipline as
 The exported file loads in ``chrome://tracing`` / Perfetto and in
 ``json.loads`` — every event carries ``ph``/``ts``/``name`` (the
 acceptance contract tests assert).
+
+Causal tracing (docs/observability.md): a :class:`TraceContext` is the
+lightweight identity that rides an item across a stage boundary (a
+prefetched batch through its channel, a checkpoint job into the writer,
+a serve request through its queue), and the ``flow_start`` /
+``flow_step`` / ``flow_end`` methods emit Chrome *flow events*
+(``ph: s/t/f``) that draw causal arrows between the spans enclosing
+them — producer thread to consumer thread.  Flow events are plain
+host-side appends emitted INSIDE already-open spans, so the tested
+zero-added-device-syncs contract is untouched.  Chrome binds a flow by
+the (cat, id, name) triple; emit every phase of one flow with the same
+name.  ``flush_flows`` (called by ``export``) terminates flows still
+open at shutdown so an aborted run's arrows don't dangle.
 """
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+_ids = itertools.count(1)
+
+
+def _next_id() -> int:
+    # itertools.count.__next__ is atomic under the GIL
+    return next(_ids)
+
+
+class TraceContext:
+    """Process-wide-unique identity for one unit of work crossing a
+    stage boundary.  ``trace_id`` is the Chrome flow id; ``span_id`` /
+    ``parent_id`` give nested hand-offs (``child()``) a lineage without
+    any global registry.  Deliberately tiny: it is attached to every
+    prefetched batch and serve request on hot paths."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id: int, span_id: int = 0,
+                 parent_id: int = 0):
+        self.trace_id = int(trace_id)
+        self.span_id = int(span_id)
+        self.parent_id = int(parent_id)
+
+    @classmethod
+    def new(cls) -> "TraceContext":
+        return cls(trace_id=_next_id())
+
+    def child(self) -> "TraceContext":
+        """A hand-off one hop further down the same flow."""
+        return TraceContext(self.trace_id, span_id=_next_id(),
+                            parent_id=self.span_id)
+
+    def __repr__(self):
+        return (f"TraceContext(trace_id={self.trace_id}, "
+                f"span_id={self.span_id}, parent_id={self.parent_id})")
+
+
+class AsyncSpan:
+    """An open Chrome *async* event pair (``ph: b``/``e``), for
+    intervals that overlap other instances of themselves and cross
+    threads — per-request serving lifetimes.  Complete (``X``) events
+    assume a per-thread call stack and mis-render overlapping,
+    non-nested slices; async events are matched by (cat, id, name) and
+    render on their own track.  The ``b`` is emitted at construction on
+    the opening thread; ``end()`` (idempotent) emits the ``e`` wherever
+    the interval actually closes."""
+
+    __slots__ = ("_tracer", "name", "cat", "id", "_done")
+
+    def __init__(self, tracer: "TraceRecorder", name: str, cat: str,
+                 span_id: int, args: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.id = int(span_id)
+        self._done = False
+        tracer._emit_async("b", name, cat, self.id, args)
+
+    def end(self, **extra_args):
+        if self._done:
+            return
+        self._done = True
+        self._tracer._emit_async("e", self.name, self.cat, self.id,
+                                 extra_args or None)
 
 
 class SpanHandle:
@@ -68,6 +147,9 @@ class TraceRecorder:
         self.process_name = process_name
         self.max_events = max_events
         self._tids: Dict[int, int] = {}
+        #: flows started but not yet finished: flow_id -> (name, cat);
+        #: flush_flows terminates them so arrows never dangle
+        self._open_flows: Dict[int, Tuple[str, str]] = {}
 
     # -- clock / ids ----------------------------------------------------
     def _now_us(self) -> float:
@@ -82,12 +164,17 @@ class TraceRecorder:
             return tid
 
     # -- recording ------------------------------------------------------
-    def _append(self, ev: dict):
+    def _append(self, ev: dict, force: bool = False) -> bool:
+        """``force`` bypasses the cap — used ONLY for flow terminators,
+        whose count is bounded by the flow starts already admitted (a
+        dropped ``f`` would leave an ``s`` dangling and make diagnose
+        report phantom in-flight work on a healthy capped run)."""
         with self._lock:
-            if len(self._events) >= self.max_events:
+            if not force and len(self._events) >= self.max_events:
                 self._dropped += 1
-                return
+                return False
             self._events.append(ev)
+            return True
 
     def _emit_complete(self, name: str, cat: str, ts_us: float,
                        dur_us: float, args: Optional[dict]):
@@ -109,6 +196,22 @@ class TraceRecorder:
     def begin(self, name: str, cat: str = "runtime", **args) -> SpanHandle:
         return SpanHandle(self, name, cat, args or None)
 
+    def _emit_async(self, ph: str, name: str, cat: str, span_id: int,
+                    args: Optional[dict]):
+        ev = {"name": name, "cat": cat, "ph": ph, "id": int(span_id),
+              "pid": self.pid, "tid": self._tid(),
+              "ts": round(self._now_us(), 3)}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def async_begin(self, name: str, span_id: int, cat: str = "runtime",
+                    **args) -> AsyncSpan:
+        """Open an async (``b``/``e``) interval — overlap-safe and
+        cross-thread; use for per-request lifetimes where many
+        instances of the same name run concurrently."""
+        return AsyncSpan(self, name, cat, span_id, args or None)
+
     def instant(self, name: str, cat: str = "runtime", **args):
         ev = {"name": name, "cat": cat, "ph": "i", "s": "p",
               "pid": self.pid, "tid": self._tid(),
@@ -116,6 +219,55 @@ class TraceRecorder:
         if args:
             ev["args"] = args
         self._append(ev)
+
+    # -- flow events (causal arrows between spans) ----------------------
+    @staticmethod
+    def _flow_id(ctx) -> int:
+        return ctx if isinstance(ctx, int) else int(ctx.trace_id)
+
+    def _emit_flow(self, ph: str, name: str, cat: str, ctx,
+                   args: Optional[dict]) -> bool:
+        ev = {"name": name, "cat": cat, "ph": ph, "id": self._flow_id(ctx),
+              "pid": self.pid, "tid": self._tid(),
+              "ts": round(self._now_us(), 3)}
+        if ph == "f":
+            ev["bp"] = "e"  # bind to the enclosing slice, like s/t do
+        if args:
+            ev["args"] = args
+        # terminators ride past the cap: an admitted "s" must never be
+        # left dangling because its "f" arrived after the buffer filled
+        return self._append(ev, force=(ph == "f"))
+
+    def flow_start(self, name: str, ctx, cat: str = "flow", **args):
+        """Open a causal flow INSIDE the producer's span (``ph: s`` —
+        the arrow's tail binds to the enclosing slice).  ``ctx`` is a
+        :class:`TraceContext` or a bare int flow id."""
+        if self._emit_flow("s", name, cat, ctx, args or None):
+            with self._lock:
+                self._open_flows[self._flow_id(ctx)] = (name, cat)
+
+    def flow_step(self, name: str, ctx, cat: str = "flow", **args):
+        """Intermediate hand-off (``ph: t``) — e.g. each decode tick a
+        serve request participates in."""
+        self._emit_flow("t", name, cat, ctx, args or None)
+
+    def flow_end(self, name: str, ctx, cat: str = "flow", **args):
+        """Terminate the flow INSIDE the consumer's span (``ph: f`` with
+        ``bp: e`` — the arrowhead binds to the enclosing slice)."""
+        with self._lock:
+            self._open_flows.pop(self._flow_id(ctx), None)
+        self._emit_flow("f", name, cat, ctx, args or None)
+
+    def flush_flows(self) -> int:
+        """Terminate every still-open flow (a poisoned stage, a request
+        in flight at shutdown) so the trace has no dangling arrows;
+        ``export`` calls this.  Returns the number flushed."""
+        with self._lock:
+            pending = list(self._open_flows.items())
+            self._open_flows.clear()
+        for fid, (name, cat) in pending:
+            self._emit_flow("f", name, cat, fid, {"flushed": True})
+        return len(pending)
 
     def counter(self, name: str, values: Dict[str, float],
                 cat: str = "runtime"):
@@ -137,6 +289,7 @@ class TraceRecorder:
 
     def export(self, path: str):
         """Write the Chrome trace-event JSON object form."""
+        self.flush_flows()
         with self._lock:
             events = list(self._events)
             dropped = self._dropped

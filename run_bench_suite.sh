@@ -2,13 +2,46 @@
 # Round-3 hardware bench suite, priority order per VERDICT.md "Next round" #1-2.
 # Each bench has internal watchdogs + subprocess device probes; never SIGTERM
 # TPU jobs externally (wedges the tunnel - BENCH_NOTES.md).
+#
+# --gate: opt-in regression tripwire (tools/benchgate) — after each leg
+# whose bench wrote a fresh BENCH_<name>.json, compare its headline
+# metric against the committed predecessor and ABORT the suite nonzero
+# on a >20% regression.  Off by default: hardware-window runs must
+# finish and report even when slower.
 cd /root/repo
-echo "=== suite start $(date -u +%H:%M:%S) ===" >> bench_suite.log
+GATE=0
+ARGS=()
+for a in "$@"; do
+  if [ "$a" = "--gate" ]; then GATE=1; else ARGS+=("$a"); fi
+done
+set -- "${ARGS[@]}"
+echo "=== suite start $(date -u +%H:%M:%S) gate=$GATE ===" >> bench_suite.log
+gate() {
+  name=$1
+  if [ "$GATE" = "1" ] && [ -f "BENCH_${name}.json" ]; then
+    echo "=== $name benchgate ===" >> bench_suite.log
+    python -m tools.benchgate "BENCH_${name}.json" \
+      >> bench_suite.log 2>&1
+    rc=$?
+    # only exit 1 is a REGRESSION; 0 covers pass/skip/first-run and
+    # 2 (unreadable artifact) is logged but must not wedge a
+    # hardware-window suite
+    if [ "$rc" = "1" ]; then
+      echo "=== $name benchgate REGRESSED — aborting suite ===" \
+        | tee -a bench_suite.log >&2
+      exit 1
+    elif [ "$rc" != "0" ]; then
+      echo "=== $name benchgate rc=$rc (artifact unreadable; " \
+           "continuing) ===" >> bench_suite.log
+    fi
+  fi
+}
 run() {
   name=$1; shift
   echo "=== $name start $(date -u +%H:%M:%S) ===" >> bench_suite.log
   "$@" > "BENCH_${name}_raw.json" 2>> bench_suite.log
   echo "=== $name done rc=$? $(date -u +%H:%M:%S) ===" >> bench_suite.log
+  gate "$name"
 }
 # --serve: just the serving A/B (pure CPU — bench_serve pins
 # JAX_PLATFORMS=cpu; the continuous-batching claim is a scheduling

@@ -1,0 +1,107 @@
+"""tools/benchgate — the BENCH_*.json regression tripwire (fixture
+pairs; stdlib-only, no jax needed)."""
+import json
+
+import pytest
+
+from tools.benchgate import (compare, headline, is_lower_better,
+                             load_committed, main)
+
+
+def _art(value, metric="tokens_per_s_speedup"):
+    return {"metric": metric, "value": value}
+
+
+# ---------------------------------------------------------------------------
+# compare() over fixture pairs
+# ---------------------------------------------------------------------------
+
+def test_improvement_and_small_drift_pass():
+    assert not compare(_art(5.5), _art(5.0))["regressed"]     # faster
+    assert not compare(_art(4.5), _art(5.0))["regressed"]     # -10% ok
+
+
+def test_regression_beyond_threshold_fails():
+    res = compare(_art(3.9), _art(5.0))                       # -22%
+    assert res["regressed"]
+    assert res["change"] == pytest.approx(-0.22)
+    # tighter threshold trips earlier
+    assert compare(_art(4.5), _art(5.0), threshold=0.05)["regressed"]
+
+
+def test_lower_better_metrics_invert_direction():
+    assert is_lower_better("serve_token_p99_latency")
+    assert not is_lower_better("serve_continuous_batching_speedup")
+    lat = "decode_p99_latency"
+    assert compare(_art(0.5, lat), _art(1.0, lat))["regressed"] is False
+    assert compare(_art(1.3, lat), _art(1.0, lat))["regressed"] is True
+    # explicit override beats the name heuristic
+    assert compare(_art(1.3), _art(1.0),
+                   lower_better=True)["regressed"] is True
+
+
+def test_bool_metric_one_to_zero_fails():
+    m = "stage_chaos_degraded_run"
+    assert compare(_art(0, m), _art(1, m))["regressed"] is True
+    assert compare(_art(1, m), _art(1, m))["regressed"] is False
+
+
+def test_zero_baseline_and_metric_rename_are_not_failures():
+    # a committed failed bench (value=0) cannot regress further down on
+    # a higher-is-better metric
+    assert compare(_art(5.0), _art(0.0))["regressed"] is False
+    res = compare(_art(5.0, "new_metric"), _art(1.0, "old_metric"))
+    assert res["comparable"] is False and res["regressed"] is False
+
+
+def test_headline_rejects_non_bench_docs():
+    with pytest.raises(ValueError):
+        headline({"not": "a bench"})
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes (the run_bench_suite.sh --gate contract)
+# ---------------------------------------------------------------------------
+
+def _write(path, doc):
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+def test_cli_pass_fail_and_missing_inputs(tmp_path, capsys):
+    fresh = _write(tmp_path / "BENCH_x.json", _art(5.0))
+    good = _write(tmp_path / "base_good.json", _art(4.8))
+    bad = _write(tmp_path / "base_bad.json", _art(8.0))
+    assert main([fresh, "--baseline", good]) == 0
+    assert main([fresh, "--baseline", bad]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out
+    assert main([str(tmp_path / "missing.json"),
+                 "--baseline", good]) == 2
+    assert main([fresh, "--baseline",
+                 str(tmp_path / "missing.json")]) == 2
+
+
+def test_cli_pre_gate_artifacts_skip_not_fail(tmp_path, capsys):
+    """Regression: legacy BENCH files without a headline metric/value
+    (raw result tables, lists) are SKIPPED (exit 0), never treated as a
+    regression — --gate must not wedge the hardware suite on them."""
+    fresh = _write(tmp_path / "BENCH_flash.json",
+                   {"fwd_ms": 1.2, "bwd_ms": 3.4})   # no value key
+    base = _write(tmp_path / "base.json", {"fwd_ms": 1.0})
+    assert main([fresh, "--baseline", base]) == 0
+    assert "not a gateable artifact" in capsys.readouterr().out
+    listy = _write(tmp_path / "BENCH_bert.json", [{"rows": 1}])
+    assert main([listy, "--baseline", base]) == 0
+
+
+def test_cli_no_committed_predecessor_passes(tmp_path, capsys):
+    # tmp_path is not a git repo: load_committed degrades to None and
+    # the gate passes with a first-run note (a renamed/new bench must
+    # not wedge the suite; tier-1 stays hermetic — fixture pairs only,
+    # never the live working-tree artifacts)
+    fresh = _write(tmp_path / "BENCH_new.json", _art(1.0))
+    assert load_committed(fresh) is None
+    assert main([fresh]) == 0
+    assert "no committed predecessor" in capsys.readouterr().out

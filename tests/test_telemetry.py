@@ -421,6 +421,106 @@ def test_telemetry_config_block_defaults_and_validation():
                         1)
 
 
+def test_prometheus_hostile_label_values_escaped():
+    """Satellite: label values containing backslash, double-quote, and
+    newline must escape per the exposition format (and still parse
+    line-by-line — a newline smuggled into a label would tear the
+    format)."""
+    reg = MetricsRegistry()
+    hostile = 'pa\\th"quoted"\nline2'
+    reg.counter("hostile_total", "h").inc(1, label=hostile)
+    text = prometheus_text(reg)
+    lines = text.strip().splitlines()
+    for line in lines:
+        assert _PROM_LINE.match(line), f"unparseable line: {line!r}"
+    sample = next(l for l in lines if l.startswith("hostile_total{"))
+    assert '\\\\' in sample          # backslash doubled
+    assert '\\"' in sample           # quote escaped
+    assert '\\n' in sample and "\n" not in sample  # newline literalized
+
+
+def test_prometheus_help_fallback_and_escaping():
+    """Satellite: every metric emits a # HELP line — gauges/summaries
+    registered without help text fall back to their name, and help text
+    with newlines/backslashes is escaped (one line per record)."""
+    reg = MetricsRegistry()
+    reg.gauge("helpless_gauge").set(1.0)           # no help text
+    reg.histogram("helpless_seconds").observe(0.5)  # no help text
+    reg.counter("multi_total", "line one\nline two \\ slash").inc()
+    text = prometheus_text(reg)
+    lines = text.strip().splitlines()
+    assert "# HELP helpless_gauge helpless_gauge" in lines
+    assert "# HELP helpless_seconds helpless_seconds" in lines
+    assert ("# HELP multi_total line one\\nline two \\\\ slash"
+            in lines)
+    for line in lines:
+        assert _PROM_LINE.match(line), f"unparseable line: {line!r}"
+
+
+def test_summarize_and_diagnose_tolerate_torn_tail(tmp_path, capsys):
+    """Satellite: a killed run's truncated final events.jsonl line is
+    skipped AND counted — never silently dropped."""
+    from deepspeed_tpu.telemetry.cli import diagnose
+    path = tmp_path / "events.jsonl"
+    with open(path, "w") as f:
+        for i in range(4):
+            f.write(json.dumps({"kind": "step", "step": i + 1,
+                                "dispatch_s": 0.001}) + "\n")
+        # the torn tail: a write killed mid-record, no trailing newline
+        f.write('{"kind": "sync", "step": 4, "interval_')
+    rep = summarize(str(path))
+    assert rep["steps"] == 4
+    assert rep["bad_lines"] == 1
+    out = capsys.readouterr().out
+    assert "skipped 1 unparseable" in out
+    drep = diagnose(str(tmp_path))
+    assert drep["skipped_lines"] == 1
+    assert drep["last_step"] == 4
+    dout = capsys.readouterr().out
+    assert "skipped 1 malformed/torn" in dout
+
+
+def test_heartbeat_ages_and_summarize_liveness_row(tmp_path, capsys):
+    """Satellite: heartbeat staleness is operator-visible — beat_ages
+    over real heartbeat fixtures, the heartbeat_age_s gauge path, and
+    the summarize liveness row built from a metrics snapshot."""
+    from deepspeed_tpu.telemetry.heartbeat import (HeartbeatWriter,
+                                                   beat_ages,
+                                                   read_heartbeats)
+    hb_dir = tmp_path / "hb"
+    w0 = HeartbeatWriter(str(hb_dir), process_index=0, host="hostA")
+    w1 = HeartbeatWriter(str(hb_dir), process_index=1, host="hostB")
+    w0.beat(3)
+    w1.beat(3)
+    beats = read_heartbeats(str(hb_dir))
+    now = beats["hostA/0"]["time"]
+    ages = beat_ages(beats, now=now + 7.5)
+    assert set(ages) == {"hostA/0", "hostB/1"}
+    assert ages["hostA/0"] == pytest.approx(7.5, abs=1.0)
+    # clock skew clamps at zero, never negative
+    assert beat_ages(beats, now=now - 100)["hostA/0"] == 0.0
+
+    # the gauge lands in the metrics snapshot -> summarize liveness row
+    reg = MetricsRegistry()
+    g = reg.gauge("heartbeat_age_s", "beat age")
+    for key, age in ages.items():
+        g.set(age, host=key)
+    reg.counter("straggler_detected_total", "s").inc()
+    path = tmp_path / "events.jsonl"
+    hub_like = json.dumps({"kind": "metrics", "step": 3,
+                           "metrics": reg.snapshot()})
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "step", "step": 1,
+                            "dispatch_s": 0.001}) + "\n")
+        f.write(hub_like + "\n")
+    rep = summarize(str(path))
+    assert rep["liveness_hosts"] == 2
+    assert rep["liveness_max_age_s"] == pytest.approx(
+        max(ages.values()), rel=1e-6)
+    out = capsys.readouterr().out
+    assert "liveness" in out and "2 host(s)" in out
+
+
 def test_hub_close_idempotent(tmp_path):
     hub = TelemetryHub(str(tmp_path), compile_events=False, memory=False)
     hub.record_step(1, 0.01)

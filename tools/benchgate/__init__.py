@@ -1,0 +1,172 @@
+"""benchgate — a regression gate over the committed ``BENCH_*.json``
+artifacts (docs/observability.md).
+
+Every bench in this repo writes a small JSON with a ``metric`` name and
+a headline ``value`` (speedup ratio, tokens/s, boolean-as-1).  The gate
+compares a FRESH artifact against its committed predecessor
+(``git show <rev>:<path>``, default HEAD) and exits nonzero when the
+headline regressed by more than ``threshold`` (default 20%) — the
+tripwire that keeps "the bench quietly got slower" from landing.
+
+Direction is inferred from the metric name (latency/seconds-ish names
+are lower-better; throughput/speedup names higher-better) and can be
+forced with ``--lower-better`` / ``--higher-better``.  A missing
+committed predecessor (first run of a new bench) passes with a note —
+the gate compares history, it does not invent it.
+
+Stdlib only; ``git`` is invoked as a subprocess and its absence (or a
+non-repo checkout) degrades to the same first-run pass.
+
+Usage (the ``run_bench_suite.sh --gate`` leg runs this per bench):
+
+    python -m tools.benchgate BENCH_serve.json
+    python -m tools.benchgate BENCH_x.json --baseline old/BENCH_x.json
+    python -m tools.benchgate BENCH_x.json --rev HEAD~1 --threshold 0.1
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Optional
+
+DEFAULT_THRESHOLD = 0.20
+
+#: metric-name substrings that mean "smaller is better"
+LOWER_BETTER_HINTS = ("latency", "_p50", "_p99", "time_s", "_seconds",
+                      "wall_s", "stall", "_age")
+
+
+def headline(doc: dict):
+    """(metric name, float value) of a BENCH_*.json document."""
+    if not isinstance(doc, dict) or "value" not in doc:
+        raise ValueError("not a bench artifact: no 'value' key")
+    return str(doc.get("metric", "?")), float(doc["value"])
+
+
+def is_lower_better(metric: str,
+                    override: Optional[bool] = None) -> bool:
+    if override is not None:
+        return override
+    m = metric.lower()
+    return any(h in m for h in LOWER_BETTER_HINTS)
+
+
+def compare(fresh: dict, baseline: dict,
+            threshold: float = DEFAULT_THRESHOLD,
+            lower_better: Optional[bool] = None) -> dict:
+    """Compare two bench artifacts; ``regressed`` is True when the
+    fresh headline moved the WRONG way by more than ``threshold``
+    (relative).  Metric-name mismatch is not comparable (never a
+    failure — a renamed bench must not wedge the suite)."""
+    f_metric, f_val = headline(fresh)
+    b_metric, b_val = headline(baseline)
+    if f_metric != b_metric:
+        return {"metric": f_metric, "baseline_metric": b_metric,
+                "comparable": False, "regressed": False,
+                "reason": f"metric changed ({b_metric!r} -> "
+                          f"{f_metric!r}); not comparable"}
+    lower = is_lower_better(f_metric, lower_better)
+    if b_val == 0:
+        # a 0 baseline (failed bench committed as value=0) has no
+        # relative scale; regression = any further move the wrong way
+        change = 0.0 if f_val == b_val else float("inf")
+        regressed = (f_val > b_val) if lower else (f_val < b_val)
+    else:
+        change = (f_val - b_val) / abs(b_val)
+        regressed = (change > threshold) if lower \
+            else (change < -threshold)
+    return {"metric": f_metric, "fresh": f_val, "baseline": b_val,
+            "change": change, "threshold": threshold,
+            "lower_better": lower, "comparable": True,
+            "regressed": bool(regressed),
+            "reason": (f"{f_metric}: {b_val:g} -> {f_val:g} "
+                       f"({change:+.1%}, "
+                       f"{'lower' if lower else 'higher'}-is-better, "
+                       f"threshold {threshold:.0%})"
+                       if change not in (float('inf'),) else
+                       f"{f_metric}: {b_val:g} -> {f_val:g}")}
+
+
+def load_committed(path: str, rev: str = "HEAD") -> Optional[dict]:
+    """The artifact's committed predecessor via ``git show``; None when
+    there is none (first run / no git) — the gate then passes."""
+    absd = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        top = subprocess.run(
+            ["git", "-C", absd, "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=30)
+        if top.returncode != 0:
+            return None
+        rel = os.path.relpath(os.path.abspath(path), top.stdout.strip())
+        out = subprocess.run(
+            ["git", "-C", absd, "show", f"{rev}:{rel}"],
+            capture_output=True, text=True, timeout=30)
+        if out.returncode != 0:
+            return None
+        return json.loads(out.stdout)
+    except (OSError, ValueError, subprocess.SubprocessError):
+        return None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.benchgate",
+        description="fail (exit 1) when a fresh BENCH_*.json regressed "
+                    "its committed predecessor's headline metric")
+    parser.add_argument("fresh", help="path to the fresh BENCH_*.json")
+    parser.add_argument("--baseline",
+                        help="explicit baseline file (default: the "
+                             "committed predecessor via git show)")
+    parser.add_argument("--rev", default="HEAD",
+                        help="git revision holding the predecessor "
+                             "(default HEAD)")
+    parser.add_argument("--threshold", type=float,
+                        default=DEFAULT_THRESHOLD,
+                        help="relative regression tolerance "
+                             "(default 0.20)")
+    dir_group = parser.add_mutually_exclusive_group()
+    dir_group.add_argument("--lower-better", dest="lower",
+                           action="store_true", default=None,
+                           help="force lower-is-better")
+    dir_group.add_argument("--higher-better", dest="lower",
+                           action="store_false",
+                           help="force higher-is-better")
+    args = parser.parse_args(argv)
+    try:
+        with open(args.fresh) as f:
+            fresh = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"benchgate: cannot read {args.fresh}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.baseline is not None:
+        try:
+            with open(args.baseline) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"benchgate: cannot read baseline "
+                  f"{args.baseline}: {e}", file=sys.stderr)
+            return 2
+    else:
+        baseline = load_committed(args.fresh, rev=args.rev)
+        if baseline is None:
+            print(f"benchgate: no committed predecessor for "
+                  f"{args.fresh} at {args.rev} (first run?) — PASS "
+                  "with nothing to compare")
+            return 0
+    try:
+        res = compare(fresh, baseline, threshold=args.threshold,
+                      lower_better=args.lower)
+    except ValueError as e:
+        # pre-gate artifacts (BENCH_flash/bert/moe carry raw result
+        # tables, no headline metric/value): not gateable, never a
+        # failure — the suite's own docstring rule
+        print(f"benchgate: {args.fresh} is not a gateable artifact "
+              f"({e}) — SKIPPED")
+        return 0
+    status = "REGRESSED" if res["regressed"] else "OK"
+    print(f"benchgate: {status} — {res['reason']}")
+    return 1 if res["regressed"] else 0

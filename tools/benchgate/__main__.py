@@ -1,0 +1,6 @@
+"""``python -m tools.benchgate`` entry point."""
+import sys
+
+from . import main
+
+sys.exit(main())

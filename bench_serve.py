@@ -1,8 +1,9 @@
 #!/usr/bin/env python
-"""Serving bench: continuous batching vs sequential per-request decode.
+"""Serving bench: continuous batching vs sequential per-request decode,
+and (``--paged``) the paged-KV concurrency/prefix-reuse A/B.
 
-The claim under test is a SCHEDULING claim, so it is CPU-provable with
-the repo's established fault-injection idiom: ``DS_STAGE_DELAY_S=
+The claims under test are SCHEDULING claims, so they are CPU-provable
+with the repo's established fault-injection idiom: ``DS_STAGE_DELAY_S=
 serve:<s>`` charges every serving tick (admission prefill + masked
 decode step) a synthetic device time, the way the prefetch/offload
 benches inject collate/H2D latency.  A slot pool of size S then retires
@@ -19,6 +20,25 @@ tokens/s and p50/p99 per-token latency come from the same
 Emits BENCH_serve.json:
     {"metric": "serve_continuous_batching_speedup", "value": ...,
      "batched": {...}, "sequential": {...}}
+
+``--paged ab`` runs the PAGED A/B (docs/serving.md) instead:
+
+* **Admitted-slots-at-fixed-KV-bytes** (the headline): the same mixed
+  short/long open-loop workload against (a) the pre-page slot cache
+  whose ``slots × max_seq_len`` stride fills a fixed KV-byte budget and
+  (b) a page pool of the SAME bytes — max concurrently admitted
+  requests is a pure scheduling fact (no injected time needed); the
+  paged pool admits ≥2× because short requests hold pages, not strides.
+* **Prefix-reuse compute proof**: K requests sharing a prompt template
+  with unique suffixes, prefix cache on vs off, under injected
+  per-page prefill device time (the serve stage's delay unit in paged
+  mode) — total prefill time collapses from ``K × template`` to
+  ``1 template + K deltas``, read from the same tracer-timestamp
+  windows the ``serve/prefill`` spans cover.
+
+Emits BENCH_serve_paged.json:
+    {"metric": "serve_paged_admitted_ratio", "value": ...,
+     "paged": {...}, "legacy": {...}, "prefix": {...}}
 """
 import json
 import os
@@ -127,19 +147,201 @@ def run_ab(slots=8, n_requests=16, prompt_len=8, gen_tokens=16,
     return rec
 
 
+# ---------------------------------------------------------------------------
+# --paged: page-table indirection + prefix reuse A/B (docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+def _run_mixed_leg(model, params, serving, requests, tag):
+    """Serve a mixed short/long workload (all submitted up front — the
+    saturation snapshot) and record the max concurrently ADMITTED
+    requests: the number the KV layout, not the wall clock, decides."""
+    from deepspeed_tpu.inference import ServeEngine
+    eng = ServeEngine(model, {"serving": serving}, params=params)
+    reqs = [eng.submit(p, max_new_tokens=g) for p, g in requests]
+    max_concurrent = 0
+    ticks = 0
+    while eng.scheduler.active or eng._pending or eng.queue.qsize():
+        eng.step()
+        ticks += 1
+        max_concurrent = max(max_concurrent, len(eng.scheduler.active))
+        assert ticks < 100_000
+    assert all(r.error is None for r in reqs), \
+        [r.error for r in reqs if r.error]
+    tokens = [r.tokens for r in reqs]
+    kv_bytes = eng.cache_spec.bytes
+    eng.close()
+    return {"tag": tag, "kv_bytes": kv_bytes,
+            "max_concurrent": max_concurrent, "ticks": ticks,
+            "requests": len(reqs),
+            "tokens_total": sum(len(t) for t in tokens)}, tokens
+
+
+def _run_prefix_leg(model, params, serving, prompts, gen_tokens,
+                    tick_delay_s):
+    """Serve template-sharing prompts under injected per-page prefill
+    device time; total prefill seconds comes from the same windows the
+    ``serve/prefill`` tracer spans cover (req.prefill_s)."""
+    from deepspeed_tpu.inference import ServeEngine
+    prev = os.environ.get("DS_STAGE_DELAY_S")
+    try:
+        eng = ServeEngine(model, {"serving": serving}, params=params)
+        # compile prefill/decode BEFORE arming the delay: the A/B
+        # measures scheduling, not XLA compile time
+        eng.submit(prompts[0][:1], max_new_tokens=1)
+        eng.run_until_idle()
+        os.environ["DS_STAGE_DELAY_S"] = f"serve:{tick_delay_s}"
+        from deepspeed_tpu.runtime.stages import reset_fault_injection
+        reset_fault_injection()
+        reqs = [eng.submit(p, max_new_tokens=gen_tokens) for p in prompts]
+        eng.run_until_idle()
+        assert all(r.error is None for r in reqs)
+        out = {
+            "prefill_total_s": sum(r.prefill_s for r in reqs),
+            "computed_tokens": [r.computed_len for r in reqs],
+            "shared_tokens": [r.shared_len for r in reqs],
+            "prefix_hits": eng.prefix.hits if eng.prefix else 0,
+        }
+        tokens = [r.tokens for r in reqs]
+        eng.close()
+        return out, tokens
+    finally:
+        if prev is None:
+            os.environ.pop("DS_STAGE_DELAY_S", None)
+        else:
+            os.environ["DS_STAGE_DELAY_S"] = prev
+        from deepspeed_tpu.runtime.stages import reset_fault_injection
+        reset_fault_injection()
+
+
+def run_paged_ab(kv_budget_slots=4, max_seq_len=64, page_len=8,
+                 n_requests=24, long_every=4, template_len=24,
+                 prefix_k=6, tick_delay_s=0.03, out_dir="."):
+    """The paged A/B: (1) admitted concurrency at a fixed KV-byte
+    budget under a short/long mix, (2) prefix-reuse prefill compute.
+    ``kv_budget_slots`` sets the budget: the slot count whose fixed
+    strides exactly spend it on the legacy arm."""
+    import jax
+    import numpy as np
+    model = _build_model()
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+
+    # -- leg 1: admitted slots at fixed KV bytes ------------------------
+    # budget = kv_budget_slots full strides; the page pool spends the
+    # same bytes as pages (+1 scratch page)
+    budget_tokens = kv_budget_slots * max_seq_len
+    pages = budget_tokens // page_len + 1
+    short = dict(prompt=4, gen=4)       # 8 live tokens -> 1 page
+    long = dict(prompt=template_len, gen=16)
+    requests = []
+    for i in range(n_requests):
+        spec = long if (i % long_every == long_every - 1) else short
+        requests.append((list(rng.integers(0, 256, (spec["prompt"],))),
+                         spec["gen"]))
+    legacy, tok_l = _run_mixed_leg(
+        model, params,
+        {"slots": kv_budget_slots, "max_seq_len": max_seq_len,
+         "prefill_len": template_len + page_len, "queue_capacity": 256},
+        requests, "legacy")
+    paged, tok_p = _run_mixed_leg(
+        model, params,
+        {"slots": 4 * kv_budget_slots, "max_seq_len": max_seq_len,
+         "prefill_len": template_len + page_len, "queue_capacity": 256,
+         "page_len": page_len, "pages": pages},
+        requests, "paged")
+    # over-subscribing the pool may TRUNCATE a long request at pool
+    # exhaustion (the pool-aware kv_capacity finish — the documented
+    # backpressure, docs/serving.md); it must never DIVERGE: every
+    # paged stream matches the legacy arm token for token up to its
+    # length
+    truncated = 0
+    for tl, tp in zip(tok_l, tok_p):
+        assert tp == tl[:len(tp)], "paged arm diverged from legacy"
+        truncated += tp != tl
+    paged["truncated"] = truncated
+
+    # -- leg 2: prefix reuse — compute ∝ 1 template + K deltas ----------
+    template = list(rng.integers(0, 256, (template_len,)))
+    prompts = [template + list(rng.integers(0, 256, (4,)))
+               for _ in range(prefix_k)]
+    serving = {"slots": 4, "max_seq_len": max_seq_len,
+               "prefill_len": template_len + page_len,
+               "page_len": page_len, "queue_capacity": 256}
+    on, tok_on = _run_prefix_leg(
+        model, params, {**serving, "prefix_cache": True}, prompts, 2,
+        tick_delay_s)
+    off, tok_off = _run_prefix_leg(
+        model, params, {**serving, "prefix_cache": False}, prompts, 2,
+        tick_delay_s)
+    assert tok_on == tok_off, "prefix cache changed the token streams"
+
+    rec = {
+        "metric": "serve_paged_admitted_ratio",
+        "value": paged["max_concurrent"] / legacy["max_concurrent"],
+        "page_len": page_len,
+        "paged": paged,
+        "legacy": legacy,
+        "prefix": {
+            "k": prefix_k,
+            "template_len": template_len,
+            "tick_delay_s": tick_delay_s,
+            "on": on,
+            "off": off,
+            "prefill_ratio": (on["prefill_total_s"]
+                              / max(off["prefill_total_s"], 1e-9)),
+        },
+    }
+    with open(os.path.join(out_dir, "BENCH_serve_paged.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+    return rec
+
+
 def main():
     import argparse
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--slots", type=int, default=8)
-    parser.add_argument("--requests", type=int, default=16)
-    parser.add_argument("--prompt", type=int, default=8)
-    parser.add_argument("--gen", type=int, default=16)
-    parser.add_argument("--delay", type=float, default=0.02,
-                        help="injected per-tick device time (s)")
+    parser.add_argument("--slots", type=int, default=None,
+                        help="slot pool size (default 8); with --paged "
+                             "this is the KV-byte budget in legacy-slot "
+                             "strides (default 4 there)")
+    parser.add_argument("--requests", type=int, default=None,
+                        help="workload size (default 16; 24 with "
+                             "--paged)")
+    parser.add_argument("--prompt", type=int, default=8,
+                        help="prompt length (unpaged A/B only — the "
+                             "paged leg drives a fixed short/long mix)")
+    parser.add_argument("--gen", type=int, default=16,
+                        help="tokens per request (unpaged A/B only)")
+    parser.add_argument("--delay", type=float, default=None,
+                        help="injected device time (s): per TICK for "
+                             "the unpaged A/B (default 0.02), per "
+                             "prefill PAGE for the --paged prefix leg "
+                             "(default 0.03)")
+    parser.add_argument("--paged", choices=("on", "off", "ab"),
+                        default=None,
+                        help="run the paged-KV A/B instead "
+                             "(BENCH_serve_paged.json); 'ab' = both "
+                             "arms (on/off are accepted for symmetry "
+                             "with the other benches and also run the "
+                             "full A/B — both arms are needed for the "
+                             "ratio)")
     args = parser.parse_args()
-    rec = run_ab(slots=args.slots, n_requests=args.requests,
-                 prompt_len=args.prompt, gen_tokens=args.gen,
-                 tick_delay_s=args.delay)
+    if args.paged is not None:
+        kw = {}
+        if args.delay is not None:
+            kw["tick_delay_s"] = args.delay
+        if args.slots is not None:
+            kw["kv_budget_slots"] = args.slots
+        if args.requests is not None:
+            kw["n_requests"] = args.requests
+        rec = run_paged_ab(**kw)
+    else:
+        rec = run_ab(slots=(8 if args.slots is None else args.slots),
+                     n_requests=(16 if args.requests is None
+                                 else args.requests),
+                     prompt_len=args.prompt, gen_tokens=args.gen,
+                     tick_delay_s=(0.02 if args.delay is None
+                                   else args.delay))
     print(json.dumps(rec), flush=True)
     return 0
 

@@ -414,9 +414,17 @@ class DeepSpeedServingConfig:
             sv, C.SERVING_FLUSH_INTERVAL, C.SERVING_FLUSH_INTERVAL_DEFAULT)
         self.eos_id = get_scalar_param(
             sv, C.SERVING_EOS_ID, C.SERVING_EOS_ID_DEFAULT)
+        self.page_len = get_scalar_param(
+            sv, C.SERVING_PAGE_LEN, C.SERVING_PAGE_LEN_DEFAULT)
+        self.pages = get_scalar_param(
+            sv, C.SERVING_PAGES, C.SERVING_PAGES_DEFAULT)
+        self.prefix_cache = get_scalar_param(
+            sv, C.SERVING_PREFIX_CACHE, C.SERVING_PREFIX_CACHE_DEFAULT)
         for name, v, lo in ((C.SERVING_SLOTS, self.slots, 1),
                             (C.SERVING_MAX_SEQ_LEN, self.max_seq_len, 0),
                             (C.SERVING_PREFILL_LEN, self.prefill_len, 0),
+                            (C.SERVING_PAGE_LEN, self.page_len, 0),
+                            (C.SERVING_PAGES, self.pages, 0),
                             (C.SERVING_QUEUE_CAPACITY,
                              self.queue_capacity, 1),
                             (C.SERVING_FLUSH_INTERVAL,
@@ -439,6 +447,22 @@ class DeepSpeedServingConfig:
             raise DeepSpeedConfigError(
                 f"serving.{C.SERVING_EOS_ID} must be an int token id "
                 f"(-1 = none), got {self.eos_id!r}")
+        # JSON "true"/"false" strings are truthy — a string here would
+        # silently flip the prefix plane, the PR 5 async_save bug class
+        if not isinstance(self.prefix_cache, bool):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_PREFIX_CACHE} must be a bool, got "
+                f"{self.prefix_cache!r}")
+        if self.pages and not self.page_len:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_PAGES}={self.pages} needs "
+                f"serving.{C.SERVING_PAGE_LEN} > 0 (pages size a paged "
+                "pool; page_len=0 is the pre-page slot cache)")
+        if self.page_len and self.pages and self.pages < 2:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_PAGES}={self.pages} is too small: "
+                "page 0 is the reserved scratch page, so a usable pool "
+                "needs at least 2 pages (0 = auto-size)")
 
 
 class DeepSpeedPipelineConfig:

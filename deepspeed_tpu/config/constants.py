@@ -465,6 +465,23 @@ SERVING_FLUSH_INTERVAL_DEFAULT = 50
 # (per-request eos_id overrides)
 SERVING_EOS_ID = "eos_id"
 SERVING_EOS_ID_DEFAULT = -1
+# paged KV cache (PagedAttention, PAPERS.md): tokens-per-page of the
+# flat page pool replacing the fixed max_seq_len stride per slot.
+# 0 = paged OFF (the pre-page slot cache — the parity reference arm).
+SERVING_PAGE_LEN = "page_len"
+SERVING_PAGE_LEN_DEFAULT = 0
+# total pages in the pool (page 0 is the reserved scratch page masked
+# writes land on).  0 = auto: enough for every slot at max_seq_len
+# (capacity-neutral) + the scratch page, rounded up to the mesh's data
+# width so the pool DP-shards evenly.
+SERVING_PAGES = "pages"
+SERVING_PAGES_DEFAULT = 0
+# prefix reuse over shared pages (RadixAttention, PAPERS.md): prompt
+# prefixes hash to refcounted read-only pages so template-sharing
+# requests pay prefill once; divergent appends copy-on-write the last
+# partial page.  Only meaningful with page_len > 0.
+SERVING_PREFIX_CACHE = "prefix_cache"
+SERVING_PREFIX_CACHE_DEFAULT = True
 
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 PLD_ENABLED = "enabled"

@@ -9,6 +9,10 @@ and the ServeEngine (engine.py) whose ONE compiled decode program
 serves arbitrary request mixes with zero recompiles.
 """
 from .engine import ServeEngine  # noqa: F401
-from .kv_cache import (KVCacheSpec, cache_partition_specs,  # noqa: F401
-                       cache_shardings, init_cache, shard_cache)
-from .scheduler import Request, SlotScheduler  # noqa: F401
+from .kv_cache import (KVCacheSpec, PagedKVCacheSpec,  # noqa: F401
+                       cache_partition_specs, cache_shardings,
+                       init_cache, init_paged_cache,
+                       paged_cache_shardings, paged_partition_specs,
+                       shard_cache)
+from .scheduler import (PagePool, PrefixCache, Request,  # noqa: F401
+                        SlotScheduler)

@@ -18,9 +18,24 @@ serve every mix —
 
 Admission/eviction are the continuous-batching moves (Orca, PAPERS.md):
 a finished slot is refilled on the very next tick instead of waiting
-for the batch to drain.  The KV cache pages through the slot layout of
-``kv_cache.py`` — TP-sharded heads, DP-sharded slots — via the
+for the batch to drain.  The KV cache rides the layouts of
+``kv_cache.py`` — TP-sharded heads, DP-sharded slots/pages — via the
 ordinary mesh plumbing.
+
+Paged mode (``serving.page_len > 0`` — PagedAttention + RadixAttention,
+PAPERS.md): KV storage becomes a flat pool of fixed-size pages and each
+slot gets a host-owned int32 page table passed as a TRACED operand, so
+a short request holds ``ceil(len/page_len)`` pages instead of a full
+``max_seq_len`` stride — the pool, not the slot count, caps how many
+users fit a chip (bench_serve.py --paged proves the multiple).  The
+scheduler grows a refcounted page allocator (free-list alloc on
+admission/append, free on eviction; ``kv_capacity`` finishes become
+pool-exhaustion-aware and admission backpressures when even prefix-
+cache eviction can't free enough pages) and, on top, PREFIX CACHING:
+prompt prefixes hash to refcounted read-only shared pages, a divergent
+append copy-on-writes the last partial page, and the prefill program
+computes only the uncached delta — N requests sharing a system prompt
+store and prefill it once.
 
 Fault plane: the request queue is a stages.py :class:`Channel` and all
 serving work runs under one :class:`Stage` record ("serve", points
@@ -46,12 +61,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..config.config import (DeepSpeedConfig, DeepSpeedServingConfig,
                              DeepSpeedStagesConfig,
                              DeepSpeedTelemetryConfig)
-from ..parallel.mesh import build_mesh
-from ..runtime.stages import Channel, Stage, StageGraph
+from ..parallel.mesh import DATA_AXIS, build_mesh
+from ..runtime.stages import Channel, Stage, StageGraph, injected_delay
 from ..utils.logging import logger
-from .kv_cache import (KVCacheSpec, cache_shardings, init_cache,
-                       shard_cache, validate_cache_mesh)
-from .scheduler import Request, SlotScheduler
+from .kv_cache import (KVCacheSpec, PagedKVCacheSpec, cache_shardings,
+                       init_cache, init_paged_cache,
+                       paged_cache_shardings, shard_cache,
+                       validate_cache_mesh, validate_paged_cache_mesh)
+from .scheduler import PagePool, PrefixCache, Request, SlotScheduler
 
 
 class _ServeConfigView:
@@ -136,13 +153,45 @@ class ServeEngine:
                                    self._param_shardings)
         wte = params["wte"] if isinstance(params, dict) else None
         kv_dtype = wte.dtype if wte is not None else jnp.float32
-        self.cache_spec = KVCacheSpec(
-            layers=mcfg.n_layer, slots=self.slots, heads=mcfg.n_head,
-            max_len=self.max_seq_len, head_dim=mcfg.d_head,
-            dtype=kv_dtype)
-        validate_cache_mesh(mesh, self.cache_spec)
-        self._cache_shardings = cache_shardings(mesh)
-        self.cache = shard_cache(init_cache(self.cache_spec), mesh)
+        self.page_len = cfg.serving.page_len
+        self.paged = self.page_len > 0
+        if self.paged:
+            self.max_pages = -(-self.max_seq_len // self.page_len)
+            pages = cfg.serving.pages
+            if pages == 0:
+                # capacity-neutral auto-size: every slot can still reach
+                # max_seq_len, plus the scratch page, rounded up to the
+                # data width so the pool DP-shards evenly
+                pages = 1 + self.slots * self.max_pages
+                dp = mesh.shape.get(DATA_AXIS, 1)
+                pages += (-pages) % dp
+            self.cache_spec = PagedKVCacheSpec(
+                layers=mcfg.n_layer, slots=self.slots,
+                heads=mcfg.n_head, pages=pages, page_len=self.page_len,
+                head_dim=mcfg.d_head, max_pages=self.max_pages,
+                dtype=kv_dtype)
+            validate_paged_cache_mesh(mesh, self.cache_spec)
+            self._cache_shardings = paged_cache_shardings(mesh)
+            self.cache = shard_cache(init_paged_cache(self.cache_spec),
+                                     mesh, self._cache_shardings)
+            self.pool = PagePool(pages)
+            self.prefix = (PrefixCache(self.page_len, self.pool)
+                           if cfg.serving.prefix_cache else None)
+            #: host-owned page tables, one row per slot; dead entries
+            #: hold the scratch page (a valid index, masked data)
+            self._table = np.zeros((self.slots, self.max_pages),
+                                   np.int32)
+        else:
+            self.pool = None
+            self.prefix = None
+            self.cache_spec = KVCacheSpec(
+                layers=mcfg.n_layer, slots=self.slots, heads=mcfg.n_head,
+                max_len=self.max_seq_len, head_dim=mcfg.d_head,
+                dtype=kv_dtype)
+            validate_cache_mesh(mesh, self.cache_spec)
+            self._cache_shardings = cache_shardings(mesh)
+            self.cache = shard_cache(init_cache(self.cache_spec), mesh,
+                                     self._cache_shardings)
 
         # -- pallas interpret + ambient mesh scope (the engine idiom) ----
         from ..ops.pallas.runtime import (interpret_scope,
@@ -162,31 +211,73 @@ class ServeEngine:
 
         # -- compiled programs -------------------------------------------
         rep = NamedSharding(mesh, P())
+        self._copy_fn = None
 
-        def prefill_fn(params, cache, tokens, length, slot):
-            logits, ks, vs = self.model.prefill(params, tokens)
-            new_k = ks[:, 0][:, None].astype(cache["k"].dtype)
-            new_v = vs[:, 0][:, None].astype(cache["v"].dtype)
-            start = (0, slot, 0, 0, 0)
-            k_cache = jax.lax.dynamic_update_slice(cache["k"], new_k,
-                                                   start)
-            v_cache = jax.lax.dynamic_update_slice(cache["v"], new_v,
-                                                   start)
-            lengths = jax.lax.dynamic_update_slice(
-                cache["lengths"], length[None].astype(jnp.int32),
-                (slot,))
-            last = jax.lax.dynamic_index_in_dim(
-                logits, length - 1, axis=1, keepdims=False)[0]
-            first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            return ({"k": k_cache, "v": v_cache, "lengths": lengths},
-                    first_tok)
+        if self.paged:
+            # delta-aware prefill over the page pool: page_row,
+            # prefix_len and delta_len are TRACED, so one program
+            # serves full prefills AND prefix-hit deltas
+            def prefill_fn(params, cache, tokens, delta_len, prefix_len,
+                           page_row, slot):
+                logits, kp, vp = self.model.prefill_paged(
+                    params, tokens, delta_len, prefix_len, page_row,
+                    cache["k"], cache["v"])
+                total = jnp.reshape(prefix_len + delta_len,
+                                    (1,)).astype(jnp.int32)
+                lengths = jax.lax.dynamic_update_slice(
+                    cache["lengths"], total, (slot,))
+                last = jax.lax.dynamic_index_in_dim(
+                    logits, delta_len - 1, axis=1, keepdims=False)[0]
+                first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                return ({"k": kp, "v": vp, "lengths": lengths},
+                        first_tok)
 
-        def decode_fn(params, cache, tokens, active):
-            logits, k, v, new_len = self.model.decode_step(
-                params, tokens, cache["k"], cache["v"],
-                cache["lengths"], active, impl=self.decode_impl)
-            next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return ({"k": k, "v": v, "lengths": new_len}, next_tok)
+            def decode_fn(params, cache, tokens, active, page_table):
+                logits, k, v, new_len = self.model.decode_step_paged(
+                    params, tokens, cache["k"], cache["v"], page_table,
+                    cache["lengths"], active, impl=self.decode_impl)
+                next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return ({"k": k, "v": v, "lengths": new_len}, next_tok)
+
+            # copy-on-write: duplicate one page (src/dst traced — zero
+            # recompiles no matter which pages diverge)
+            def copy_fn(cache, src, dst):
+                k, v = cache["k"], cache["v"]
+                pk = jax.lax.dynamic_slice_in_dim(k, src, 1, axis=1)
+                pv = jax.lax.dynamic_slice_in_dim(v, src, 1, axis=1)
+                k = jax.lax.dynamic_update_slice_in_dim(k, pk, dst,
+                                                        axis=1)
+                v = jax.lax.dynamic_update_slice_in_dim(v, pv, dst,
+                                                        axis=1)
+                return {"k": k, "v": v, "lengths": cache["lengths"]}
+
+            self._copy_fn = jax.jit(copy_fn, donate_argnums=(0,),
+                                    out_shardings=self._cache_shardings)
+        else:
+            def prefill_fn(params, cache, tokens, length, slot):
+                logits, ks, vs = self.model.prefill(params, tokens)
+                new_k = ks[:, 0][:, None].astype(cache["k"].dtype)
+                new_v = vs[:, 0][:, None].astype(cache["v"].dtype)
+                start = (0, slot, 0, 0, 0)
+                k_cache = jax.lax.dynamic_update_slice(cache["k"],
+                                                       new_k, start)
+                v_cache = jax.lax.dynamic_update_slice(cache["v"],
+                                                       new_v, start)
+                lengths = jax.lax.dynamic_update_slice(
+                    cache["lengths"], length[None].astype(jnp.int32),
+                    (slot,))
+                last = jax.lax.dynamic_index_in_dim(
+                    logits, length - 1, axis=1, keepdims=False)[0]
+                first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                return ({"k": k_cache, "v": v_cache, "lengths": lengths},
+                        first_tok)
+
+            def decode_fn(params, cache, tokens, active):
+                logits, k, v, new_len = self.model.decode_step(
+                    params, tokens, cache["k"], cache["v"],
+                    cache["lengths"], active, impl=self.decode_impl)
+                next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return ({"k": k, "v": v, "lengths": new_len}, next_tok)
 
         self._prefill_fn = jax.jit(
             prefill_fn, donate_argnums=(1,),
@@ -203,8 +294,14 @@ class ServeEngine:
             fallback="chaos-free direct serving (injection plane "
                      "bypassed)")
         # flight recorder: every stage event samples the request-queue
-        # depth, so a dump shows the backlog trajectory before a failure
-        self.stage.depth_fn = self.queue.qsize
+        # depth (and, paged, the pool's free pages), so a dump shows
+        # the backlog + headroom trajectory before a failure
+        if self.paged:
+            self.stage.depth_fn = lambda: {
+                "depth": self.queue.qsize(),
+                "free_pages": self.pool.free_count}
+        else:
+            self.stage.depth_fn = self.queue.qsize
         self.stage.on_degrade = lambda st: self.dump_flight_record(
             reason=f"stage {st.name!r} degraded to {st.fallback}")
         self._graph = StageGraph()
@@ -227,6 +324,8 @@ class ServeEngine:
                 storm_threshold=cfg.telemetry.recompile_storm_threshold)
             self.telemetry.track_program("decode_step", self._decode_fn)
             self.telemetry.track_program("prefill", self._prefill_fn)
+            if self._copy_fn is not None:
+                self.telemetry.track_program("copy_page", self._copy_fn)
             reg = self.telemetry.registry
             self._tokens_total = reg.counter(
                 "serve_tokens_total", "generated tokens")
@@ -248,6 +347,20 @@ class ServeEngine:
                 "level scheduling number)")
             self._active_gauge = reg.gauge(
                 "serve_active_slots", "slots decoding this tick")
+            if self.paged:
+                self._pages_total_gauge = reg.gauge(
+                    "serve_pages_total",
+                    "allocatable KV pages (excludes the scratch page)")
+                self._pages_total_gauge.set(self.cache_spec.pages - 1)
+                self._free_pages_gauge = reg.gauge(
+                    "serve_free_pages", "unallocated KV pages")
+                self._free_pages_gauge.set(self.pool.free_count)
+                self._prefix_hits = reg.counter(
+                    "serve_prefix_hits_total",
+                    "admissions that reused cached prefix pages")
+                self._prefix_misses = reg.counter(
+                    "serve_prefix_misses_total",
+                    "admissions that found no cached prefix")
 
             def _stage_counter(name, help, n):
                 reg.counter(name, help).inc(n)
@@ -257,6 +370,10 @@ class ServeEngine:
         self._rid = 0
         self._ticks = 0
         self._closed = False
+        #: requests popped from the queue but not yet admitted — the
+        #: page-pool backpressure parking spot (head goes first, so
+        #: admission order is preserved under exhaustion)
+        self._pending: deque = deque()
         self._latencies: deque = deque(maxlen=8192)
         self._flush_every = cfg.serving.flush_interval_ticks
         self._last_flush_t = time.perf_counter()
@@ -357,10 +474,14 @@ class ServeEngine:
         if self.telemetry is None:
             return None
         try:
+            extra = {"active_slots": len(self.scheduler.active),
+                     "queued": self.queue.qsize()}
+            if self.paged:
+                extra["free_pages"] = self.pool.free_count
+                extra["pending"] = len(self._pending)
             return self.telemetry.dump_flight_record(
                 {"serve": self.stage}, self._ticks, reason, error=error,
-                extra={"active_slots": len(self.scheduler.active),
-                       "queued": self.queue.qsize()})
+                extra=extra)
         except Exception:
             logger.exception("serve flight-record dump failed "
                              "(reason=%r)", reason)
@@ -388,6 +509,19 @@ class ServeEngine:
         if p50 is not None:
             scalars["serve_token_p50_s"] = p50
             scalars["serve_token_p99_s"] = p99
+        if self.paged:
+            usable = self.cache_spec.pages - 1
+            scalars["serve_free_pages"] = float(self.pool.free_count)
+            scalars["serve_page_utilization"] = (
+                self.pool.used_count / usable if usable else 0.0)
+            if self.prefix is not None:
+                tot = self.prefix.hits + self.prefix.misses
+                if tot:
+                    scalars["serve_prefix_hit_ratio"] = \
+                        self.prefix.hits / tot
+                scalars["serve_prefix_hit_tokens"] = \
+                    float(self.prefix.hit_tokens)
+                scalars["serve_page_cow_total"] = float(self.prefix.cow)
         self.telemetry.on_sync(step=self._ticks, scalars=scalars)
         self._last_flush_t = now
         self._last_flush_tokens = self._tokens_seen
@@ -410,6 +544,15 @@ class ServeEngine:
                 "raise the bucket or truncate the prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.paged:
+            need = -(-len(prompt) // self.page_len)
+            usable = self.cache_spec.pages - 1
+            if need > usable:
+                raise ValueError(
+                    f"prompt needs {need} KV pages but the pool only "
+                    f"has {usable} allocatable pages "
+                    f"(serving.pages={self.cache_spec.pages}, page 0 "
+                    "reserved); it could never be admitted")
         self._rid += 1
         req = Request(rid=self._rid, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
@@ -438,7 +581,145 @@ class ServeEngine:
             return None
 
     # -- admission (prefill) ----------------------------------------------
-    def _admit_one(self, req: Request) -> None:
+    def _admit_one(self, req: Request) -> bool:
+        """Admit one request (prefill + slot assignment).  Returns False
+        when the paged pool can't hold it yet (backpressure — the
+        request stays parked); True otherwise."""
+        if self.paged:
+            return self._admit_one_paged(req)
+        return self._admit_one_slot(req)
+
+    def _alloc_pages(self, n: int):
+        """``n`` fresh pages, evicting least-recently-hit prefix-cache
+        leaves under pressure (the eviction-ordered backpressure valve);
+        None when the pool is dry even after eviction."""
+        pages = self.pool.alloc(n)
+        if pages is None and self.prefix is not None:
+            if self.prefix.evict(n):
+                pages = self.pool.alloc(n)
+        return pages
+
+    def _copy_page(self, src: int, dst: int) -> None:
+        with self._span("serve/page_cow", src=src, dst=dst):
+            with self._pallas_scope():
+                self.cache = self._copy_fn(self.cache, np.int32(src),
+                                           np.int32(dst))
+
+    def _charge_prefill_delay(self, computed_tokens: int) -> None:
+        """Paged arm of the injected-device-time model: the serve
+        stage's ``DS_STAGE_DELAY_S`` unit is ONE PAGE of prefill
+        compute.  ``stage.check`` already charged one unit at the admit
+        boundary; charge the remaining ``ceil(computed/page_len) - 1``
+        here, inside the prefill span — so a prefix-hit delta pays for
+        its delta pages only and the bench's tracer-timestamp proof
+        reads compute ∝ 1 template + K deltas (bench_serve.py)."""
+        if self.stage.degraded:
+            return
+        d = injected_delay(self.stage.name)
+        if d <= 0:
+            return
+        chunks = max(1, -(-computed_tokens // self.page_len))
+        if chunks > 1:
+            time.sleep(d * (chunks - 1))
+
+    def _admit_one_paged(self, req: Request) -> bool:
+        total_pages = -(-len(req.prompt) // self.page_len)
+        if self.prefix is not None:
+            shared_len, spages, cow = self.prefix.match(req.prompt)
+        else:
+            shared_len, spages, cow = 0, [], False
+        need = total_pages - len(spages) + (1 if cow else 0)
+        fresh = self._alloc_pages(need)
+        if fresh is None:
+            if self.prefix is not None:
+                self.prefix.release(spages)
+            return False
+        held = list(spages) + fresh
+        try:
+            # queue wait ends HERE, before any device work: the COW
+            # copy below (and its first-use compile) is compute and
+            # must land in the prefill attribution, not as a spurious
+            # queue-wait spike in the PR 9 latency split
+            req.admit_t = time.perf_counter()
+            if req.queue_span is not None:
+                req.queue_span.end()
+                req.queue_span = None
+            if self.telemetry is not None:
+                self._queue_wait_hist.observe(req.admit_t - req.submit_t)
+            fi = 0
+            if cow:
+                # divergent append into a shared partial page: copy it
+                # into a fresh page BEFORE the delta prefill writes its
+                # remaining rows (the COW of docs/serving.md)
+                self._copy_page(spages[-1], fresh[0])
+                self.pool.deref(spages[-1])
+                held.remove(spages[-1])
+                row = spages[:-1] + fresh[:1]
+                fi = 1
+            else:
+                row = list(spages)
+            row.extend(fresh[fi:])
+            delta = req.prompt[shared_len:]
+            tokens = np.zeros((1, self.prefill_len), np.int32)
+            tokens[0, :len(delta)] = delta
+            row_np = np.zeros((self.max_pages,), np.int32)
+            row_np[:len(row)] = row
+            with self._span("serve/prefill", rid=req.rid,
+                            prompt_len=len(req.prompt),
+                            computed=len(delta), shared=shared_len):
+                tr = self._tracer
+                if tr is not None and req.ctx is not None:
+                    tr.flow_start("serve/request", req.ctx, cat="serve",
+                                  rid=req.rid)
+                self._charge_prefill_delay(len(delta))
+                with self._pallas_scope():
+                    self.cache, first = self._prefill_fn(
+                        self.params, self.cache, tokens,
+                        np.int32(len(delta)), np.int32(shared_len),
+                        row_np, np.int32(self.scheduler.free[0]))
+                first = int(np.asarray(jax.block_until_ready(first)))
+        except BaseException:
+            # roll back every page this admission still holds a ref on
+            for p in held:
+                self.pool.deref(p)
+            raise
+        now = time.perf_counter()
+        req.prefill_s = now - req.admit_t
+        slot = self.scheduler.admit(req, now=now)
+        if self.prefix is not None:
+            # stats count SUCCESSFUL admissions only — neither a
+            # parked request re-matching every tick nor a failed
+            # prefill may inflate the hit ratio; the COW count's one
+            # source of truth is prefix.cow (the flush scalar)
+            self.prefix.note_admission(shared_len)
+            if cow:
+                self.prefix.cow += 1
+            if self.telemetry is not None:
+                (self._prefix_hits if shared_len
+                 else self._prefix_misses).inc()
+        req.pages = row
+        req.shared_len = shared_len
+        req.computed_len = len(delta)
+        self._table[slot, :] = 0
+        self._table[slot, :len(row)] = row
+        if self.prefix is not None:
+            # register the freshly computed pages for future sharers
+            # (full pages of prompt[:-1] + the partial tail)
+            self.prefix.insert(req.prompt, row)
+        req.kv_len = len(req.prompt)
+        req.tokens.append(first)
+        req.token_times.append(now - req.submit_t)
+        req.last_token = first
+        self._count_token(now - req.submit_t)
+        if self.telemetry is not None:
+            self._ttft_hist.observe(now - req.submit_t)
+        reason = self.scheduler.finish_reason(req, first,
+                                              self.max_seq_len)
+        if reason is not None:
+            self._finish(slot, reason)
+        return True
+
+    def _admit_one_slot(self, req: Request) -> bool:
         tokens = np.zeros((1, self.prefill_len), np.int32)
         tokens[0, :len(req.prompt)] = req.prompt
         length = np.int32(len(req.prompt))
@@ -478,20 +759,31 @@ class ServeEngine:
                                               self.max_seq_len)
         if reason is not None:
             self._finish(slot, reason)
+        return True
 
     def _admit(self) -> None:
         while self.scheduler.has_free():
-            req = self._pop_request()
-            if req is None:
-                return
+            if self._pending:
+                req = self._pending[0]
+            else:
+                req = self._pop_request()
+                if req is None:
+                    return
+                self._pending.append(req)
             try:
-                self.stage.call("admit", lambda r=req: self._admit_one(r),
-                                path=f"rid={req.rid}")
+                ok = self.stage.call(
+                    "admit", lambda r=req: self._admit_one(r),
+                    path=f"rid={req.rid}")
+                if not ok:
+                    # page-pool backpressure: the head request stays
+                    # parked until eviction/release frees pages —
+                    # admission order is preserved, the pool (not the
+                    # slot count) is the binding constraint now
+                    return
+                self._pending.popleft()
             except BaseException as e:
-                req.error = e
-                self._write_request_record(req)
-                self._end_request_trace(req, error=e)
-                req.done.set()
+                self._pending.popleft()
+                self._fail_request(req, e)
                 if not isinstance(e, Exception):
                     # KeyboardInterrupt / SystemExit are not a
                     # per-request failure: the cache may have been
@@ -503,8 +795,6 @@ class ServeEngine:
                 # its error and keep serving (Orca-style isolation) —
                 # unless the cache was donated into the failing call, in
                 # which case the engine is broken and must poison
-                if self.telemetry is not None:
-                    self._requests_failed.inc()
                 logger.error("serve: admission of rid=%d failed: %r",
                              req.rid, e)
                 if not isinstance(self.cache.get("k"), jnp.ndarray) or \
@@ -512,8 +802,19 @@ class ServeEngine:
                     self._poison(e)
                     raise
 
+    def _release_pages(self, req: Request) -> None:
+        if req.pages:
+            for p in req.pages:
+                self.pool.deref(p)
+        req.pages = None
+
     def _finish(self, slot: int, reason: str) -> None:
         req = self.scheduler.release(slot, reason)
+        if self.paged:
+            # eviction = page frees + a zeroed table row (scratch): the
+            # freed pages are immediately admissible capacity
+            self._table[slot, :] = 0
+            self._release_pages(req)
         # record + trace close BEFORE done.set(): a waiter released by
         # result() must find the completed artifacts already written
         self._write_request_record(req)
@@ -525,6 +826,21 @@ class ServeEngine:
     # -- the decode tick --------------------------------------------------
     def _decode_tick(self) -> int:
         active_map = dict(self.scheduler.active)
+        if self.paged:
+            # page-boundary appends allocate BEFORE the tick; a dry
+            # pool (even after prefix-cache eviction) finishes the
+            # request with the pool-exhaustion-aware kv_capacity reason
+            # instead of letting the program write into the void
+            for slot, req in list(active_map.items()):
+                idx = req.kv_len // self.page_len
+                if idx >= len(req.pages):
+                    pg = self._alloc_pages(1)
+                    if pg is None:
+                        self._finish(slot, "kv_capacity")
+                        del active_map[slot]
+                        continue
+                    req.pages.append(pg[0])
+                    self._table[slot, idx] = pg[0]
         if not active_map:
             return 0
         tokens = np.zeros((self.slots,), np.int32)
@@ -544,8 +860,13 @@ class ServeEngine:
                                      cat="serve", rid=req.rid,
                                      tick=self._ticks)
             with self._pallas_scope():
-                self.cache, next_tok = self._decode_fn(
-                    self.params, self.cache, tokens, active)
+                if self.paged:
+                    self.cache, next_tok = self._decode_fn(
+                        self.params, self.cache, tokens, active,
+                        self._table)
+                else:
+                    self.cache, next_tok = self._decode_fn(
+                        self.params, self.cache, tokens, active)
             # the per-token latency point: the pull IS the device sync,
             # inside the span (transfer-real, JL006-clean)
             next_host = np.asarray(jax.block_until_ready(next_tok))
@@ -579,6 +900,8 @@ class ServeEngine:
             raise
         if self.telemetry is not None:
             self._active_gauge.set(len(self.scheduler.active))
+            if self.paged:
+                self._free_pages_gauge.set(self.pool.free_count)
         self._ticks += 1
         if self._ticks % self._flush_every == 0:
             self._flush()
@@ -589,15 +912,29 @@ class ServeEngine:
         total tokens produced."""
         total = 0
         for _ in range(max_ticks):
-            if not self.scheduler.active and self.queue.qsize() == 0:
+            if not self.scheduler.active and not self._pending \
+                    and self.queue.qsize() == 0:
                 return total
             total += self.step()
         raise RuntimeError(
             f"serve loop still busy after max_ticks={max_ticks} "
             f"({len(self.scheduler.active)} active, "
+            f"{len(self._pending)} pending, "
             f"{self.queue.qsize()} queued)")
 
     # -- failure + shutdown ----------------------------------------------
+    def _fail_request(self, req: Request, err: BaseException) -> None:
+        """The one per-request failure path: record + trace close
+        BEFORE done.set() (a released waiter must find the artifacts
+        written), and keep the failed counter consistent with the
+        record-derived summarize count."""
+        req.error = err
+        self._write_request_record(req)
+        self._end_request_trace(req, error=err)
+        req.done.set()
+        if self.telemetry is not None:
+            self._requests_failed.inc()
+
     def _poison(self, err: BaseException) -> None:
         """A failed decode tick is fatal for every in-flight request:
         donation means the cache is gone.  Typed propagation — requests
@@ -608,12 +945,14 @@ class ServeEngine:
         self.stage.record_event("poison", error=repr(err))
         for slot in list(self.scheduler.active):
             req = self.scheduler.release(slot, "error")
-            req.error = err
-            self._write_request_record(req)
-            self._end_request_trace(req, error=err)
-            req.done.set()
-            if self.telemetry is not None:
-                self._requests_failed.inc()
+            if self.paged:
+                self._table[slot, :] = 0
+                self._release_pages(req)
+            self._fail_request(req, err)
+        # backpressure-parked requests are in flight too — fail them
+        # with the same original exception, never strand their waiters
+        while self._pending:
+            self._fail_request(self._pending.popleft(), err)
         self.dump_flight_record(reason="serve poison", error=err)
 
     def _close_queue(self):
@@ -627,15 +966,12 @@ class ServeEngine:
             items = list(self.queue.items)
             self.queue.items.clear()
             self.queue.cond.notify_all()
+        items = list(self._pending) + items
+        self._pending.clear()
         for req in items:
-            req.error = err
-            self._write_request_record(req)
-            self._end_request_trace(req, error=err)
-            req.done.set()
-            if self.telemetry is not None:
-                # keep the registry counter consistent with the failed
-                # serve_request records summarize derives its count from
-                self._requests_failed.inc()
+            self._fail_request(req, err)
+        if self.prefix is not None:
+            self.prefix.clear()
 
     def _close_telemetry(self):
         if self.telemetry is not None:

@@ -1,28 +1,43 @@
-"""Slot-based KV cache: the device state of the serving engine.
+"""Slot-based and page-pooled KV caches: the device state of serving.
 
-One fixed-shape pytree holds every request's keys/values:
+Two layouts share this file (docs/serving.md):
+
+**Slot cache** (the pre-page reference arm) — one fixed stride per
+slot:
 
     k, v     [L, S, H, T, Dh]   layer-major, slot-batched
     lengths  [S] int32          per-slot LIVE length (0 = free slot)
 
+**Paged cache** (``serving.page_len > 0`` — PagedAttention, PAPERS.md)
+— a flat pool of fixed-size pages plus host-owned page tables:
+
+    k, v     [L, P, H, page_len, Dh]   layer-major, page-pooled
+    lengths  [S] int32                 per-slot LIVE length
+
+A slot's KV rows live wherever its int32 page table (a TRACED operand
+of the decode program, never part of any compiled shape) points; page 0
+is the reserved scratch page masked writes of inactive slots land on,
+so scatter conflicts can only happen between no-op writes.  Short
+requests hold ceil(len/page_len) pages instead of a full ``max_seq_len``
+stride — the pool, not the slot count, caps concurrency.
+
 The shapes never change for the life of the engine — admission writes a
-prefilled request's K/V rows into its slot, decode appends one row per
-tick, eviction just zeroes the slot's ``lengths`` entry on the next
-admission (the stale rows are masked by length and never attended; the
-decode kernel hard-zeroes length-0 slots).  That static-shape contract
-is what lets ONE compiled decode program serve arbitrary request mixes
-(docs/serving.md).
+prefilled request's K/V rows in place, decode appends one row per tick,
+eviction is host bookkeeping (page frees / masked stale rows).  That
+static-shape contract is what lets ONE compiled decode program serve
+arbitrary request mixes.
 
 Sharding rides the existing mesh plumbing (parallel/mesh.py): heads on
 the ``model`` axis (the same Megatron split the qkv weights declare, so
-each TP shard caches exactly the heads it computes), slots on the
-``data`` axis (replica-parallel serving — the EP/DP batch dimension).
-``lengths`` is replicated: every shard runs the same masking.
+each TP shard caches exactly the heads it computes), slots — or the
+page pool — on the ``data`` axis (replica-parallel serving — the EP/DP
+batch dimension).  ``lengths`` is replicated: every shard runs the same
+masking.
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -71,20 +86,15 @@ def cache_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
             for name, spec in cache_partition_specs().items()}
 
 
-def validate_cache_mesh(mesh: Mesh, spec: KVCacheSpec) -> None:
-    """The slot/head counts must divide their mesh axes — fail at build
-    time with the real story, not as a GSPMD sharding error mid-serve."""
-    dp = mesh.shape.get(DATA_AXIS, 1)
+def _validate_tp_and_axes(mesh: Mesh, heads: int, what: str) -> None:
+    """The checks both cache layouts share: TP-divisible heads and a
+    strictly (data, model) mesh — fail at build time with the real
+    story, not as a GSPMD sharding error mid-serve."""
     tp = mesh.shape.get(MODEL_AXIS, 1)
-    if spec.slots % dp != 0:
+    if heads % tp != 0:
         raise ValueError(
-            f"serving.slots={spec.slots} must be divisible by the mesh's "
-            f"data axis ({dp}): slots are the replica-sharded batch "
-            "dimension of the decode program")
-    if spec.heads % tp != 0:
-        raise ValueError(
-            f"model heads={spec.heads} must be divisible by the mesh's "
-            f"model axis ({tp}) to TP-shard the KV cache")
+            f"model heads={heads} must be divisible by the mesh's "
+            f"model axis ({tp}) to TP-shard the {what}")
     for axis in ("pipe", "seq"):
         if mesh.shape.get(axis, 1) != 1:
             raise ValueError(
@@ -93,8 +103,96 @@ def validate_cache_mesh(mesh: Mesh, spec: KVCacheSpec) -> None:
                 "(data, model) mesh")
 
 
-def shard_cache(cache: Dict[str, jnp.ndarray],
-                mesh: Mesh) -> Dict[str, jnp.ndarray]:
-    sh = cache_shardings(mesh)
-    return {name: jax.device_put(leaf, sh[name])
-            for name, leaf in cache.items()}
+def validate_cache_mesh(mesh: Mesh, spec: KVCacheSpec) -> None:
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    if spec.slots % dp != 0:
+        raise ValueError(
+            f"serving.slots={spec.slots} must be divisible by the mesh's "
+            f"data axis ({dp}): slots are the replica-sharded batch "
+            "dimension of the decode program")
+    _validate_tp_and_axes(mesh, spec.heads, "KV cache")
+
+
+# ---------------------------------------------------------------------------
+# paged layout (serving.page_len > 0)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedKVCacheSpec:
+    """The flat page pool: ``pages`` fixed-size pages of ``page_len``
+    tokens each (page 0 reserved as the scratch page), referenced by
+    per-slot page tables the host owns."""
+    layers: int
+    slots: int
+    heads: int
+    pages: int
+    page_len: int
+    head_dim: int
+    #: table width: pages a slot can reference (ceil(max_len/page_len))
+    max_pages: int
+    dtype: Any = jnp.float32
+
+    @property
+    def bytes(self) -> int:
+        per = jnp.dtype(self.dtype).itemsize
+        return (2 * self.layers * self.pages * self.heads * self.page_len
+                * self.head_dim * per)
+
+    @property
+    def page_bytes(self) -> int:
+        """HBM of ONE page across layers and both of k/v — the
+        allocation quantum the bench's fixed-byte budget divides by."""
+        per = jnp.dtype(self.dtype).itemsize
+        return 2 * self.layers * self.heads * self.page_len \
+            * self.head_dim * per
+
+
+def init_paged_cache(spec: PagedKVCacheSpec) -> Dict[str, jnp.ndarray]:
+    """Fresh all-free paged pool (host zeros; shard with
+    :func:`shard_cache` before handing it to compiled programs)."""
+    shape = (spec.layers, spec.pages, spec.heads, spec.page_len,
+             spec.head_dim)
+    return {
+        "k": jnp.zeros(shape, spec.dtype),
+        "v": jnp.zeros(shape, spec.dtype),
+        "lengths": jnp.zeros((spec.slots,), jnp.int32),
+    }
+
+
+def paged_partition_specs() -> Dict[str, P]:
+    """Pool pages on ``data``, heads on ``model`` — the page pool is
+    the DP-sharded storage dimension the way slots were."""
+    kv = P(None, DATA_AXIS, MODEL_AXIS, None, None)
+    return {"k": kv, "v": kv, "lengths": P()}
+
+
+def paged_cache_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
+    return {name: NamedSharding(mesh, spec)
+            for name, spec in paged_partition_specs().items()}
+
+
+def validate_paged_cache_mesh(mesh: Mesh,
+                              spec: PagedKVCacheSpec) -> None:
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    if spec.pages % dp != 0:
+        raise ValueError(
+            f"serving.pages={spec.pages} must be divisible by the "
+            f"mesh's data axis ({dp}): the page pool is the DP-sharded "
+            "storage dimension of the decode program")
+    _validate_tp_and_axes(mesh, spec.heads, "KV page pool")
+
+
+def shard_cache(cache: Dict[str, jnp.ndarray], mesh: Mesh,
+                shardings: Optional[Dict[str, NamedSharding]] = None,
+                ) -> Dict[str, jnp.ndarray]:
+    """Place a cache pytree (either layout) onto the mesh with ONE
+    batched list-form ``jax.device_put`` for all leaves — the PR 3/4
+    ``_assemble``/``_shard_batch`` idiom: one dispatch instead of one
+    per leaf (the spy test in tests/test_paged_kv.py pins the count)."""
+    if shardings is None:
+        shardings = cache_shardings(mesh)
+    names = sorted(cache)
+    placed = jax.device_put([cache[n] for n in names],
+                            [shardings[n] for n in names])
+    return dict(zip(names, placed))

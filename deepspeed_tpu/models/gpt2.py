@@ -225,6 +225,23 @@ class GPT2Model(TrainModule):
         return gpt2_decode_step(self.config, params, tokens, k_cache,
                                 v_cache, lengths, active, impl=impl)
 
+    def prefill_paged(self, params, tokens, delta_len, prefix_len,
+                      page_row, k_pool, v_pool):
+        """Delta-aware prefill into a paged KV pool — see
+        ``gpt2_prefill_paged``."""
+        return gpt2_prefill_paged(self.config, params, tokens,
+                                  delta_len, prefix_len, page_row,
+                                  k_pool, v_pool)
+
+    def decode_step_paged(self, params, tokens, k_pool, v_pool,
+                          page_table, lengths, active,
+                          impl: Optional[str] = None):
+        """One masked decode tick over the paged KV pool — see
+        ``gpt2_decode_step_paged``."""
+        return gpt2_decode_step_paged(self.config, params, tokens,
+                                      k_pool, v_pool, page_table,
+                                      lengths, active, impl=impl)
+
     # ---------------- param-streaming declaration ----------------
     def streaming_param_spec(self, params):
         """The stacked block leaves stream (one layer per scan tick);
@@ -569,6 +586,212 @@ def gpt2_decode_step(cfg: GPT2Config, params, tokens, k_cache, v_cache,
     logits = (x @ params["wte"].astype(x.dtype).T)[:, 0]
     new_lengths = lengths + active.astype(jnp.int32)
     return logits, k_cache, v_cache, new_lengths
+
+
+# ---------------------------------------------------------------------------
+# paged serving paths (serving.page_len > 0, docs/serving.md): the same
+# block helpers over a flat page pool [P, H, page_len, Dh] addressed
+# through per-slot int32 page tables.  Page 0 is the reserved scratch
+# page: every MASKED write is routed there, so a scatter conflict can
+# only be two no-ops colliding — an active slot's row is never racing
+# a masked write.
+# ---------------------------------------------------------------------------
+
+
+def _paged_cache_write(pool, new, page_ids, offs, active):
+    """Masked one-row-per-slot write into the page pool:
+    ``pool[page_ids[s], :, offs[s]] = new[s]`` where ``active[s]``;
+    inactive slots write their OLD value back at the scratch page.
+    pool [P, H, page_len, Dh], new [S, H, Dh], page_ids/offs [S] int32
+    (already routed to scratch for inactive slots), active [S] bool."""
+    old = pool[page_ids, :, offs]                       # [S, H, Dh]
+    blended = jnp.where(active[:, None, None], new.astype(pool.dtype),
+                        old)
+    return pool.at[page_ids, :, offs].set(blended)
+
+
+def gpt2_block_decode_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
+                            page_table, positions, att_len, active,
+                            impl: str):
+    """One block for a single paged decode tick: x [S, 1, D]; writes
+    the token's K/V at ``positions`` into the slot's page (masked by
+    ``active``, inactive routed to scratch) then attends over
+    ``att_len`` live keys per slot through the page table."""
+    q, k, v = gpt2_qkv_heads(cfg, bp, x)                # [S, H, 1, Dh]
+    page_len = k_pool.shape[2]
+    s_idx = jnp.arange(page_table.shape[0])
+    page_ids = jnp.where(active,
+                         page_table[s_idx, positions // page_len], 0)
+    offs = positions % page_len
+    k_pool = _paged_cache_write(k_pool, k[:, :, 0], page_ids, offs,
+                                active)
+    v_pool = _paged_cache_write(v_pool, v[:, :, 0], page_ids, offs,
+                                active)
+    from ..ops.pallas.decode_attention import decode_attention_paged
+    attn = decode_attention_paged(q[:, :, 0], k_pool, v_pool,
+                                  page_table, att_len, impl=impl)
+    x = gpt2_attn_project(bp, x, attn[:, :, None, :], 0.0, None)
+    h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    return x + gpt2_ffn(bp, h), k_pool, v_pool
+
+
+def gpt2_decode_step_paged(cfg: GPT2Config, params, tokens, k_pool,
+                           v_pool, page_table, lengths, active,
+                           impl: Optional[str] = None):
+    """One decode tick for every slot at once over the paged pool —
+    the paged twin of ``gpt2_decode_step`` (same masked-no-op contract,
+    same traced-operand zero-recompile contract; the page table is one
+    more traced operand).
+
+    tokens [S] int32; k_pool/v_pool [L, P, H, page_len, Dh];
+    page_table [S, max_pages] int32 (dead entries = scratch page 0);
+    lengths [S] int32 — live KV length BEFORE this token; active [S]
+    bool.  Returns (logits [S, V], k_pool, v_pool, new_lengths)."""
+    if impl is None:
+        impl = _decode_attn_impl(cfg)
+    page_len = k_pool.shape[3]
+    cap = page_table.shape[1] * page_len
+    lengths = lengths.astype(jnp.int32)
+    positions = jnp.clip(lengths, 0, min(cap, cfg.n_positions) - 1)
+    x = (params["wte"][tokens][:, None, :]
+         + params["wpe"][positions][:, None, :])
+    att_len = jnp.where(active, lengths + 1, 0).astype(jnp.int32)
+    block_params = params["blocks"]
+    if cfg.scan_layers:
+        def body(x, xs):
+            bp, kc, vc = xs
+            x, kc, vc = gpt2_block_decode_paged(
+                cfg, bp, x, kc, vc, page_table, positions, att_len,
+                active, impl)
+            return x, (kc, vc)
+        x, (k_pool, v_pool) = jax.lax.scan(
+            body, x, (block_params, k_pool, v_pool))
+    else:
+        kc_l, vc_l = [], []
+        for i in range(cfg.n_layer):
+            bp = jax.tree.map(lambda a, i=i: a[i], block_params)
+            x, kc, vc = gpt2_block_decode_paged(
+                cfg, bp, x, k_pool[i], v_pool[i], page_table,
+                positions, att_len, active, impl)
+            kc_l.append(kc)
+            vc_l.append(vc)
+        k_pool, v_pool = jnp.stack(kc_l), jnp.stack(vc_l)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    logits = (x @ params["wte"].astype(x.dtype).T)[:, 0]
+    new_lengths = lengths + active.astype(jnp.int32)
+    return logits, k_pool, v_pool, new_lengths
+
+
+def gpt2_block_prefill_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
+                             page_row, prefix_len, delta_len):
+    """One block of the delta-aware paged prefill: compute the DELTA
+    tokens' K/V (positions ``prefix_len + i``), scatter them into the
+    slot's pages, then attend.
+
+    Two attention arms under ``lax.cond`` on the TRACED ``prefix_len``:
+
+    * ``prefix_len == 0`` (no cached prefix) — the model's OWN prefill
+      attention (flash or dense, exactly ``gpt2_block_prefill``'s ops),
+      so a paged prefill without a prefix hit is BITWISE identical to
+      the pre-page prefill: the parity anchor of tests/test_paged_kv.py.
+    * ``prefix_len > 0`` — dense attention over the pool gathered
+      through ``page_row``: delta query ``i`` (absolute position
+      ``prefix_len+i``) attends every key at absolute position
+      ``<= prefix_len+i`` — the cached prefix plus the causal delta.
+    """
+    q, k, v = gpt2_qkv_heads(cfg, bp, x)                # [1, H, Tq, Dh]
+    Tq = x.shape[1]
+    page_len = k_pool.shape[2]
+    cap = page_row.shape[0] * page_len
+    abs_pos = prefix_len + jnp.arange(Tq, dtype=jnp.int32)
+    valid = jnp.arange(Tq) < delta_len
+    # masked rows route to the scratch page: a clipped dead position
+    # must never collide with a live row's (page, off) target
+    abs_clip = jnp.clip(abs_pos, 0, cap - 1)
+    page_ids = jnp.where(valid, page_row[abs_clip // page_len], 0)
+    offs = abs_clip % page_len
+    kn = k[0].transpose(1, 0, 2)                        # [Tq, H, Dh]
+    vn = v[0].transpose(1, 0, 2)
+    k_pool = _paged_cache_write(k_pool, kn, page_ids, offs, valid)
+    v_pool = _paged_cache_write(v_pool, vn, page_ids, offs, valid)
+
+    def _self_arm(_):
+        # the pre-page prefill attention, op for op
+        if cfg.attn_impl == "flash":
+            from ..ops.pallas.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=True)
+        return causal_attention(q, k, v)
+
+    def _gather_arm(_):
+        from ..ops.pallas.decode_attention import (_default_scale,
+                                                   paged_gather)
+        kg = paged_gather(k_pool, page_row[None])[0]    # [H, T', Dh]
+        vg = paged_gather(v_pool, page_row[None])[0]
+        scale = _default_scale(cfg.d_head)
+        s = jnp.einsum("htd,hsd->hts", q[0], kg,
+                       preferred_element_type=jnp.float32) * scale
+        key_pos = jnp.arange(kg.shape[1], dtype=jnp.int32)
+        ok = key_pos[None, :] <= abs_pos[:, None]       # [Tq, T']
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+        s = jnp.where(ok[None], s, neg)
+        probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("hts,hsd->htd", probs, vg)[None]
+
+    attn = jax.lax.cond(prefix_len == 0, _self_arm, _gather_arm,
+                        operand=None)
+    x = gpt2_attn_project(bp, x, attn, 0.0, None)
+    h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    return x + gpt2_ffn(bp, h), k_pool, v_pool
+
+
+def gpt2_prefill_paged(cfg: GPT2Config, params, tokens, delta_len,
+                       prefix_len, page_row, k_pool, v_pool):
+    """Delta-aware prefill into the paged pool (ONE compiled program
+    for full prefills AND prefix-hit deltas — ``prefix_len``,
+    ``delta_len`` and ``page_row`` are all traced).
+
+    tokens [1, Tq] int32 — the DELTA tokens (prompt minus the cached
+    prefix) right-padded to the static prefill bucket; delta_len /
+    prefix_len scalars; page_row [max_pages] int32 — the slot's FULL
+    table (shared prefix pages + freshly allocated delta pages, dead
+    entries = scratch); k_pool/v_pool [L, P, H, page_len, Dh].
+
+    Returns (logits [1, Tq, V], k_pool, v_pool): logits[0, i] scores
+    the token after absolute position ``prefix_len + i`` — the first
+    generated token reads ``logits[0, delta_len - 1]``.  Padding rows
+    produce garbage-but-finite logits and never contaminate live rows
+    (their K/V scatter is masked to the scratch page)."""
+    B, Tq = tokens.shape
+    if Tq > cfg.n_positions:
+        raise ValueError(
+            f"sequence length {Tq} exceeds n_positions={cfg.n_positions}")
+    prefix_len = jnp.asarray(prefix_len, jnp.int32)
+    delta_len = jnp.asarray(delta_len, jnp.int32)
+    pos = jnp.clip(prefix_len + jnp.arange(Tq, dtype=jnp.int32), 0,
+                   cfg.n_positions - 1)
+    x = params["wte"][tokens] + params["wpe"][pos][None]
+    block_params = params["blocks"]
+    if cfg.scan_layers:
+        def body(x, xs):
+            bp, kc, vc = xs
+            x, kc, vc = gpt2_block_prefill_paged(
+                cfg, bp, x, kc, vc, page_row, prefix_len, delta_len)
+            return x, (kc, vc)
+        x, (k_pool, v_pool) = jax.lax.scan(
+            body, x, (block_params, k_pool, v_pool))
+    else:
+        kc_l, vc_l = [], []
+        for i in range(cfg.n_layer):
+            bp = jax.tree.map(lambda a, i=i: a[i], block_params)
+            x, kc, vc = gpt2_block_prefill_paged(
+                cfg, bp, x, k_pool[i], v_pool[i], page_row, prefix_len,
+                delta_len)
+            kc_l.append(kc)
+            vc_l.append(vc)
+        k_pool, v_pool = jnp.stack(kc_l), jnp.stack(vc_l)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    logits = x @ params["wte"].astype(x.dtype).T
+    return logits, k_pool, v_pool
 
 
 def _layer_norm(x, scale, bias, eps: float = 1e-5):

@@ -22,13 +22,17 @@ enforces, here with traced lengths.
 Compute for blocks entirely beyond a slot's length is skipped
 (``pl.when``), but their HBM->VMEM streaming is not: block index maps
 are grid-index functions and cannot read traced lengths, so a short
-slot still pays full-cache bandwidth.  A scalar-prefetch grid (the
-paged-attention trick) can reclaim that; on the CPU/interpret tier this
-is irrelevant and the simple grid keeps the kernel in the family the
-round-3 hardware notes proved out.
+slot still pays full-cache bandwidth.  The PAGED kernel below
+(:func:`decode_attention_paged`) closes exactly that gap with the
+scalar-prefetch grid of PagedAttention (PAPERS.md): the per-slot page
+table rides as a ``PrefetchScalarGridSpec`` operand, block index maps
+read it to gather the slot's pages per k-block, and a slot streams
+only the pages it owns — the KV layout becomes ``[P, H, page_len, Dh]``
+(a flat pool) instead of one ``max_seq_len`` stride per slot.
 
-``impl='dense'`` is the interpretable reference fallback: the same
-masking semantics in plain jnp, the differential-test oracle and the
+``impl='dense'`` is the interpretable reference fallback on both
+entry points: the same masking semantics in plain jnp (the paged arm
+gathers with ``jnp.take``), the differential-test oracle and the
 serving engine's CPU path.
 """
 from __future__ import annotations
@@ -203,3 +207,158 @@ def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return _decode_pallas(q, k, v, lengths.astype(jnp.int32),
                           sm_scale=sm_scale, block_k=block_k,
                           interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention: page-table indirection over a flat pool
+# ---------------------------------------------------------------------------
+
+
+def paged_gather(pool: jnp.ndarray,
+                 page_table: jnp.ndarray) -> jnp.ndarray:
+    """Materialize a slot-major dense view of the page pool:
+    ``pool [P, H, page_len, Dh]`` gathered through
+    ``page_table [S, max_pages]`` -> ``[S, H, max_pages*page_len, Dh]``.
+
+    Position ``p`` of slot ``s`` is row ``p % page_len`` of page
+    ``page_table[s, p // page_len]`` — the layout contract every paged
+    consumer (kernel, reference, prefill) shares.  ``jnp.take`` keeps
+    the page table traced, so this is recompilation-free."""
+    g = jnp.take(pool, page_table, axis=0)  # [S, M, H, page_len, Dh]
+    S, M, H, L, Dh = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(S, H, M * L, Dh)
+
+
+def _decode_paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr,
+                         *, sm_scale: float, page_len: int, heads: int):
+    jk = pl.program_id(1)
+    nk = pl.num_programs(1)
+    slot = pl.program_id(0) // heads
+    length = len_ref[slot]
+
+    @pl.when(jk == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # whole page at or beyond the live length: nothing to do (its table
+    # entry points at the scratch page — valid storage, dead data)
+    @pl.when(jk * page_len < length)
+    def _compute():
+        q = q_ref[0]                                    # [8, d] broadcast
+        k = k_ref[0, 0]                                 # [page_len, d]
+        v = v_ref[0, 0]                                 # [page_len, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        k_ids = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+            + jk * page_len
+        s = jnp.where(k_ids < length, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = jnp.where(l == 0.0, 0.0,
+                             acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _decode_paged_pallas(q, k_pages, v_pages, page_table, lengths, *,
+                         sm_scale, interpret):
+    P, H, page_len, Dh = k_pages.shape
+    S, max_pages = page_table.shape
+    qf = jnp.broadcast_to(q.reshape(S * H, 1, Dh), (S * H, 8, Dh))
+    pt_flat = page_table.astype(jnp.int32).reshape(-1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S * H, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 8, Dh), lambda g, j, pt, ln: (g, 0, 0)),
+            # THE paged move: the k/v block for grid cell (g, j) is
+            # whatever page the slot's table names — a short slot
+            # streams only the pages it owns (plus scratch no-ops)
+            pl.BlockSpec(
+                (1, 1, page_len, Dh),
+                lambda g, j, pt, ln, H=H, M=max_pages:
+                    (pt[(g // H) * M + j], g % H, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, page_len, Dh),
+                lambda g, j, pt, ln, H=H, M=max_pages:
+                    (pt[(g // H) * M + j], g % H, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 8, Dh), lambda g, j, pt, ln: (g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, Dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_paged_kernel, sm_scale=sm_scale,
+                          page_len=page_len, heads=H),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S * H, 8, Dh), q.dtype),
+        interpret=interpret,
+    )(pt_flat, lengths.astype(jnp.int32), qf, k_pages, v_pages)
+    return out[:, 0, :].reshape(S, H, Dh)
+
+
+def decode_attention_paged(q: jnp.ndarray, k_pages: jnp.ndarray,
+                           v_pages: jnp.ndarray,
+                           page_table: jnp.ndarray,
+                           lengths: jnp.ndarray,
+                           sm_scale: Optional[float] = None,
+                           impl: str = "pallas",
+                           interpret: Optional[bool] = None
+                           ) -> jnp.ndarray:
+    """Single-query attention over a PAGED KV pool (docs/serving.md).
+
+    q: [S, H, Dh] — one new query token per slot.
+    k_pages, v_pages: [P, H, page_len, Dh] — the flat page pool; a
+        slot's position ``p`` lives at row ``p % page_len`` of page
+        ``page_table[s, p // page_len]``.
+    page_table: [S, max_pages] int32, TRACED — dead entries must hold a
+        valid page id (the engine fills them with the scratch page 0);
+        their data is masked, their streaming is a no-op read.
+    lengths: [S] int32, TRACED — per-slot live KV length including the
+        position this query's K/V was just written to.  0 = free slot
+        -> exact-zero output.
+
+    ``impl='dense'`` gathers the pool dense with ``jnp.take`` and runs
+    :func:`decode_attention_reference` — values identical to the
+    pre-page slot layout, the CPU-bitwise parity anchor.  ``'pallas'``
+    is the scalar-prefetch kernel (interpret mode off-TPU)."""
+    assert q.ndim == 3 and k_pages.ndim == 4, (q.shape, k_pages.shape)
+    P, H, page_len, Dh = k_pages.shape
+    S, max_pages = page_table.shape
+    assert q.shape == (S, H, Dh), (q.shape, k_pages.shape)
+    if sm_scale is None:
+        sm_scale = _default_scale(Dh)
+    if impl == "dense":
+        kg = paged_gather(k_pages, page_table)
+        vg = paged_gather(v_pages, page_table)
+        return decode_attention_reference(q, kg, vg, lengths,
+                                          sm_scale=sm_scale)
+    if impl != "pallas":
+        raise ValueError(
+            f"decode_attention_paged impl={impl!r}: expected 'pallas' "
+            "or 'dense'")
+    if interpret is None:
+        interpret = _use_interpret()
+    return _decode_paged_pallas(q, k_pages, v_pages,
+                                page_table.astype(jnp.int32),
+                                lengths.astype(jnp.int32),
+                                sm_scale=sm_scale, interpret=interpret)

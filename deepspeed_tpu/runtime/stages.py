@@ -431,8 +431,11 @@ class Stage:
         self.events: deque = deque(maxlen=FLIGHT_RING_SIZE)
         #: optional queue-depth sampler (the owning subsystem installs
         #: its channel's qsize) — sampled into every recorded event so a
-        #: dump shows the depth trajectory leading up to a failure
-        self.depth_fn: Optional[Callable[[], int]] = None
+        #: dump shows the depth trajectory leading up to a failure.  May
+        #: return a dict of named int gauges instead (keep a "depth"
+        #: key for the primary trajectory): the serve stage samples
+        #: queue depth AND the KV page pool's free-page count
+        self.depth_fn: Optional[Callable[[], Any]] = None
         #: one-shot hook fired when the stage DEGRADES (the engine dumps
         #: a flight record); called outside the stage lock
         self.on_degrade: Optional[Callable[["Stage"], None]] = None
@@ -448,7 +451,15 @@ class Stage:
         ev = {"t": time.time(), "kind": kind}
         if self.depth_fn is not None:
             try:
-                ev["depth"] = int(self.depth_fn())
+                d = self.depth_fn()
+                if isinstance(d, dict):
+                    # multi-gauge sampler (the serve stage stamps queue
+                    # depth AND free-page count); "depth" stays the
+                    # primary key diagnose's trajectory reads
+                    for dk, dv in d.items():
+                        ev[dk] = int(dv)
+                else:
+                    ev["depth"] = int(d)
             except Exception:
                 pass
         ev.update(fields)

@@ -72,6 +72,11 @@ def summarize(path: str, out=None) -> dict:
     sv_tps: List[float] = []
     sv_p50: List[float] = []
     sv_p99: List[float] = []
+    sv_page_util: List[float] = []
+    sv_free_pages: Optional[float] = None
+    sv_prefix_hit: Optional[float] = None
+    sv_prefix_tokens: Optional[float] = None
+    sv_cow: Optional[float] = None
     # per-request serving records (kind: serve_request) — the
     # queue/prefill/decode latency attribution split
     sv_requests = 0
@@ -144,6 +149,24 @@ def summarize(path: str, out=None) -> dict:
                 sp99 = scalars.get("serve_token_p99_s")
                 if sp99 is not None:
                     sv_p99.append(float(sp99))
+                # paged KV pool (docs/serving.md): utilization averages
+                # over flushes; free pages / prefix stats are cumulative
+                # — the LAST flush is the run's answer
+                pu = scalars.get("serve_page_utilization")
+                if pu is not None:
+                    sv_page_util.append(float(pu))
+                fp = scalars.get("serve_free_pages")
+                if fp is not None:
+                    sv_free_pages = float(fp)
+                pr = scalars.get("serve_prefix_hit_ratio")
+                if pr is not None:
+                    sv_prefix_hit = float(pr)
+                pt = scalars.get("serve_prefix_hit_tokens")
+                if pt is not None:
+                    sv_prefix_tokens = float(pt)
+                cw = scalars.get("serve_page_cow_total")
+                if cw is not None:
+                    sv_cow = float(cw)
                 sg = scalars.get("straggler_detected_total")
                 if sg is not None:
                     # cumulative counter: the last/maximum value is the
@@ -227,6 +250,12 @@ def summarize(path: str, out=None) -> dict:
         "serve_ttft_p99_s": _percentile(sv_ttft, 0.99),
         "serve_decode_p50_s": _percentile(sv_decode, 0.50),
         "serve_decode_p99_s": _percentile(sv_decode, 0.99),
+        "serve_page_utilization": (sum(sv_page_util) / len(sv_page_util)
+                                   if sv_page_util else None),
+        "serve_free_pages": sv_free_pages,
+        "serve_prefix_hit_ratio": sv_prefix_hit,
+        "serve_prefix_hit_tokens": sv_prefix_tokens,
+        "serve_page_cow_total": sv_cow,
         "liveness_hosts": len(beat_ages) or None,
         "liveness_max_age_s": (max(beat_ages.values())
                                if beat_ages else None),
@@ -285,6 +314,26 @@ def summarize(path: str, out=None) -> dict:
         print(f"    decode/tok  p50 "
               f"{_fmt_s(report['serve_decode_p50_s'])}  p99 "
               f"{_fmt_s(report['serve_decode_p99_s'])}", file=out)
+    if report["serve_page_utilization"] is not None:
+        # paged KV pool: mean fraction of allocatable pages in use; the
+        # free count is the last flush's headroom (docs/serving.md)
+        free_txt = (f"  free {int(report['serve_free_pages'])} pages"
+                    if report["serve_free_pages"] is not None else "")
+        print(f"  kv page pool       "
+              f"{report['serve_page_utilization'] * 100:.0f}% utilized"
+              f"{free_txt}", file=out)
+    if report["serve_prefix_hit_ratio"] is not None:
+        # prefix reuse: fraction of admissions that found cached prefix
+        # pages, the prompt tokens whose prefill they skipped, and the
+        # copy-on-write count (divergent appends into shared pages)
+        tok_txt = (f", {int(report['serve_prefix_hit_tokens'])} prompt "
+                   "tokens reused"
+                   if report["serve_prefix_hit_tokens"] else "")
+        cow_txt = (f", {int(report['serve_page_cow_total'])} COW"
+                   if report["serve_page_cow_total"] else "")
+        print(f"  prefix cache       "
+              f"{report['serve_prefix_hit_ratio'] * 100:.0f}% hit"
+              f"{tok_txt}{cow_txt}", file=out)
     if beat_ages:
         # liveness (docs/elastic.md): supervisor-visible staleness made
         # operator-visible — last beat age per host at the final sync

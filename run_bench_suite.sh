@@ -43,11 +43,12 @@ run() {
   echo "=== $name done rc=$? $(date -u +%H:%M:%S) ===" >> bench_suite.log
   gate "$name"
 }
-# --serve: just the serving A/B (pure CPU — bench_serve pins
-# JAX_PLATFORMS=cpu; the continuous-batching claim is a scheduling
-# claim proven with injected per-tick device time, never the tunnel)
+# --serve: just the serving A/Bs (pure CPU — bench_serve pins
+# JAX_PLATFORMS=cpu; the continuous-batching and paged-KV claims are
+# scheduling claims proven with injected device time, never the tunnel)
 if [ "$1" = "--serve" ]; then
   run serve python bench_serve.py
+  run serve_paged python bench_serve.py --paged ab
   exit 0
 fi
 # capacity runs LAST: its probes are subprocesses killed on timeout,
@@ -66,6 +67,9 @@ run elastic python bench.py --elastic-smoke
 # serving A/B: continuous batching vs sequential decode (pure CPU,
 # injected per-tick device time — see docs/serving.md)
 run serve python bench_serve.py
+# paged-KV A/B: admitted slots at fixed KV bytes + prefix-reuse
+# prefill compute (pure CPU scheduling claims — see docs/serving.md)
+run serve_paged python bench_serve.py --paged ab
 run bert python bench_bert.py
 run sparse python bench_sparse.py
 run flash python bench_flash.py

@@ -1,0 +1,715 @@
+"""Paged KV cache with prefix reuse (docs/serving.md):
+
+* kernel parity matrix at page-boundary-covering lengths — fp32
+  BITWISE dense-paged vs the pre-page dense reference (the ``jnp.take``
+  anchor) and pallas-paged vs the pre-page pallas kernel; pallas vs
+  dense at the established kernel tolerance,
+* token-stream identity of the paged engine vs the pre-page engine,
+* the zero-recompile contract across mixed page-count request waves,
+* prefix cache: shared-template reuse, copy-on-write of the last
+  partial page, leaf-LRU eviction, pool accounting,
+* pool-exhaustion backpressure + the pool-aware ``kv_capacity`` finish,
+* the batched-``device_put`` satellite, deque free lists, config
+  validation, telemetry flow, flight-recorder depth fields, benchgate
+  direction pin, and the ``bench_serve.py --paged`` smoke.
+"""
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfigError
+from deepspeed_tpu.inference import (PagedKVCacheSpec, ServeEngine,
+                                     init_paged_cache, shard_cache)
+from deepspeed_tpu.inference.kv_cache import (KVCacheSpec, init_cache,
+                                              paged_cache_shardings,
+                                              validate_paged_cache_mesh)
+from deepspeed_tpu.inference.scheduler import (PagePool, PrefixCache,
+                                               SlotScheduler)
+from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2Model,
+                                       gpt2_prefill, gpt2_prefill_paged)
+from deepspeed_tpu.ops.pallas.decode_attention import (
+    decode_attention, decode_attention_paged, paged_gather)
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime.stages import reset_fault_injection
+
+TINY = GPT2Config(vocab_size=128, n_positions=64, d_model=32, n_layer=2,
+                  n_head=4, remat=None, attn_impl="dense")
+TINY_FLASH = GPT2Config(**{**TINY.__dict__, "attn_impl": "flash"})
+
+_CHAOS_ENVS = ("DS_STAGE_FAULT", "DS_STAGE_DELAY_S")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    for env in _CHAOS_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    reset_fault_injection()
+    yield
+    reset_fault_injection()
+
+
+def _tokens(n, vocab=128, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, vocab, (n,)).astype(np.int32)
+
+
+def _pool_and_table(S, H, page_len, max_pages, Dh, seed=0):
+    """A filled pool + disjoint per-slot tables (page 0 = scratch)."""
+    rng = np.random.RandomState(seed)
+    P = 1 + S * max_pages
+    kp = jnp.asarray(rng.randn(P, H, page_len, Dh), jnp.float32)
+    vp = jnp.asarray(rng.randn(P, H, page_len, Dh), jnp.float32)
+    pt = np.arange(1, P).reshape(S, max_pages).astype(np.int32)
+    return kp, vp, jnp.asarray(pt)
+
+
+# ---------------------------------------------------------------------------
+# kernel parity matrix at page-boundary-covering lengths
+# ---------------------------------------------------------------------------
+
+#: len < page_len, == page_len, spanning 3 pages, plus the free slot
+PAGE_BOUNDARY_LENGTHS = [0, 7, 16, 2 * 16 + 5]
+
+
+def test_paged_kernel_parity_matrix():
+    """fp32 parity at page-boundary lengths: dense-paged is BITWISE
+    against the pre-page dense reference on the gathered layout (the
+    jnp.take anchor), pallas-paged is BITWISE against the pre-page
+    pallas kernel at the same block size, and pallas-vs-dense holds the
+    established kernel tolerance."""
+    S, H, page_len, max_pages, Dh = 4, 3, 16, 3, 32
+    kp, vp, pt = _pool_and_table(S, H, page_len, max_pages, Dh)
+    q = jnp.asarray(np.random.RandomState(1).randn(S, H, Dh), jnp.float32)
+    lengths = jnp.asarray(PAGE_BOUNDARY_LENGTHS, jnp.int32)
+    out_d = decode_attention_paged(q, kp, vp, pt, lengths, impl="dense")
+    out_p = decode_attention_paged(q, kp, vp, pt, lengths, impl="pallas",
+                                   interpret=True)
+    # the pre-page reference arms over the SAME values, gathered dense
+    kg, vg = paged_gather(kp, pt), paged_gather(vp, pt)
+    ref_d = decode_attention(q, kg, vg, lengths, impl="dense")
+    ref_p = decode_attention(q, kg, vg, lengths, impl="pallas",
+                             interpret=True, block_k=page_len)
+    np.testing.assert_array_equal(np.asarray(out_d), np.asarray(ref_d))
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(ref_p))
+    np.testing.assert_allclose(out_p, out_d, atol=2e-6, rtol=2e-6)
+    # free slot (length 0) outputs exact zeros on both paged arms
+    assert (np.asarray(out_d[0]) == 0).all()
+    assert (np.asarray(out_p[0]) == 0).all()
+
+
+def test_paged_kernel_masks_dead_pages():
+    """Garbage in pages beyond a slot's live length — and in the dead
+    table entries pointing at the scratch page — must never leak."""
+    S, H, page_len, max_pages, Dh = 2, 2, 8, 3, 16
+    kp, vp, pt = _pool_and_table(S, H, page_len, max_pages, Dh, seed=2)
+    q = jnp.asarray(np.random.RandomState(3).randn(S, H, Dh), jnp.float32)
+    lengths = jnp.asarray([5, 8], jnp.int32)  # only page 0 of each live
+    ptn = np.asarray(pt).copy()
+    poisoned_pt = ptn.copy()
+    poisoned_pt[:, 1:] = 0                    # dead entries -> scratch
+    kp_bad = kp.at[ptn[0, 1]].set(1e4).at[0].set(-1e4)
+    vp_bad = vp.at[ptn[0, 1]].set(1e4).at[0].set(-1e4)
+    for impl in ("dense", "pallas"):
+        clean = decode_attention_paged(q, kp, vp, pt, lengths, impl=impl)
+        dirty = decode_attention_paged(q, kp_bad, vp_bad,
+                                       jnp.asarray(poisoned_pt),
+                                       lengths, impl=impl)
+        np.testing.assert_array_equal(np.asarray(clean),
+                                      np.asarray(dirty))
+
+
+def test_paged_kernel_single_compile_across_tables():
+    """Page table AND lengths are traced: one jit cache entry no
+    matter the mix."""
+    S, H, page_len, max_pages, Dh = 3, 2, 8, 2, 16
+    kp, vp, pt = _pool_and_table(S, H, page_len, max_pages, Dh)
+    q = jnp.asarray(np.random.RandomState(4).randn(S, H, Dh), jnp.float32)
+    f = jax.jit(lambda q, k, v, t, l: decode_attention_paged(
+        q, k, v, t, l, impl="pallas"))
+    for tab, lens in ((pt, [0, 3, 16]),
+                      (jnp.zeros_like(pt), [0, 0, 0]),
+                      (pt[::-1], [8, 8, 1])):
+        f(q, kp, vp, tab, jnp.asarray(lens, jnp.int32)).block_until_ready()
+    assert f._cache_size() == 1
+
+
+def test_paged_kernel_rejects_unknown_impl():
+    S, H, page_len, max_pages, Dh = 2, 2, 8, 2, 16
+    kp, vp, pt = _pool_and_table(S, H, page_len, max_pages, Dh)
+    q = jnp.asarray(np.zeros((S, H, Dh)), jnp.float32)
+    with pytest.raises(ValueError, match="impl"):
+        decode_attention_paged(q, kp, vp, pt,
+                               jnp.zeros((S,), jnp.int32), impl="cuda")
+
+
+# ---------------------------------------------------------------------------
+# paged prefill: bitwise against the pre-page prefill when no prefix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cfg", [TINY, TINY_FLASH],
+                         ids=["dense", "flash"])
+def test_paged_prefill_no_prefix_bitwise(cfg):
+    """The ``prefix_len == 0`` arm of the paged prefill runs the
+    model's OWN attention (dense or flash) — logits AND the written
+    K/V pages are BITWISE identical to ``gpt2_prefill``."""
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    page_len, max_pages = 8, 3
+    t_prompt = 13                                  # spans 2 pages
+    toks = _tokens(t_prompt, seed=5)[None]
+    logits_ref, ks, vs = gpt2_prefill(cfg, params, jnp.asarray(toks))
+    L, H, Dh = cfg.n_layer, cfg.n_head, cfg.d_head
+    P = 1 + max_pages
+    kp = jnp.zeros((L, P, H, page_len, Dh), jnp.float32)
+    vp = jnp.zeros((L, P, H, page_len, Dh), jnp.float32)
+    row = np.zeros((max_pages,), np.int32)
+    npg = -(-t_prompt // page_len)
+    row[:npg] = np.arange(1, 1 + npg)
+    pad = np.zeros((1, 16), np.int32)
+    pad[0, :t_prompt] = toks[0]
+    logits, kp, vp = gpt2_prefill_paged(
+        cfg, params, jnp.asarray(pad), np.int32(t_prompt), np.int32(0),
+        jnp.asarray(row), kp, vp)
+    np.testing.assert_array_equal(np.asarray(logits[0, :t_prompt]),
+                                  np.asarray(logits_ref[0]))
+    for layer in range(L):
+        got_k = paged_gather(kp[layer], jnp.asarray(row)[None])[0]
+        got_v = paged_gather(vp[layer], jnp.asarray(row)[None])[0]
+        np.testing.assert_array_equal(
+            np.asarray(got_k[:, :t_prompt]), np.asarray(ks[layer, 0]))
+        np.testing.assert_array_equal(
+            np.asarray(got_v[:, :t_prompt]), np.asarray(vs[layer, 0]))
+
+
+# ---------------------------------------------------------------------------
+# engine: token streams identical to the pre-page engine
+# ---------------------------------------------------------------------------
+
+
+def _serve_cfg(slots=4, max_seq=32, prefill=24, telemetry_path=None,
+               **serving_extra):
+    cfg = {"serving": {"slots": slots, "max_seq_len": max_seq,
+                       "prefill_len": prefill, **serving_extra}}
+    if telemetry_path is not None:
+        cfg["telemetry"] = {"enabled": True,
+                            "output_path": str(telemetry_path)}
+    return cfg
+
+
+#: prompt lengths covering every page boundary of page_len=8: inside
+#: the first page, == page_len, and spanning 3 pages
+BOUNDARY_PROMPTS = [1, 3, 8, 17, 20]
+
+
+@pytest.mark.parametrize("cfg", [TINY, TINY_FLASH],
+                         ids=["dense", "flash"])
+def test_paged_engine_token_streams_match_prepage(cfg):
+    """THE engine-level acceptance bar: the paged engine emits
+    token-for-token the same streams as the pre-page engine — for
+    single-page-sufficient requests AND page-spanning ones, on both
+    kernel arms."""
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    prompts = [list(_tokens(n, seed=10 + i))
+               for i, n in enumerate(BOUNDARY_PROMPTS)]
+
+    def run(extra):
+        eng = ServeEngine(model, _serve_cfg(**extra), params=params)
+        rs = [eng.submit(p, max_new_tokens=10) for p in prompts]
+        eng.run_until_idle()
+        toks = [r.tokens for r in rs]
+        assert all(r.error is None for r in rs)
+        assert all(r.finish_reason == "length" for r in rs)
+        eng.close()
+        return toks
+
+    assert run({}) == run({"page_len": 8})
+
+
+def test_paged_engine_dense_decode_is_bitwise_vs_prepage():
+    """On the dense arm the whole paged chain (prefill + every decode
+    tick) is bitwise, so even argmax TIES can't diverge: compare full
+    greedy streams at an adversarially long generation."""
+    model = GPT2Model(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    p = list(_tokens(9, seed=33))
+
+    def run(extra):
+        eng = ServeEngine(model, _serve_cfg(slots=1, **extra),
+                          params=params)
+        r = eng.submit(p, max_new_tokens=23)   # to the kv_capacity edge
+        eng.run_until_idle()
+        out = (r.tokens, r.finish_reason)
+        eng.close()
+        return out
+
+    assert run({}) == run({"page_len": 8})
+
+
+def test_paged_zero_recompiles_mixed_page_count_waves(tmp_path):
+    """Acceptance bar: one compiled decode program (and one prefill,
+    one COW copy) survives waves of requests with VARYING page counts —
+    zero recompiles, cache size 1."""
+    eng = ServeEngine(GPT2Model(TINY), _serve_cfg(
+        slots=3, page_len=8, telemetry_path=tmp_path))
+    rng = np.random.default_rng(7)
+    reqs = []
+    for wave in range(3):
+        for i in range(5):
+            n = int(rng.integers(1, 24))       # 1..3 pages per prompt
+            reqs.append(eng.submit(
+                list(_tokens(n, seed=100 * wave + i)),
+                max_new_tokens=int(rng.integers(1, 9))))
+        eng.run_until_idle()
+    assert all(r.error is None for r in reqs)
+    eng.telemetry.compile_monitor.sample()
+    reg = eng.telemetry.registry
+    for prog in ("decode_step", "prefill", "copy_page"):
+        assert reg.counter("recompiles_total").value(program=prog) == 0
+    assert eng._decode_fn._cache_size() == 1
+    assert eng._prefill_fn._cache_size() == 1
+    eng.close()
+
+
+def test_paged_submit_validation():
+    eng = ServeEngine(GPT2Model(TINY), _serve_cfg(
+        slots=2, page_len=8, pages=3, prefill=24))
+    # 2 usable pages: a 3-page prompt can never be admitted
+    with pytest.raises(ValueError, match="KV pages"):
+        eng.submit(list(_tokens(17, seed=1)))
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# prefix cache: shared templates, COW, eviction, accounting
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_cache_shared_template_prefills_delta_only(tmp_path):
+    """K requests sharing a template: the prefill computes the full
+    prompt once and only the delta afterwards; token streams stay
+    identical to prefix-cache-off."""
+    model = GPT2Model(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    template = list(_tokens(16, seed=40))          # exactly 2 pages
+    prompts = [template + list(_tokens(3, seed=41 + i))
+               for i in range(4)]
+
+    def run(prefix_cache, tel=None):
+        eng = ServeEngine(model, _serve_cfg(
+            page_len=8, prefix_cache=prefix_cache, telemetry_path=tel),
+            params=params)
+        rs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+        eng.run_until_idle()
+        out = [r.tokens for r in rs]
+        computed = [r.computed_len for r in rs]
+        shared = [r.shared_len for r in rs]
+        stats = (eng.prefix.hits, eng.prefix.misses,
+                 eng.prefix.hit_tokens) if eng.prefix else None
+        reg = (eng.telemetry.registry if eng.telemetry else None)
+        hits_counter = (reg.counter("serve_prefix_hits_total").value()
+                        if reg else None)
+        eng.close()
+        assert all(r.error is None for r in rs)
+        return out, computed, shared, stats, hits_counter
+
+    on = run(True, tel=tmp_path)
+    off = run(False)
+    assert on[0] == off[0], "prefix cache changed the token streams"
+    # first request misses and computes everything; later ones compute
+    # only the 3-token suffix + the uncacheable last-page remainder
+    assert on[1][0] == 19 and all(c == 3 for c in on[1][1:])
+    assert on[2][0] == 0 and all(s == 16 for s in on[2][1:])
+    assert on[3] == (3, 1, 48)
+    assert on[4] == 3
+    # prefix-cache-off never shares
+    assert all(c == 19 for c in off[1])
+
+
+def test_prefix_cache_cow_on_divergent_append():
+    """Identical prompts share down INTO the last partial page; the
+    divergent append triggers copy-on-write, and the streams match a
+    no-prefix-cache run bit for bit."""
+    model = GPT2Model(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = list(_tokens(13, seed=50))            # 1 full + 5-token tail
+
+    def run(prefix_cache):
+        eng = ServeEngine(model, _serve_cfg(
+            page_len=8, prefix_cache=prefix_cache), params=params)
+        rs = [eng.submit(list(prompt), max_new_tokens=6)
+              for _ in range(3)]
+        eng.run_until_idle()
+        out = [r.tokens for r in rs]
+        cow = eng.prefix.cow if eng.prefix else None
+        eng.close()
+        assert all(r.error is None for r in rs)
+        return out, cow
+
+    on, cow = run(True)
+    off, _ = run(False)
+    assert on == off
+    # requests 2 and 3 hit the partial tail (4 cacheable tokens of it)
+    # and each must COW before appending
+    assert cow == 2
+
+
+def test_prefix_cache_last_token_never_cached():
+    """The vLLM rule: a full-prompt hit still computes >= 1 token so
+    prefill has logits to emit the first generated token from."""
+    pool = PagePool(8)
+    pc = PrefixCache(4, pool)
+    prompt = list(range(8))                        # exactly 2 pages
+    pages = pool.alloc(2)
+    pc.insert(prompt, pages)
+    # an identical prompt may share at most len-1 = 7 tokens -> only
+    # the first full page (4) + 3 tokens of the second
+    shared, spages, cow = pc.match(prompt)
+    assert shared == 7 and len(spages) == 2 and cow
+    pc.release(spages)
+
+
+def test_prefix_cache_leaf_lru_eviction_keeps_chains_reachable():
+    pool = PagePool(16)
+    pc = PrefixCache(4, pool)
+    # two chains sharing nothing: A (2 full pages + tail), B (1 full)
+    a = [1] * 4 + [2] * 4 + [3, 3]
+    b = [9] * 4 + [8, 8]
+    pa = pool.alloc(3)
+    pc.insert(a, pa)
+    pb = pool.alloc(2)
+    pc.insert(b, pb)
+    held = pc.entries
+    assert held == 5
+    # evict until 12 pages free: leaf-first order means a chain's inner
+    # page is never dropped while a deeper entry still chains through it
+    pc.evict(12)
+    for d, fe in pc.full.items():
+        parent = fe.parent
+        while parent:
+            assert parent in pc.full, "evicted an inner chain page"
+            parent = pc.full[parent].parent
+    for parent in pc.partials:
+        assert parent == "" or parent in pc.full
+
+
+def test_page_pool_contracts():
+    pool = PagePool(5)
+    assert pool.free_count == 4 and pool.used_count == 0
+    got = pool.alloc(2)
+    assert len(got) == 2 and 0 not in got
+    assert pool.alloc(3) is None                   # no side effects
+    assert pool.free_count == 2
+    pool.ref(got[0])
+    pool.deref(got[0])
+    assert pool.free_count == 2                    # still held once
+    pool.deref(got[0])
+    assert pool.free_count == 3                    # freed
+    pool.deref(got[1])
+    with pytest.raises(AssertionError, match="double free"):
+        pool.deref(got[1])
+    with pytest.raises(ValueError, match="scratch"):
+        pool.ref(0)
+    with pytest.raises(ValueError, match="2 pages"):
+        PagePool(1)
+
+
+def test_slot_scheduler_free_list_is_deque():
+    from collections import deque
+    s = SlotScheduler(4)
+    assert isinstance(s.free, deque)
+    eng = ServeEngine(GPT2Model(TINY), _serve_cfg(page_len=8))
+    assert isinstance(eng.pool.free, deque)
+    eng.close()
+
+
+def test_paged_pool_accounting_after_drain():
+    """Every page returns to the free list once its holders are gone:
+    slots release on finish, the prefix cache holds only its entries."""
+    model = GPT2Model(TINY)
+    eng = ServeEngine(model, _serve_cfg(page_len=8, prefix_cache=True))
+    usable = eng.cache_spec.pages - 1
+    rs = [eng.submit(list(_tokens(n, seed=60 + n)), max_new_tokens=4)
+          for n in (3, 9, 17)]
+    eng.run_until_idle()
+    assert all(r.error is None for r in rs)
+    # only the prefix cache still holds pages — one per entry
+    assert eng.pool.used_count == eng.prefix.entries
+    assert sum(eng.pool.refs.values()) == eng.prefix.entries
+    eng.prefix.clear()
+    assert eng.pool.free_count == usable
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# pool exhaustion: backpressure + pool-aware kv_capacity
+# ---------------------------------------------------------------------------
+
+
+def test_pool_exhaustion_admission_backpressure():
+    """More demand than pages: admission parks requests (order
+    preserved) until releases free pages — every request completes."""
+    model = GPT2Model(TINY)
+    eng = ServeEngine(model, _serve_cfg(
+        slots=4, page_len=8, pages=5, prefix_cache=False))
+    # each request needs 2 pages (prompt 9) but only 4 are usable
+    rs = [eng.submit(list(_tokens(9, seed=70 + i)), max_new_tokens=3)
+          for i in range(4)]
+    saw_pending = False
+    ticks = 0
+    while eng.scheduler.active or eng._pending or eng.queue.qsize():
+        eng.step()
+        saw_pending = saw_pending or bool(eng._pending)
+        ticks += 1
+        assert ticks < 1000
+    assert saw_pending, "pool never backpressured"
+    for r in rs:
+        assert r.error is None and r.finish_reason == "length"
+    eng.close()
+
+
+def test_pool_exhaustion_decode_append_finishes_kv_capacity():
+    """A request that can't grow into a new page finishes with the
+    pool-exhaustion-aware kv_capacity reason instead of wedging."""
+    model = GPT2Model(TINY)
+    eng = ServeEngine(model, _serve_cfg(
+        slots=2, page_len=8, pages=2, prefix_cache=False))
+    r = eng.submit(list(_tokens(8, seed=80)), max_new_tokens=50)
+    eng.run_until_idle()
+    # prompt fills the single usable page; the first append needs a
+    # second page that doesn't exist
+    assert r.finish_reason == "kv_capacity"
+    assert len(r.tokens) == 1                      # the prefill token
+    assert r.error is None
+    eng.close()
+
+
+def test_paged_close_fails_parked_requests():
+    model = GPT2Model(TINY)
+    eng = ServeEngine(model, _serve_cfg(
+        slots=4, page_len=8, pages=3, prefix_cache=False))
+    rs = [eng.submit(list(_tokens(9, seed=90 + i)), max_new_tokens=4)
+          for i in range(3)]
+    eng.step()              # admits the first, parks/queues the rest
+    eng.close()
+    # every request the pool backpressured (parked OR still queued)
+    # fails typed at close instead of hanging its waiter
+    failed = [r for r in rs if r.error is not None]
+    assert len(failed) == 2
+    for r in failed:
+        assert r.done.is_set()
+        with pytest.raises(RuntimeError, match="closed"):
+            r.result(timeout=0)
+
+
+# ---------------------------------------------------------------------------
+# sharding: batched placement + TP/DP paged serving
+# ---------------------------------------------------------------------------
+
+
+def test_shard_cache_issues_one_batched_device_put(monkeypatch):
+    """The PR 3/4 idiom: ONE list-form jax.device_put for every cache
+    leaf, both layouts — a put per leaf is a dispatch per leaf."""
+    calls = []
+    real = jax.device_put
+
+    def spy(x, device=None, **kw):
+        calls.append(x)
+        return real(x, device, **kw)
+
+    mesh = build_mesh(dp=2, tp=2, devices=jax.devices()[:4])
+    spec = PagedKVCacheSpec(layers=2, slots=4, heads=4, pages=8,
+                            page_len=4, head_dim=8, max_pages=2)
+    monkeypatch.setattr(jax, "device_put", spy)
+    cache = shard_cache(init_paged_cache(spec), mesh,
+                        paged_cache_shardings(mesh))
+    assert len(calls) == 1 and isinstance(calls[0], list)
+    assert cache["k"].shape == (2, 8, 4, 4, 8)
+    calls.clear()
+    legacy = KVCacheSpec(layers=2, slots=8, heads=4, max_len=8,
+                         head_dim=4)
+    shard_cache(init_cache(legacy), mesh)
+    assert len(calls) == 1 and isinstance(calls[0], list)
+
+
+def test_paged_cache_mesh_validation():
+    spec = PagedKVCacheSpec(layers=2, slots=4, heads=4, pages=7,
+                            page_len=4, head_dim=8, max_pages=2)
+    with pytest.raises(ValueError, match="pages"):
+        validate_paged_cache_mesh(
+            build_mesh(dp=2, devices=jax.devices()[:2]), spec)
+    spec2 = PagedKVCacheSpec(layers=2, slots=4, heads=3, pages=8,
+                             page_len=4, head_dim=8, max_pages=2)
+    with pytest.raises(ValueError, match="model axis"):
+        validate_paged_cache_mesh(
+            build_mesh(dp=1, tp=2, devices=jax.devices()[:2]), spec2)
+    assert spec.page_bytes == 2 * 2 * 4 * 4 * 8 * 4
+    assert spec.bytes == spec.page_bytes * spec.pages
+
+
+def test_paged_tp_dp_sharded_matches_single_device():
+    model = GPT2Model(TINY_FLASH)
+    params = model.init(jax.random.PRNGKey(0))
+    prompts = [list(_tokens(5, seed=i)) for i in range(4)]
+
+    def run(mesh):
+        eng = ServeEngine(model, _serve_cfg(page_len=8), mesh=mesh,
+                          params=params)
+        rs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        eng.run_until_idle()
+        toks = [r.tokens for r in rs]
+        eng.close()
+        return toks
+
+    base = run(None)
+    sharded = run(build_mesh(dp=2, tp=2, devices=jax.devices()[:4]))
+    assert base == sharded
+
+
+# ---------------------------------------------------------------------------
+# config block
+# ---------------------------------------------------------------------------
+
+
+def test_paged_serving_config_validation():
+    from deepspeed_tpu.config.config import DeepSpeedServingConfig
+    ok = DeepSpeedServingConfig({"serving": {"page_len": 16,
+                                             "pages": 64}})
+    assert ok.page_len == 16 and ok.pages == 64 and ok.prefix_cache
+    off = DeepSpeedServingConfig({"serving": {}})
+    assert off.page_len == 0 and off.pages == 0
+    with pytest.raises(DeepSpeedConfigError, match="page_len"):
+        DeepSpeedServingConfig({"serving": {"page_len": -1}})
+    with pytest.raises(DeepSpeedConfigError, match="page_len"):
+        DeepSpeedServingConfig({"serving": {"pages": 8}})
+    with pytest.raises(DeepSpeedConfigError, match="scratch"):
+        DeepSpeedServingConfig({"serving": {"page_len": 8, "pages": 1}})
+    with pytest.raises(DeepSpeedConfigError, match="prefix_cache"):
+        DeepSpeedServingConfig({"serving": {"prefix_cache": "false"}})
+
+
+# ---------------------------------------------------------------------------
+# telemetry: gauges -> sync scalars -> summarize rows; flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_paged_telemetry_flows_to_summarize(tmp_path, capsys):
+    from deepspeed_tpu.telemetry.cli import summarize
+    model = GPT2Model(TINY)
+    eng = ServeEngine(model, _serve_cfg(
+        page_len=8, telemetry_path=tmp_path, flush_interval_ticks=2),
+        params=model.init(jax.random.PRNGKey(0)))
+    template = list(_tokens(16, seed=95))
+    for i in range(3):
+        eng.submit(template + list(_tokens(2, seed=96 + i)),
+                   max_new_tokens=4)
+    eng.run_until_idle()
+    reg = eng.telemetry.registry
+    assert reg.gauge("serve_pages_total").value() == \
+        eng.cache_spec.pages - 1
+    assert reg.counter("serve_prefix_hits_total").value() == 2
+    eng.close()
+    events = os.path.join(str(tmp_path), "events.jsonl")
+    report = summarize(events)
+    out = capsys.readouterr().out
+    assert report["serve_page_utilization"] is not None
+    assert report["serve_free_pages"] is not None
+    assert report["serve_prefix_hit_ratio"] == pytest.approx(2 / 3)
+    assert report["serve_prefix_hit_tokens"] == 32
+    assert "kv page pool" in out and "prefix cache" in out
+
+
+def test_serve_stage_depth_snapshots_include_free_pages():
+    """The flight-recorder satellite: every serve stage ring event now
+    carries the pool's free-page count next to the queue depth."""
+    model = GPT2Model(TINY)
+    eng = ServeEngine(model, _serve_cfg(page_len=8))
+    eng.submit(list(_tokens(5, seed=97)), max_new_tokens=3)
+    eng.run_until_idle()
+    snap = eng.stage.flight_snapshot()
+    assert snap["events"], "no stage events recorded"
+    for ev in snap["events"]:
+        assert "free_pages" in ev and "depth" in ev
+        assert 0 <= ev["free_pages"] <= eng.cache_spec.pages - 1
+    eng.close()
+    # the pre-page engine keeps its plain int depth
+    eng2 = ServeEngine(model, _serve_cfg())
+    eng2.submit(list(_tokens(3, seed=98)), max_new_tokens=2)
+    eng2.run_until_idle()
+    evs = eng2.stage.flight_snapshot()["events"]
+    assert evs and all("depth" in e and "free_pages" not in e
+                       for e in evs)
+    eng2.close()
+
+
+# ---------------------------------------------------------------------------
+# injected prefill device time ∝ computed pages (the bench's cost model)
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_hit_prefill_pays_delta_chunks_only(monkeypatch):
+    monkeypatch.setenv("DS_STAGE_DELAY_S", "serve:0.05")
+    reset_fault_injection()
+    model = GPT2Model(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = ServeEngine(model, _serve_cfg(page_len=8), params=params)
+    template = list(_tokens(16, seed=99))
+    r1 = eng.submit(template + [1, 2], max_new_tokens=1)
+    eng.run_until_idle()
+    r2 = eng.submit(template + [3, 4], max_new_tokens=1)
+    eng.run_until_idle()
+    eng.close()
+    # r1 computed 18 tokens = 3 chunks -> 2 extra delay units inside
+    # the prefill window; r2 computed 2 tokens -> 0 extra
+    assert r1.prefill_s >= 0.10
+    assert r2.prefill_s < 0.05
+
+
+# ---------------------------------------------------------------------------
+# benchgate: explicit direction pin for the new headline
+# ---------------------------------------------------------------------------
+
+
+def test_benchgate_paged_ratio_is_higher_better():
+    from tools.benchgate import compare, is_lower_better
+    assert not is_lower_better("serve_paged_admitted_ratio")
+    fresh = {"metric": "serve_paged_admitted_ratio", "value": 1.2}
+    base = {"metric": "serve_paged_admitted_ratio", "value": 4.0}
+    assert compare(fresh, base)["regressed"]
+    assert not compare(base, fresh)["regressed"]
+
+
+# ---------------------------------------------------------------------------
+# bench smoke: >= 2x admitted slots at fixed KV bytes, prefix ∝ deltas
+# ---------------------------------------------------------------------------
+
+
+def test_bench_serve_paged_smoke(tmp_path):
+    import importlib.util
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "bench_serve.py")
+    spec = importlib.util.spec_from_file_location(
+        "bench_serve_for_paged_test", path)
+    bench_serve = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_serve)
+    rec = bench_serve.run_paged_ab(
+        kv_budget_slots=2, max_seq_len=32, page_len=8, n_requests=8,
+        template_len=16, prefix_k=3, tick_delay_s=0.02,
+        out_dir=str(tmp_path))
+    assert rec["metric"] == "serve_paged_admitted_ratio"
+    # the CPU-provable acceptance bar: >= 2x admitted concurrency at a
+    # fixed KV-byte budget under the short/long mix
+    assert rec["value"] >= 2.0
+    assert rec["paged"]["max_concurrent"] >= \
+        2 * rec["legacy"]["max_concurrent"]
+    # prefix caching: total prefill ∝ 1 template + K deltas
+    assert rec["prefix"]["prefill_ratio"] < 0.75
+    assert rec["prefix"]["on"]["prefix_hits"] == 2
+    art = json.load(open(os.path.join(str(tmp_path),
+                                      "BENCH_serve_paged.json")))
+    assert art["value"] == rec["value"]

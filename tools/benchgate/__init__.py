@@ -38,6 +38,16 @@ DEFAULT_THRESHOLD = 0.20
 LOWER_BETTER_HINTS = ("latency", "_p50", "_p99", "time_s", "_seconds",
                       "wall_s", "stall", "_age")
 
+#: explicit per-metric direction pins (checked before the name
+#: heuristics; a --lower-better/--higher-better flag still wins).
+#: Value is is-lower-better.  serve_paged_admitted_ratio: admitted
+#: concurrent requests per fixed KV byte — more users per chip is the
+#: whole point, so HIGHER is better even though nothing in the name
+#: says "speedup".
+METRIC_DIRECTIONS = {
+    "serve_paged_admitted_ratio": False,
+}
+
 
 def headline(doc: dict):
     """(metric name, float value) of a BENCH_*.json document."""
@@ -51,6 +61,8 @@ def is_lower_better(metric: str,
     if override is not None:
         return override
     m = metric.lower()
+    if m in METRIC_DIRECTIONS:
+        return METRIC_DIRECTIONS[m]
     return any(h in m for h in LOWER_BETTER_HINTS)
 
 

@@ -420,6 +420,11 @@ class DeepSpeedServingConfig:
             sv, C.SERVING_PAGES, C.SERVING_PAGES_DEFAULT)
         self.prefix_cache = get_scalar_param(
             sv, C.SERVING_PREFIX_CACHE, C.SERVING_PREFIX_CACHE_DEFAULT)
+        self.speculate_k = get_scalar_param(
+            sv, C.SERVING_SPECULATE_K, C.SERVING_SPECULATE_K_DEFAULT)
+        self.temperature = get_scalar_param(
+            sv, C.SERVING_TEMPERATURE, C.SERVING_TEMPERATURE_DEFAULT)
+        self.draft = self._validate_draft(sv.get(C.SERVING_DRAFT))
         for name, v, lo in ((C.SERVING_SLOTS, self.slots, 1),
                             (C.SERVING_MAX_SEQ_LEN, self.max_seq_len, 0),
                             (C.SERVING_PREFILL_LEN, self.prefill_len, 0),
@@ -463,6 +468,74 @@ class DeepSpeedServingConfig:
                 f"serving.{C.SERVING_PAGES}={self.pages} is too small: "
                 "page 0 is the reserved scratch page, so a usable pool "
                 "needs at least 2 pages (0 = auto-size)")
+        if not isinstance(self.speculate_k, int) \
+                or isinstance(self.speculate_k, bool) \
+                or self.speculate_k < 0:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_SPECULATE_K} must be an int >= 0 "
+                f"(0 = speculation off), got {self.speculate_k!r}")
+        if isinstance(self.temperature, bool) \
+                or not isinstance(self.temperature, (int, float)) \
+                or self.temperature < 0:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_TEMPERATURE} must be a number >= 0 "
+                f"(0 = greedy), got {self.temperature!r}")
+        self.temperature = float(self.temperature)
+
+    @staticmethod
+    def _validate_draft(draft) -> Dict[str, Any]:
+        """Eager validation of the ``serving.draft`` block: a typo'd
+        draft dimension must fail at config parse, not as a shape error
+        inside the first verify pass.  Returns the block with defaults
+        filled (vocab_size/n_positions are the ENGINE's to force from
+        the target model — they are rejected here)."""
+        if draft is None:
+            draft = {}
+        if not isinstance(draft, dict):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_DRAFT} must be a dict of draft-"
+                f"model dimensions, got {draft!r}")
+        allowed = {C.SERVING_DRAFT_D_MODEL, C.SERVING_DRAFT_N_LAYER,
+                   C.SERVING_DRAFT_N_HEAD, C.SERVING_DRAFT_ATTN_IMPL}
+        unknown = set(draft) - allowed
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_DRAFT} has unknown key(s) "
+                f"{sorted(unknown)}; allowed: {sorted(allowed)} "
+                "(vocab_size/n_positions always follow the target "
+                "model)")
+        out = {
+            C.SERVING_DRAFT_D_MODEL: get_scalar_param(
+                draft, C.SERVING_DRAFT_D_MODEL,
+                C.SERVING_DRAFT_D_MODEL_DEFAULT),
+            C.SERVING_DRAFT_N_LAYER: get_scalar_param(
+                draft, C.SERVING_DRAFT_N_LAYER,
+                C.SERVING_DRAFT_N_LAYER_DEFAULT),
+            C.SERVING_DRAFT_N_HEAD: get_scalar_param(
+                draft, C.SERVING_DRAFT_N_HEAD,
+                C.SERVING_DRAFT_N_HEAD_DEFAULT),
+            C.SERVING_DRAFT_ATTN_IMPL: get_scalar_param(
+                draft, C.SERVING_DRAFT_ATTN_IMPL,
+                C.SERVING_DRAFT_ATTN_IMPL_DEFAULT),
+        }
+        for key in (C.SERVING_DRAFT_D_MODEL, C.SERVING_DRAFT_N_LAYER,
+                    C.SERVING_DRAFT_N_HEAD):
+            v = out[key]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise DeepSpeedConfigError(
+                    f"serving.{C.SERVING_DRAFT}.{key} must be an int "
+                    f">= 1, got {v!r}")
+        if out[C.SERVING_DRAFT_D_MODEL] % out[C.SERVING_DRAFT_N_HEAD]:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_DRAFT}: d_model="
+                f"{out[C.SERVING_DRAFT_D_MODEL]} must be divisible by "
+                f"n_head={out[C.SERVING_DRAFT_N_HEAD]}")
+        if out[C.SERVING_DRAFT_ATTN_IMPL] not in ("", "flash", "dense"):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_DRAFT}.{C.SERVING_DRAFT_ATTN_IMPL} "
+                "must be '' (follow the target), 'flash', or 'dense', "
+                f"got {out[C.SERVING_DRAFT_ATTN_IMPL]!r}")
+        return out
 
 
 class DeepSpeedPipelineConfig:

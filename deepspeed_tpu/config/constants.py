@@ -482,6 +482,35 @@ SERVING_PAGES_DEFAULT = 0
 # partial page.  Only meaningful with page_len > 0.
 SERVING_PREFIX_CACHE = "prefix_cache"
 SERVING_PREFIX_CACHE_DEFAULT = True
+# speculative decoding (Leviathan/Chen 2023, PAPERS.md): draft tokens
+# proposed per tick; the target scores all k+1 positions in ONE widened
+# verify program and emits the accepted prefix + one bonus token.
+# 0 = speculation OFF (the one-token-per-tick parity reference arm).
+SERVING_SPECULATE_K = "speculate_k"
+SERVING_SPECULATE_K_DEFAULT = 0
+# decode sampling temperature for the whole engine (STATIC — it picks
+# the compiled emission/acceptance arm).  0.0 = greedy (bitwise the
+# pre-sampling argmax); > 0 samples softmax(logits/T), and speculation
+# switches to the Chen et al. rejection-sampling acceptance that
+# recovers the target distribution exactly.
+SERVING_TEMPERATURE = "temperature"
+SERVING_TEMPERATURE_DEFAULT = 0.0
+# the DRAFT model block (speculate_k > 0): a small GPT-2 config built
+# through the ordinary config system.  vocab_size/n_positions are
+# FORCED from the target model (the proposal streams must share a
+# token space); everything else defaults tiny.  The draft always runs
+# its own fixed-stride slot KV cache — at draft scale a full stride is
+# a rounding error next to the target's pool, paged or not.
+SERVING_DRAFT = "draft"
+SERVING_DRAFT_D_MODEL = "d_model"
+SERVING_DRAFT_D_MODEL_DEFAULT = 256
+SERVING_DRAFT_N_LAYER = "n_layer"
+SERVING_DRAFT_N_LAYER_DEFAULT = 2
+SERVING_DRAFT_N_HEAD = "n_head"
+SERVING_DRAFT_N_HEAD_DEFAULT = 4
+# draft attention impl: '' = follow the target model's attn_impl
+SERVING_DRAFT_ATTN_IMPL = "attn_impl"
+SERVING_DRAFT_ATTN_IMPL_DEFAULT = ""
 
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 PLD_ENABLED = "enabled"

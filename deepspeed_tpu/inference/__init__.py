@@ -16,3 +16,6 @@ from .kv_cache import (KVCacheSpec, PagedKVCacheSpec,  # noqa: F401
                        shard_cache)
 from .scheduler import (PagePool, PrefixCache, Request,  # noqa: F401
                         SlotScheduler)
+from .speculative import (greedy_accept,  # noqa: F401
+                          rejection_sample_accept, select_next_token,
+                          speculative_accept)

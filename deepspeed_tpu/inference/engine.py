@@ -37,13 +37,30 @@ append copy-on-writes the last partial page, and the prefill program
 computes only the uncached delta — N requests sharing a system prompt
 store and prefill it once.
 
+Speculative decoding (``serving.speculate_k > 0`` — Leviathan et al.
+2023, Chen et al. 2023, PAPERS.md): a small DRAFT model (the
+``serving.draft`` config block; its own fixed-stride slot KV cache)
+proposes k tokens per tick in one compiled propose program, and the
+target scores all k+1 positions per slot in ONE widened
+``verify_step`` program — the pass that used to buy one token now buys
+``accepted + 1`` of them, so wall-clock per token scales with
+1/mean-accepted-length (bench_serve.py --spec proves it on CPU).
+Greedy acceptance emits exactly the non-speculative stream (the parity
+bar); ``serving.temperature > 0`` switches to rejection-sampling
+acceptance that recovers the target distribution
+(inference/speculative.py).  Rollback: unpaged masks lengths back;
+paged frees the pages only rejected speculation touched.  Accepted-
+length variance makes per-slot progress uneven — exactly what the
+masked slot machinery absorbs.
+
 Fault plane: the request queue is a stages.py :class:`Channel` and all
 serving work runs under one :class:`Stage` record ("serve", points
 ``admit``/``step``), so poison/drain semantics, graceful degradation
 (budget-exhausted → chaos-free direct serving) and the unified
 ``DS_STAGE_FAULT``/``DS_STAGE_DELAY_S`` spec apply unchanged — the
 bench's A/B leg injects its synthetic per-tick device time through
-exactly that knob.
+exactly that knob.  In spec mode one delay unit buys one TARGET pass
+(a whole verify block), not one token — docs/stages.md.
 """
 from __future__ import annotations
 
@@ -69,6 +86,7 @@ from .kv_cache import (KVCacheSpec, PagedKVCacheSpec, cache_shardings,
                        paged_cache_shardings, shard_cache,
                        validate_cache_mesh, validate_paged_cache_mesh)
 from .scheduler import PagePool, PrefixCache, Request, SlotScheduler
+from .speculative import select_next_token, speculative_accept
 
 
 class _ServeConfigView:
@@ -108,7 +126,7 @@ class ServeEngine:
     """
 
     def __init__(self, model, config=None, mesh=None, params=None,
-                 seed: int = 0):
+                 seed: int = 0, draft_params=None):
         self.model = model
         cfg = _ServeConfigView(config)
         self.serving_config = cfg.serving
@@ -139,6 +157,18 @@ class ServeEngine:
             self.decode_impl = _decode_attn_impl(mcfg)
         else:
             self.decode_impl = cfg.serving.decode_impl
+        #: draft-verify speculation (0 = off — the parity reference arm)
+        self.spec_k = cfg.serving.speculate_k
+        #: STATIC sampling temperature: it selects the compiled
+        #: emission/acceptance arm for the engine's lifetime, so
+        #: changing it can never recompile mid-serve
+        self.temperature = cfg.serving.temperature
+        self._rng_base = (jax.random.PRNGKey(seed ^ 0x5eed)
+                          if self.temperature > 0 else None)
+        self._rng_n = 0
+        self._spec_proposed_n = 0
+        self._spec_accepted_n = 0
+        self._spec_passes = 0
 
         # -- params + cache, sharded over the mesh -----------------------
         if params is None:
@@ -212,13 +242,19 @@ class ServeEngine:
         # -- compiled programs -------------------------------------------
         rep = NamedSharding(mesh, P())
         self._copy_fn = None
+        # the one shared next-token rule (inference/speculative.py):
+        # greedy at temperature 0 — bitwise the argmax these programs
+        # used to inline — sampling otherwise.  Programs take a
+        # trailing *rng operand only when the static temperature
+        # demands one, so the 0-temperature programs are unchanged.
+        temp = self.temperature
 
         if self.paged:
             # delta-aware prefill over the page pool: page_row,
             # prefix_len and delta_len are TRACED, so one program
             # serves full prefills AND prefix-hit deltas
             def prefill_fn(params, cache, tokens, delta_len, prefix_len,
-                           page_row, slot):
+                           page_row, slot, *rng):
                 logits, kp, vp = self.model.prefill_paged(
                     params, tokens, delta_len, prefix_len, page_row,
                     cache["k"], cache["v"])
@@ -228,15 +264,18 @@ class ServeEngine:
                     cache["lengths"], total, (slot,))
                 last = jax.lax.dynamic_index_in_dim(
                     logits, delta_len - 1, axis=1, keepdims=False)[0]
-                first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                first_tok = select_next_token(last, temp,
+                                              rng[0] if rng else None)
                 return ({"k": kp, "v": vp, "lengths": lengths},
                         first_tok)
 
-            def decode_fn(params, cache, tokens, active, page_table):
+            def decode_fn(params, cache, tokens, active, page_table,
+                          *rng):
                 logits, k, v, new_len = self.model.decode_step_paged(
                     params, tokens, cache["k"], cache["v"], page_table,
                     cache["lengths"], active, impl=self.decode_impl)
-                next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                next_tok = select_next_token(logits, temp,
+                                             rng[0] if rng else None)
                 return ({"k": k, "v": v, "lengths": new_len}, next_tok)
 
             # copy-on-write: duplicate one page (src/dst traced — zero
@@ -254,7 +293,7 @@ class ServeEngine:
             self._copy_fn = jax.jit(copy_fn, donate_argnums=(0,),
                                     out_shardings=self._cache_shardings)
         else:
-            def prefill_fn(params, cache, tokens, length, slot):
+            def prefill_fn(params, cache, tokens, length, slot, *rng):
                 logits, ks, vs = self.model.prefill(params, tokens)
                 new_k = ks[:, 0][:, None].astype(cache["k"].dtype)
                 new_v = vs[:, 0][:, None].astype(cache["v"].dtype)
@@ -268,15 +307,17 @@ class ServeEngine:
                     (slot,))
                 last = jax.lax.dynamic_index_in_dim(
                     logits, length - 1, axis=1, keepdims=False)[0]
-                first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                first_tok = select_next_token(last, temp,
+                                              rng[0] if rng else None)
                 return ({"k": k_cache, "v": v_cache, "lengths": lengths},
                         first_tok)
 
-            def decode_fn(params, cache, tokens, active):
+            def decode_fn(params, cache, tokens, active, *rng):
                 logits, k, v, new_len = self.model.decode_step(
                     params, tokens, cache["k"], cache["v"],
                     cache["lengths"], active, impl=self.decode_impl)
-                next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                next_tok = select_next_token(logits, temp,
+                                             rng[0] if rng else None)
                 return ({"k": k, "v": v, "lengths": new_len}, next_tok)
 
         self._prefill_fn = jax.jit(
@@ -285,6 +326,9 @@ class ServeEngine:
         self._decode_fn = jax.jit(
             decode_fn, donate_argnums=(1,),
             out_shardings=(self._cache_shardings, rep))
+        if self.spec_k:
+            self._build_spec_plane(cfg, mcfg, kv_dtype, draft_params,
+                                   seed, rep)
 
         # -- fault plane: queue as a Channel, work under one Stage -------
         self.queue = Channel(capacity=cfg.serving.queue_capacity)
@@ -294,12 +338,11 @@ class ServeEngine:
             fallback="chaos-free direct serving (injection plane "
                      "bypassed)")
         # flight recorder: every stage event samples the request-queue
-        # depth (and, paged, the pool's free pages), so a dump shows
-        # the backlog + headroom trajectory before a failure
-        if self.paged:
-            self.stage.depth_fn = lambda: {
-                "depth": self.queue.qsize(),
-                "free_pages": self.pool.free_count}
+        # depth (and, paged, the pool's free pages; speculating, the
+        # live accept ratio), so a dump shows the backlog + headroom +
+        # speculation-health trajectory before a failure
+        if self.paged or self.spec_k:
+            self.stage.depth_fn = self._stage_depth
         else:
             self.stage.depth_fn = self.queue.qsize
         self.stage.on_degrade = lambda st: self.dump_flight_record(
@@ -326,6 +369,13 @@ class ServeEngine:
             self.telemetry.track_program("prefill", self._prefill_fn)
             if self._copy_fn is not None:
                 self.telemetry.track_program("copy_page", self._copy_fn)
+            if self.spec_k:
+                self.telemetry.track_program("verify_step",
+                                             self._verify_fn)
+                self.telemetry.track_program("draft_propose",
+                                             self._propose_fn)
+                self.telemetry.track_program("draft_prefill",
+                                             self._draft_prefill_fn)
             reg = self.telemetry.registry
             self._tokens_total = reg.counter(
                 "serve_tokens_total", "generated tokens")
@@ -361,6 +411,17 @@ class ServeEngine:
                 self._prefix_misses = reg.counter(
                     "serve_prefix_misses_total",
                     "admissions that found no cached prefix")
+            if self.spec_k:
+                self._spec_proposed = reg.counter(
+                    "serve_spec_proposed_total",
+                    "draft tokens proposed to the verify program")
+                self._spec_accepted_ctr = reg.counter(
+                    "serve_spec_accepted_total",
+                    "accepted draft tokens actually emitted")
+                self._spec_len_hist = reg.histogram(
+                    "serve_spec_accepted_len",
+                    "tokens emitted per verify pass (accepted draft "
+                    "prefix + the bonus token)")
 
             def _stage_counter(name, help, n):
                 reg.counter(name, help).inc(n)
@@ -379,6 +440,168 @@ class ServeEngine:
         self._last_flush_t = time.perf_counter()
         self._last_flush_tokens = 0
         self._tokens_seen = 0
+
+    # -- speculative decoding: the draft plane --------------------------
+    def _build_spec_plane(self, cfg, mcfg, kv_dtype, draft_params,
+                          seed: int, rep) -> None:
+        """Build the draft model + its slot KV cache + the three
+        compiled spec programs (docs/serving.md "speculative
+        decoding"): ``draft_prefill`` (mirror the prompt into the
+        draft cache at admission), ``draft_propose`` (k+1 chained
+        draft decode steps in ONE program — the extra step writes the
+        last proposal's K/V so the draft cache stays aligned with the
+        target on full acceptance), and ``verify_step`` (the widened
+        target pass + acceptance, zero recompiles across any accepted-
+        length mix).
+
+        The draft always runs the fixed-stride SLOT cache, paged
+        target or not: at draft scale a full stride is a rounding
+        error next to the target pool, and it keeps the rollback a
+        pure lengths mask."""
+        from ..models.gpt2 import GPT2Config, GPT2Model, _decode_attn_impl
+        from ..config import constants as C
+        d = cfg.serving.draft
+        draft_cfg = GPT2Config(
+            vocab_size=mcfg.vocab_size, n_positions=mcfg.n_positions,
+            d_model=d[C.SERVING_DRAFT_D_MODEL],
+            n_layer=d[C.SERVING_DRAFT_N_LAYER],
+            n_head=d[C.SERVING_DRAFT_N_HEAD],
+            remat=None,
+            attn_impl=d[C.SERVING_DRAFT_ATTN_IMPL] or mcfg.attn_impl)
+        self.draft_config = draft_cfg
+        self.draft_model = GPT2Model(draft_cfg)
+        self._draft_impl = ("dense" if self.decode_impl == "dense"
+                            else _decode_attn_impl(draft_cfg))
+        if draft_params is None:
+            draft_params = self.draft_model.init(
+                jax.random.PRNGKey(seed + 1))
+        dspecs = self.draft_model.param_partition_specs(draft_params)
+        if dspecs is None:
+            dspecs = jax.tree.map(lambda _: P(), draft_params)
+        dshard = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), dspecs,
+            is_leaf=lambda s: isinstance(s, P))
+        self.draft_params = jax.tree.map(jax.device_put, draft_params,
+                                         dshard)
+        dspec = KVCacheSpec(
+            layers=draft_cfg.n_layer, slots=self.slots,
+            heads=draft_cfg.n_head, max_len=self.max_seq_len,
+            head_dim=draft_cfg.d_head, dtype=kv_dtype)
+        validate_cache_mesh(self.mesh, dspec)
+        self.draft_cache_spec = dspec
+        self._draft_shardings = cache_shardings(self.mesh)
+        self._draft_cache = shard_cache(init_cache(dspec), self.mesh,
+                                        self._draft_shardings)
+
+        temp = self.temperature
+        k_spec = self.spec_k
+        W = k_spec + 1
+
+        def draft_prefill_fn(dparams, dcache, tokens, length, slot):
+            _, ks, vs = self.draft_model.prefill(dparams, tokens)
+            new_k = ks[:, 0][:, None].astype(dcache["k"].dtype)
+            new_v = vs[:, 0][:, None].astype(dcache["v"].dtype)
+            start = (0, slot, 0, 0, 0)
+            k_cache = jax.lax.dynamic_update_slice(dcache["k"], new_k,
+                                                   start)
+            v_cache = jax.lax.dynamic_update_slice(dcache["v"], new_v,
+                                                   start)
+            lengths = jax.lax.dynamic_update_slice(
+                dcache["lengths"], length[None].astype(jnp.int32),
+                (slot,))
+            return {"k": k_cache, "v": v_cache, "lengths": lengths}
+
+        def propose_fn(dparams, dcache, cur, active, *rng):
+            def body(carry, i):
+                cache, tok = carry
+                logits, kk, vv, nl = self.draft_model.decode_step(
+                    dparams, tok, cache["k"], cache["v"],
+                    cache["lengths"], active, impl=self._draft_impl)
+                lg = logits.astype(jnp.float32)
+                if temp > 0:
+                    sk = jax.random.fold_in(rng[0], i)
+                    nxt = select_next_token(lg, temp, sk)
+                    out = (nxt, jax.nn.softmax(lg / temp, axis=-1))
+                else:
+                    nxt = select_next_token(lg)
+                    out = nxt
+                return ({"k": kk, "v": vv, "lengths": nl}, nxt), out
+            (dcache, _), ys = jax.lax.scan(
+                body, (dcache, cur.astype(jnp.int32)),
+                jnp.arange(W, dtype=jnp.int32))
+            if temp > 0:
+                return (dcache, ys[0][:k_spec].T,
+                        jnp.transpose(ys[1][:k_spec], (1, 0, 2)))
+            return dcache, ys[:k_spec].T
+
+        def verify_core(params, cache, cur, proposals, active,
+                        page_table, qprobs, key):
+            tokens_w = jnp.concatenate(
+                [cur[:, None].astype(jnp.int32),
+                 proposals.astype(jnp.int32)], axis=1)
+            if self.paged:
+                logits, kc, vc = self.model.verify_step_paged(
+                    params, tokens_w, cache["k"], cache["v"],
+                    page_table, cache["lengths"], active,
+                    impl=self.decode_impl)
+            else:
+                logits, kc, vc = self.model.verify_step(
+                    params, tokens_w, cache["k"], cache["v"],
+                    cache["lengths"], active, impl=self.decode_impl)
+            out_tok, accepted = speculative_accept(
+                logits.astype(jnp.float32), proposals, qprobs, temp,
+                key)
+            adv = jnp.where(active, accepted + 1, 0).astype(jnp.int32)
+            new_len = jnp.minimum(cache["lengths"] + adv,
+                                  jnp.int32(self.max_seq_len))
+            return ({"k": kc, "v": vc, "lengths": new_len}, out_tok,
+                    accepted)
+
+        if self.paged:
+            def verify_fn(params, cache, cur, proposals, active,
+                          page_table, *s):
+                return verify_core(params, cache, cur, proposals,
+                                   active, page_table,
+                                   s[0] if s else None,
+                                   s[1] if s else None)
+        else:
+            def verify_fn(params, cache, cur, proposals, active, *s):
+                return verify_core(params, cache, cur, proposals,
+                                   active, None, s[0] if s else None,
+                                   s[1] if s else None)
+
+        self._draft_prefill_fn = jax.jit(
+            draft_prefill_fn, donate_argnums=(1,),
+            out_shardings=self._draft_shardings)
+        prop_out = ((self._draft_shardings, rep, rep) if temp > 0
+                    else (self._draft_shardings, rep))
+        self._propose_fn = jax.jit(propose_fn, donate_argnums=(1,),
+                                   out_shardings=prop_out)
+        self._verify_fn = jax.jit(
+            verify_fn, donate_argnums=(1,),
+            out_shardings=(self._cache_shardings, rep, rep))
+
+    def _maybe_key(self):
+        """One fresh PRNG key per sampling program call — an empty
+        tuple at temperature 0, where no program takes one."""
+        if self._rng_base is None:
+            return ()
+        self._rng_n += 1
+        return (jax.random.fold_in(self._rng_base, self._rng_n),)
+
+    def _spec_ratio(self) -> float:
+        """The live draft-acceptance ratio — ONE formula shared by the
+        depth dict, the flight-record extras and the flush scalar."""
+        return round(
+            self._spec_accepted_n / max(self._spec_proposed_n, 1), 4)
+
+    def _stage_depth(self):
+        d: Dict[str, Any] = {"depth": self.queue.qsize()}
+        if self.paged:
+            d["free_pages"] = self.pool.free_count
+        if self.spec_k:
+            d["spec_accept_ratio"] = self._spec_ratio()
+        return d
 
     # -- telemetry helpers ----------------------------------------------
     def _span(self, name: str, **args):
@@ -479,6 +702,8 @@ class ServeEngine:
             if self.paged:
                 extra["free_pages"] = self.pool.free_count
                 extra["pending"] = len(self._pending)
+            if self.spec_k:
+                extra["spec_accept_ratio"] = self._spec_ratio()
             return self.telemetry.dump_flight_record(
                 {"serve": self.stage}, self._ticks, reason, error=error,
                 extra=extra)
@@ -522,6 +747,14 @@ class ServeEngine:
                 scalars["serve_prefix_hit_tokens"] = \
                     float(self.prefix.hit_tokens)
                 scalars["serve_page_cow_total"] = float(self.prefix.cow)
+        if self.spec_k and self._spec_passes:
+            # cumulative over the run (like the prefix scalars): the
+            # LAST flush is the run's answer — mean accepted length is
+            # tokens-per-target-pass, the 1/MAL speedup denominator
+            scalars["serve_spec_accept_ratio"] = self._spec_ratio()
+            scalars["serve_spec_mean_accepted_len"] = (
+                (self._spec_accepted_n + self._spec_passes)
+                / self._spec_passes)
         self.telemetry.on_sync(step=self._ticks, scalars=scalars)
         self._last_flush_t = now
         self._last_flush_tokens = self._tokens_seen
@@ -622,6 +855,20 @@ class ServeEngine:
         if chunks > 1:
             time.sleep(d * (chunks - 1))
 
+    def _draft_prefill(self, req: Request) -> None:
+        """Mirror the admitted prompt into the DRAFT's slot cache so
+        next tick's proposals start from the same history the target
+        holds.  The prefill logits are discarded — the tick's first
+        pending token is the TARGET's emission."""
+        dtokens = np.zeros((1, self.prefill_len), np.int32)
+        dtokens[0, :len(req.prompt)] = req.prompt
+        with self._span("serve/draft_prefill", rid=req.rid):
+            with self._pallas_scope():
+                self._draft_cache = self._draft_prefill_fn(
+                    self.draft_params, self._draft_cache, dtokens,
+                    np.int32(len(req.prompt)),
+                    np.int32(self.scheduler.free[0]))
+
     def _admit_one_paged(self, req: Request) -> bool:
         total_pages = -(-len(req.prompt) // self.page_len)
         if self.prefix is not None:
@@ -676,8 +923,13 @@ class ServeEngine:
                     self.cache, first = self._prefill_fn(
                         self.params, self.cache, tokens,
                         np.int32(len(delta)), np.int32(shared_len),
-                        row_np, np.int32(self.scheduler.free[0]))
+                        row_np, np.int32(self.scheduler.free[0]),
+                        *self._maybe_key())
                 first = int(np.asarray(jax.block_until_ready(first)))
+            if self.spec_k:
+                # the draft mirrors the FULL prompt (it has no prefix
+                # cache — draft prefill is cheap by construction)
+                self._draft_prefill(req)
         except BaseException:
             # roll back every page this admission still holds a ref on
             for p in held:
@@ -741,8 +993,11 @@ class ServeEngine:
             with self._pallas_scope():
                 self.cache, first = self._prefill_fn(
                     self.params, self.cache, tokens, length,
-                    np.int32(self.scheduler.free[0]))
+                    np.int32(self.scheduler.free[0]),
+                    *self._maybe_key())
             first = int(np.asarray(jax.block_until_ready(first)))
+        if self.spec_k:
+            self._draft_prefill(req)
         now = time.perf_counter()
         req.prefill_s = now - req.admit_t
         slot = self.scheduler.admit(req, now=now)
@@ -797,10 +1052,21 @@ class ServeEngine:
                 # which case the engine is broken and must poison
                 logger.error("serve: admission of rid=%d failed: %r",
                              req.rid, e)
-                if not isinstance(self.cache.get("k"), jnp.ndarray) or \
-                        getattr(self.cache["k"], "is_deleted", lambda: False)():
+                if self._cache_broken():
                     self._poison(e)
                     raise
+
+    def _cache_broken(self) -> bool:
+        """True when a failing call consumed a donated KV cache —
+        target or draft: either loss means the engine cannot keep
+        serving and must poison instead of isolating the request."""
+        def dead(cache):
+            k = cache.get("k")
+            return not isinstance(k, jnp.ndarray) or \
+                getattr(k, "is_deleted", lambda: False)()
+        if dead(self.cache):
+            return True
+        return bool(self.spec_k) and dead(self._draft_cache)
 
     def _release_pages(self, req: Request) -> None:
         if req.pages:
@@ -863,10 +1129,11 @@ class ServeEngine:
                 if self.paged:
                     self.cache, next_tok = self._decode_fn(
                         self.params, self.cache, tokens, active,
-                        self._table)
+                        self._table, *self._maybe_key())
                 else:
                     self.cache, next_tok = self._decode_fn(
-                        self.params, self.cache, tokens, active)
+                        self.params, self.cache, tokens, active,
+                        *self._maybe_key())
             # the per-token latency point: the pull IS the device sync,
             # inside the span (transfer-real, JL006-clean)
             next_host = np.asarray(jax.block_until_ready(next_tok))
@@ -887,14 +1154,158 @@ class ServeEngine:
                 self._finish(slot, reason)
         return produced
 
+    def _spec_tick(self) -> int:
+        """One SPECULATIVE serving tick (serving.speculate_k > 0): the
+        draft proposes k tokens per active slot (k+1 chained draft
+        passes in one compiled program), the target scores all k+1
+        positions per slot in ONE widened verify pass, and each
+        request advances by its accepted prefix plus the bonus token —
+        1 to k+1 tokens for one target pass.  Accepted-length variance
+        across slots is absorbed by the same masked machinery as
+        admission/eviction; rejection rollback masks lengths back
+        (unpaged) or frees the speculated pages (paged)."""
+        W = self.spec_k + 1
+        active_map = dict(self.scheduler.active)
+        if self.paged:
+            # allocate the whole speculative block's pages up front: a
+            # pool too dry to hold W more rows (even after prefix-leaf
+            # eviction) finishes the request with the same pool-aware
+            # kv_capacity reason as the one-token appends
+            for slot, req in list(active_map.items()):
+                need = -(-min(req.kv_len + W, self.max_seq_len)
+                         // self.page_len)
+                extra = need - len(req.pages)
+                if extra > 0:
+                    pg = self._alloc_pages(extra)
+                    if pg is None:
+                        self._finish(slot, "kv_capacity")
+                        del active_map[slot]
+                        continue
+                    for p in pg:
+                        self._table[slot, len(req.pages)] = p
+                        req.pages.append(p)
+        if not active_map:
+            return 0
+        tokens = np.zeros((self.slots,), np.int32)
+        active = np.zeros((self.slots,), bool)
+        for slot, req in active_map.items():
+            tokens[slot] = req.last_token
+            active[slot] = True
+        with self._span("serve/draft_propose", active=len(active_map),
+                        k=self.spec_k):
+            with self._pallas_scope():
+                out = self._propose_fn(self.draft_params,
+                                       self._draft_cache, tokens,
+                                       active, *self._maybe_key())
+            if self.temperature > 0:
+                self._draft_cache, proposals, qprobs = out
+                extra = (qprobs,) + self._maybe_key()
+            else:
+                self._draft_cache, proposals = out
+                extra = ()
+            # drain the draft INSIDE its span so the window times real
+            # draft compute (the verify pull below syncs the rest)
+            jax.block_until_ready(proposals)
+        with self._span("serve/verify_step", active=len(active_map),
+                        k=self.spec_k):
+            tr = self._tracer
+            if tr is not None:
+                for req in active_map.values():
+                    if req.ctx is not None:
+                        tr.flow_step("serve/request", req.ctx,
+                                     cat="serve", rid=req.rid,
+                                     tick=self._ticks)
+            with self._pallas_scope():
+                if self.paged:
+                    self.cache, out_tok, accepted = self._verify_fn(
+                        self.params, self.cache, tokens, proposals,
+                        active, self._table, *extra)
+                else:
+                    self.cache, out_tok, accepted = self._verify_fn(
+                        self.params, self.cache, tokens, proposals,
+                        active, *extra)
+            # the per-block latency point: the pull IS the device
+            # sync, inside the span (transfer-real, JL006-clean)
+            out_host = np.asarray(jax.block_until_ready(out_tok))
+            acc_host = np.asarray(accepted)
+        now = time.perf_counter()
+        produced = 0
+        for slot, req in active_map.items():
+            m = int(acc_host[slot])
+            emit = [int(t) for t in out_host[slot, :m + 1]]
+            finished = False
+            first_of_block = True
+            used = 0
+            for tok in emit:
+                # the block lands at one wall moment: the first token
+                # carries the pass latency, the rest arrive "free" —
+                # the burst semantics the latency histograms should see
+                req.kv_len += 1
+                req.tokens.append(tok)
+                lat = (now - req.last_t) if first_of_block else 0.0
+                first_of_block = False
+                req.token_times.append(lat)
+                self._count_token(lat)
+                produced += 1
+                used += 1
+                reason = self.scheduler.finish_reason(
+                    req, tok, self.max_seq_len)
+                if reason is not None:
+                    # EOS (or budget/capacity) INSIDE the accepted
+                    # block: the tail of the block is discarded, the
+                    # slot frees this tick — _finish releases every
+                    # page incl. the speculative pre-allocation
+                    self._finish(slot, reason)
+                    finished = True
+                    break
+            # accounting counts tokens the pass actually DELIVERED
+            # (used - 1 accepted drafts + the first/bonus token), not
+            # what verify hypothetically accepted: an EOS/budget/
+            # capacity truncation inside the block must not let the
+            # mean-accepted-length scalars drift from
+            # serve_tokens_total (they share the 1/MAL denominator)
+            req.spec_accepted.append(used - 1)
+            self._spec_passes += 1
+            self._spec_proposed_n += self.spec_k
+            self._spec_accepted_n += used - 1
+            if self.telemetry is not None:
+                self._spec_proposed.inc(self.spec_k)
+                self._spec_accepted_ctr.inc(used - 1)
+                self._spec_len_hist.observe(used)
+            if finished:
+                continue
+            req.last_t = now
+            req.last_token = emit[-1]
+            if self.paged:
+                # rollback: keep the pages covering the verified rows,
+                # free the ones only rejected speculation touched
+                keep = -(-req.kv_len // self.page_len)
+                while len(req.pages) > keep:
+                    pg = req.pages.pop()
+                    self._table[slot, len(req.pages)] = 0
+                    self.pool.deref(pg)
+        # draft rollback: one replicated lengths row masks every live
+        # slot's draft KV back to its verified length (rejected draft
+        # rows become dead tail the kernels never attend)
+        dlen = np.zeros((self.slots,), np.int32)
+        for slot, req in self.scheduler.active.items():
+            dlen[slot] = req.kv_len
+        self._draft_cache = dict(self._draft_cache)
+        self._draft_cache["lengths"] = jax.device_put(
+            jnp.asarray(dlen), self._draft_shardings["lengths"])
+        return produced
+
     def step(self) -> int:
         """One serving tick: admit into free slots, then one masked
-        decode over the whole pool.  Returns tokens produced."""
+        decode — or, speculating, one draft-propose + widened-verify
+        block — over the whole pool.  Returns tokens produced."""
         if self._closed:
             raise RuntimeError("ServeEngine is closed")
         self._admit()
         try:
-            n = self.stage.call("step", self._decode_tick)
+            n = self.stage.call(
+                "step",
+                self._spec_tick if self.spec_k else self._decode_tick)
         except BaseException as e:
             self._poison(e)
             raise

@@ -79,6 +79,11 @@ class Request:
     pages: Optional[List[int]] = None
     shared_len: int = 0
     computed_len: int = 0
+    #: speculative decoding (engine-internal, serving.speculate_k > 0):
+    #: accepted draft tokens per verify pass — the per-request record
+    #: of the uneven per-slot progress the masked slot machinery
+    #: absorbs (docs/serving.md "speculative decoding")
+    spec_accepted: List[int] = dataclasses.field(default_factory=list)
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until the request finishes; raises its error if it

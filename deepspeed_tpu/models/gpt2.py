@@ -242,6 +242,22 @@ class GPT2Model(TrainModule):
                                       k_pool, v_pool, page_table,
                                       lengths, active, impl=impl)
 
+    def verify_step(self, params, tokens, k_cache, v_cache, lengths,
+                    active, impl: Optional[str] = None):
+        """Score W speculative tokens per slot in one widened decode
+        pass — see ``gpt2_verify_step``."""
+        return gpt2_verify_step(self.config, params, tokens, k_cache,
+                                v_cache, lengths, active, impl=impl)
+
+    def verify_step_paged(self, params, tokens, k_pool, v_pool,
+                          page_table, lengths, active,
+                          impl: Optional[str] = None):
+        """The paged twin of ``verify_step`` — see
+        ``gpt2_verify_step_paged``."""
+        return gpt2_verify_step_paged(self.config, params, tokens,
+                                      k_pool, v_pool, page_table,
+                                      lengths, active, impl=impl)
+
     # ---------------- param-streaming declaration ----------------
     def streaming_param_spec(self, params):
         """The stacked block leaves stream (one layer per scan tick);
@@ -586,6 +602,171 @@ def gpt2_decode_step(cfg: GPT2Config, params, tokens, k_cache, v_cache,
     logits = (x @ params["wte"].astype(x.dtype).T)[:, 0]
     new_lengths = lengths + active.astype(jnp.int32)
     return logits, k_cache, v_cache, new_lengths
+
+
+# ---------------------------------------------------------------------------
+# speculative verify path (serving.speculate_k > 0, docs/serving.md):
+# ONE widened decode pass scores W = k+1 new tokens per slot — the
+# slot's pending token plus its k draft proposals — writing all W K/V
+# rows (masked) and attending each query over its own causal window.
+# Same block helpers, same masked-no-op contract as gpt2_decode_step;
+# acceptance/rollback are the engine's (inference/speculative.py).
+# ---------------------------------------------------------------------------
+
+
+def _verify_rows(lengths, active, W: int, cap: int):
+    """The per-row geometry every verify arm shares: absolute positions
+    (clipped), write validity, and per-query attention lengths.
+
+    Row ``i`` of slot ``s`` sits at absolute position ``lengths[s]+i``
+    and attends ``lengths[s]+i+1`` keys.  Rows beyond ``cap`` (the
+    cache stride / table capacity) are masked — their K/V write is a
+    no-op and their output row is exact-zero garbage the engine's
+    acceptance truncation discards (a kv_capacity finish is at most W
+    tokens away)."""
+    base = lengths.astype(jnp.int32)
+    offs = jnp.arange(W, dtype=jnp.int32)[None, :]
+    abs_pos = base[:, None] + offs                      # [S, W]
+    row_valid = active[:, None] & (abs_pos < cap)
+    positions = jnp.clip(abs_pos, 0, cap - 1)
+    row_lens = jnp.where(row_valid, abs_pos + 1, 0).astype(jnp.int32)
+    return positions, row_valid, row_lens
+
+
+def gpt2_block_verify(cfg: GPT2Config, bp, x, k_cache, v_cache,
+                      positions, row_valid, row_lens, impl: str):
+    """One block of the verify pass: x [S, W, D] (W new tokens per
+    slot); writes all W K/V rows (masked per row) then runs the
+    multi-query decode attention."""
+    q, k, v = gpt2_qkv_heads(cfg, bp, x)                # [S, H, W, Dh]
+    W = x.shape[1]
+    for i in range(W):                                  # static, W <= 9
+        k_cache = _cache_write(k_cache, k[:, :, i], positions[:, i],
+                               row_valid[:, i])
+        v_cache = _cache_write(v_cache, v[:, :, i], positions[:, i],
+                               row_valid[:, i])
+    from ..ops.pallas.decode_attention import decode_attention_multi
+    attn = decode_attention_multi(q, k_cache, v_cache, row_lens,
+                                  impl=impl)            # [S, H, W, Dh]
+    x = gpt2_attn_project(bp, x, attn, 0.0, None)
+    h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    return x + gpt2_ffn(bp, h), k_cache, v_cache
+
+
+def gpt2_verify_step(cfg: GPT2Config, params, tokens, k_cache, v_cache,
+                     lengths, active, impl: Optional[str] = None):
+    """One speculative verify pass for every slot at once (static
+    shapes — W = k+1 is baked into the program, everything else is
+    traced, so the one-compiled-verify-program contract holds across
+    arbitrary accepted-length mixes).
+
+    tokens [S, W] int32 — per slot: its pending last token followed by
+    its k draft proposals; k_cache/v_cache [L, S, H, T, Dh]; lengths
+    [S] int32 — live KV length BEFORE this pass; active [S] bool.
+
+    Returns ``(logits [S, W, V], k_cache, v_cache)``: ``logits[s, i]``
+    scores the token AFTER ``tokens[s, i]`` (absolute position
+    ``lengths[s] + i``).  Lengths are NOT advanced — how far the cache
+    really moved is the acceptance decision, made by the caller
+    (inference/speculative.py); un-accepted rows simply stay masked
+    beyond the advanced length (the unpaged rollback is free)."""
+    if impl is None:
+        impl = _decode_attn_impl(cfg)
+    S, W = tokens.shape
+    T = k_cache.shape[3]
+    cap = min(T, cfg.n_positions)
+    positions, row_valid, row_lens = _verify_rows(lengths, active, W,
+                                                  cap)
+    x = params["wte"][tokens] + params["wpe"][positions]    # [S, W, D]
+    block_params = params["blocks"]
+    if cfg.scan_layers:
+        def body(x, xs):
+            bp, kc, vc = xs
+            x, kc, vc = gpt2_block_verify(cfg, bp, x, kc, vc, positions,
+                                          row_valid, row_lens, impl)
+            return x, (kc, vc)
+        x, (k_cache, v_cache) = jax.lax.scan(
+            body, x, (block_params, k_cache, v_cache))
+    else:
+        kc_l, vc_l = [], []
+        for i in range(cfg.n_layer):
+            bp = jax.tree.map(lambda a, i=i: a[i], block_params)
+            x, kc, vc = gpt2_block_verify(cfg, bp, x, k_cache[i],
+                                          v_cache[i], positions,
+                                          row_valid, row_lens, impl)
+            kc_l.append(kc)
+            vc_l.append(vc)
+        k_cache, v_cache = jnp.stack(kc_l), jnp.stack(vc_l)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    logits = x @ params["wte"].astype(x.dtype).T            # [S, W, V]
+    return logits, k_cache, v_cache
+
+
+def gpt2_block_verify_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
+                            page_table, positions, row_valid, row_lens,
+                            impl: str):
+    """One block of the PAGED verify pass: W masked page-routed writes
+    (invalid rows to the scratch page) then the paged multi-query
+    attention."""
+    q, k, v = gpt2_qkv_heads(cfg, bp, x)                # [S, H, W, Dh]
+    W = x.shape[1]
+    page_len = k_pool.shape[2]
+    s_idx = jnp.arange(page_table.shape[0])
+    for i in range(W):                                  # static, W <= 9
+        pos = positions[:, i]
+        page_ids = jnp.where(row_valid[:, i],
+                             page_table[s_idx, pos // page_len], 0)
+        offs = pos % page_len
+        k_pool = _paged_cache_write(k_pool, k[:, :, i], page_ids, offs,
+                                    row_valid[:, i])
+        v_pool = _paged_cache_write(v_pool, v[:, :, i], page_ids, offs,
+                                    row_valid[:, i])
+    from ..ops.pallas.decode_attention import decode_attention_paged_multi
+    attn = decode_attention_paged_multi(q, k_pool, v_pool, page_table,
+                                        row_lens, impl=impl)
+    x = gpt2_attn_project(bp, x, attn, 0.0, None)
+    h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    return x + gpt2_ffn(bp, h), k_pool, v_pool
+
+
+def gpt2_verify_step_paged(cfg: GPT2Config, params, tokens, k_pool,
+                           v_pool, page_table, lengths, active,
+                           impl: Optional[str] = None):
+    """The paged twin of ``gpt2_verify_step`` — same contract over the
+    page pool; the engine must have allocated pages covering all W
+    speculative rows before the pass (rollback frees the ones the
+    acceptance didn't keep)."""
+    if impl is None:
+        impl = _decode_attn_impl(cfg)
+    S, W = tokens.shape
+    page_len = k_pool.shape[3]
+    cap = min(page_table.shape[1] * page_len, cfg.n_positions)
+    positions, row_valid, row_lens = _verify_rows(lengths, active, W,
+                                                  cap)
+    x = params["wte"][tokens] + params["wpe"][positions]
+    block_params = params["blocks"]
+    if cfg.scan_layers:
+        def body(x, xs):
+            bp, kc, vc = xs
+            x, kc, vc = gpt2_block_verify_paged(
+                cfg, bp, x, kc, vc, page_table, positions, row_valid,
+                row_lens, impl)
+            return x, (kc, vc)
+        x, (k_pool, v_pool) = jax.lax.scan(
+            body, x, (block_params, k_pool, v_pool))
+    else:
+        kc_l, vc_l = [], []
+        for i in range(cfg.n_layer):
+            bp = jax.tree.map(lambda a, i=i: a[i], block_params)
+            x, kc, vc = gpt2_block_verify_paged(
+                cfg, bp, x, k_pool[i], v_pool[i], page_table, positions,
+                row_valid, row_lens, impl)
+            kc_l.append(kc)
+            vc_l.append(vc)
+        k_pool, v_pool = jnp.stack(kc_l), jnp.stack(vc_l)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    logits = x @ params["wte"].astype(x.dtype).T
+    return logits, k_pool, v_pool
 
 
 # ---------------------------------------------------------------------------

@@ -34,6 +34,19 @@ only the pages it owns — the KV layout becomes ``[P, H, page_len, Dh]``
 entry points: the same masking semantics in plain jnp (the paged arm
 gathers with ``jnp.take``), the differential-test oracle and the
 serving engine's CPU path.
+
+MULTI-QUERY arm (speculative decoding, docs/serving.md): the verify
+half of draft-verify speculation scores ``W = k+1`` new tokens per
+slot in ONE pass, so both entry points grow a ``*_multi`` twin taking
+``W`` query rows and PER-QUERY live lengths ``[S, W]`` — query ``i``
+(absolute position ``base + i``) attends every key below
+``lengths[s, i]`` = ``base + i + 1``.  The kernels reuse the sublane
+dimension the single query only broadcast into: up to 8 query rows
+ride one tile (W padded up to a sublane multiple), each with its own
+length mask, same grid, same streaming.  The dense multi reference is
+DEFINED as W stacked single-query calls — fp32-bitwise against
+sequential decode ticks by construction, the parity anchor the
+widened program is verified against (tests/test_spec_decode.py).
 """
 from __future__ import annotations
 
@@ -362,3 +375,303 @@ def decode_attention_paged(q: jnp.ndarray, k_pages: jnp.ndarray,
                                 page_table.astype(jnp.int32),
                                 lengths.astype(jnp.int32),
                                 sm_scale=sm_scale, interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# multi-query decode attention: the speculative verify arm
+# ---------------------------------------------------------------------------
+
+
+def decode_attention_multi_reference(q, k, v, lengths, sm_scale=None):
+    """W stacked single-query references: ``q [S, H, W, Dh]`` against
+    ``k/v [S, H, T, Dh]`` with PER-QUERY lengths ``[S, W]`` — query
+    ``i`` is exactly ``decode_attention_reference(q[:, :, i], ...,
+    lengths[:, i])``, so a verify pass is fp32-BITWISE against the W
+    sequential decode ticks it replaces (the parity anchor of
+    tests/test_spec_decode.py).  W is small and static (k+1 <= 9), so
+    the unrolled loop stays one trace."""
+    W = q.shape[2]
+    outs = [decode_attention_reference(q[:, :, i], k, v, lengths[:, i],
+                                       sm_scale=sm_scale)
+            for i in range(W)]
+    return jnp.stack(outs, axis=2)                      # [S, H, W, Dh]
+
+
+def _rows_pad(w: int) -> int:
+    """Query rows padded to the TPU sublane multiple (min one tile)."""
+    return max(8, -(-w // 8) * 8)
+
+
+def _multi_len_op(lengths: jnp.ndarray, wp: int) -> jnp.ndarray:
+    """Per-query lengths [S, W] as a broadcast [S, Wp, 128] int32 tile
+    (padding rows get length 0 -> exact-zero outputs, sliced away)."""
+    S, W = lengths.shape
+    lens = jnp.zeros((S, wp), jnp.int32)
+    lens = lens.at[:, :W].set(lengths.astype(jnp.int32))
+    return jnp.broadcast_to(lens[:, :, None], (S, wp, 128))
+
+
+def _pad_queries(q: jnp.ndarray, wp: int) -> jnp.ndarray:
+    """[S, H, W, Dh] -> [S*H, Wp, Dh] with zero padding rows."""
+    S, H, W, Dh = q.shape
+    qf = q.reshape(S * H, W, Dh)
+    if wp > W:
+        qf = jnp.pad(qf, ((0, 0), (0, wp - W), (0, 0)))
+    return qf
+
+
+def _decode_multi_kernel(q_ref, len_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr,
+                         *, sm_scale: float, block_k: int):
+    jk = pl.program_id(1)
+    nk = pl.num_programs(1)
+    row_lens = len_ref[0][:, 0:1]                       # [Wp, 1]
+
+    @pl.when(jk == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # the block computes when ANY row still has live keys in it; rows
+    # whose own length ends earlier mask themselves below
+    @pl.when(jk * block_k < jnp.max(row_lens))
+    def _compute():
+        q = q_ref[0]                                    # [Wp, d]
+        k = k_ref[0]                                    # [bk, d]
+        v = v_ref[0]                                    # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [Wp, bk]
+        k_ids = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+            + jk * block_k
+        mask = k_ids < row_lens
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # unlike the single-query kernel, a ROW can be fully masked in
+        # a block another row keeps live: its m_new stays NEG_INF and
+        # exp(NEG_INF - NEG_INF) would be 1, so p is masked explicitly
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        # length-0 rows (inactive slots, padding rows) -> exact zeros
+        o_ref[0] = jnp.where(l == 0.0, 0.0,
+                             acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _decode_multi_pallas(q, k, v, lengths, *, sm_scale, block_k,
+                         interpret):
+    S, H, T, Dh = k.shape
+    W = q.shape[2]
+    wp = _rows_pad(W)
+    block_k = min(block_k, max(T, 8))
+    kf = _pad_seq(k.reshape(S * H, T, Dh), block_k, 1)
+    vf = _pad_seq(v.reshape(S * H, T, Dh), block_k, 1)
+    nk = kf.shape[1] // block_k
+    qf = _pad_queries(q, wp)
+    len_op = _multi_len_op(lengths, wp)
+    out = pl.pallas_call(
+        functools.partial(_decode_multi_kernel, sm_scale=sm_scale,
+                          block_k=block_k),
+        grid=(S * H, nk),
+        in_specs=[
+            pl.BlockSpec((1, wp, Dh), lambda g, j: (g, 0, 0)),
+            pl.BlockSpec((1, wp, 128), lambda g, j, H=H: (g // H, 0, 0)),
+            pl.BlockSpec((1, block_k, Dh), lambda g, j: (g, j, 0)),
+            pl.BlockSpec((1, block_k, Dh), lambda g, j: (g, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, wp, Dh), lambda g, j: (g, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((S * H, wp, Dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((wp, 128), jnp.float32),
+            pltpu.VMEM((wp, 128), jnp.float32),
+            pltpu.VMEM((wp, Dh), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, len_op, kf, vf)
+    return out[:, :W, :].reshape(S, H, W, Dh)
+
+
+def decode_attention_multi(q: jnp.ndarray, k: jnp.ndarray,
+                           v: jnp.ndarray, lengths: jnp.ndarray,
+                           sm_scale: Optional[float] = None,
+                           block_k: int = 256,
+                           impl: str = "pallas",
+                           interpret: Optional[bool] = None
+                           ) -> jnp.ndarray:
+    """Multi-query attention over the slot KV cache — the speculative
+    ``verify_step``'s widened decode (docs/serving.md).
+
+    q: [S, H, W, Dh] — W new query tokens per slot (the pending token
+        + its k draft proposals; W = k+1).
+    k, v: [S, H, T, Dh] — the slot cache with ALL W new rows already
+        written (write-then-attend, exactly the decode contract).
+    lengths: [S, W] int32, TRACED — per-QUERY live length including the
+        query's own position (row ``i`` of an active slot at base
+        length L is ``L + i + 1``); 0 = masked row -> exact zeros.
+
+    ``impl='dense'`` is W stacked single-query references (bitwise the
+    sequential ticks being replaced); ``'pallas'`` packs the W rows
+    into the sublane dimension of the single-query kernel's tiles."""
+    assert q.ndim == 4 and k.ndim == 4, (q.shape, k.shape)
+    S, H, T, Dh = k.shape
+    W = q.shape[2]
+    assert q.shape == (S, H, W, Dh), (q.shape, k.shape)
+    assert lengths.shape == (S, W), (lengths.shape, q.shape)
+    if sm_scale is None:
+        sm_scale = _default_scale(Dh)
+    if impl == "dense":
+        return decode_attention_multi_reference(q, k, v, lengths,
+                                                sm_scale=sm_scale)
+    if impl != "pallas":
+        raise ValueError(
+            f"decode_attention_multi impl={impl!r}: expected 'pallas' "
+            "or 'dense'")
+    if interpret is None:
+        interpret = _use_interpret()
+    return _decode_multi_pallas(q, k, v, lengths.astype(jnp.int32),
+                                sm_scale=sm_scale, block_k=block_k,
+                                interpret=interpret)
+
+
+def _decode_paged_multi_kernel(pt_ref, q_ref, len_ref, k_ref, v_ref,
+                               o_ref, m_scr, l_scr, acc_scr,
+                               *, sm_scale: float, page_len: int):
+    jk = pl.program_id(1)
+    nk = pl.num_programs(1)
+    row_lens = len_ref[0][:, 0:1]                       # [Wp, 1]
+
+    @pl.when(jk == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(jk * page_len < jnp.max(row_lens))
+    def _compute():
+        q = q_ref[0]                                    # [Wp, d]
+        k = k_ref[0, 0]                                 # [page_len, d]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        k_ids = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+            + jk * page_len
+        mask = k_ids < row_lens
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = jnp.where(l == 0.0, 0.0,
+                             acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _decode_paged_multi_pallas(q, k_pages, v_pages, page_table, lengths,
+                               *, sm_scale, interpret):
+    P, H, page_len, Dh = k_pages.shape
+    S, max_pages = page_table.shape
+    W = q.shape[2]
+    wp = _rows_pad(W)
+    qf = _pad_queries(q, wp)
+    len_op = _multi_len_op(lengths, wp)
+    pt_flat = page_table.astype(jnp.int32).reshape(-1)
+    # only the page table needs scalar prefetch (it feeds the index
+    # maps); the per-query lengths ride as an ordinary VMEM tile
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S * H, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, wp, Dh), lambda g, j, pt: (g, 0, 0)),
+            pl.BlockSpec((1, wp, 128),
+                         lambda g, j, pt, H=H: (g // H, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, page_len, Dh),
+                lambda g, j, pt, H=H, M=max_pages:
+                    (pt[(g // H) * M + j], g % H, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, page_len, Dh),
+                lambda g, j, pt, H=H, M=max_pages:
+                    (pt[(g // H) * M + j], g % H, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, wp, Dh), lambda g, j, pt: (g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((wp, 128), jnp.float32),
+            pltpu.VMEM((wp, 128), jnp.float32),
+            pltpu.VMEM((wp, Dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_paged_multi_kernel, sm_scale=sm_scale,
+                          page_len=page_len),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S * H, wp, Dh), q.dtype),
+        interpret=interpret,
+    )(pt_flat, qf, len_op, k_pages, v_pages)
+    return out[:, :W, :].reshape(S, H, W, Dh)
+
+
+def decode_attention_paged_multi(q: jnp.ndarray, k_pages: jnp.ndarray,
+                                 v_pages: jnp.ndarray,
+                                 page_table: jnp.ndarray,
+                                 lengths: jnp.ndarray,
+                                 sm_scale: Optional[float] = None,
+                                 impl: str = "pallas",
+                                 interpret: Optional[bool] = None
+                                 ) -> jnp.ndarray:
+    """Multi-query attention over the PAGED KV pool — the paged twin of
+    :func:`decode_attention_multi` (same per-query ``lengths [S, W]``
+    contract) with the page pool/table layout of
+    :func:`decode_attention_paged`.  ``impl='dense'`` gathers the pool
+    with ``jnp.take`` then runs the stacked single-query reference —
+    values identical to the unpaged multi arm on the same logical
+    cache; ``'pallas'`` is the scalar-prefetch kernel with W query
+    rows per tile (interpret mode off-TPU)."""
+    assert q.ndim == 4 and k_pages.ndim == 4, (q.shape, k_pages.shape)
+    P, H, page_len, Dh = k_pages.shape
+    S, max_pages = page_table.shape
+    W = q.shape[2]
+    assert q.shape == (S, H, W, Dh), (q.shape, k_pages.shape)
+    assert lengths.shape == (S, W), (lengths.shape, q.shape)
+    if sm_scale is None:
+        sm_scale = _default_scale(Dh)
+    if impl == "dense":
+        kg = paged_gather(k_pages, page_table)
+        vg = paged_gather(v_pages, page_table)
+        return decode_attention_multi_reference(q, kg, vg, lengths,
+                                                sm_scale=sm_scale)
+    if impl != "pallas":
+        raise ValueError(
+            f"decode_attention_paged_multi impl={impl!r}: expected "
+            "'pallas' or 'dense'")
+    if interpret is None:
+        interpret = _use_interpret()
+    return _decode_paged_multi_pallas(q, k_pages, v_pages,
+                                      page_table.astype(jnp.int32),
+                                      lengths.astype(jnp.int32),
+                                      sm_scale=sm_scale,
+                                      interpret=interpret)

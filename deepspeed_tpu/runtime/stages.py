@@ -454,10 +454,14 @@ class Stage:
                 d = self.depth_fn()
                 if isinstance(d, dict):
                     # multi-gauge sampler (the serve stage stamps queue
-                    # depth AND free-page count); "depth" stays the
-                    # primary key diagnose's trajectory reads
+                    # depth, free-page count, and the live speculation
+                    # accept ratio); "depth" stays the primary key
+                    # diagnose's trajectory reads.  Float gauges (the
+                    # accept ratio) keep their fraction — int() would
+                    # truncate every ratio to 0
                     for dk, dv in d.items():
-                        ev[dk] = int(dv)
+                        ev[dk] = float(dv) if isinstance(dv, float) \
+                            else int(dv)
                 else:
                     ev["depth"] = int(d)
             except Exception:

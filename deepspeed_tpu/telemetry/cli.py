@@ -77,6 +77,8 @@ def summarize(path: str, out=None) -> dict:
     sv_prefix_hit: Optional[float] = None
     sv_prefix_tokens: Optional[float] = None
     sv_cow: Optional[float] = None
+    sv_spec_accept: Optional[float] = None
+    sv_spec_mal: Optional[float] = None
     # per-request serving records (kind: serve_request) — the
     # queue/prefill/decode latency attribution split
     sv_requests = 0
@@ -167,6 +169,15 @@ def summarize(path: str, out=None) -> dict:
                 cw = scalars.get("serve_page_cow_total")
                 if cw is not None:
                     sv_cow = float(cw)
+                # speculative decoding (docs/serving.md): both scalars
+                # are cumulative over the run — the LAST flush is the
+                # run's answer
+                sa = scalars.get("serve_spec_accept_ratio")
+                if sa is not None:
+                    sv_spec_accept = float(sa)
+                sm = scalars.get("serve_spec_mean_accepted_len")
+                if sm is not None:
+                    sv_spec_mal = float(sm)
                 sg = scalars.get("straggler_detected_total")
                 if sg is not None:
                     # cumulative counter: the last/maximum value is the
@@ -256,6 +267,8 @@ def summarize(path: str, out=None) -> dict:
         "serve_prefix_hit_ratio": sv_prefix_hit,
         "serve_prefix_hit_tokens": sv_prefix_tokens,
         "serve_page_cow_total": sv_cow,
+        "serve_spec_accept_ratio": sv_spec_accept,
+        "serve_spec_mean_accepted_len": sv_spec_mal,
         "liveness_hosts": len(beat_ages) or None,
         "liveness_max_age_s": (max(beat_ages.values())
                                if beat_ages else None),
@@ -334,6 +347,17 @@ def summarize(path: str, out=None) -> dict:
         print(f"  prefix cache       "
               f"{report['serve_prefix_hit_ratio'] * 100:.0f}% hit"
               f"{tok_txt}{cow_txt}", file=out)
+    if report["serve_spec_mean_accepted_len"] is not None:
+        # speculative decoding: draft-token acceptance + tokens per
+        # target pass — the speedup denominator (wall/token tracks
+        # 1/mean-accepted-length, docs/serving.md)
+        acc_txt = (f"  accept {report['serve_spec_accept_ratio'] * 100:.0f}"
+                   "% of drafts"
+                   if report["serve_spec_accept_ratio"] is not None
+                   else "")
+        print(f"  speculation        "
+              f"{report['serve_spec_mean_accepted_len']:.2f} tokens/"
+              f"target pass{acc_txt}", file=out)
     if beat_ages:
         # liveness (docs/elastic.md): supervisor-visible staleness made
         # operator-visible — last beat age per host at the final sync

@@ -49,6 +49,7 @@ run() {
 if [ "$1" = "--serve" ]; then
   run serve python bench_serve.py
   run serve_paged python bench_serve.py --paged ab
+  run serve_spec python bench_serve.py --spec ab
   exit 0
 fi
 # capacity runs LAST: its probes are subprocesses killed on timeout,
@@ -70,6 +71,10 @@ run serve python bench_serve.py
 # paged-KV A/B: admitted slots at fixed KV bytes + prefix-reuse
 # prefill compute (pure CPU scheduling claims — see docs/serving.md)
 run serve_paged python bench_serve.py --paged ab
+# speculative-decoding A/B: draft-verify vs one-token-per-tick under
+# injected per-PASS device time; wall/token tracks 1/mean-accepted-
+# length (pure CPU scheduling claim — see docs/serving.md)
+run serve_spec python bench_serve.py --spec ab
 run bert python bench_bert.py
 run sparse python bench_sparse.py
 run flash python bench_flash.py

@@ -46,6 +46,9 @@ LOWER_BETTER_HINTS = ("latency", "_p50", "_p99", "time_s", "_seconds",
 #: says "speedup".
 METRIC_DIRECTIONS = {
     "serve_paged_admitted_ratio": False,
+    # wall-clock per token, spec vs non-spec: smaller = more tokens
+    # per target pass (docs/serving.md "speculative decoding")
+    "serve_spec_wall_per_token_ratio": True,
 }
 
 

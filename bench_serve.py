@@ -56,6 +56,7 @@ Emits BENCH_serve_spec.json:
     {"metric": "serve_spec_wall_per_token_ratio", "value": ...,
      "spec": {...}, "baseline": {...}}
 """
+import contextlib
 import json
 import os
 import sys
@@ -71,6 +72,75 @@ def _build_model():
     return GPT2Model(cfg)
 
 
+# ---------------------------------------------------------------------------
+# the shared leg harness (one copy, not one per mode)
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def _injected_delay(delay_s):
+    """Arm ``DS_STAGE_DELAY_S=serve:<s>`` for one leg and restore the
+    previous spec (re-parsing the cached spec both ways) — the
+    save/arm/restore dance every A/B leg used to hand-copy."""
+    from deepspeed_tpu.runtime.stages import reset_fault_injection
+    prev = os.environ.get("DS_STAGE_DELAY_S")
+    try:
+        if delay_s is not None:
+            os.environ["DS_STAGE_DELAY_S"] = f"serve:{delay_s}"
+            reset_fault_injection()
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("DS_STAGE_DELAY_S", None)
+        else:
+            os.environ["DS_STAGE_DELAY_S"] = prev
+        reset_fault_injection()
+
+
+def _mode_kwargs(args, **attr_to_kw):
+    """Per-mode default sentinels: every mode flag defaults to None at
+    the parser, and ONLY explicitly-given values are forwarded, so
+    each ``run_*_ab`` keeps its own mode defaults (the paged/spec/
+    quant A/Bs want different slot counts, delays and budgets than the
+    plain one).  One copy of the forwarding — the third mode no longer
+    clones the other two's kwargs blocks."""
+    kw = {}
+    for attr, name in attr_to_kw.items():
+        v = getattr(args, attr)
+        if v is not None:
+            kw[name] = v
+    return kw
+
+
+def _kv_budget_bytes(model, slots, max_seq_len):
+    """The fixed KV-byte budget: what ``slots`` legacy fp strides cost,
+    read from the cache spec (dtype itemsize included — fp16 and int8
+    legs report TRUE bytes, not a hardcoded 4 bytes/elem)."""
+    from deepspeed_tpu.inference.kv_cache import KVCacheSpec
+    import jax.numpy as jnp
+    cfg = model.config
+    return KVCacheSpec(layers=cfg.n_layer, slots=slots,
+                       heads=cfg.n_head, max_len=max_seq_len,
+                       head_dim=cfg.d_head, dtype=jnp.float32).bytes
+
+
+def _pages_for_budget(model, budget_bytes, page_len, quant=False):
+    """(pages, page_bytes): allocatable pages a byte budget buys (+1
+    for the scratch page, which spends no budget — it is masked-write
+    storage, not request capacity), from the paged spec's
+    ``page_bytes`` — the quant arm's sidecar-inclusive quantum, so the
+    int8 leg's extra pages are real bytes, never a 4-bytes/elem
+    assumption."""
+    from deepspeed_tpu.inference.kv_cache import PagedKVCacheSpec
+    import jax.numpy as jnp
+    cfg = model.config
+    spec = PagedKVCacheSpec(
+        layers=cfg.n_layer, slots=1, heads=cfg.n_head, pages=1,
+        page_len=page_len, head_dim=cfg.d_head, max_pages=1,
+        dtype=(jnp.int8 if quant else jnp.float32), quant=quant)
+    return budget_bytes // spec.page_bytes + 1, spec.page_bytes
+
+
 def run_leg(model, params, *, slots, n_requests, prompt_len, gen_tokens,
             tick_delay_s, arrival_s, tag):
     """One leg: serve ``n_requests`` arriving open-loop every
@@ -83,23 +153,21 @@ def run_leg(model, params, *, slots, n_requests, prompt_len, gen_tokens,
     import shutil
     import tempfile
     tel_dir = tempfile.mkdtemp(prefix=f"bench_serve_tel_{tag}_")
-    prev = os.environ.get("DS_STAGE_DELAY_S")
-    try:
-        eng = ServeEngine(model, {
-            "serving": {"slots": slots, "max_seq_len": 64,
-                        "prefill_len": max(prompt_len, 1),
-                        "flush_interval_ticks": 10},
-            "telemetry": {"enabled": True, "output_path": tel_dir,
-                          "memory": False},
-        }, params=params)
-        rng = np.random.default_rng(0)
-        prompts = [rng.integers(0, 256, (prompt_len,)).astype(np.int32)
-                   for _ in range(n_requests)]
-        # warm up (compile prefill + decode) BEFORE arming the delay and
-        # the clock: the A/B measures scheduling, not XLA compile time
-        eng.submit(prompts[0], max_new_tokens=2)
-        eng.run_until_idle()
-        os.environ["DS_STAGE_DELAY_S"] = f"serve:{tick_delay_s}"
+    eng = ServeEngine(model, {
+        "serving": {"slots": slots, "max_seq_len": 64,
+                    "prefill_len": max(prompt_len, 1),
+                    "flush_interval_ticks": 10},
+        "telemetry": {"enabled": True, "output_path": tel_dir,
+                      "memory": False},
+    }, params=params)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, (prompt_len,)).astype(np.int32)
+               for _ in range(n_requests)]
+    # warm up (compile prefill + decode) BEFORE arming the delay and
+    # the clock: the A/B measures scheduling, not XLA compile time
+    eng.submit(prompts[0], max_new_tokens=2)
+    eng.run_until_idle()
+    with _injected_delay(tick_delay_s):
         t0 = time.perf_counter()
         arrivals = [t0 + i * arrival_s for i in range(n_requests)]
         reqs = []
@@ -115,14 +183,9 @@ def run_leg(model, params, *, slots, n_requests, prompt_len, gen_tokens,
                 continue
             eng.step()
         wall = time.perf_counter() - t0
-        assert all(r.error is None for r in reqs)
-        tokens = sum(len(r.tokens) for r in reqs)
-        eng.close()
-    finally:
-        if prev is None:
-            os.environ.pop("DS_STAGE_DELAY_S", None)
-        else:
-            os.environ["DS_STAGE_DELAY_S"] = prev
+    assert all(r.error is None for r in reqs)
+    tokens = sum(len(r.tokens) for r in reqs)
+    eng.close()
     with open(os.devnull, "w") as devnull:
         report = summarize(os.path.join(tel_dir, "events.jsonl"),
                            out=devnull)
@@ -185,11 +248,24 @@ def _run_mixed_leg(model, params, serving, requests, tag):
     assert all(r.error is None for r in reqs), \
         [r.error for r in reqs if r.error]
     tokens = [r.tokens for r in reqs]
-    kv_bytes = eng.cache_spec.bytes
+    # TRUE device bytes from the engine's memory plane (spec itemsize +
+    # quant sidecars + param tree) — never recomputed by hand here, and
+    # cross-checked against the REAL array bytes so a spec-accounting
+    # bug (e.g. a sidecar miscount) cannot silently skew a fixed-byte
+    # headline
+    kv_bytes = eng.kv_bytes
+    data_bytes = sum(int(eng.cache[key].nbytes) for key in eng.cache
+                     if key != "lengths")
+    assert data_bytes == eng.cache_spec.bytes, \
+        (data_bytes, eng.cache_spec.bytes)
+    param_bytes = eng.param_bytes
+    truncated = sum(r.finish_reason == "kv_capacity" for r in reqs)
     eng.close()
     return {"tag": tag, "kv_bytes": kv_bytes,
+            "param_bytes": param_bytes,
             "max_concurrent": max_concurrent, "ticks": ticks,
             "requests": len(reqs),
+            "kv_capacity_finishes": truncated,
             "tokens_total": sum(len(t) for t in tokens)}, tokens
 
 
@@ -199,35 +275,24 @@ def _run_prefix_leg(model, params, serving, prompts, gen_tokens,
     device time; total prefill seconds comes from the same windows the
     ``serve/prefill`` tracer spans cover (req.prefill_s)."""
     from deepspeed_tpu.inference import ServeEngine
-    prev = os.environ.get("DS_STAGE_DELAY_S")
-    try:
-        eng = ServeEngine(model, {"serving": serving}, params=params)
-        # compile prefill/decode BEFORE arming the delay: the A/B
-        # measures scheduling, not XLA compile time
-        eng.submit(prompts[0][:1], max_new_tokens=1)
-        eng.run_until_idle()
-        os.environ["DS_STAGE_DELAY_S"] = f"serve:{tick_delay_s}"
-        from deepspeed_tpu.runtime.stages import reset_fault_injection
-        reset_fault_injection()
+    eng = ServeEngine(model, {"serving": serving}, params=params)
+    # compile prefill/decode BEFORE arming the delay: the A/B
+    # measures scheduling, not XLA compile time
+    eng.submit(prompts[0][:1], max_new_tokens=1)
+    eng.run_until_idle()
+    with _injected_delay(tick_delay_s):
         reqs = [eng.submit(p, max_new_tokens=gen_tokens) for p in prompts]
         eng.run_until_idle()
-        assert all(r.error is None for r in reqs)
-        out = {
-            "prefill_total_s": sum(r.prefill_s for r in reqs),
-            "computed_tokens": [r.computed_len for r in reqs],
-            "shared_tokens": [r.shared_len for r in reqs],
-            "prefix_hits": eng.prefix.hits if eng.prefix else 0,
-        }
-        tokens = [r.tokens for r in reqs]
-        eng.close()
-        return out, tokens
-    finally:
-        if prev is None:
-            os.environ.pop("DS_STAGE_DELAY_S", None)
-        else:
-            os.environ["DS_STAGE_DELAY_S"] = prev
-        from deepspeed_tpu.runtime.stages import reset_fault_injection
-        reset_fault_injection()
+    assert all(r.error is None for r in reqs)
+    out = {
+        "prefill_total_s": sum(r.prefill_s for r in reqs),
+        "computed_tokens": [r.computed_len for r in reqs],
+        "shared_tokens": [r.shared_len for r in reqs],
+        "prefix_hits": eng.prefix.hits if eng.prefix else 0,
+    }
+    tokens = [r.tokens for r in reqs]
+    eng.close()
+    return out, tokens
 
 
 def run_paged_ab(kv_budget_slots=4, max_seq_len=64, page_len=8,
@@ -245,9 +310,11 @@ def run_paged_ab(kv_budget_slots=4, max_seq_len=64, page_len=8,
 
     # -- leg 1: admitted slots at fixed KV bytes ------------------------
     # budget = kv_budget_slots full strides; the page pool spends the
-    # same bytes as pages (+1 scratch page)
-    budget_tokens = kv_budget_slots * max_seq_len
-    pages = budget_tokens // page_len + 1
+    # same BYTES as pages (+1 scratch page) — both sides read their
+    # dtype itemsize from the cache specs, never a 4-bytes/elem
+    # assumption (the fp16/int8 legs of --quant ride the same helper)
+    budget_bytes = _kv_budget_bytes(model, kv_budget_slots, max_seq_len)
+    pages, _ = _pages_for_budget(model, budget_bytes, page_len)
     short = dict(prompt=4, gen=4)       # 8 live tokens -> 1 page
     long = dict(prompt=template_len, gen=16)
     requests = []
@@ -314,6 +381,118 @@ def run_paged_ab(kv_budget_slots=4, max_seq_len=64, page_len=8,
 
 
 # ---------------------------------------------------------------------------
+# --quant: int8 weights + int8 KV pages A/B (docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+def _token_agreement(a, b):
+    """Positionwise greedy-stream agreement over two request lists —
+    REPORTED, never asserted equal: quantization is a tolerance tier,
+    not a bitwise one (docs/serving.md)."""
+    total = same = 0
+    for ta, tb in zip(a, b):
+        for x, y in zip(ta, tb):
+            total += 1
+            same += x == y
+    return same / max(total, 1)
+
+
+def run_quant_ab(kv_budget_slots=4, max_seq_len=64, page_len=8,
+                 slots=64, n_requests=96, long_every=4, out_dir="."):
+    """The quantized-serving A/B (docs/serving.md "quantized serving").
+
+    **KV leg (the headline)**: the same mixed short/long workload
+    against fp pages and int8 pages whose pools spend the SAME byte
+    budget (``kv_budget_slots`` legacy fp strides, bytes via the cache
+    specs — sidecars included).  Request geometry is page-exact
+    (prompt+gen fills whole pages), so nothing ever appends past its
+    admission allocation: 0 truncations by construction, and the max
+    concurrently admitted count is a pure bytes-per-page fact.
+
+    **Weights leg**: the same workload with weights='int8' (fp pages)
+    — params HBM from the ``serve_param_bytes`` plane (the param-tree
+    bytes ``collect_memory_stats()`` would show on a device with
+    allocator stats; the raw snapshot rides along), expected >= 1.8x
+    smaller.  Greedy token agreement vs the fp leg is REPORTED for
+    every arm, never asserted equal."""
+    import jax
+    import numpy as np
+    from deepspeed_tpu.runtime.utils import collect_memory_stats
+    model = _build_model()
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+
+    budget_bytes = _kv_budget_bytes(model, kv_budget_slots, max_seq_len)
+    pages_fp, _ = _pages_for_budget(model, budget_bytes, page_len)
+    pages_q, _ = _pages_for_budget(model, budget_bytes, page_len,
+                                   quant=True)
+    # page-exact geometry: short = 1 page live, long = 3 pages live —
+    # decode never crosses a page boundary, so the pool can never dry
+    # mid-request (0 kv_capacity finishes, asserted below); gen=4
+    # keeps every request alive across several ticks so the sampled
+    # max-concurrency sees the full admitted wave
+    short = dict(prompt=page_len - 4, gen=4)
+    long = dict(prompt=3 * page_len - 4, gen=4)
+    requests = []
+    for i in range(n_requests):
+        spec = long if (i % long_every == long_every - 1) else short
+        requests.append((list(rng.integers(0, 256, (spec["prompt"],))),
+                         spec["gen"]))
+    base = {"slots": slots, "max_seq_len": max_seq_len,
+            "prefill_len": long["prompt"], "queue_capacity": 256,
+            "page_len": page_len, "prefix_cache": False}
+    fp, tok_fp = _run_mixed_leg(
+        model, params, {**base, "pages": pages_fp}, requests, "fp")
+    q, tok_q = _run_mixed_leg(
+        model, params,
+        {**base, "pages": pages_q,
+         "quantization": {"kv": "int8"}}, requests, "int8")
+    # allocatable pages spend <= the budget by construction of
+    # _pages_for_budget; the REAL accounting guard is the per-leg
+    # array-bytes == spec-bytes assert in _run_mixed_leg, plus: the
+    # int8 pool (sidecar included) must not cost more device bytes
+    # than the fp pool it beats
+    assert q["kv_bytes"] <= fp["kv_bytes"], (q["kv_bytes"],
+                                             fp["kv_bytes"])
+    truncations = fp["kv_capacity_finishes"] + q["kv_capacity_finishes"]
+    assert truncations == 0, "page-exact workload truncated"
+
+    # weights leg: same workload, int8 weights over fp pages
+    w8, tok_w8 = _run_mixed_leg(
+        model, params,
+        {**base, "pages": pages_fp,
+         "quantization": {"weights": "int8"}}, requests, "weights_int8")
+    params_ratio = fp["param_bytes"] / w8["param_bytes"]
+
+    rec = {
+        "metric": "serve_quant_admitted_ratio",
+        "value": q["max_concurrent"] / fp["max_concurrent"],
+        "kv_budget_bytes": budget_bytes,
+        "page_len": page_len,
+        "truncations": truncations,
+        "int8": q,
+        "fp": fp,
+        "weights": {
+            "leg": w8,
+            "param_bytes_fp": fp["param_bytes"],
+            "param_bytes_int8": w8["param_bytes"],
+            "params_hbm_ratio": params_ratio,
+            # allocator-stats snapshot (empty device list on the CPU
+            # oracle; real HBM on TPU) — the same plane
+            # collect_memory_stats() feeds the telemetry gauges
+            "collect_memory_stats": collect_memory_stats(),
+        },
+        "token_agreement_vs_fp": {
+            "kv_int8": _token_agreement(tok_fp, tok_q),
+            "weights_int8": _token_agreement(tok_fp, tok_w8),
+        },
+    }
+    with open(os.path.join(out_dir, "BENCH_serve_quant.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+    return rec
+
+
+# ---------------------------------------------------------------------------
 # --spec: draft-verify speculative decoding A/B (docs/serving.md)
 # ---------------------------------------------------------------------------
 
@@ -325,50 +504,39 @@ def _run_spec_leg(model, params, serving, draft_params, prompts,
     events.jsonl serve_request records carry (the tracer-span window),
     mean accepted length from the engine's speculation scalars."""
     from deepspeed_tpu.inference import ServeEngine
-    from deepspeed_tpu.runtime.stages import reset_fault_injection
 
     import shutil
     import tempfile
     tel_dir = tempfile.mkdtemp(prefix=f"bench_serve_spec_{tag}_")
-    prev = os.environ.get("DS_STAGE_DELAY_S")
-    try:
-        eng = ServeEngine(model, {
-            "serving": serving,
-            "telemetry": {"enabled": True, "output_path": tel_dir,
-                          "memory": False},
-        }, params=params, draft_params=draft_params)
-        # compile every program BEFORE arming the delay: the A/B
-        # measures scheduling, not XLA compile time
-        warm = eng.submit(prompts[0][:4], max_new_tokens=2)
-        eng.run_until_idle()
-        # the warmup's truncated pass must not contaminate the
-        # measured statistics: reset the speculation counters and
-        # remember its rid so the events.jsonl scan below skips it
-        warm_rid = warm.rid
-        eng._spec_passes = 0
-        eng._spec_accepted_n = 0
-        eng._spec_proposed_n = 0
-        os.environ["DS_STAGE_DELAY_S"] = f"serve:{pass_delay_s}"
-        reset_fault_injection()
+    eng = ServeEngine(model, {
+        "serving": serving,
+        "telemetry": {"enabled": True, "output_path": tel_dir,
+                      "memory": False},
+    }, params=params, draft_params=draft_params)
+    # compile every program BEFORE arming the delay: the A/B
+    # measures scheduling, not XLA compile time
+    warm = eng.submit(prompts[0][:4], max_new_tokens=2)
+    eng.run_until_idle()
+    # the warmup's truncated pass must not contaminate the
+    # measured statistics: reset the speculation counters and
+    # remember its rid so the events.jsonl scan below skips it
+    warm_rid = warm.rid
+    eng._spec_passes = 0
+    eng._spec_accepted_n = 0
+    eng._spec_proposed_n = 0
+    with _injected_delay(pass_delay_s):
         t0 = time.perf_counter()
         reqs = [eng.submit(p, max_new_tokens=gen_tokens)
                 for p in prompts]
         eng.run_until_idle()
         wall = time.perf_counter() - t0
-        assert all(r.error is None for r in reqs)
-        tokens = [r.tokens for r in reqs]
-        n_tokens = sum(len(t) for t in tokens)
-        passes = eng._spec_passes
-        mal = ((eng._spec_accepted_n + passes) / passes
-               if passes else 1.0)
-        eng.close()
-    finally:
-        if prev is None:
-            os.environ.pop("DS_STAGE_DELAY_S", None)
-        else:
-            os.environ["DS_STAGE_DELAY_S"] = prev
-        from deepspeed_tpu.runtime.stages import reset_fault_injection
-        reset_fault_injection()
+    assert all(r.error is None for r in reqs)
+    tokens = [r.tokens for r in reqs]
+    n_tokens = sum(len(t) for t in tokens)
+    passes = eng._spec_passes
+    mal = ((eng._spec_accepted_n + passes) / passes
+           if passes else 1.0)
+    eng.close()
     # per-token decode time from the completion records' timestamps —
     # the same windows the decode/verify spans cover (PR 9
     # attribution).  STEADY-STATE only: a request's first decode
@@ -479,10 +647,10 @@ def main():
     parser.add_argument("--requests", type=int, default=None,
                         help="workload size (default 16; 24 with "
                              "--paged)")
-    parser.add_argument("--prompt", type=int, default=8,
-                        help="prompt length (unpaged and --spec A/Bs — "
-                             "the paged leg drives a fixed short/long "
-                             "mix)")
+    parser.add_argument("--prompt", type=int, default=None,
+                        help="prompt length (unpaged and --spec A/Bs, "
+                             "default 8 — the paged/quant legs drive a "
+                             "fixed short/long mix)")
     parser.add_argument("--gen", type=int, default=None,
                         help="tokens per request (default 16; with "
                              "--spec, 4*(k+1)+1 — block-aligned for "
@@ -506,38 +674,38 @@ def main():
                              "(BENCH_serve_spec.json); both arms always "
                              "run — the headline is the spec/non-spec "
                              "wall-per-token ratio")
+    parser.add_argument("--quant", choices=("on", "off", "ab"),
+                        default=None,
+                        help="run the quantized-serving A/B instead "
+                             "(BENCH_serve_quant.json): admitted "
+                             "concurrency at a fixed KV-byte budget, "
+                             "int8 vs fp pages, plus the int8-weights "
+                             "params-HBM leg; both arms always run — "
+                             "the headline is a ratio")
     parser.add_argument("--k", type=int, default=4,
                         help="draft tokens per tick for --spec "
                              "(default 4)")
     args = parser.parse_args()
+    # one shared dispatch harness: every mode forwards ONLY the flags
+    # the user gave (None sentinels), so each run_*_ab keeps its own
+    # per-mode defaults — no more per-mode kwargs blocks to clone
     if args.spec is not None:
-        kw = {"k": args.k, "prompt_len": args.prompt}
-        if args.delay is not None:
-            kw["pass_delay_s"] = args.delay
-        if args.slots is not None:
-            kw["slots"] = args.slots
-        if args.requests is not None:
-            kw["n_requests"] = args.requests
-        if args.gen is not None:
-            kw["gen_tokens"] = args.gen
-        rec = run_spec_ab(**kw)
+        rec = run_spec_ab(**{"k": args.k}, **_mode_kwargs(
+            args, delay="pass_delay_s", slots="slots",
+            requests="n_requests", gen="gen_tokens",
+            prompt="prompt_len"))
+    elif args.quant is not None:
+        rec = run_quant_ab(**_mode_kwargs(
+            args, slots="kv_budget_slots", requests="n_requests"))
     elif args.paged is not None:
-        kw = {}
-        if args.delay is not None:
-            kw["tick_delay_s"] = args.delay
-        if args.slots is not None:
-            kw["kv_budget_slots"] = args.slots
-        if args.requests is not None:
-            kw["n_requests"] = args.requests
-        rec = run_paged_ab(**kw)
+        rec = run_paged_ab(**_mode_kwargs(
+            args, delay="tick_delay_s", slots="kv_budget_slots",
+            requests="n_requests"))
     else:
-        rec = run_ab(slots=(8 if args.slots is None else args.slots),
-                     n_requests=(16 if args.requests is None
-                                 else args.requests),
-                     prompt_len=args.prompt,
-                     gen_tokens=(16 if args.gen is None else args.gen),
-                     tick_delay_s=(0.02 if args.delay is None
-                                   else args.delay))
+        rec = run_ab(**_mode_kwargs(
+            args, slots="slots", requests="n_requests",
+            prompt="prompt_len", gen="gen_tokens",
+            delay="tick_delay_s"))
     print(json.dumps(rec), flush=True)
     return 0
 

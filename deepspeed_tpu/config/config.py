@@ -425,6 +425,8 @@ class DeepSpeedServingConfig:
         self.temperature = get_scalar_param(
             sv, C.SERVING_TEMPERATURE, C.SERVING_TEMPERATURE_DEFAULT)
         self.draft = self._validate_draft(sv.get(C.SERVING_DRAFT))
+        self.quantization = self._validate_quantization(
+            sv.get(C.SERVING_QUANTIZATION), self.page_len)
         for name, v, lo in ((C.SERVING_SLOTS, self.slots, 1),
                             (C.SERVING_MAX_SEQ_LEN, self.max_seq_len, 0),
                             (C.SERVING_PREFILL_LEN, self.prefill_len, 0),
@@ -535,6 +537,56 @@ class DeepSpeedServingConfig:
                 f"serving.{C.SERVING_DRAFT}.{C.SERVING_DRAFT_ATTN_IMPL} "
                 "must be '' (follow the target), 'flash', or 'dense', "
                 f"got {out[C.SERVING_DRAFT_ATTN_IMPL]!r}")
+        return out
+
+    @staticmethod
+    def _validate_quantization(quant, page_len: int) -> Dict[str, str]:
+        """Eager validation of ``serving.quantization`` (docs/serving.md
+        "quantized serving"): a typo'd arm must fail at config parse,
+        not as a silent fp fallback under production traffic.  Returns
+        the block with defaults filled ('fp16' = the master dtype as
+        loaded — no cast, bitwise-unchanged programs)."""
+        if quant is None:
+            quant = {}
+        if not isinstance(quant, dict):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_QUANTIZATION} must be a dict "
+                f"(weights/kv arms), got {quant!r}")
+        allowed = {C.SERVING_QUANT_WEIGHTS, C.SERVING_QUANT_KV}
+        unknown = set(quant) - allowed
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_QUANTIZATION} has unknown key(s) "
+                f"{sorted(unknown)}; allowed: {sorted(allowed)}")
+        out = {
+            C.SERVING_QUANT_WEIGHTS: get_scalar_param(
+                quant, C.SERVING_QUANT_WEIGHTS,
+                C.SERVING_QUANT_WEIGHTS_DEFAULT),
+            C.SERVING_QUANT_KV: get_scalar_param(
+                quant, C.SERVING_QUANT_KV, C.SERVING_QUANT_KV_DEFAULT),
+        }
+        for key in allowed:
+            if out[key] not in ("fp16", "int8"):
+                raise DeepSpeedConfigError(
+                    f"serving.{C.SERVING_QUANTIZATION}.{key} must be "
+                    f"'fp16' (the master dtype — no quantization) or "
+                    f"'int8', got {out[key]!r}")
+        if out[C.SERVING_QUANT_KV] == "int8" and not page_len:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_QUANT_KV}='int8' requires serving."
+                f"{C.SERVING_PAGE_LEN} > 0: quantized KV is a property "
+                "of the paged pool (the slot layout keeps the master "
+                "dtype)")
+        if out[C.SERVING_QUANT_KV] == "int8" and page_len > 128:
+            # the fused-dequant kernels ride one scale lane per stored
+            # row (ops/pallas/decode_attention.py _scale_tile) — catch
+            # the limit here, not as a trace error on the first decode
+            # tick under live traffic
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_QUANT_KV}='int8' supports "
+                f"serving.{C.SERVING_PAGE_LEN} <= 128 (one scale lane "
+                f"per page row in the fused-dequant kernels), got "
+                f"{page_len}")
         return out
 
 

@@ -511,6 +511,25 @@ SERVING_DRAFT_N_HEAD_DEFAULT = 4
 # draft attention impl: '' = follow the target model's attn_impl
 SERVING_DRAFT_ATTN_IMPL = "attn_impl"
 SERVING_DRAFT_ATTN_IMPL_DEFAULT = ""
+# quantized serving plane (LLM.int8 weights + KVQuant/KIVI-style KV
+# pages, PAPERS.md; docs/serving.md "quantized serving").  Each arm is
+# independently togglable; 'fp16' = the master dtype as loaded (fp16 on
+# a half-precision deployment, fp32 on the CPU oracle) — NO cast, so
+# the default config is bitwise-unchanged vs the pre-quant engine.
+SERVING_QUANTIZATION = "quantization"
+# 'int8': one-shot post-load symmetric per-output-channel absmax
+# quantization of the GPT-2 matmul weights (attn qkv/proj, MLP) with
+# dequant fused into the serving matmuls as (int8_w · x) * scale; the
+# fp master copy never reaches device memory — params HBM ~ halved.
+SERVING_QUANT_WEIGHTS = "weights"
+SERVING_QUANT_WEIGHTS_DEFAULT = "fp16"
+# 'int8': the paged KV pool stores int8 rows + a per-(page, head, row)
+# fp32 scale sidecar, quantized on write inside the compiled programs
+# and dequantized fused into the decode kernels — ~2x more pages in
+# the same KV bytes, multiplicative with serving.page_len.  Requires
+# page_len > 0 (the slot layout keeps the master dtype).
+SERVING_QUANT_KV = "kv"
+SERVING_QUANT_KV_DEFAULT = "fp16"
 
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 PLD_ENABLED = "enabled"

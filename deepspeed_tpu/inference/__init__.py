@@ -14,6 +14,9 @@ from .kv_cache import (KVCacheSpec, PagedKVCacheSpec,  # noqa: F401
                        init_cache, init_paged_cache,
                        paged_cache_shardings, paged_partition_specs,
                        shard_cache)
+from .quantize import (dequantize_rows, param_nbytes,  # noqa: F401
+                       quantize_channels, quantize_gpt2_params,
+                       quantize_rows, quantized_partition_specs)
 from .scheduler import (PagePool, PrefixCache, Request,  # noqa: F401
                         SlotScheduler)
 from .speculative import (greedy_accept,  # noqa: F401

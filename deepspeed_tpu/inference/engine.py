@@ -53,6 +53,20 @@ paged frees the pages only rejected speculation touched.  Accepted-
 length variance makes per-slot progress uneven — exactly what the
 masked slot machinery absorbs.
 
+Quantized serving (``serving.quantization``, docs/serving.md): two
+independently togglable int8 arms, both STATIC for the engine's life.
+``weights='int8'`` quantizes the GPT-2 matmul weights per output
+channel at build (LLM.int8, PAPERS.md) and fuses dequant into the
+serving matmuls — the fp master never reaches the device, params HBM
+~ halves (the ``serve_param_bytes`` plane measures it).
+``kv='int8'`` stores the paged pool as int8 rows + per-row fp32 scale
+sidecars, quantized on write inside the compiled programs and
+dequantized fused in the decode kernels — ~2x more pages in the same
+KV bytes (``bench_serve.py --quant`` proves the admitted-concurrency
+multiple), composing multiplicatively with paging and making the
+speculative draft plane nearly free.  Default off = every program
+bitwise-unchanged.
+
 Fault plane: the request queue is a stages.py :class:`Channel` and all
 serving work runs under one :class:`Stage` record ("serve", points
 ``admit``/``step``), so poison/drain semantics, graceful degradation
@@ -163,6 +177,12 @@ class ServeEngine:
         #: emission/acceptance arm for the engine's lifetime, so
         #: changing it can never recompile mid-serve
         self.temperature = cfg.serving.temperature
+        #: quantized serving plane (docs/serving.md "quantized
+        #: serving"): both arms are STATIC — they select compiled
+        #: program shapes/dtypes for the engine's lifetime
+        self.quant_weights = (
+            cfg.serving.quantization["weights"] == "int8")
+        self.quant_kv = cfg.serving.quantization["kv"] == "int8"
         self._rng_base = (jax.random.PRNGKey(seed ^ 0x5eed)
                           if self.temperature > 0 else None)
         self._rng_n = 0
@@ -176,6 +196,16 @@ class ServeEngine:
         pspecs = model.param_partition_specs(params)
         if pspecs is None:
             pspecs = jax.tree.map(lambda _: P(), params)
+        if self.quant_weights:
+            # one-shot post-load quantization (LLM.int8, PAPERS.md):
+            # the fp master tree stays on the host — only int8 weights
+            # + fp32 scale rows are placed on the mesh, so params HBM
+            # ~ halves vs fp16 (collect_memory_stats / the
+            # serve_param_bytes gauge are the measurement plane)
+            from .quantize import (quantize_gpt2_params,
+                                   quantized_partition_specs)
+            params = quantize_gpt2_params(params)
+            pspecs = quantized_partition_specs(pspecs)
         self._param_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s), pspecs,
             is_leaf=lambda s: isinstance(s, P))
@@ -185,6 +215,11 @@ class ServeEngine:
         kv_dtype = wte.dtype if wte is not None else jnp.float32
         self.page_len = cfg.serving.page_len
         self.paged = self.page_len > 0
+        if self.quant_kv and not self.paged:
+            raise ValueError(
+                "serving.quantization.kv='int8' requires a paged cache "
+                "(serving.page_len > 0); the slot layout keeps the "
+                "master dtype")
         if self.paged:
             self.max_pages = -(-self.max_seq_len // self.page_len)
             pages = cfg.serving.pages
@@ -199,9 +234,11 @@ class ServeEngine:
                 layers=mcfg.n_layer, slots=self.slots,
                 heads=mcfg.n_head, pages=pages, page_len=self.page_len,
                 head_dim=mcfg.d_head, max_pages=self.max_pages,
-                dtype=kv_dtype)
+                dtype=(jnp.int8 if self.quant_kv else kv_dtype),
+                quant=self.quant_kv)
             validate_paged_cache_mesh(mesh, self.cache_spec)
-            self._cache_shardings = paged_cache_shardings(mesh)
+            self._cache_shardings = paged_cache_shardings(
+                mesh, quant=self.quant_kv)
             self.cache = shard_cache(init_paged_cache(self.cache_spec),
                                      mesh, self._cache_shardings)
             self.pool = PagePool(pages)
@@ -250,14 +287,26 @@ class ServeEngine:
         temp = self.temperature
 
         if self.paged:
+            quant_kv = self.quant_kv
+
+            def cache_scales(cache):
+                """The scale-sidecar kwargs of the model's paged entry
+                points — empty on the fp pool, so those traces stay
+                byte-identical to the pre-quant programs."""
+                if not quant_kv:
+                    return {}
+                return {"k_scale": cache["k_scale"],
+                        "v_scale": cache["v_scale"]}
+
             # delta-aware prefill over the page pool: page_row,
             # prefix_len and delta_len are TRACED, so one program
             # serves full prefills AND prefix-hit deltas
             def prefill_fn(params, cache, tokens, delta_len, prefix_len,
                            page_row, slot, *rng):
-                logits, kp, vp = self.model.prefill_paged(
+                out = self.model.prefill_paged(
                     params, tokens, delta_len, prefix_len, page_row,
-                    cache["k"], cache["v"])
+                    cache["k"], cache["v"], **cache_scales(cache))
+                logits, kp, vp = out[0], out[1], out[2]
                 total = jnp.reshape(prefix_len + delta_len,
                                     (1,)).astype(jnp.int32)
                 lengths = jax.lax.dynamic_update_slice(
@@ -266,29 +315,40 @@ class ServeEngine:
                     logits, delta_len - 1, axis=1, keepdims=False)[0]
                 first_tok = select_next_token(last, temp,
                                               rng[0] if rng else None)
-                return ({"k": kp, "v": vp, "lengths": lengths},
-                        first_tok)
+                newc = {"k": kp, "v": vp, "lengths": lengths}
+                if quant_kv:
+                    newc["k_scale"], newc["v_scale"] = out[3], out[4]
+                return newc, first_tok
 
             def decode_fn(params, cache, tokens, active, page_table,
                           *rng):
-                logits, k, v, new_len = self.model.decode_step_paged(
+                out = self.model.decode_step_paged(
                     params, tokens, cache["k"], cache["v"], page_table,
-                    cache["lengths"], active, impl=self.decode_impl)
+                    cache["lengths"], active, impl=self.decode_impl,
+                    **cache_scales(cache))
+                logits, k, v, new_len = out[0], out[1], out[2], out[-1]
                 next_tok = select_next_token(logits, temp,
                                              rng[0] if rng else None)
-                return ({"k": k, "v": v, "lengths": new_len}, next_tok)
+                newc = {"k": k, "v": v, "lengths": new_len}
+                if quant_kv:
+                    newc["k_scale"], newc["v_scale"] = out[3], out[4]
+                return newc, next_tok
 
             # copy-on-write: duplicate one page (src/dst traced — zero
-            # recompiles no matter which pages diverge)
+            # recompiles no matter which pages diverge).  Every pool-
+            # shaped leaf is copied — on the quantized cache that
+            # includes the scale sidecars, or the COW'd page would
+            # dequantize with the wrong scales.
             def copy_fn(cache, src, dst):
-                k, v = cache["k"], cache["v"]
-                pk = jax.lax.dynamic_slice_in_dim(k, src, 1, axis=1)
-                pv = jax.lax.dynamic_slice_in_dim(v, src, 1, axis=1)
-                k = jax.lax.dynamic_update_slice_in_dim(k, pk, dst,
-                                                        axis=1)
-                v = jax.lax.dynamic_update_slice_in_dim(v, pv, dst,
-                                                        axis=1)
-                return {"k": k, "v": v, "lengths": cache["lengths"]}
+                out = dict(cache)
+                for key in ("k", "v", "k_scale", "v_scale"):
+                    if key not in cache:
+                        continue
+                    a = cache[key]
+                    pg = jax.lax.dynamic_slice_in_dim(a, src, 1, axis=1)
+                    out[key] = jax.lax.dynamic_update_slice_in_dim(
+                        a, pg, dst, axis=1)
+                return out
 
             self._copy_fn = jax.jit(copy_fn, donate_argnums=(0,),
                                     out_shardings=self._cache_shardings)
@@ -353,6 +413,19 @@ class ServeEngine:
         self._graph.register("telemetry", close=self._close_telemetry,
                              drain=self._flush)
 
+        # -- memory planes (docs/serving.md "quantized serving"): the
+        # device bytes the params and KV cache claim, from the param
+        # tree + cache spec — the ONE accounting the serve_*_bytes
+        # gauges, the summarize "serving memory" row and the bench's
+        # fixed-KV-byte budgets read (no more hand-recomputed
+        # bytes-per-element claims in bench legs)
+        from .quantize import param_nbytes
+        self.param_bytes = param_nbytes(self.params)
+        self.kv_bytes = self.cache_spec.bytes
+        if self.spec_k:
+            self.param_bytes += param_nbytes(self.draft_params)
+            self.kv_bytes += self.draft_cache_spec.bytes
+
         # -- telemetry ---------------------------------------------------
         self.telemetry = None
         if cfg.telemetry.enabled:
@@ -397,6 +470,16 @@ class ServeEngine:
                 "level scheduling number)")
             self._active_gauge = reg.gauge(
                 "serve_active_slots", "slots decoding this tick")
+            self._param_bytes_gauge = reg.gauge(
+                "serve_param_bytes",
+                "device bytes of the serving params (target + draft; "
+                "int8 weights + scales under quantization)")
+            self._param_bytes_gauge.set(self.param_bytes)
+            self._kv_bytes_gauge = reg.gauge(
+                "serve_kv_bytes",
+                "device bytes of the KV cache from its spec (both "
+                "layouts; incl. quant scale sidecars + draft cache)")
+            self._kv_bytes_gauge.set(self.kv_bytes)
             if self.paged:
                 self._pages_total_gauge = reg.gauge(
                     "serve_pages_total",
@@ -478,6 +561,15 @@ class ServeEngine:
         dspecs = self.draft_model.param_partition_specs(draft_params)
         if dspecs is None:
             dspecs = jax.tree.map(lambda _: P(), draft_params)
+        if self.quant_weights:
+            # the draft rides the weights arm too (ISSUE: a quantized
+            # draft is nearly free); its slot KV cache keeps the
+            # master dtype — at draft scale the stride is a rounding
+            # error and the rollback stays a pure lengths mask
+            from .quantize import (quantize_gpt2_params,
+                                   quantized_partition_specs)
+            draft_params = quantize_gpt2_params(draft_params)
+            dspecs = quantized_partition_specs(dspecs)
         dshard = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), dspecs,
             is_leaf=lambda s: isinstance(s, P))
@@ -539,11 +631,18 @@ class ServeEngine:
             tokens_w = jnp.concatenate(
                 [cur[:, None].astype(jnp.int32),
                  proposals.astype(jnp.int32)], axis=1)
+            newc = {}
             if self.paged:
-                logits, kc, vc = self.model.verify_step_paged(
+                scales = ({"k_scale": cache["k_scale"],
+                           "v_scale": cache["v_scale"]}
+                          if self.quant_kv else {})
+                out = self.model.verify_step_paged(
                     params, tokens_w, cache["k"], cache["v"],
                     page_table, cache["lengths"], active,
-                    impl=self.decode_impl)
+                    impl=self.decode_impl, **scales)
+                logits, kc, vc = out[0], out[1], out[2]
+                if self.quant_kv:
+                    newc["k_scale"], newc["v_scale"] = out[3], out[4]
             else:
                 logits, kc, vc = self.model.verify_step(
                     params, tokens_w, cache["k"], cache["v"],
@@ -554,8 +653,8 @@ class ServeEngine:
             adv = jnp.where(active, accepted + 1, 0).astype(jnp.int32)
             new_len = jnp.minimum(cache["lengths"] + adv,
                                   jnp.int32(self.max_seq_len))
-            return ({"k": kc, "v": vc, "lengths": new_len}, out_tok,
-                    accepted)
+            newc.update({"k": kc, "v": vc, "lengths": new_len})
+            return newc, out_tok, accepted
 
         if self.paged:
             def verify_fn(params, cache, cur, proposals, active,
@@ -728,7 +827,12 @@ class ServeEngine:
         dt = max(now - self._last_flush_t, 1e-9)
         toks = self._tokens_seen - self._last_flush_tokens
         lat = sorted(self._latencies)
-        scalars = {"serve_tokens_per_s": toks / dt}
+        scalars = {"serve_tokens_per_s": toks / dt,
+                   # static for the engine's life, but flushed as
+                   # scalars so the offline summarize "serving memory"
+                   # row needs only events.jsonl
+                   "serve_param_bytes": float(self.param_bytes),
+                   "serve_kv_bytes": float(self.kv_bytes)}
         p50 = _percentile(lat, 0.50)
         p99 = _percentile(lat, 0.99)
         if p50 is not None:

@@ -122,7 +122,15 @@ def validate_cache_mesh(mesh: Mesh, spec: KVCacheSpec) -> None:
 class PagedKVCacheSpec:
     """The flat page pool: ``pages`` fixed-size pages of ``page_len``
     tokens each (page 0 reserved as the scratch page), referenced by
-    per-slot page tables the host owns."""
+    per-slot page tables the host owns.
+
+    ``quant`` (serving.quantization.kv='int8', docs/serving.md): the
+    pool stores int8 rows (``dtype`` must be int8) plus a fp32 scale
+    sidecar ``[L, pages, H, page_len]`` — one scale per stored token
+    row per head, quantized at write time (inference/quantize.py).
+    ``bytes``/``page_bytes`` include the sidecar: they are the ONE
+    source of KV-byte truth the bench budgets and the
+    ``serve_kv_bytes`` gauge read."""
     layers: int
     slots: int
     heads: int
@@ -132,44 +140,70 @@ class PagedKVCacheSpec:
     #: table width: pages a slot can reference (ceil(max_len/page_len))
     max_pages: int
     dtype: Any = jnp.float32
+    #: int8 rows + per-(page, head, row) fp32 scale sidecar
+    quant: bool = False
 
     @property
     def bytes(self) -> int:
         per = jnp.dtype(self.dtype).itemsize
-        return (2 * self.layers * self.pages * self.heads * self.page_len
-                * self.head_dim * per)
+        n = (2 * self.layers * self.pages * self.heads * self.page_len
+             * self.head_dim * per)
+        if self.quant:
+            n += (2 * self.layers * self.pages * self.heads
+                  * self.page_len * 4)
+        return n
 
     @property
     def page_bytes(self) -> int:
-        """HBM of ONE page across layers and both of k/v — the
-        allocation quantum the bench's fixed-byte budget divides by."""
+        """HBM of ONE page across layers and both of k/v (incl. the
+        quant scale sidecar rows) — the allocation quantum the bench's
+        fixed-byte budget divides by."""
         per = jnp.dtype(self.dtype).itemsize
-        return 2 * self.layers * self.heads * self.page_len \
+        n = 2 * self.layers * self.heads * self.page_len \
             * self.head_dim * per
+        if self.quant:
+            n += 2 * self.layers * self.heads * self.page_len * 4
+        return n
 
 
 def init_paged_cache(spec: PagedKVCacheSpec) -> Dict[str, jnp.ndarray]:
     """Fresh all-free paged pool (host zeros; shard with
-    :func:`shard_cache` before handing it to compiled programs)."""
+    :func:`shard_cache` before handing it to compiled programs).
+    Quantized pools get all-zero scale sidecars: dequant of a never-
+    written row is 0 * scale = exact zero, the same dead-data story as
+    the fp pool."""
     shape = (spec.layers, spec.pages, spec.heads, spec.page_len,
              spec.head_dim)
-    return {
+    cache = {
         "k": jnp.zeros(shape, spec.dtype),
         "v": jnp.zeros(shape, spec.dtype),
         "lengths": jnp.zeros((spec.slots,), jnp.int32),
     }
+    if spec.quant:
+        sshape = (spec.layers, spec.pages, spec.heads, spec.page_len)
+        cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
+    return cache
 
 
-def paged_partition_specs() -> Dict[str, P]:
+def paged_partition_specs(quant: bool = False) -> Dict[str, P]:
     """Pool pages on ``data``, heads on ``model`` — the page pool is
-    the DP-sharded storage dimension the way slots were."""
+    the DP-sharded storage dimension the way slots were.  The quant
+    scale sidecars shard exactly like their pools (minus the row dim's
+    trailing head_dim)."""
     kv = P(None, DATA_AXIS, MODEL_AXIS, None, None)
-    return {"k": kv, "v": kv, "lengths": P()}
+    specs = {"k": kv, "v": kv, "lengths": P()}
+    if quant:
+        sc = P(None, DATA_AXIS, MODEL_AXIS, None)
+        specs["k_scale"] = sc
+        specs["v_scale"] = sc
+    return specs
 
 
-def paged_cache_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
+def paged_cache_shardings(mesh: Mesh,
+                          quant: bool = False) -> Dict[str, NamedSharding]:
     return {name: NamedSharding(mesh, spec)
-            for name, spec in paged_partition_specs().items()}
+            for name, spec in paged_partition_specs(quant).items()}
 
 
 def validate_paged_cache_mesh(mesh: Mesh,

@@ -226,21 +226,25 @@ class GPT2Model(TrainModule):
                                 v_cache, lengths, active, impl=impl)
 
     def prefill_paged(self, params, tokens, delta_len, prefix_len,
-                      page_row, k_pool, v_pool):
+                      page_row, k_pool, v_pool, k_scale=None,
+                      v_scale=None):
         """Delta-aware prefill into a paged KV pool — see
         ``gpt2_prefill_paged``."""
         return gpt2_prefill_paged(self.config, params, tokens,
                                   delta_len, prefix_len, page_row,
-                                  k_pool, v_pool)
+                                  k_pool, v_pool, k_scale=k_scale,
+                                  v_scale=v_scale)
 
     def decode_step_paged(self, params, tokens, k_pool, v_pool,
                           page_table, lengths, active,
-                          impl: Optional[str] = None):
+                          impl: Optional[str] = None, k_scale=None,
+                          v_scale=None):
         """One masked decode tick over the paged KV pool — see
         ``gpt2_decode_step_paged``."""
         return gpt2_decode_step_paged(self.config, params, tokens,
                                       k_pool, v_pool, page_table,
-                                      lengths, active, impl=impl)
+                                      lengths, active, impl=impl,
+                                      k_scale=k_scale, v_scale=v_scale)
 
     def verify_step(self, params, tokens, k_cache, v_cache, lengths,
                     active, impl: Optional[str] = None):
@@ -251,12 +255,14 @@ class GPT2Model(TrainModule):
 
     def verify_step_paged(self, params, tokens, k_pool, v_pool,
                           page_table, lengths, active,
-                          impl: Optional[str] = None):
+                          impl: Optional[str] = None, k_scale=None,
+                          v_scale=None):
         """The paged twin of ``verify_step`` — see
         ``gpt2_verify_step_paged``."""
         return gpt2_verify_step_paged(self.config, params, tokens,
                                       k_pool, v_pool, page_table,
-                                      lengths, active, impl=impl)
+                                      lengths, active, impl=impl,
+                                      k_scale=k_scale, v_scale=v_scale)
 
     # ---------------- param-streaming declaration ----------------
     def streaming_param_spec(self, params):
@@ -335,12 +341,27 @@ def gpt2_block_forward(cfg: GPT2Config, bp, x, rng, train: bool):
     return x + _dropout(h, drop, r3)
 
 
+def _wscale(y, bp, name: str):
+    """Fused weight dequant (serving.quantization.weights='int8',
+    docs/serving.md): a quantized tree carries an ``<name>_scale``
+    sibling per matmul weight, and because the scale is per OUTPUT
+    channel, ``x · (w8 · s) == (x · w8) · s`` — one multiply on the
+    matmul output, never a dequantized weight matrix.  Trees without
+    scales (every training path, the default serving config) take the
+    no-op branch: their trace is byte-identical to the pre-quant
+    code."""
+    s = bp.get(name + "_scale")
+    return y if s is None else y * s.astype(y.dtype)
+
+
 def gpt2_ffn(bp, h):
     """fc → gelu → proj over already-normalized input (dense FFN body,
     shared with the MoE flavor's dense blocks)."""
-    h = h @ bp["fc_w"].astype(h.dtype) + bp["fc_b"].astype(h.dtype)
+    h = _wscale(h @ bp["fc_w"].astype(h.dtype), bp, "fc_w") \
+        + bp["fc_b"].astype(h.dtype)
     h = jax.nn.gelu(h, approximate=True)
-    return h @ bp["proj_w"].astype(h.dtype) + bp["proj_b"].astype(h.dtype)
+    return _wscale(h @ bp["proj_w"].astype(h.dtype), bp, "proj_w") \
+        + bp["proj_b"].astype(h.dtype)
 
 
 def gpt2_qkv_heads(cfg: GPT2Config, bp, x):
@@ -353,7 +374,8 @@ def gpt2_qkv_heads(cfg: GPT2Config, bp, x):
     h = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
     # contraction keeps q/k/v on a dedicated unsharded dim — slicing it is
     # local under TP (see the qkv_w layout note in GPT2Model.init)
-    qkv = (jnp.einsum("btd,dke->btke", h, bp["qkv_w"].astype(h.dtype))
+    qkv = (_wscale(jnp.einsum("btd,dke->btke", h,
+                              bp["qkv_w"].astype(h.dtype)), bp, "qkv_w")
            + bp["qkv_b"].astype(h.dtype))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
@@ -368,7 +390,8 @@ def gpt2_attn_project(bp, x, attn, drop: float, rng):
     shared with the serving paths; ``rng`` may be None when drop=0)."""
     B, H, T, Dh = attn.shape
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
-    attn = attn @ bp["out_w"].astype(x.dtype) + bp["out_b"].astype(x.dtype)
+    attn = _wscale(attn @ bp["out_w"].astype(x.dtype), bp, "out_w") \
+        + bp["out_b"].astype(x.dtype)
     return x + _dropout(attn, drop, rng)
 
 
@@ -704,10 +727,11 @@ def gpt2_verify_step(cfg: GPT2Config, params, tokens, k_cache, v_cache,
 
 def gpt2_block_verify_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
                             page_table, positions, row_valid, row_lens,
-                            impl: str):
+                            impl: str, k_scale=None, v_scale=None):
     """One block of the PAGED verify pass: W masked page-routed writes
     (invalid rows to the scratch page) then the paged multi-query
-    attention."""
+    attention — quantizing each row on write and running the fused-
+    dequant multi arm when the pool is int8."""
     q, k, v = gpt2_qkv_heads(cfg, bp, x)                # [S, H, W, Dh]
     W = x.shape[1]
     page_len = k_pool.shape[2]
@@ -717,27 +741,32 @@ def gpt2_block_verify_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
         page_ids = jnp.where(row_valid[:, i],
                              page_table[s_idx, pos // page_len], 0)
         offs = pos % page_len
-        k_pool = _paged_cache_write(k_pool, k[:, :, i], page_ids, offs,
-                                    row_valid[:, i])
-        v_pool = _paged_cache_write(v_pool, v[:, :, i], page_ids, offs,
-                                    row_valid[:, i])
+        k_pool, k_scale = _paged_write(k_pool, k_scale, k[:, :, i],
+                                       page_ids, offs, row_valid[:, i])
+        v_pool, v_scale = _paged_write(v_pool, v_scale, v[:, :, i],
+                                       page_ids, offs, row_valid[:, i])
     from ..ops.pallas.decode_attention import decode_attention_paged_multi
     attn = decode_attention_paged_multi(q, k_pool, v_pool, page_table,
-                                        row_lens, impl=impl)
+                                        row_lens, impl=impl,
+                                        k_scale=k_scale,
+                                        v_scale=v_scale)
     x = gpt2_attn_project(bp, x, attn, 0.0, None)
     h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
-    return x + gpt2_ffn(bp, h), k_pool, v_pool
+    return (x + gpt2_ffn(bp, h), k_pool, v_pool, k_scale, v_scale)
 
 
 def gpt2_verify_step_paged(cfg: GPT2Config, params, tokens, k_pool,
                            v_pool, page_table, lengths, active,
-                           impl: Optional[str] = None):
+                           impl: Optional[str] = None,
+                           k_scale=None, v_scale=None):
     """The paged twin of ``gpt2_verify_step`` — same contract over the
     page pool; the engine must have allocated pages covering all W
     speculative rows before the pass (rollback frees the ones the
-    acceptance didn't keep)."""
+    acceptance didn't keep).  With the int8 pool's scale sidecars the
+    return grows to (logits, k_pool, v_pool, k_scale, v_scale)."""
     if impl is None:
         impl = _decode_attn_impl(cfg)
+    quant = k_scale is not None
     S, W = tokens.shape
     page_len = k_pool.shape[3]
     cap = min(page_table.shape[1] * page_len, cfg.n_positions)
@@ -747,25 +776,33 @@ def gpt2_verify_step_paged(cfg: GPT2Config, params, tokens, k_pool,
     block_params = params["blocks"]
     if cfg.scan_layers:
         def body(x, xs):
-            bp, kc, vc = xs
-            x, kc, vc = gpt2_block_verify_paged(
+            bp, kc, vc, ks, vs = xs
+            x, kc, vc, ks, vs = gpt2_block_verify_paged(
                 cfg, bp, x, kc, vc, page_table, positions, row_valid,
-                row_lens, impl)
-            return x, (kc, vc)
-        x, (k_pool, v_pool) = jax.lax.scan(
-            body, x, (block_params, k_pool, v_pool))
+                row_lens, impl, k_scale=ks, v_scale=vs)
+            return x, (kc, vc, ks, vs)
+        x, (k_pool, v_pool, k_scale, v_scale) = jax.lax.scan(
+            body, x, (block_params, k_pool, v_pool, k_scale, v_scale))
     else:
-        kc_l, vc_l = [], []
+        kc_l, vc_l, ks_l, vs_l = [], [], [], []
         for i in range(cfg.n_layer):
             bp = jax.tree.map(lambda a, i=i: a[i], block_params)
-            x, kc, vc = gpt2_block_verify_paged(
+            x, kc, vc, ks, vs = gpt2_block_verify_paged(
                 cfg, bp, x, k_pool[i], v_pool[i], page_table, positions,
-                row_valid, row_lens, impl)
+                row_valid, row_lens, impl,
+                k_scale=None if k_scale is None else k_scale[i],
+                v_scale=None if v_scale is None else v_scale[i])
             kc_l.append(kc)
             vc_l.append(vc)
+            ks_l.append(ks)
+            vs_l.append(vs)
         k_pool, v_pool = jnp.stack(kc_l), jnp.stack(vc_l)
+        if quant:
+            k_scale, v_scale = jnp.stack(ks_l), jnp.stack(vs_l)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
     logits = x @ params["wte"].astype(x.dtype).T
+    if quant:
+        return logits, k_pool, v_pool, k_scale, v_scale
     return logits, k_pool, v_pool
 
 
@@ -791,34 +828,65 @@ def _paged_cache_write(pool, new, page_ids, offs, active):
     return pool.at[page_ids, :, offs].set(blended)
 
 
+def _paged_cache_write_quant(pool, scales, new, page_ids, offs, active):
+    """The quantize-on-write twin of :func:`_paged_cache_write`
+    (serving.quantization.kv='int8'): each fp row is quantized per
+    (row, head) — symmetric absmax int8 + one fp32 scale
+    (inference/quantize.py, the ONE quantization rule) — and both the
+    int8 row and its scale land under the same mask, so an inactive
+    slot's scale write is the same old-value no-op as its data write.
+    pool int8 [P, H, page_len, Dh], scales fp32 [P, H, page_len]."""
+    from ..inference.quantize import quantize_rows
+    q8, s = quantize_rows(new)                          # [S,H,Dh]/[S,H]
+    old = pool[page_ids, :, offs]
+    old_s = scales[page_ids, :, offs]
+    blended = jnp.where(active[:, None, None], q8, old)
+    blended_s = jnp.where(active[:, None], s, old_s)
+    return (pool.at[page_ids, :, offs].set(blended),
+            scales.at[page_ids, :, offs].set(blended_s))
+
+
+def _paged_write(pool, scales, new, page_ids, offs, active):
+    """Dispatch one masked row write to the fp or quantized pool arm —
+    ``scales`` None selects the pre-quant write, byte for byte."""
+    if scales is None:
+        return _paged_cache_write(pool, new, page_ids, offs, active), None
+    return _paged_cache_write_quant(pool, scales, new, page_ids, offs,
+                                    active)
+
+
 def gpt2_block_decode_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
                             page_table, positions, att_len, active,
-                            impl: str):
+                            impl: str, k_scale=None, v_scale=None):
     """One block for a single paged decode tick: x [S, 1, D]; writes
     the token's K/V at ``positions`` into the slot's page (masked by
     ``active``, inactive routed to scratch) then attends over
-    ``att_len`` live keys per slot through the page table."""
+    ``att_len`` live keys per slot through the page table.  With the
+    int8 pool (``k_scale``/``v_scale`` [P, H, page_len]) the write
+    quantizes per row and the attention runs the fused-dequant arm."""
     q, k, v = gpt2_qkv_heads(cfg, bp, x)                # [S, H, 1, Dh]
     page_len = k_pool.shape[2]
     s_idx = jnp.arange(page_table.shape[0])
     page_ids = jnp.where(active,
                          page_table[s_idx, positions // page_len], 0)
     offs = positions % page_len
-    k_pool = _paged_cache_write(k_pool, k[:, :, 0], page_ids, offs,
-                                active)
-    v_pool = _paged_cache_write(v_pool, v[:, :, 0], page_ids, offs,
-                                active)
+    k_pool, k_scale = _paged_write(k_pool, k_scale, k[:, :, 0],
+                                   page_ids, offs, active)
+    v_pool, v_scale = _paged_write(v_pool, v_scale, v[:, :, 0],
+                                   page_ids, offs, active)
     from ..ops.pallas.decode_attention import decode_attention_paged
     attn = decode_attention_paged(q[:, :, 0], k_pool, v_pool,
-                                  page_table, att_len, impl=impl)
+                                  page_table, att_len, impl=impl,
+                                  k_scale=k_scale, v_scale=v_scale)
     x = gpt2_attn_project(bp, x, attn[:, :, None, :], 0.0, None)
     h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
-    return x + gpt2_ffn(bp, h), k_pool, v_pool
+    return (x + gpt2_ffn(bp, h), k_pool, v_pool, k_scale, v_scale)
 
 
 def gpt2_decode_step_paged(cfg: GPT2Config, params, tokens, k_pool,
                            v_pool, page_table, lengths, active,
-                           impl: Optional[str] = None):
+                           impl: Optional[str] = None,
+                           k_scale=None, v_scale=None):
     """One decode tick for every slot at once over the paged pool —
     the paged twin of ``gpt2_decode_step`` (same masked-no-op contract,
     same traced-operand zero-recompile contract; the page table is one
@@ -827,9 +895,16 @@ def gpt2_decode_step_paged(cfg: GPT2Config, params, tokens, k_pool,
     tokens [S] int32; k_pool/v_pool [L, P, H, page_len, Dh];
     page_table [S, max_pages] int32 (dead entries = scratch page 0);
     lengths [S] int32 — live KV length BEFORE this token; active [S]
-    bool.  Returns (logits [S, V], k_pool, v_pool, new_lengths)."""
+    bool.  Returns (logits [S, V], k_pool, v_pool, new_lengths).
+
+    Quantized pool (serving.quantization.kv='int8'): pass the fp32
+    scale sidecars ``k_scale``/``v_scale`` [L, P, H, page_len] — the
+    return grows to (logits, k_pool, v_pool, k_scale, v_scale,
+    new_lengths); they are one more scan carry, still traced, still
+    zero-recompile."""
     if impl is None:
         impl = _decode_attn_impl(cfg)
+    quant = k_scale is not None
     page_len = k_pool.shape[3]
     cap = page_table.shape[1] * page_len
     lengths = lengths.astype(jnp.int32)
@@ -840,31 +915,40 @@ def gpt2_decode_step_paged(cfg: GPT2Config, params, tokens, k_pool,
     block_params = params["blocks"]
     if cfg.scan_layers:
         def body(x, xs):
-            bp, kc, vc = xs
-            x, kc, vc = gpt2_block_decode_paged(
+            bp, kc, vc, ks, vs = xs
+            x, kc, vc, ks, vs = gpt2_block_decode_paged(
                 cfg, bp, x, kc, vc, page_table, positions, att_len,
-                active, impl)
-            return x, (kc, vc)
-        x, (k_pool, v_pool) = jax.lax.scan(
-            body, x, (block_params, k_pool, v_pool))
+                active, impl, k_scale=ks, v_scale=vs)
+            return x, (kc, vc, ks, vs)
+        x, (k_pool, v_pool, k_scale, v_scale) = jax.lax.scan(
+            body, x, (block_params, k_pool, v_pool, k_scale, v_scale))
     else:
-        kc_l, vc_l = [], []
+        kc_l, vc_l, ks_l, vs_l = [], [], [], []
         for i in range(cfg.n_layer):
             bp = jax.tree.map(lambda a, i=i: a[i], block_params)
-            x, kc, vc = gpt2_block_decode_paged(
+            x, kc, vc, ks, vs = gpt2_block_decode_paged(
                 cfg, bp, x, k_pool[i], v_pool[i], page_table,
-                positions, att_len, active, impl)
+                positions, att_len, active, impl,
+                k_scale=None if k_scale is None else k_scale[i],
+                v_scale=None if v_scale is None else v_scale[i])
             kc_l.append(kc)
             vc_l.append(vc)
+            ks_l.append(ks)
+            vs_l.append(vs)
         k_pool, v_pool = jnp.stack(kc_l), jnp.stack(vc_l)
+        if quant:
+            k_scale, v_scale = jnp.stack(ks_l), jnp.stack(vs_l)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
     logits = (x @ params["wte"].astype(x.dtype).T)[:, 0]
     new_lengths = lengths + active.astype(jnp.int32)
+    if quant:
+        return logits, k_pool, v_pool, k_scale, v_scale, new_lengths
     return logits, k_pool, v_pool, new_lengths
 
 
 def gpt2_block_prefill_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
-                             page_row, prefix_len, delta_len):
+                             page_row, prefix_len, delta_len,
+                             k_scale=None, v_scale=None):
     """One block of the delta-aware paged prefill: compute the DELTA
     tokens' K/V (positions ``prefix_len + i``), scatter them into the
     slot's pages, then attend.
@@ -875,10 +959,15 @@ def gpt2_block_prefill_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
       attention (flash or dense, exactly ``gpt2_block_prefill``'s ops),
       so a paged prefill without a prefix hit is BITWISE identical to
       the pre-page prefill: the parity anchor of tests/test_paged_kv.py.
+      With the int8 pool the attention still runs over the EXACT fp
+      K/V (only the STORED rows are quantized — the standard KV-quant
+      discipline: prefill computes full-precision, decode reads back
+      dequantized; docs/serving.md tolerance tiers).
     * ``prefix_len > 0`` — dense attention over the pool gathered
-      through ``page_row``: delta query ``i`` (absolute position
-      ``prefix_len+i``) attends every key at absolute position
-      ``<= prefix_len+i`` — the cached prefix plus the causal delta.
+      through ``page_row`` (dequantized on the quant arm): delta query
+      ``i`` (absolute position ``prefix_len+i``) attends every key at
+      absolute position ``<= prefix_len+i`` — the cached prefix plus
+      the causal delta.
     """
     q, k, v = gpt2_qkv_heads(cfg, bp, x)                # [1, H, Tq, Dh]
     Tq = x.shape[1]
@@ -893,8 +982,10 @@ def gpt2_block_prefill_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
     offs = abs_clip % page_len
     kn = k[0].transpose(1, 0, 2)                        # [Tq, H, Dh]
     vn = v[0].transpose(1, 0, 2)
-    k_pool = _paged_cache_write(k_pool, kn, page_ids, offs, valid)
-    v_pool = _paged_cache_write(v_pool, vn, page_ids, offs, valid)
+    k_pool, k_scale = _paged_write(k_pool, k_scale, kn, page_ids, offs,
+                                   valid)
+    v_pool, v_scale = _paged_write(v_pool, v_scale, vn, page_ids, offs,
+                                   valid)
 
     def _self_arm(_):
         # the pre-page prefill attention, op for op
@@ -905,28 +996,35 @@ def gpt2_block_prefill_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
 
     def _gather_arm(_):
         from ..ops.pallas.decode_attention import (_default_scale,
+                                                   dequantize_paged,
                                                    paged_gather)
-        kg = paged_gather(k_pool, page_row[None])[0]    # [H, T', Dh]
-        vg = paged_gather(v_pool, page_row[None])[0]
+        if k_scale is not None:
+            kg = dequantize_paged(k_pool, k_scale, page_row[None])[0]
+            vg = dequantize_paged(v_pool, v_scale, page_row[None])[0]
+        else:
+            kg = paged_gather(k_pool, page_row[None])[0]  # [H, T', Dh]
+            vg = paged_gather(v_pool, page_row[None])[0]
         scale = _default_scale(cfg.d_head)
-        s = jnp.einsum("htd,hsd->hts", q[0], kg,
+        s = jnp.einsum("htd,hsd->hts", q[0], kg.astype(q.dtype),
                        preferred_element_type=jnp.float32) * scale
         key_pos = jnp.arange(kg.shape[1], dtype=jnp.int32)
         ok = key_pos[None, :] <= abs_pos[:, None]       # [Tq, T']
         neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
         s = jnp.where(ok[None], s, neg)
         probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        return jnp.einsum("hts,hsd->htd", probs, vg)[None]
+        return jnp.einsum("hts,hsd->htd", probs,
+                          vg.astype(q.dtype))[None]
 
     attn = jax.lax.cond(prefix_len == 0, _self_arm, _gather_arm,
                         operand=None)
     x = gpt2_attn_project(bp, x, attn, 0.0, None)
     h = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
-    return x + gpt2_ffn(bp, h), k_pool, v_pool
+    return (x + gpt2_ffn(bp, h), k_pool, v_pool, k_scale, v_scale)
 
 
 def gpt2_prefill_paged(cfg: GPT2Config, params, tokens, delta_len,
-                       prefix_len, page_row, k_pool, v_pool):
+                       prefix_len, page_row, k_pool, v_pool,
+                       k_scale=None, v_scale=None):
     """Delta-aware prefill into the paged pool (ONE compiled program
     for full prefills AND prefix-hit deltas — ``prefix_len``,
     ``delta_len`` and ``page_row`` are all traced).
@@ -941,11 +1039,15 @@ def gpt2_prefill_paged(cfg: GPT2Config, params, tokens, delta_len,
     the token after absolute position ``prefix_len + i`` — the first
     generated token reads ``logits[0, delta_len - 1]``.  Padding rows
     produce garbage-but-finite logits and never contaminate live rows
-    (their K/V scatter is masked to the scratch page)."""
+    (their K/V scatter is masked to the scratch page).
+
+    Quantized pool: pass ``k_scale``/``v_scale`` [L, P, H, page_len];
+    the return grows to (logits, k_pool, v_pool, k_scale, v_scale)."""
     B, Tq = tokens.shape
     if Tq > cfg.n_positions:
         raise ValueError(
             f"sequence length {Tq} exceeds n_positions={cfg.n_positions}")
+    quant = k_scale is not None
     prefix_len = jnp.asarray(prefix_len, jnp.int32)
     delta_len = jnp.asarray(delta_len, jnp.int32)
     pos = jnp.clip(prefix_len + jnp.arange(Tq, dtype=jnp.int32), 0,
@@ -954,24 +1056,33 @@ def gpt2_prefill_paged(cfg: GPT2Config, params, tokens, delta_len,
     block_params = params["blocks"]
     if cfg.scan_layers:
         def body(x, xs):
-            bp, kc, vc = xs
-            x, kc, vc = gpt2_block_prefill_paged(
-                cfg, bp, x, kc, vc, page_row, prefix_len, delta_len)
-            return x, (kc, vc)
-        x, (k_pool, v_pool) = jax.lax.scan(
-            body, x, (block_params, k_pool, v_pool))
+            bp, kc, vc, ks, vs = xs
+            x, kc, vc, ks, vs = gpt2_block_prefill_paged(
+                cfg, bp, x, kc, vc, page_row, prefix_len, delta_len,
+                k_scale=ks, v_scale=vs)
+            return x, (kc, vc, ks, vs)
+        x, (k_pool, v_pool, k_scale, v_scale) = jax.lax.scan(
+            body, x, (block_params, k_pool, v_pool, k_scale, v_scale))
     else:
-        kc_l, vc_l = [], []
+        kc_l, vc_l, ks_l, vs_l = [], [], [], []
         for i in range(cfg.n_layer):
             bp = jax.tree.map(lambda a, i=i: a[i], block_params)
-            x, kc, vc = gpt2_block_prefill_paged(
+            x, kc, vc, ks, vs = gpt2_block_prefill_paged(
                 cfg, bp, x, k_pool[i], v_pool[i], page_row, prefix_len,
-                delta_len)
+                delta_len,
+                k_scale=None if k_scale is None else k_scale[i],
+                v_scale=None if v_scale is None else v_scale[i])
             kc_l.append(kc)
             vc_l.append(vc)
+            ks_l.append(ks)
+            vs_l.append(vs)
         k_pool, v_pool = jnp.stack(kc_l), jnp.stack(vc_l)
+        if quant:
+            k_scale, v_scale = jnp.stack(ks_l), jnp.stack(vs_l)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
     logits = x @ params["wte"].astype(x.dtype).T
+    if quant:
+        return logits, k_pool, v_pool, k_scale, v_scale
     return logits, k_pool, v_pool
 
 

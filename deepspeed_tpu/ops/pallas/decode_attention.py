@@ -47,6 +47,19 @@ length mask, same grid, same streaming.  The dense multi reference is
 DEFINED as W stacked single-query calls — fp32-bitwise against
 sequential decode ticks by construction, the parity anchor the
 widened program is verified against (tests/test_spec_decode.py).
+
+FUSED-DEQUANT arms (``serving.quantization.kv='int8'``, docs/
+serving.md "quantized serving"): both paged entry points accept the
+int8 pool's per-row scale sidecars ``k_scale``/``v_scale``
+[P, H, page_len].  Because the scale is per KEY ROW, dequant folds
+into the score/prob columns — ``q·(k8·sk) == (q·k8)·sk`` and
+``p·(v8·sv) == (p·sv)·v8`` — so the kernel streams int8 pages from
+HBM (the bandwidth halving) and never materializes an fp page.  The
+scale rows ride the same page-table indirection as the blocks they
+scale; ``impl='dense'`` dequantizes the gathered view
+(:func:`dequantize_paged`) — the interpretable definition of the
+quantize→dequant semantics the fused arms are verified against
+(tests/test_quant_serve.py).
 """
 from __future__ import annotations
 
@@ -242,9 +255,66 @@ def paged_gather(pool: jnp.ndarray,
     return g.transpose(0, 2, 1, 3, 4).reshape(S, H, M * L, Dh)
 
 
-def _decode_paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr,
-                         *, sm_scale: float, page_len: int, heads: int):
+def paged_gather_scales(scales: jnp.ndarray,
+                        page_table: jnp.ndarray) -> jnp.ndarray:
+    """The scale-sidecar twin of :func:`paged_gather`:
+    ``scales [P, H, page_len]`` -> ``[S, H, max_pages*page_len]`` —
+    row ``p`` of the gathered view carries the scale its int8 K/V row
+    was quantized with."""
+    g = jnp.take(scales, page_table, axis=0)  # [S, M, H, page_len]
+    S, M, H, L = g.shape
+    return g.transpose(0, 2, 1, 3).reshape(S, H, M * L)
+
+
+def dequantize_paged(pool: jnp.ndarray, scales: jnp.ndarray,
+                     page_table: jnp.ndarray) -> jnp.ndarray:
+    """Gather + dequantize an int8 pool dense: the interpretable
+    definition of what a quantized page MEANS (``stored value = int8 *
+    its row scale``) — the semantics anchor the fused kernels are
+    verified against (tests/test_quant_serve.py)."""
+    from ...inference.quantize import dequantize_rows
+    return dequantize_rows(paged_gather(pool, page_table),
+                           paged_gather_scales(scales, page_table))
+
+
+def _scale_tile(scales: jnp.ndarray) -> jnp.ndarray:
+    """Scale sidecar ``[P, H, page_len]`` as a lane-packed VMEM
+    operand ``[P, H, 8, 128]``: lane ``r`` of every sublane holds row
+    ``r``'s scale (page_len <= 128 — enforced eagerly by the serving
+    config, re-checked here for direct kernel users).  The same
+    broadcast-tile idiom the kernels already use for traced lengths —
+    the fused arms read one (1, 1, 8, 128) block per (page, head)
+    through the scalar-prefetch page table, exactly like the int8 data
+    block it scales.
+
+    COST NOTE: this operand is rebuilt inside every compiled call (a
+    pad + sublane broadcast over the whole pool, 2·P·H·4KiB per layer
+    per tick) — transient bandwidth, not HBM capacity; the sidecar the
+    cache STORES stays the compact ``[P, H, page_len]`` (storing the
+    kernel layout would cost 8-128x the sidecar bytes and eat the
+    capacity win this arm exists for).  The hardware refinement
+    (docs/serving.md) is to pack the scale row into a spare lane of
+    the int8 page so it streams with the data it scales."""
+    Pp, Hh, pl = scales.shape
+    if pl > 128:
+        raise ValueError(
+            f"quantized pages support page_len <= 128 (one scale lane "
+            f"per row), got page_len={pl}")
+    lanes = jnp.pad(scales.astype(jnp.float32),
+                    ((0, 0), (0, 0), (0, 128 - pl)))
+    return jnp.broadcast_to(lanes[:, :, None, :], (Pp, Hh, 8, 128))
+
+
+def _decode_paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                         sm_scale: float, page_len: int, heads: int):
+    # fused-dequant arm (int8 pages): two extra scale-tile refs ride
+    # between the pool blocks and the output.  The python-level branch
+    # keeps the fp arm's trace byte-identical to the pre-quant kernel.
+    quant = len(rest) > 4
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     jk = pl.program_id(1)
     nk = pl.num_programs(1)
     slot = pl.program_id(0) // heads
@@ -263,9 +333,19 @@ def _decode_paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]                                    # [8, d] broadcast
         k = k_ref[0, 0]                                 # [page_len, d]
         v = v_ref[0, 0]                                 # [page_len, d]
+        if quant:
+            # dequant folds into the score/prob columns: the scale is
+            # per KEY ROW, so q·(k8*sk) == (q·k8)*sk and p·(v8*sv) ==
+            # (p*sv)·v8 — the int8 page never materializes in fp
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
+            ks_row = ks_ref[0, 0][0:1, :page_len]       # [1, page_len]
+            vs_row = vs_ref[0, 0][0:1, :page_len]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
+        if quant:
+            s = s * ks_row
         k_ids = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
             + jk * page_len
         s = jnp.where(k_ids < length, s, NEG_INF)
@@ -276,8 +356,9 @@ def _decode_paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[:] = jnp.broadcast_to(
             alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True),
             l_scr.shape)
+        pv = (p * vs_row) if quant else p
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            pv.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
@@ -290,28 +371,35 @@ def _decode_paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _decode_paged_pallas(q, k_pages, v_pages, page_table, lengths, *,
-                         sm_scale, interpret):
+                         sm_scale, interpret, k_scale=None, v_scale=None):
     P, H, page_len, Dh = k_pages.shape
     S, max_pages = page_table.shape
+    quant = k_scale is not None
     qf = jnp.broadcast_to(q.reshape(S * H, 1, Dh), (S * H, 8, Dh))
     pt_flat = page_table.astype(jnp.int32).reshape(-1)
+
+    def page_block(g, j, pt, ln, H=H, M=max_pages):
+        # THE paged move: the block for grid cell (g, j) is whatever
+        # page the slot's table names — a short slot streams only the
+        # pages it owns (plus scratch no-ops)
+        return (pt[(g // H) * M + j], g % H, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 8, Dh), lambda g, j, pt, ln: (g, 0, 0)),
+        pl.BlockSpec((1, 1, page_len, Dh), page_block),
+        pl.BlockSpec((1, 1, page_len, Dh), page_block),
+    ]
+    operands = [qf, k_pages, v_pages]
+    if quant:
+        # the scale rows ride the SAME page-table indirection as the
+        # int8 blocks they dequantize, as lane-packed (8, 128) tiles
+        in_specs += [pl.BlockSpec((1, 1, 8, 128), page_block),
+                     pl.BlockSpec((1, 1, 8, 128), page_block)]
+        operands += [_scale_tile(k_scale), _scale_tile(v_scale)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S * H, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, 8, Dh), lambda g, j, pt, ln: (g, 0, 0)),
-            # THE paged move: the k/v block for grid cell (g, j) is
-            # whatever page the slot's table names — a short slot
-            # streams only the pages it owns (plus scratch no-ops)
-            pl.BlockSpec(
-                (1, 1, page_len, Dh),
-                lambda g, j, pt, ln, H=H, M=max_pages:
-                    (pt[(g // H) * M + j], g % H, 0, 0)),
-            pl.BlockSpec(
-                (1, 1, page_len, Dh),
-                lambda g, j, pt, ln, H=H, M=max_pages:
-                    (pt[(g // H) * M + j], g % H, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 8, Dh), lambda g, j, pt, ln: (g, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((8, 128), jnp.float32),
@@ -323,10 +411,24 @@ def _decode_paged_pallas(q, k_pages, v_pages, page_table, lengths, *,
         functools.partial(_decode_paged_kernel, sm_scale=sm_scale,
                           page_len=page_len, heads=H),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S * H, 8, Dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((S * H, 8, Dh),
+                                       jnp.float32 if quant else q.dtype),
         interpret=interpret,
-    )(pt_flat, lengths.astype(jnp.int32), qf, k_pages, v_pages)
-    return out[:, 0, :].reshape(S, H, Dh)
+    )(pt_flat, lengths.astype(jnp.int32), *operands)
+    return out[:, 0, :].reshape(S, H, Dh).astype(q.dtype)
+
+
+def _check_quant_args(k_pages, k_scale, v_scale, what: str):
+    """The fused-dequant contract both paged entry points share: the
+    two scale sidecars come together and only over an int8 pool."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError(
+            f"{what}: k_scale and v_scale must be passed together "
+            "(the fused-dequant arm scales both pools)")
+    if k_scale is not None and k_pages.dtype != jnp.int8:
+        raise ValueError(
+            f"{what}: scale operands imply an int8 page pool, got "
+            f"dtype {k_pages.dtype}")
 
 
 def decode_attention_paged(q: jnp.ndarray, k_pages: jnp.ndarray,
@@ -335,7 +437,9 @@ def decode_attention_paged(q: jnp.ndarray, k_pages: jnp.ndarray,
                            lengths: jnp.ndarray,
                            sm_scale: Optional[float] = None,
                            impl: str = "pallas",
-                           interpret: Optional[bool] = None
+                           interpret: Optional[bool] = None,
+                           k_scale: Optional[jnp.ndarray] = None,
+                           v_scale: Optional[jnp.ndarray] = None
                            ) -> jnp.ndarray:
     """Single-query attention over a PAGED KV pool (docs/serving.md).
 
@@ -349,20 +453,32 @@ def decode_attention_paged(q: jnp.ndarray, k_pages: jnp.ndarray,
     lengths: [S] int32, TRACED — per-slot live KV length including the
         position this query's K/V was just written to.  0 = free slot
         -> exact-zero output.
+    k_scale, v_scale: [P, H, page_len] fp32, TRACED — the quantized
+        pool's per-row scale sidecars (serving.quantization.kv='int8';
+        the pool is then int8 and dequant fuses into the kernel).
+        None = the fp pool, byte-identical to the pre-quant programs.
 
     ``impl='dense'`` gathers the pool dense with ``jnp.take`` and runs
     :func:`decode_attention_reference` — values identical to the
-    pre-page slot layout, the CPU-bitwise parity anchor.  ``'pallas'``
-    is the scalar-prefetch kernel (interpret mode off-TPU)."""
+    pre-page slot layout, the CPU-bitwise parity anchor; on the quant
+    arm it dequantizes the gathered view first
+    (:func:`dequantize_paged` — the semantics the fused kernel is
+    verified against).  ``'pallas'`` is the scalar-prefetch kernel
+    (interpret mode off-TPU)."""
     assert q.ndim == 3 and k_pages.ndim == 4, (q.shape, k_pages.shape)
     P, H, page_len, Dh = k_pages.shape
     S, max_pages = page_table.shape
     assert q.shape == (S, H, Dh), (q.shape, k_pages.shape)
+    _check_quant_args(k_pages, k_scale, v_scale, "decode_attention_paged")
     if sm_scale is None:
         sm_scale = _default_scale(Dh)
     if impl == "dense":
-        kg = paged_gather(k_pages, page_table)
-        vg = paged_gather(v_pages, page_table)
+        if k_scale is not None:
+            kg = dequantize_paged(k_pages, k_scale, page_table)
+            vg = dequantize_paged(v_pages, v_scale, page_table)
+        else:
+            kg = paged_gather(k_pages, page_table)
+            vg = paged_gather(v_pages, page_table)
         return decode_attention_reference(q, kg, vg, lengths,
                                           sm_scale=sm_scale)
     if impl != "pallas":
@@ -374,7 +490,8 @@ def decode_attention_paged(q: jnp.ndarray, k_pages: jnp.ndarray,
     return _decode_paged_pallas(q, k_pages, v_pages,
                                 page_table.astype(jnp.int32),
                                 lengths.astype(jnp.int32),
-                                sm_scale=sm_scale, interpret=interpret)
+                                sm_scale=sm_scale, interpret=interpret,
+                                k_scale=k_scale, v_scale=v_scale)
 
 
 # ---------------------------------------------------------------------------
@@ -547,8 +664,14 @@ def decode_attention_multi(q: jnp.ndarray, k: jnp.ndarray,
 
 
 def _decode_paged_multi_kernel(pt_ref, q_ref, len_ref, k_ref, v_ref,
-                               o_ref, m_scr, l_scr, acc_scr,
-                               *, sm_scale: float, page_len: int):
+                               *rest, sm_scale: float, page_len: int):
+    # fused-dequant arm: see _decode_paged_kernel — same two scale-tile
+    # refs, same python-level branch keeping the fp trace unchanged
+    quant = len(rest) > 4
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     jk = pl.program_id(1)
     nk = pl.num_programs(1)
     row_lens = len_ref[0][:, 0:1]                       # [Wp, 1]
@@ -564,9 +687,16 @@ def _decode_paged_multi_kernel(pt_ref, q_ref, len_ref, k_ref, v_ref,
         q = q_ref[0]                                    # [Wp, d]
         k = k_ref[0, 0]                                 # [page_len, d]
         v = v_ref[0, 0]
+        if quant:
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
+            ks_row = ks_ref[0, 0][0:1, :page_len]       # [1, page_len]
+            vs_row = vs_ref[0, 0][0:1, :page_len]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
+        if quant:
+            s = s * ks_row
         k_ids = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
             + jk * page_len
         mask = k_ids < row_lens
@@ -578,8 +708,9 @@ def _decode_paged_multi_kernel(pt_ref, q_ref, len_ref, k_ref, v_ref,
         l_scr[:] = jnp.broadcast_to(
             alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True),
             l_scr.shape)
+        pv = (p * vs_row) if quant else p
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            pv.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
@@ -592,32 +723,38 @@ def _decode_paged_multi_kernel(pt_ref, q_ref, len_ref, k_ref, v_ref,
 
 
 def _decode_paged_multi_pallas(q, k_pages, v_pages, page_table, lengths,
-                               *, sm_scale, interpret):
+                               *, sm_scale, interpret, k_scale=None,
+                               v_scale=None):
     P, H, page_len, Dh = k_pages.shape
     S, max_pages = page_table.shape
     W = q.shape[2]
     wp = _rows_pad(W)
+    quant = k_scale is not None
     qf = _pad_queries(q, wp)
     len_op = _multi_len_op(lengths, wp)
     pt_flat = page_table.astype(jnp.int32).reshape(-1)
+
+    def page_block(g, j, pt, H=H, M=max_pages):
+        return (pt[(g // H) * M + j], g % H, 0, 0)
+
     # only the page table needs scalar prefetch (it feeds the index
     # maps); the per-query lengths ride as an ordinary VMEM tile
+    in_specs = [
+        pl.BlockSpec((1, wp, Dh), lambda g, j, pt: (g, 0, 0)),
+        pl.BlockSpec((1, wp, 128),
+                     lambda g, j, pt, H=H: (g // H, 0, 0)),
+        pl.BlockSpec((1, 1, page_len, Dh), page_block),
+        pl.BlockSpec((1, 1, page_len, Dh), page_block),
+    ]
+    operands = [qf, len_op, k_pages, v_pages]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, 8, 128), page_block),
+                     pl.BlockSpec((1, 1, 8, 128), page_block)]
+        operands += [_scale_tile(k_scale), _scale_tile(v_scale)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(S * H, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, wp, Dh), lambda g, j, pt: (g, 0, 0)),
-            pl.BlockSpec((1, wp, 128),
-                         lambda g, j, pt, H=H: (g // H, 0, 0)),
-            pl.BlockSpec(
-                (1, 1, page_len, Dh),
-                lambda g, j, pt, H=H, M=max_pages:
-                    (pt[(g // H) * M + j], g % H, 0, 0)),
-            pl.BlockSpec(
-                (1, 1, page_len, Dh),
-                lambda g, j, pt, H=H, M=max_pages:
-                    (pt[(g // H) * M + j], g % H, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, wp, Dh), lambda g, j, pt: (g, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((wp, 128), jnp.float32),
@@ -629,10 +766,11 @@ def _decode_paged_multi_pallas(q, k_pages, v_pages, page_table, lengths,
         functools.partial(_decode_paged_multi_kernel, sm_scale=sm_scale,
                           page_len=page_len),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S * H, wp, Dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((S * H, wp, Dh),
+                                       jnp.float32 if quant else q.dtype),
         interpret=interpret,
-    )(pt_flat, qf, len_op, k_pages, v_pages)
-    return out[:, :W, :].reshape(S, H, W, Dh)
+    )(pt_flat, *operands)
+    return out[:, :W, :].reshape(S, H, W, Dh).astype(q.dtype)
 
 
 def decode_attention_paged_multi(q: jnp.ndarray, k_pages: jnp.ndarray,
@@ -641,13 +779,17 @@ def decode_attention_paged_multi(q: jnp.ndarray, k_pages: jnp.ndarray,
                                  lengths: jnp.ndarray,
                                  sm_scale: Optional[float] = None,
                                  impl: str = "pallas",
-                                 interpret: Optional[bool] = None
+                                 interpret: Optional[bool] = None,
+                                 k_scale: Optional[jnp.ndarray] = None,
+                                 v_scale: Optional[jnp.ndarray] = None
                                  ) -> jnp.ndarray:
     """Multi-query attention over the PAGED KV pool — the paged twin of
     :func:`decode_attention_multi` (same per-query ``lengths [S, W]``
     contract) with the page pool/table layout of
-    :func:`decode_attention_paged`.  ``impl='dense'`` gathers the pool
-    with ``jnp.take`` then runs the stacked single-query reference —
+    :func:`decode_attention_paged`, including its fused-dequant arm
+    (``k_scale``/``v_scale`` [P, H, page_len] over an int8 pool).
+    ``impl='dense'`` gathers the pool with ``jnp.take`` (dequantizing
+    on the quant arm) then runs the stacked single-query reference —
     values identical to the unpaged multi arm on the same logical
     cache; ``'pallas'`` is the scalar-prefetch kernel with W query
     rows per tile (interpret mode off-TPU)."""
@@ -657,11 +799,17 @@ def decode_attention_paged_multi(q: jnp.ndarray, k_pages: jnp.ndarray,
     W = q.shape[2]
     assert q.shape == (S, H, W, Dh), (q.shape, k_pages.shape)
     assert lengths.shape == (S, W), (lengths.shape, q.shape)
+    _check_quant_args(k_pages, k_scale, v_scale,
+                      "decode_attention_paged_multi")
     if sm_scale is None:
         sm_scale = _default_scale(Dh)
     if impl == "dense":
-        kg = paged_gather(k_pages, page_table)
-        vg = paged_gather(v_pages, page_table)
+        if k_scale is not None:
+            kg = dequantize_paged(k_pages, k_scale, page_table)
+            vg = dequantize_paged(v_pages, v_scale, page_table)
+        else:
+            kg = paged_gather(k_pages, page_table)
+            vg = paged_gather(v_pages, page_table)
         return decode_attention_multi_reference(q, kg, vg, lengths,
                                                 sm_scale=sm_scale)
     if impl != "pallas":
@@ -674,4 +822,5 @@ def decode_attention_paged_multi(q: jnp.ndarray, k_pages: jnp.ndarray,
                                       page_table.astype(jnp.int32),
                                       lengths.astype(jnp.int32),
                                       sm_scale=sm_scale,
-                                      interpret=interpret)
+                                      interpret=interpret,
+                                      k_scale=k_scale, v_scale=v_scale)

@@ -79,6 +79,8 @@ def summarize(path: str, out=None) -> dict:
     sv_cow: Optional[float] = None
     sv_spec_accept: Optional[float] = None
     sv_spec_mal: Optional[float] = None
+    sv_param_bytes: Optional[float] = None
+    sv_kv_bytes: Optional[float] = None
     # per-request serving records (kind: serve_request) — the
     # queue/prefill/decode latency attribution split
     sv_requests = 0
@@ -178,6 +180,15 @@ def summarize(path: str, out=None) -> dict:
                 sm = scalars.get("serve_spec_mean_accepted_len")
                 if sm is not None:
                     sv_spec_mal = float(sm)
+                # serving memory plane (docs/serving.md "quantized
+                # serving"): static per engine — the last flush is the
+                # run's answer
+                pb = scalars.get("serve_param_bytes")
+                if pb is not None:
+                    sv_param_bytes = float(pb)
+                kb = scalars.get("serve_kv_bytes")
+                if kb is not None:
+                    sv_kv_bytes = float(kb)
                 sg = scalars.get("straggler_detected_total")
                 if sg is not None:
                     # cumulative counter: the last/maximum value is the
@@ -269,6 +280,8 @@ def summarize(path: str, out=None) -> dict:
         "serve_page_cow_total": sv_cow,
         "serve_spec_accept_ratio": sv_spec_accept,
         "serve_spec_mean_accepted_len": sv_spec_mal,
+        "serve_param_bytes": sv_param_bytes,
+        "serve_kv_bytes": sv_kv_bytes,
         "liveness_hosts": len(beat_ages) or None,
         "liveness_max_age_s": (max(beat_ages.values())
                                if beat_ages else None),
@@ -358,6 +371,14 @@ def summarize(path: str, out=None) -> dict:
         print(f"  speculation        "
               f"{report['serve_spec_mean_accepted_len']:.2f} tokens/"
               f"target pass{acc_txt}", file=out)
+    if sv_param_bytes is not None or sv_kv_bytes is not None:
+        # serving memory: device bytes of params (int8 + scales under
+        # weight quantization) and the KV cache spec (incl. quant
+        # sidecars) — the KV-byte claims bench legs used to recompute
+        # by hand now come from this one plane
+        print(f"  serving memory     params "
+              f"{_fmt_bytes(sv_param_bytes)}  kv "
+              f"{_fmt_bytes(sv_kv_bytes)}", file=out)
     if beat_ages:
         # liveness (docs/elastic.md): supervisor-visible staleness made
         # operator-visible — last beat age per host at the final sync

@@ -50,6 +50,7 @@ if [ "$1" = "--serve" ]; then
   run serve python bench_serve.py
   run serve_paged python bench_serve.py --paged ab
   run serve_spec python bench_serve.py --spec ab
+  run serve_quant python bench_serve.py --quant ab
   exit 0
 fi
 # capacity runs LAST: its probes are subprocesses killed on timeout,
@@ -75,6 +76,10 @@ run serve_paged python bench_serve.py --paged ab
 # injected per-PASS device time; wall/token tracks 1/mean-accepted-
 # length (pure CPU scheduling claim — see docs/serving.md)
 run serve_spec python bench_serve.py --spec ab
+# quantized-serving A/B: admitted concurrency at a fixed KV-byte
+# budget (int8 vs fp pages) + int8-weights params-HBM leg (pure CPU
+# capacity claims from the cache/param byte planes — docs/serving.md)
+run serve_quant python bench_serve.py --quant ab
 run bert python bench_bert.py
 run sparse python bench_sparse.py
 run flash python bench_flash.py

@@ -49,6 +49,11 @@ METRIC_DIRECTIONS = {
     # wall-clock per token, spec vs non-spec: smaller = more tokens
     # per target pass (docs/serving.md "speculative decoding")
     "serve_spec_wall_per_token_ratio": True,
+    # admitted concurrent requests at a fixed KV-byte budget, int8 vs
+    # fp pages: more users per chip — HIGHER is better even though
+    # nothing in the name says "speedup" (docs/serving.md "quantized
+    # serving")
+    "serve_quant_admitted_ratio": False,
 }
 
 

@@ -55,6 +55,24 @@ the two legs' token streams are asserted identical (greedy parity).
 Emits BENCH_serve_spec.json:
     {"metric": "serve_spec_wall_per_token_ratio", "value": ...,
      "spec": {...}, "baseline": {...}}
+
+``--fleet`` runs the SERVING-FLEET A/B (docs/serving.md "serving
+fleet") instead: the same open-loop workload against a 1-replica and a
+2-replica fleet (real ``inference.replica`` subprocesses behind the
+``inference/fleet.py`` router) under identical injected per-tick
+device time — aggregate tokens/s should scale with the replica count
+(the headline, expected >= 1.8x at 2 replicas) because each replica is
+a full slot pool paying its own ticks.  A second leg drives the
+replica-kill + autoscale-up trace: under sustained load one of two
+replicas is SIGKILLed mid-stream; the router fails over every
+queued-but-unstarted request (zero lost, asserted from the per-request
+completion records), the queue-wait p99 breaches ``fleet.slo_p99_s``,
+the autoscaler spawns a replacement, and the tail-phase p99 returns
+under the SLO.
+
+Emits BENCH_fleet.json:
+    {"metric": "fleet_scaling_tokens_ratio", "value": ...,
+     "one_replica": {...}, "two_replicas": {...}, "killtrace": {...}}
 """
 import contextlib
 import json
@@ -637,6 +655,231 @@ def run_spec_ab(k=4, slots=6, n_requests=6, prompt_len=8,
     return rec
 
 
+# ---------------------------------------------------------------------------
+# --fleet: router + replicated engines + SLO autoscaling A/B
+# ---------------------------------------------------------------------------
+
+
+def _fleet_config(replicas, *, min_replicas=1, max_replicas=None,
+                  slots=4, slo_p99_s=30.0, up_window_s=1.0,
+                  down_window_s=600.0):
+    """One fleet ds_config: tiny deterministic model (every replica
+    inits identical params from the shared seed), short hysteresis
+    windows sized for a CPU bench, scale-down effectively off (the
+    legs measure throughput/failover, not retirement)."""
+    return {
+        "serving": {"slots": slots, "max_seq_len": 64,
+                    "prefill_len": 8, "queue_capacity": 512,
+                    "flush_interval_ticks": 10},
+        "telemetry": {"enabled": False},
+        "fleet": {"replicas": replicas, "min_replicas": min_replicas,
+                  "max_replicas": max_replicas or max(replicas, 1),
+                  "slo_p99_s": slo_p99_s,
+                  "scale_up_window_s": up_window_s,
+                  "scale_down_window_s": down_window_s,
+                  "spawn_timeout_s": 120.0, "backoff_base_s": 0.2,
+                  "heartbeat_timeout_s": 60.0},
+        "fleet_model": {"vocab_size": 256, "n_positions": 64,
+                        "d_model": 64, "n_layer": 2, "n_head": 4,
+                        "attn_impl": "dense", "seed": 0},
+    }
+
+
+def _fleet_prompts(n, prompt_len=6, seed=0):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    return [[int(t) for t in rng.integers(0, 256, (prompt_len,))]
+            for _ in range(n)]
+
+
+def _run_fleet_leg(n_replicas, n_requests, gen_tokens, tick_delay_s,
+                   tag):
+    """One scaling leg: spawn the fleet, warm every replica (compile
+    happens off the clock), then serve the saturation workload (all
+    requests submitted up front) under injected per-tick device time.
+    Aggregate tokens/s comes from the router-side completion stream;
+    the wall window starts at the first measured submit."""
+    import shutil
+    import tempfile
+    from deepspeed_tpu.inference.fleet import FleetRouter
+    d = tempfile.mkdtemp(prefix=f"bench_fleet_{tag}_")
+    prompts = _fleet_prompts(n_requests)
+    with _injected_delay(tick_delay_s):
+        router = FleetRouter(_fleet_config(n_replicas), fleet_dir=d)
+        try:
+            router.start()
+            # one warm request per replica: JSQ spreads them, so every
+            # replica compiles prefill+decode before the clock starts
+            for _ in range(n_replicas):
+                router.submit(prompts[0], max_new_tokens=2)
+            router.run_until_idle(max_s=180)
+            t0 = time.perf_counter()
+            reqs = [router.submit(p, max_new_tokens=gen_tokens)
+                    for p in prompts]
+            router.run_until_idle(max_s=600)
+            wall = time.perf_counter() - t0
+            assert all(r.error is None for r in reqs), \
+                [repr(r.error) for r in reqs if r.error]
+            tokens = sum(len(r.tokens) for r in reqs)
+            p99 = router.queue_wait_p99(window_s=1e9)
+        finally:
+            router.close()
+            shutil.rmtree(d, ignore_errors=True)
+    return {"replicas": n_replicas, "requests": n_requests,
+            "tokens": tokens, "wall_s": wall,
+            "tokens_per_s": tokens / wall,
+            "queue_wait_p99_s": p99}
+
+
+def _read_fleet_records(fleet_dir):
+    from deepspeed_tpu.telemetry.cli import _read_jsonl_tolerant
+    records, _ = _read_jsonl_tolerant(
+        os.path.join(fleet_dir, "events.jsonl"))
+    return records
+
+
+def _run_fleet_killtrace(slo_p99_s, n_requests, arrival_s, gen_tokens,
+                         tick_delay_s, kill_after_s):
+    """The replica-kill + autoscale-up trace: 2 replicas under open-
+    loop load sized ABOVE one replica's capacity, one replica
+    SIGKILLed mid-stream.  Queued-but-unstarted requests fail over
+    (zero lost — asserted from the completion records), queue-wait p99
+    breaches the SLO while one replica carries everything, the
+    autoscaler spawns a replacement, and the tail-phase p99 lands back
+    under the SLO."""
+    import shutil
+    import tempfile
+    from deepspeed_tpu.inference.fleet import FleetRouter
+    d = tempfile.mkdtemp(prefix="bench_fleet_kill_")
+    prompts = _fleet_prompts(n_requests, seed=1)
+    cfg = _fleet_config(2, min_replicas=1, max_replicas=3, slots=2,
+                        slo_p99_s=slo_p99_s, up_window_s=0.5)
+    with _injected_delay(tick_delay_s):
+        router = FleetRouter(cfg, fleet_dir=d)
+        try:
+            router.start()
+            initial_ids = sorted(router.replicas)
+            for _ in range(2):
+                router.submit(prompts[0], max_new_tokens=2)
+            router.run_until_idle(max_s=180)
+            t0 = time.perf_counter()
+            reqs = []
+            submit_ts = []
+            killed = None
+            recover_t = None
+            nxt = 0
+            while nxt < n_requests or not router.idle():
+                now = time.perf_counter() - t0
+                while nxt < n_requests and nxt * arrival_s <= now:
+                    reqs.append(router.submit(
+                        prompts[nxt], max_new_tokens=gen_tokens))
+                    submit_ts.append(now)
+                    nxt += 1
+                if killed is None and now >= kill_after_s:
+                    # kill the busier initial replica: guaranteed
+                    # queued-but-unstarted work to fail over
+                    victims = [r for r in router.replicas.values()
+                               if r.id in initial_ids
+                               and r.state == "ready"]
+                    victims.sort(key=lambda r: -len(r.outstanding))
+                    killed = victims[0].id
+                    router.kill_replica(killed)
+                if recover_t is None and any(
+                        rid not in initial_ids
+                        and router.replicas[rid].state == "ready"
+                        for rid in router.replicas):
+                    recover_t = time.perf_counter() - t0
+                router.poll(0.01)
+            wall = time.perf_counter() - t0
+            records = _read_fleet_records(d)
+        finally:
+            router.close()
+            shutil.rmtree(d, ignore_errors=True)
+
+    # zero queued-but-unstarted requests lost: asserted from the
+    # per-request completion records — every failed record must have
+    # started=True (its tokens were already streaming: typed
+    # ReplicaFailure, not silently-retriable work)
+    completions = {r["rid"]: r for r in records
+                   if r.get("kind") == "fleet_request"}
+    submits = [r for r in records if r.get("kind") == "fleet_submit"]
+    assert len(completions) == len(submits), \
+        f"dangling requests: {len(submits) - len(completions)}"
+    lost = [r for r in completions.values()
+            if r.get("error") and not r.get("started")]
+    assert not lost, f"queued-but-unstarted requests lost: {lost}"
+    failovers = sum(int(r.get("failed_over") or 0) for r in records
+                    if r.get("kind") == "replica_dead")
+    assert failovers > 0, "the kill never hit queued work"
+    midstream = [r for r in completions.values() if r.get("error")]
+    # p99 attribution by phase: degraded = submitted after the kill
+    # while only one replica served; recovered = submitted after the
+    # autoscaled replacement came up.  The SLO claim is about the tail.
+    assert recover_t is not None, "autoscale never spawned"
+
+    from deepspeed_tpu.inference.fleet import _p99
+
+    def _phase_p99(lo, hi):
+        return _p99([
+            completions[r.rid]["queue_wait_s"]
+            for r, t in zip(reqs, submit_ts)
+            if lo <= t < hi and r.rid in completions
+            and completions[r.rid].get("queue_wait_s") is not None])
+
+    p99_degraded = _phase_p99(kill_after_s, recover_t)
+    # the recovered phase starts one backlog-drain grace after the
+    # replacement came up (the surplus capacity needs a moment to eat
+    # the degraded phase's queue); the claim is the TAIL holds the SLO
+    drain_grace_s = min(2.0, (wall - recover_t) / 3)
+    p99_recovered = _phase_p99(recover_t + drain_grace_s, 1e9)
+    assert p99_recovered is not None and p99_recovered < slo_p99_s, \
+        (p99_recovered, slo_p99_s)
+    return {
+        "slo_p99_s": slo_p99_s,
+        "requests": n_requests,
+        "arrival_s": arrival_s,
+        "tick_delay_s": tick_delay_s,
+        "killed_replica": killed,
+        "kill_after_s": kill_after_s,
+        "recover_after_s": recover_t,
+        "wall_s": wall,
+        "failovers": failovers,
+        "midstream_failed": len(midstream),
+        "unstarted_lost": 0,
+        "queue_wait_p99_degraded_s": p99_degraded,
+        "queue_wait_p99_recovered_s": p99_recovered,
+    }
+
+
+def run_fleet_ab(n_requests=16, gen_tokens=16, tick_delay_s=0.04,
+                 slo_p99_s=1.5, out_dir="."):
+    """The fleet A/B: aggregate tokens/s at 1 vs 2 replicas under
+    identical injected per-tick device time (the headline, >= 1.8x
+    expected — each replica is an independent slot pool paying its own
+    ticks), plus the replica-kill + autoscale-up trace."""
+    one = _run_fleet_leg(1, n_requests, gen_tokens, tick_delay_s,
+                         "one")
+    two = _run_fleet_leg(2, n_requests, gen_tokens, tick_delay_s,
+                         "two")
+    # 100 requests at 0.12s spacing = a 12s open-loop window: the kill
+    # lands early, the autoscaled replacement comes up mid-window, and
+    # the tail requests measure the RECOVERED fleet's queue wait
+    kill = _run_fleet_killtrace(
+        slo_p99_s=slo_p99_s, n_requests=100, arrival_s=0.12,
+        gen_tokens=9, tick_delay_s=tick_delay_s, kill_after_s=1.2)
+    rec = {
+        "metric": "fleet_scaling_tokens_ratio",
+        "value": two["tokens_per_s"] / one["tokens_per_s"],
+        "tick_delay_s": tick_delay_s,
+        "one_replica": one,
+        "two_replicas": two,
+        "killtrace": kill,
+    }
+    with open(os.path.join(out_dir, "BENCH_fleet.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+    return rec
+
+
 def main():
     import argparse
     parser = argparse.ArgumentParser(description=__doc__)
@@ -685,11 +928,24 @@ def main():
     parser.add_argument("--k", type=int, default=4,
                         help="draft tokens per tick for --spec "
                              "(default 4)")
+    parser.add_argument("--fleet", choices=("on", "off", "ab"),
+                        default=None,
+                        help="run the serving-fleet A/B instead "
+                             "(BENCH_fleet.json): aggregate tokens/s "
+                             "at 1 vs 2 replicas under identical "
+                             "injected per-tick device time, plus the "
+                             "replica-kill + autoscale-up trace; both "
+                             "arms always run — the headline is the "
+                             "2/1 tokens-per-second ratio")
     args = parser.parse_args()
     # one shared dispatch harness: every mode forwards ONLY the flags
     # the user gave (None sentinels), so each run_*_ab keeps its own
     # per-mode defaults — no more per-mode kwargs blocks to clone
-    if args.spec is not None:
+    if args.fleet is not None:
+        rec = run_fleet_ab(**_mode_kwargs(
+            args, requests="n_requests", gen="gen_tokens",
+            delay="tick_delay_s"))
+    elif args.spec is not None:
         rec = run_spec_ab(**{"k": args.k}, **_mode_kwargs(
             args, delay="pass_delay_s", slots="slots",
             requests="n_requests", gen="gen_tokens",

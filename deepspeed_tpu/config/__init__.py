@@ -7,6 +7,7 @@ from .config import (
     DeepSpeedActivationCheckpointingConfig,
     DeepSpeedSparseAttentionConfig,
     DeepSpeedServingConfig,
+    DeepSpeedFleetConfig,
     DeepSpeedPipelineConfig,
     DeepSpeedConfigWriter,
 )

@@ -590,6 +590,81 @@ class DeepSpeedServingConfig:
         return out
 
 
+class DeepSpeedFleetConfig:
+    """Serving-fleet block (docs/serving.md "serving fleet"): the
+    router/autoscaler knobs over N ServeEngine replicas.  Validates
+    eagerly — a typo'd SLO or an inverted min/max clamp must fail at
+    config parse, not as a silent never-scaling fleet under live
+    traffic."""
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        fl = param_dict.get(C.FLEET) or {}
+        self.replicas = get_scalar_param(
+            fl, C.FLEET_REPLICAS, C.FLEET_REPLICAS_DEFAULT)
+        self.min_replicas = get_scalar_param(
+            fl, C.FLEET_MIN_REPLICAS, C.FLEET_MIN_REPLICAS_DEFAULT)
+        self.max_replicas = get_scalar_param(
+            fl, C.FLEET_MAX_REPLICAS, C.FLEET_MAX_REPLICAS_DEFAULT)
+        self.slo_p99_s = get_scalar_param(
+            fl, C.FLEET_SLO_P99_S, C.FLEET_SLO_P99_S_DEFAULT)
+        self.scale_up_window_s = get_scalar_param(
+            fl, C.FLEET_SCALE_UP_WINDOW_S,
+            C.FLEET_SCALE_UP_WINDOW_S_DEFAULT)
+        self.scale_down_window_s = get_scalar_param(
+            fl, C.FLEET_SCALE_DOWN_WINDOW_S,
+            C.FLEET_SCALE_DOWN_WINDOW_S_DEFAULT)
+        self.heartbeat_timeout_s = get_scalar_param(
+            fl, C.FLEET_HEARTBEAT_TIMEOUT_S,
+            C.FLEET_HEARTBEAT_TIMEOUT_S_DEFAULT)
+        self.max_restarts = get_scalar_param(
+            fl, C.FLEET_MAX_RESTARTS, C.FLEET_MAX_RESTARTS_DEFAULT)
+        self.backoff_base_s = get_scalar_param(
+            fl, C.FLEET_BACKOFF_BASE_S, C.FLEET_BACKOFF_BASE_S_DEFAULT)
+        self.backoff_max_s = get_scalar_param(
+            fl, C.FLEET_BACKOFF_MAX_S, C.FLEET_BACKOFF_MAX_S_DEFAULT)
+        self.spawn_timeout_s = get_scalar_param(
+            fl, C.FLEET_SPAWN_TIMEOUT_S, C.FLEET_SPAWN_TIMEOUT_S_DEFAULT)
+        self.term_grace_s = get_scalar_param(
+            fl, C.FLEET_TERM_GRACE_S, C.FLEET_TERM_GRACE_S_DEFAULT)
+        for name, v in ((C.FLEET_REPLICAS, self.replicas),
+                        (C.FLEET_MIN_REPLICAS, self.min_replicas),
+                        (C.FLEET_MAX_REPLICAS, self.max_replicas)):
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise DeepSpeedConfigError(
+                    f"fleet.{name} must be an int >= 1, got {v!r}")
+        if not (self.min_replicas <= self.replicas <= self.max_replicas):
+            raise DeepSpeedConfigError(
+                f"fleet replica clamps must nest: min_replicas="
+                f"{self.min_replicas} <= replicas={self.replicas} <= "
+                f"max_replicas={self.max_replicas}")
+        for name, v, lo in (
+                (C.FLEET_SLO_P99_S, self.slo_p99_s, 0.0),
+                (C.FLEET_SCALE_UP_WINDOW_S, self.scale_up_window_s, 0.0),
+                (C.FLEET_SCALE_DOWN_WINDOW_S,
+                 self.scale_down_window_s, 0.0),
+                (C.FLEET_BACKOFF_BASE_S, self.backoff_base_s, 0.0),
+                (C.FLEET_BACKOFF_MAX_S, self.backoff_max_s, 0.0),
+                (C.FLEET_SPAWN_TIMEOUT_S, self.spawn_timeout_s, 0.0),
+                (C.FLEET_TERM_GRACE_S, self.term_grace_s, 0.0)):
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or v <= lo:
+                raise DeepSpeedConfigError(
+                    f"fleet.{name} must be a number > {lo}, got {v!r}")
+        if isinstance(self.heartbeat_timeout_s, bool) or \
+                not isinstance(self.heartbeat_timeout_s, (int, float)) \
+                or self.heartbeat_timeout_s < 0:
+            raise DeepSpeedConfigError(
+                f"fleet.{C.FLEET_HEARTBEAT_TIMEOUT_S} must be a number "
+                f">= 0 (0 = off), got {self.heartbeat_timeout_s!r}")
+        if not isinstance(self.max_restarts, int) \
+                or isinstance(self.max_restarts, bool) \
+                or self.max_restarts < 0:
+            raise DeepSpeedConfigError(
+                f"fleet.{C.FLEET_MAX_RESTARTS} must be an int >= 0 "
+                f"(consecutive no-progress replica failures before the "
+                f"typed give-up), got {self.max_restarts!r}")
+
+
 class DeepSpeedPipelineConfig:
     def __init__(self, param_dict: Dict[str, Any]):
         pipe = param_dict.get(C.PIPELINE) or {}
@@ -716,6 +791,7 @@ class DeepSpeedConfig:
         self.checkpoint_config = DeepSpeedCheckpointConfig(pd)
         self.stages_config = DeepSpeedStagesConfig(pd)
         self.serving_config = DeepSpeedServingConfig(pd)
+        self.fleet_config = DeepSpeedFleetConfig(pd)
         self.pipeline_config = DeepSpeedPipelineConfig(pd)
 
         self._solve_batch_triangle()

@@ -531,6 +531,65 @@ SERVING_QUANT_WEIGHTS_DEFAULT = "fp16"
 SERVING_QUANT_KV = "kv"
 SERVING_QUANT_KV_DEFAULT = "fp16"
 
+#############################################
+# Serving fleet (TPU extension; docs/serving.md "serving fleet")
+#############################################
+# Router + replicated ServeEngines + SLO autoscaling
+# (deepspeed_tpu/inference/fleet.py): one jax-free front door spawns N
+# replica subprocesses, balances admissions join-shortest-queue over
+# the replicas' heartbeat gauges, fails queued-but-unstarted requests
+# over on replica death, and scales the replica count against a
+# queue-wait SLO.
+FLEET = "fleet"
+# replicas launched at start() — the fleet's initial width
+FLEET_REPLICAS = "replicas"
+FLEET_REPLICAS_DEFAULT = 1
+# autoscale clamps: the router never retires below min_replicas and
+# never spawns above max_replicas (a runaway SLO breach must not fork
+# the host to death)
+FLEET_MIN_REPLICAS = "min_replicas"
+FLEET_MIN_REPLICAS_DEFAULT = 1
+FLEET_MAX_REPLICAS = "max_replicas"
+FLEET_MAX_REPLICAS_DEFAULT = 4
+# the SLO target: queue-wait (router submit -> replica admission) p99
+# the autoscaler defends
+FLEET_SLO_P99_S = "slo_p99_s"
+FLEET_SLO_P99_S_DEFAULT = 2.0
+# hysteresis windows: a breach (p99 over the SLO, or any request
+# waiting longer than it) must persist scale_up_window_s before a spawn;
+# slack (p99 under SLO/2 — or no waiters at all — with an empty router
+# queue) must persist scale_down_window_s before a retire.  Every scale
+# event resets both clocks, so the fleet can never flap inside a window.
+FLEET_SCALE_UP_WINDOW_S = "scale_up_window_s"
+FLEET_SCALE_UP_WINDOW_S_DEFAULT = 10.0
+FLEET_SCALE_DOWN_WINDOW_S = "scale_down_window_s"
+FLEET_SCALE_DOWN_WINDOW_S_DEFAULT = 30.0
+# a replica whose newest heartbeat is older than this is HUNG (wedged
+# device call with the process still alive): killed + failed over like
+# a dead one.  0 = heartbeat liveness off (process exits only).
+FLEET_HEARTBEAT_TIMEOUT_S = "heartbeat_timeout_s"
+FLEET_HEARTBEAT_TIMEOUT_S_DEFAULT = 60.0
+# crash-loop give-up budget: consecutive replica failures WITHOUT any
+# request completing in between before the router raises the typed
+# FleetGiveUpError (progress resets the count — a fleet serving for
+# days must not die on its max_restarts'th isolated blip)
+FLEET_MAX_RESTARTS = "max_restarts"
+FLEET_MAX_RESTARTS_DEFAULT = 3
+# exponential backoff between replica respawns (the elastic
+# supervisor's discipline, launcher/supervise.py)
+FLEET_BACKOFF_BASE_S = "backoff_base_s"
+FLEET_BACKOFF_BASE_S_DEFAULT = 1.0
+FLEET_BACKOFF_MAX_S = "backoff_max_s"
+FLEET_BACKOFF_MAX_S_DEFAULT = 30.0
+# a spawned replica must say hello within this budget or the spawn
+# counts as failed (jax import + model build + first compile all land
+# inside it — size generously for real models)
+FLEET_SPAWN_TIMEOUT_S = "spawn_timeout_s"
+FLEET_SPAWN_TIMEOUT_S_DEFAULT = 120.0
+# SIGTERM -> grace -> SIGKILL teardown window per replica
+FLEET_TERM_GRACE_S = "term_grace_s"
+FLEET_TERM_GRACE_S_DEFAULT = 5.0
+
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 PLD_ENABLED = "enabled"
 PLD_ENABLED_DEFAULT = False

@@ -9,6 +9,8 @@ and the ServeEngine (engine.py) whose ONE compiled decode program
 serves arbitrary request mixes with zero recompiles.
 """
 from .engine import ServeEngine  # noqa: F401
+from .fleet import (FleetGiveUpError, FleetRequest,  # noqa: F401
+                    FleetRouter, ReplicaFailure)
 from .kv_cache import (KVCacheSpec, PagedKVCacheSpec,  # noqa: F401
                        cache_partition_specs, cache_shardings,
                        init_cache, init_paged_cache,

@@ -1,0 +1,133 @@
+"""Minimal length-prefixed socket protocol for the serving fleet
+(docs/serving.md "serving fleet").
+
+One frame = a 4-byte big-endian length prefix + a UTF-8 JSON object.
+That is the ENTIRE protocol: the router stays import-light (stdlib
+only, no serialization deps) and a replica stays an ordinary
+``ServeEngine`` with a socket pump bolted on.  Frames are small host
+bookkeeping (token ids, rids, gauges) — never tensors — so JSON's
+overhead is noise next to a decode tick.
+
+Frame kinds (the ``kind`` key):
+
+  replica → router
+    ``hello``     {replica, pid}            connection handshake
+    ``admit``     {rid}                     the engine admitted rid —
+                                            the router stamps queue
+                                            wait NOW (the SLO signal)
+    ``token``     {rid, toks: [int, ...]}   newly generated tokens
+    ``done``      {rid, reason, tokens_total}
+    ``error``     {rid, error}              per-request failure
+  router → replica
+    ``submit``    {rid, prompt, max_new_tokens, eos_id}
+    ``shutdown``  {}                        drain in-flight, then exit 0
+
+Framing is torn-read safe by construction: :class:`FrameReader`
+buffers partial reads and yields only complete frames, so a
+non-blocking pump can feed it whatever ``recv`` returned.  An
+oversized or non-JSON frame raises :class:`WireError` — a corrupt
+stream must fail the CONNECTION (the router's failover path), never
+silently resync.
+"""
+from __future__ import annotations
+
+import json
+import select
+import socket
+import struct
+from collections import deque
+from typing import List, Tuple
+
+#: hard frame cap — a fleet frame is host bookkeeping, so anything
+#: megabytes long is a corrupt length prefix, not a real message
+MAX_FRAME_BYTES = 16 << 20
+
+_LEN = struct.Struct(">I")
+
+
+class WireError(RuntimeError):
+    """Corrupt framing (oversized length, non-JSON payload): the
+    connection is unrecoverable — tear it down and fail over."""
+
+
+def encode_frame(obj: dict) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(payload) > MAX_FRAME_BYTES:
+        raise WireError(f"frame of {len(payload)} bytes exceeds the "
+                        f"{MAX_FRAME_BYTES}-byte cap")
+    return _LEN.pack(len(payload)) + payload
+
+
+def send_frame(sock: socket.socket, obj: dict) -> None:
+    """Blocking send of one frame (``sendall`` — frames are small, and
+    a partial write would corrupt the stream for every later frame)."""
+    sock.sendall(encode_frame(obj))
+
+
+class FrameReader:
+    """Incremental decoder.  ``feed(data)`` buffers whatever a
+    (possibly non-blocking) ``recv`` returned and returns the complete
+    frames it closed over — zero, one, or many.  Frames a caller sets
+    aside (e.g. everything after a ``hello`` during the handshake)
+    ride ``pending`` until the next :func:`drain_socket`."""
+
+    def __init__(self):
+        self._buf = bytearray()
+        self.pending: deque = deque()
+
+    def feed(self, data: bytes) -> List[dict]:
+        self._buf.extend(data)
+        frames: List[dict] = []
+        while True:
+            if len(self._buf) < _LEN.size:
+                return frames
+            (n,) = _LEN.unpack_from(self._buf)
+            if n > MAX_FRAME_BYTES:
+                raise WireError(
+                    f"frame length {n} exceeds the {MAX_FRAME_BYTES}-"
+                    "byte cap (corrupt stream)")
+            if len(self._buf) < _LEN.size + n:
+                return frames
+            payload = bytes(self._buf[_LEN.size:_LEN.size + n])
+            del self._buf[:_LEN.size + n]
+            try:
+                obj = json.loads(payload.decode("utf-8"))
+            except (UnicodeDecodeError, ValueError) as e:
+                raise WireError(f"unparseable frame payload: {e}")
+            if not isinstance(obj, dict):
+                raise WireError(
+                    f"frame must be a JSON object, got "
+                    f"{type(obj).__name__}")
+            frames.append(obj)
+
+
+def drain_socket(sock: socket.socket,
+                 reader: FrameReader) -> Tuple[List[dict], bool]:
+    """Non-blocking drain: every complete frame currently readable
+    (including any the reader had pending), plus whether the peer
+    CLOSED the connection (EOF).  Works on blocking sockets too — each
+    ``recv`` is gated by a zero-timeout ``select``, so a drain never
+    stalls a single-threaded pump loop."""
+    frames: List[dict] = list(reader.pending)
+    reader.pending.clear()
+    closed = False
+    while True:
+        try:
+            readable, _, _ = select.select([sock], [], [], 0)
+        except (OSError, ValueError):
+            closed = True
+            break
+        if not readable:
+            break
+        try:
+            data = sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            break
+        except OSError:
+            closed = True
+            break
+        if not data:
+            closed = True
+            break
+        frames.extend(reader.feed(data))
+    return frames, closed

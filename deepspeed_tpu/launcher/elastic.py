@@ -32,6 +32,8 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from ..telemetry.heartbeat import StragglerMonitor, read_heartbeats
 from ..utils.logging import logger
+from .supervise import (backoff_delay, dump_supervisor_flightrec,
+                        sweep_heartbeat_files, terminate_with_grace)
 
 #: env vars the supervisor exports to every worker attempt
 ELASTIC_RESTART_ENV = "DS_ELASTIC_RESTART"
@@ -137,35 +139,14 @@ class ElasticSupervisor:
         self.events.append(ev)
 
     def _dump_flight_record(self, reason: str, error: str) -> None:
-        """Best-effort give-up post-mortem next to the heartbeat files
-        (``python -m deepspeed_tpu.telemetry diagnose <dir>`` reads it);
-        a supervisor out of options must never die on a dump failure."""
-        if not self.heartbeat_dir:
-            return
-        import json
-        import os
-        try:
-            os.makedirs(self.heartbeat_dir, exist_ok=True)
-            path = os.path.join(self.heartbeat_dir,
-                                "flightrec_supervisor.json")
-            payload = {
-                "version": 1, "reason": reason, "step": None,
-                "time": time.time(), "error": error,
-                "stages": {"supervisor": {
-                    "degraded": False, "failures": self.restarts,
-                    "max_failures": self.policy.max_restarts,
-                    "fallback": "give up (typed ElasticGiveUpError)",
-                    "surfaced": error, "events": list(self.events)}},
-                "extra": {"active_world": {h: list(s) for h, s
-                                           in self.active.items()}},
-            }
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(payload, f, default=repr)
-            os.replace(tmp, path)
-            logger.error("elastic: flight record dumped to %s", path)
-        except OSError as e:
-            logger.warning("elastic: flight-record dump failed: %s", e)
+        dump_supervisor_flightrec(
+            self.heartbeat_dir, supervisor="supervisor", reason=reason,
+            error=error, restarts=self.restarts,
+            max_restarts=self.policy.max_restarts,
+            fallback="give up (typed ElasticGiveUpError)",
+            events=self.events,
+            extra={"active_world": {h: list(s) for h, s
+                                    in self.active.items()}})
 
     # -- policy helpers -------------------------------------------------
     def total_slots(self) -> int:
@@ -227,9 +208,9 @@ class ElasticSupervisor:
             self.restarts += 1
             self._reprobe()
             self._check_viable(last_failure)
-            delay = min(
-                self.policy.backoff_base_s * (2 ** (self.restarts - 1)),
-                self.policy.backoff_max_s)
+            delay = backoff_delay(self.policy.backoff_base_s,
+                                  self.policy.backoff_max_s,
+                                  self.restarts)
             logger.info("elastic: backing off %.1fs before relaunch "
                         "(attempt %d/%d)", delay, self.restarts,
                         self.policy.max_restarts)
@@ -291,49 +272,13 @@ class ElasticSupervisor:
                       > self.heartbeat_timeout_s)
 
     def _kill(self, procs) -> None:
-        """SIGTERM the survivors (workers may run their preemption save
-        — the PR 5 hook), grace-wait, then SIGKILL the stubborn.  For
-        transports whose local client does not forward signals (plain
-        ssh/pdsh), ``remote_kill_fn`` then best-effort cleans the
-        remnant on the host itself — otherwise a hung worker keeps its
-        chips, coordinator port, and beat files into the next attempt."""
-        live = [(h, p) for h, p in procs if p.poll() is None]
-        for _, p in live:
-            try:
-                p.terminate()
-            except OSError:
-                pass
-        deadline = time.time() + self.term_grace_s
-        for _, p in live:
-            try:
-                p.wait(timeout=max(deadline - time.time(), 0.1))
-            except subprocess.TimeoutExpired:
-                try:
-                    p.kill()
-                    p.wait(timeout=5.0)
-                except (OSError, subprocess.TimeoutExpired):
-                    pass
-        if self.remote_kill_fn is not None:
-            for host in dict(live):
-                try:
-                    self.remote_kill_fn(host)
-                except Exception as e:
-                    logger.warning("elastic: remote cleanup of %s "
-                                   "failed: %s", host, e)
+        """SIGTERM → grace → SIGKILL + remote cleanup, via the shared
+        supervision helper (launcher/supervise.py)."""
+        terminate_with_grace(procs, self.term_grace_s,
+                             remote_kill_fn=self.remote_kill_fn)
 
     def _sweep_heartbeats(self) -> None:
-        """Clear stale beat files before a launch so liveness never
-        judges this attempt by the previous attempt's files."""
-        if not self.heartbeat_dir:
-            return
-        import glob
-        import os
-        for f in glob.glob(os.path.join(self.heartbeat_dir,
-                                        "heartbeat_*.json")):
-            try:
-                os.unlink(f)
-            except OSError:
-                pass
+        sweep_heartbeat_files(self.heartbeat_dir)
 
     def _reprobe(self) -> None:
         """Re-form the world from the hosts that still answer: dead

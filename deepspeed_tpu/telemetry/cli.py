@@ -7,7 +7,11 @@ latency attribution (queue/prefill/decode), liveness, and peak HBM;
 ``diagnose`` correlates a flight-record dump (``flightrec_<step>.json``)
 with events.jsonl and trace.json into a post-mortem — which stage
 failed first, the queue-depth trajectory, and the original exception
-(docs/observability.md).  Both tolerate a torn final line (a killed
+(docs/observability.md).  A serving-FLEET directory (a router's
+events.jsonl + ``replica_<id>/`` telemetry subdirs — docs/serving.md
+"serving fleet") additionally correlates per-replica flight records
+and the router's request ledger: first-failing replica, failover
+count, and dangling (submitted-but-never-completed) requests.  Both tolerate a torn final line (a killed
 run) and REPORT the skipped count instead of silently dropping it.
 This module is pure stdlib, but the ``-m`` entry point imports the
 ``deepspeed_tpu`` package (which imports jax) — on a box without the
@@ -493,6 +497,7 @@ def diagnose(directory: str, out=None) -> dict:
                 print(f"  stage {sname:<12} {evn} events", file=out)
 
     # -- events.jsonl correlation ---------------------------------------
+    records: List[dict] = []
     events_path = os.path.join(directory, "events.jsonl")
     if os.path.isfile(events_path):
         records, skipped = _read_jsonl_tolerant(events_path)
@@ -516,6 +521,76 @@ def diagnose(directory: str, out=None) -> dict:
                   file=out)
     else:
         print("  events.jsonl       not present", file=out)
+
+    # -- serving-fleet correlation (docs/serving.md "serving fleet") ----
+    # a fleet directory holds the router's events.jsonl (fleet_* kinds)
+    # plus one replica_<id>/ telemetry subdir per replica — correlate
+    # them into the fleet post-mortem: which replica failed first, how
+    # many requests failed over, and which never completed (dangling)
+    replica_dirs = sorted(
+        p for p in glob.glob(os.path.join(directory, "replica_*"))
+        if os.path.isdir(p))
+    fleet_kinds = any(str(r.get("kind", "")).startswith("fleet_")
+                      or r.get("kind") in ("replica_dead", "spawn")
+                      for r in records)
+    if replica_dirs or fleet_kinds:
+        submits = {r.get("rid") for r in records
+                   if r.get("kind") == "fleet_submit"}
+        completes = {r.get("rid") for r in records
+                     if r.get("kind") == "fleet_request"}
+        dangling = sorted(x for x in submits - completes
+                          if x is not None)
+        deaths = [r for r in records if r.get("kind") == "replica_dead"]
+        failovers = sum(int(r.get("failed_over") or 0) for r in deaths)
+        midstream = [r for r in records
+                     if r.get("kind") == "fleet_request"
+                     and r.get("error")]
+        report["fleet_replica_dirs"] = len(replica_dirs)
+        report["fleet_failover_count"] = failovers
+        report["fleet_dangling_requests"] = len(dangling)
+        report["fleet_failed_requests"] = len(midstream)
+        print(f"  fleet              {len(replica_dirs)} replica "
+              f"dir(s), {len(deaths)} replica death(s), {failovers} "
+              "request(s) failed over", file=out)
+        if deaths:
+            d0 = min(deaths, key=lambda r: r.get("t", 0))
+            report["fleet_first_dead_replica"] = d0.get("replica")
+            print(f"  first replica dead replica {d0.get('replica')} — "
+                  f"{d0.get('reason')}", file=out)
+        # earliest failure event across the replicas' own flight
+        # records: the corpse that started the cascade
+        first_fail = None
+        for rd in replica_dirs:
+            for path in glob.glob(os.path.join(rd, "flightrec_*.json")):
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                for sname, st in (doc.get("stages") or {}).items():
+                    for ev in st.get("events") or []:
+                        if ev.get("kind") in ("failure", "poison",
+                                              "surfaced", "job_failed"):
+                            key = (ev.get("t", 0), os.path.basename(rd),
+                                   sname, ev.get("error"))
+                            if first_fail is None or key < first_fail:
+                                first_fail = key
+        if first_fail is not None:
+            _, rname, sname, ferr = first_fail
+            report["fleet_first_failing_replica"] = rname
+            print(f"  first failing      {rname} (stage {sname!r}): "
+                  f"{ferr}", file=out)
+        if midstream:
+            m0 = midstream[0]
+            print(f"  mid-stream failed  {len(midstream)} request(s) "
+                  f"(first: rid={m0.get('rid')} {m0.get('error')})",
+                  file=out)
+        if dangling:
+            shown = ", ".join(str(x) for x in dangling[:8])
+            more = "..." if len(dangling) > 8 else ""
+            print(f"  DANGLING requests  {len(dangling)} submitted but "
+                  f"never completed (rid {shown}{more}) — in flight "
+                  "at the failure", file=out)
 
     # -- trace.json correlation -----------------------------------------
     trace_path = os.path.join(directory, "trace.json")
@@ -570,12 +645,16 @@ def main(argv=None) -> int:
     p_sum.add_argument("events", help="path to events.jsonl")
     p_diag = sub.add_parser(
         "diagnose",
-        help="post-mortem over a telemetry output dir: correlate "
-             "flightrec_*.json + events.jsonl + trace.json")
+        help="post-mortem over a telemetry output dir (or a serving-"
+             "fleet dir): correlate flightrec_*.json + events.jsonl + "
+             "trace.json, plus per-replica flight records and the "
+             "router request ledger for fleet dirs")
     p_diag.add_argument("directory",
                         help="telemetry output directory (holds "
                              "flightrec_*.json / events.jsonl / "
-                             "trace.json)")
+                             "trace.json) or a fleet directory "
+                             "(router events.jsonl + replica_<id>/ "
+                             "subdirs)")
     args = parser.parse_args(argv)
     if args.cmd == "summarize":
         try:

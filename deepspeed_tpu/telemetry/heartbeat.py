@@ -55,18 +55,30 @@ class HeartbeatWriter:
                            "heartbeats disabled", directory, e)
             self._warned = True
 
-    def beat(self, step: int, step_s: Optional[float] = None) -> bool:
+    def beat(self, step: int, step_s: Optional[float] = None,
+             extra: Optional[dict] = None) -> bool:
         """Emit one heartbeat; returns False when the write failed (a
         beat must never take training down — degraded liveness is the
-        monitor's problem to notice, via staleness)."""
+        monitor's problem to notice, via staleness).
+
+        ``extra`` rides additional gauges in the same record — the
+        serving fleet's replicas report ``serve_active_slots``, request
+        queue depth, ``serve_free_pages`` and the speculation accept
+        ratio this way, and the fleet router's join-shortest-queue
+        balancer reads them back (docs/serving.md "serving fleet").
+        Core liveness keys always win a collision, so a gauge can never
+        mask staleness; readers that predate the richer schema keep
+        working because they only key on the core fields."""
         now = time.time()
         if step_s is None and self._last_t is not None:
             step_s = now - self._last_t
         self._last_t = now
-        rec = {"host": self.host, "process_index": self.process_index,
-               "step": int(step), "time": now,
-               "step_s": (round(float(step_s), 6)
-                          if step_s is not None else None)}
+        rec = dict(extra or {})
+        rec.update({"host": self.host,
+                    "process_index": self.process_index,
+                    "step": int(step), "time": now,
+                    "step_s": (round(float(step_s), 6)
+                               if step_s is not None else None)})
         tmp = self.path + ".tmp"
         try:
             with open(tmp, "w") as f:

@@ -51,6 +51,7 @@ if [ "$1" = "--serve" ]; then
   run serve_paged python bench_serve.py --paged ab
   run serve_spec python bench_serve.py --spec ab
   run serve_quant python bench_serve.py --quant ab
+  run fleet python bench_serve.py --fleet ab
   exit 0
 fi
 # capacity runs LAST: its probes are subprocesses killed on timeout,
@@ -80,6 +81,11 @@ run serve_spec python bench_serve.py --spec ab
 # budget (int8 vs fp pages) + int8-weights params-HBM leg (pure CPU
 # capacity claims from the cache/param byte planes — docs/serving.md)
 run serve_quant python bench_serve.py --quant ab
+# serving-fleet A/B: router + replicated engine subprocesses — aggregate
+# tokens/s scales with replicas under identical injected per-tick device
+# time, plus the replica-kill + autoscale-up SLO-recovery trace (pure
+# CPU subprocess supervision — see docs/serving.md "serving fleet")
+run fleet python bench_serve.py --fleet ab
 run bert python bench_bert.py
 run sparse python bench_sparse.py
 run flash python bench_flash.py

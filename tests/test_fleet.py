@@ -1,0 +1,768 @@
+"""Serving fleet: wire framing, the router's JSQ/failover/autoscale
+semantics (fake socket replicas — the elastic supervisor's test
+idiom), heartbeat gauge payloads, fleet diagnose correlation, and the
+subprocess e2e bars (single-replica parity vs a bare ServeEngine;
+replica-kill failover) — docs/serving.md "serving fleet".
+"""
+import json
+import os
+import socket
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfigError
+from deepspeed_tpu.config.config import DeepSpeedFleetConfig
+from deepspeed_tpu.inference.fleet import (FleetClosedError,
+                                           FleetGiveUpError,
+                                           FleetRouter, ReplicaFailure)
+from deepspeed_tpu.inference.wire import (FrameReader, WireError,
+                                          drain_socket, encode_frame,
+                                          send_frame)
+from deepspeed_tpu.runtime.stages import reset_fault_injection
+from deepspeed_tpu.telemetry.heartbeat import (HeartbeatWriter,
+                                               StragglerMonitor,
+                                               beat_ages,
+                                               read_heartbeats)
+
+_CHAOS_ENVS = ("DS_STAGE_FAULT", "DS_STAGE_DELAY_S")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    for env in _CHAOS_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    reset_fault_injection()
+    yield
+    reset_fault_injection()
+
+
+# ---------------------------------------------------------------------------
+# wire framing
+# ---------------------------------------------------------------------------
+
+
+def test_wire_roundtrip_and_partial_feeds():
+    frames = [{"kind": "submit", "rid": 1, "prompt": [1, 2, 3]},
+              {"kind": "token", "rid": 1, "toks": [7]},
+              {"kind": "done", "rid": 1, "reason": "length"}]
+    blob = b"".join(encode_frame(f) for f in frames)
+    # byte-by-byte feeding must reassemble every frame exactly
+    r = FrameReader()
+    out = []
+    for i in range(len(blob)):
+        out.extend(r.feed(blob[i:i + 1]))
+    assert out == frames
+    # one big feed yields them all at once
+    r2 = FrameReader()
+    assert r2.feed(blob) == frames
+
+
+def test_wire_corrupt_stream_raises_typed():
+    r = FrameReader()
+    # oversized length prefix = corrupt stream, not a real frame
+    with pytest.raises(WireError):
+        r.feed(b"\xff\xff\xff\xff")
+    # valid length, non-JSON payload
+    import struct
+    r2 = FrameReader()
+    with pytest.raises(WireError):
+        r2.feed(struct.pack(">I", 4) + b"\x00\x01\x02\x03")
+    # valid JSON but not an object
+    r3 = FrameReader()
+    with pytest.raises(WireError):
+        r3.feed(struct.pack(">I", 3) + b"[1]")
+
+
+def test_wire_socket_pair_drain():
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, {"kind": "hello", "replica": 0})
+        send_frame(a, {"kind": "token", "rid": 2, "toks": [1, 2]})
+        reader = FrameReader()
+        frames, closed = drain_socket(b, reader)
+        assert [f["kind"] for f in frames] == ["hello", "token"]
+        assert not closed
+        a.close()
+        frames, closed = drain_socket(b, reader)
+        assert frames == [] and closed
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat serving gauges (the fleet's JSQ payload)
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_extra_gauges_roundtrip_and_core_keys_win(tmp_path):
+    w = HeartbeatWriter(str(tmp_path), process_index=3)
+    assert w.beat(7, step_s=0.5, extra={
+        "serve_active_slots": 2, "serve_queue_depth": 5,
+        "serve_free_pages": 11, "spec_accept_ratio": 0.75,
+        # a hostile gauge must never mask liveness: core keys win
+        "time": 1.0, "step": 999})
+    beats = read_heartbeats(str(tmp_path))
+    (rec,) = beats.values()
+    assert rec["serve_active_slots"] == 2
+    assert rec["serve_queue_depth"] == 5
+    assert rec["serve_free_pages"] == 11
+    assert rec["spec_accept_ratio"] == 0.75
+    assert rec["step"] == 7          # core beat fields won
+    assert rec["time"] > 1e9
+    # richer schema tolerated by every existing reader
+    ages = beat_ages(beats)
+    assert list(ages) and all(a >= 0 for a in ages.values())
+    rep = StragglerMonitor(ratio=2.0).update(beats)
+    assert rep["hosts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet config block
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_config_defaults_and_validation():
+    cfg = DeepSpeedFleetConfig({})
+    assert (cfg.replicas, cfg.min_replicas, cfg.max_replicas) == (1, 1, 4)
+    assert cfg.slo_p99_s == 2.0
+    cfg = DeepSpeedFleetConfig({"fleet": {"replicas": 2,
+                                          "max_replicas": 8,
+                                          "slo_p99_s": 0.5}})
+    assert cfg.replicas == 2 and cfg.slo_p99_s == 0.5
+    for bad in ({"replicas": 0}, {"min_replicas": 3, "max_replicas": 2},
+                {"replicas": 9}, {"slo_p99_s": 0},
+                {"scale_up_window_s": -1}, {"max_restarts": -1},
+                {"heartbeat_timeout_s": -2}, {"replicas": True},
+                {"backoff_base_s": "fast"}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedFleetConfig({"fleet": bad})
+
+
+# ---------------------------------------------------------------------------
+# router semantics over fake socket replicas (the launch_fn test seam)
+# ---------------------------------------------------------------------------
+
+
+class FakeProc:
+    """Popen-shaped handle the router supervises."""
+
+    def __init__(self):
+        self.rc = None
+        self.terminated = False
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+        if self.rc is None:
+            self.rc = -15
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        if self.rc is None:
+            raise subprocess.TimeoutExpired("fake", timeout or 0)
+        return self.rc
+
+
+class FakeReplica:
+    """A scripted replica: real socket to the router, test-driven
+    frames."""
+
+    def __init__(self, addr, replica_id):
+        self.id = replica_id
+        self.proc = FakeProc()
+        self.sock = socket.create_connection(addr, timeout=5.0)
+        self.sock.settimeout(5.0)
+        self.reader = FrameReader()
+        self.submits = []
+        self.saw_shutdown = False
+        send_frame(self.sock, {"kind": "hello", "replica": replica_id,
+                               "pid": 0})
+
+    def pump(self):
+        frames, _ = drain_socket(self.sock, self.reader)
+        self.submits.extend(f for f in frames
+                            if f.get("kind") == "submit")
+        if any(f.get("kind") == "shutdown" for f in frames):
+            self.saw_shutdown = True
+        return frames
+
+    def admit(self, rid):
+        send_frame(self.sock, {"kind": "admit", "rid": rid})
+
+    def tokens(self, rid, toks):
+        send_frame(self.sock, {"kind": "token", "rid": rid,
+                               "toks": list(toks)})
+
+    def done(self, rid, reason="length", total=None):
+        send_frame(self.sock, {"kind": "done", "rid": rid,
+                               "reason": reason,
+                               "tokens_total": total})
+
+    def error(self, rid, err="boom"):
+        send_frame(self.sock, {"kind": "error", "rid": rid,
+                               "error": err})
+
+    def die(self, rc=13):
+        self.proc.rc = rc
+        self.sock.close()
+
+
+class Fleet:
+    """Router + fake-replica harness with a fake autoscale clock."""
+
+    def __init__(self, tmp_path, fleet=None):
+        self.clock = [1000.0]
+        self.fakes = {}
+        # term_grace_s small: fake procs never exit on their own, and
+        # close()'s graceful-drain window would otherwise wait it out
+        cfg = {"fleet": {"heartbeat_timeout_s": 0.0,
+                         "backoff_base_s": 0.01,
+                         "term_grace_s": 0.2,
+                         "spawn_timeout_s": 1e9, **(fleet or {})}}
+        self.router = FleetRouter(
+            cfg, fleet_dir=str(tmp_path / "fleet"),
+            spawn_fn=self._spawn, now_fn=lambda: self.clock[0])
+
+    def _spawn(self, replica_id, attempt):
+        fake = FakeReplica(self.router.addr, replica_id)
+        self.fakes[replica_id] = fake
+        return fake.proc
+
+    def start(self):
+        self.router.start()
+        return self
+
+    def pump(self, n=6):
+        """A few router+fake iterations — localhost frames land fast,
+        but never assume a single poll saw them."""
+        for _ in range(n):
+            self.router.poll(0.01)
+            for f in self.fakes.values():
+                if f.proc.rc is None:
+                    f.pump()
+
+    def advance(self, dt):
+        self.clock[0] += dt
+
+
+def test_jsq_tie_breaks_deterministically_lowest_id(tmp_path):
+    fl = Fleet(tmp_path, {"replicas": 2, "max_replicas": 2}).start()
+    try:
+        reqs = [fl.router.submit([1, 2], max_new_tokens=4)
+                for _ in range(4)]
+        deadline = time.monotonic() + 5
+        while (len(fl.fakes[0].submits) + len(fl.fakes[1].submits) < 4
+               and time.monotonic() < deadline):
+            fl.pump(1)
+        # equal loads tie-break to the LOWEST replica id, alternating
+        # as outstanding counts grow: r0 gets rids 1,3 — r1 gets 2,4
+        assert [f["rid"] for f in fl.fakes[0].submits] == [1, 3]
+        assert [f["rid"] for f in fl.fakes[1].submits] == [2, 4]
+        assert [r.replica for r in reqs] == [0, 1, 0, 1]
+    finally:
+        fl.router.close()
+
+
+def test_jsq_reads_heartbeat_queue_gauges(tmp_path):
+    fl = Fleet(tmp_path, {"replicas": 2, "max_replicas": 2}).start()
+    try:
+        # replica 0 reports a deep engine-side queue via its beat: the
+        # next admission must go to replica 1 despite the id tie
+        w = HeartbeatWriter(fl.router.fleet_dir, process_index=0)
+        w.beat(1, extra={"serve_queue_depth": 5,
+                         "serve_active_slots": 2})
+        fl.router._last_beats_read = 0.0  # bypass the read throttle
+        fl.router.poll(0.01)
+        assert fl.router._beats[0]["serve_queue_depth"] == 5
+        fl.router.submit([1], max_new_tokens=2)
+        deadline = time.monotonic() + 5
+        while not fl.fakes[1].submits and time.monotonic() < deadline:
+            fl.pump(1)
+        assert [f["rid"] for f in fl.fakes[1].submits] == [1]
+        assert not fl.fakes[0].submits
+    finally:
+        fl.router.close()
+
+
+def test_failover_queued_vs_midstream(tmp_path):
+    """THE failover contract: a dead replica's queued-but-unstarted
+    requests re-dispatch (order preserved, completing normally); the
+    one whose tokens already streamed fails typed ReplicaFailure."""
+    fl = Fleet(tmp_path, {"replicas": 2, "max_replicas": 2}).start()
+    try:
+        r1 = fl.router.submit([1], max_new_tokens=4)
+        r2 = fl.router.submit([2], max_new_tokens=4)
+        r3 = fl.router.submit([3], max_new_tokens=4)
+        deadline = time.monotonic() + 5
+        while len(fl.fakes[0].submits) < 2 and \
+                time.monotonic() < deadline:
+            fl.pump(1)
+        assert [f["rid"] for f in fl.fakes[0].submits] == [1, 3]
+        # rid 1 starts streaming on replica 0; rid 3 stays queued there
+        fl.fakes[0].admit(1)
+        fl.fakes[0].tokens(1, [42, 43])
+        fl.pump()
+        assert r1.started and r1.tokens == [42, 43]
+        assert not r3.started
+        fl.fakes[0].die(13)
+        deadline = time.monotonic() + 5
+        while not r1.done.is_set() and time.monotonic() < deadline:
+            fl.pump(1)
+        # mid-stream: typed failure naming the replica
+        assert isinstance(r1.error, ReplicaFailure)
+        assert r1.error.replica == 0
+        with pytest.raises(ReplicaFailure):
+            r1.result(timeout=1)
+        # queued-but-unstarted: failed over to replica 1, completes
+        deadline = time.monotonic() + 5
+        while len(fl.fakes[1].submits) < 2 and \
+                time.monotonic() < deadline:
+            fl.pump(1)
+        assert [f["rid"] for f in fl.fakes[1].submits] == [2, 3]
+        assert r3.failovers == 1 and r3.error is None
+        fl.fakes[1].admit(2)
+        fl.fakes[1].tokens(2, [7])
+        fl.fakes[1].done(2, total=1)
+        fl.fakes[1].admit(3)
+        fl.fakes[1].tokens(3, [8, 9])
+        fl.fakes[1].done(3, total=2)
+        fl.pump()
+        assert r2.result(timeout=5) == [7]
+        assert r3.result(timeout=5) == [8, 9]
+        # a completed request resets the give-up budget
+        assert fl.router._consec_failures == 0
+    finally:
+        fl.router.close()
+
+
+def test_replica_error_frame_fails_one_request_only(tmp_path):
+    """Per-request isolation (the engine's Orca discipline, surfaced
+    through the wire): an ``error`` frame fails exactly that request —
+    the replica keeps its slot pool and the fleet keeps routing."""
+    fl = Fleet(tmp_path, {"replicas": 1, "max_replicas": 1}).start()
+    try:
+        r1 = fl.router.submit([1], max_new_tokens=2)
+        r2 = fl.router.submit([2], max_new_tokens=2)
+        deadline = time.monotonic() + 5
+        while len(fl.fakes[0].submits) < 2 and \
+                time.monotonic() < deadline:
+            fl.pump(1)
+        fl.fakes[0].error(1, "ValueError('empty prompt')")
+        fl.fakes[0].admit(2)
+        fl.fakes[0].tokens(2, [5])
+        fl.fakes[0].done(2, total=1)
+        fl.pump()
+        assert r1.error is not None and "empty prompt" in str(r1.error)
+        assert r2.result(timeout=5) == [5]
+        assert 0 in fl.router.replicas  # replica survived
+    finally:
+        fl.router.close()
+
+
+def test_autoscale_up_on_sustained_breach_with_hysteresis_and_max(
+        tmp_path):
+    fl = Fleet(tmp_path, {"replicas": 1, "max_replicas": 3,
+                          "slo_p99_s": 1.0, "scale_up_window_s": 10.0,
+                          "scale_down_window_s": 1e6}).start()
+    try:
+        # a request nobody admits: its age IS the breach signal (a
+        # wedged fleet produces no admission samples at all)
+        fl.router.submit([1], max_new_tokens=2)
+        fl.pump()
+        fl.advance(2.0)          # older than the SLO -> breach begins
+        fl.pump(1)
+        assert len(fl.router.replicas) == 1  # breach not sustained yet
+        fl.advance(5.0)
+        fl.pump(1)
+        assert len(fl.router.replicas) == 1  # still inside the window
+        fl.advance(6.0)          # breach sustained > scale_up_window_s
+        fl.pump(1)
+        assert len(fl.router.replicas) == 2  # scaled up
+        # hysteresis: the scale event reset the breach clock — no
+        # second spawn until ANOTHER full window of sustained breach
+        fl.advance(3.0)
+        fl.pump(2)
+        assert len(fl.router.replicas) == 2
+        fl.advance(11.0)
+        fl.pump(2)
+        assert len(fl.router.replicas) == 3
+        # max clamp: breach may rage on, the fleet stays at max
+        fl.advance(30.0)
+        fl.pump(3)
+        assert len(fl.router.replicas) == 3
+    finally:
+        fl.router.close()
+
+
+def test_autoscale_down_on_sustained_slack_with_min_clamp(tmp_path):
+    fl = Fleet(tmp_path, {"replicas": 2, "min_replicas": 1,
+                          "max_replicas": 2, "slo_p99_s": 1.0,
+                          "scale_up_window_s": 10.0,
+                          "scale_down_window_s": 20.0}).start()
+    try:
+        # serve one request quickly: a healthy, then idle, fleet
+        r = fl.router.submit([1], max_new_tokens=2)
+        deadline = time.monotonic() + 5
+        while not fl.fakes[0].submits and time.monotonic() < deadline:
+            fl.pump(1)
+        fl.fakes[0].admit(1)
+        fl.fakes[0].tokens(1, [3])
+        fl.fakes[0].done(1, total=1)
+        fl.pump()
+        assert r.result(timeout=5) == [3]
+        # slack begins; not sustained yet -> no retire
+        fl.advance(25.0)   # ages the wait sample out of both windows
+        fl.pump(1)
+        assert len(fl.router.replicas) == 2
+        fl.advance(21.0)   # slack sustained > scale_down_window_s
+        fl.pump(1)
+        draining = [rep for rep in fl.router.replicas.values()
+                    if rep.state == "draining"]
+        assert [rep.id for rep in draining] == [1]  # highest id drains
+        # the drained retiree exits 0 and is reaped
+        deadline = time.monotonic() + 5
+        while 1 in fl.router.replicas and time.monotonic() < deadline:
+            fl.fakes[1].pump()
+            if fl.fakes[1].saw_shutdown:
+                fl.fakes[1].proc.rc = 0
+            fl.router.poll(0.01)
+        assert sorted(fl.router.replicas) == [0]
+        # min clamp: slack forever, but the floor holds
+        fl.advance(50.0)
+        fl.pump(2)
+        fl.advance(50.0)
+        fl.pump(2)
+        assert sorted(fl.router.replicas) == [0]
+    finally:
+        fl.router.close()
+
+
+def test_give_up_typed_after_consecutive_spawn_failures(tmp_path):
+    calls = []
+
+    def bad_spawn(replica_id, attempt):
+        calls.append(replica_id)
+        raise RuntimeError("no capacity")
+
+    router = FleetRouter(
+        {"fleet": {"replicas": 1, "max_restarts": 2,
+                   "backoff_base_s": 0.01, "backoff_max_s": 0.02}},
+        fleet_dir=str(tmp_path / "fleet"), spawn_fn=bad_spawn)
+    queued = router.submit([1], max_new_tokens=2)
+    with pytest.raises(FleetGiveUpError) as ei:
+        router.start()
+    assert ei.value.restarts == 3          # budget 2 -> third strike
+    assert "no capacity" in ei.value.last_failure
+    assert len(calls) == 3
+    # the give-up failed every in-flight request typed and dumped the
+    # supervisor flight record for the post-mortem
+    assert isinstance(queued.error, FleetGiveUpError)
+    rec_path = os.path.join(router.fleet_dir,
+                            "flightrec_supervisor.json")
+    with open(rec_path) as f:
+        rec = json.load(f)
+    assert rec["stages"]["fleet"]["events"]
+    # closed: further submits are refused
+    with pytest.raises(RuntimeError):
+        router.submit([1])
+
+
+def test_spawn_timeout_counts_as_failure(tmp_path):
+    """A replica that never says hello is a failed spawn: killed,
+    counted against the give-up budget."""
+    fl = Fleet(tmp_path, {"replicas": 1, "max_restarts": 0})
+    fl.router.cfg = DeepSpeedFleetConfig(
+        {"fleet": {"replicas": 1, "max_restarts": 0,
+                   "spawn_timeout_s": 5.0, "backoff_base_s": 0.01}})
+
+    def mute_spawn(replica_id, attempt):
+        proc = FakeProc()
+        fl.fakes[replica_id] = type("F", (), {"proc": proc})()
+        return proc
+
+    fl.router.spawn_fn = mute_spawn
+    fl.router._spawn("initial")
+    fl.advance(6.0)  # past spawn_timeout_s
+    with pytest.raises(FleetGiveUpError):
+        fl.router.poll(0.01)
+
+
+def test_garbage_connection_cannot_crash_router(tmp_path):
+    """A port scanner (or corrupt framing) on the router's listen port
+    fails ITSELF — poll keeps routing and real replicas keep serving."""
+    fl = Fleet(tmp_path, {"replicas": 1, "max_replicas": 1}).start()
+    try:
+        scanner = socket.create_connection(fl.router.addr, timeout=5.0)
+        scanner.sendall(b"\xff\xff\xff\xffGARBAGE")  # >16MiB length prefix
+        fl.pump()  # must not raise
+        r1 = fl.router.submit([1], max_new_tokens=2)
+        deadline = time.monotonic() + 5
+        while not fl.fakes[0].submits and time.monotonic() < deadline:
+            fl.pump(1)
+        fl.fakes[0].admit(1)
+        fl.fakes[0].tokens(1, [9])
+        fl.fakes[0].done(1, total=1)
+        fl.pump()
+        assert r1.result(timeout=5) == [9]
+        scanner.close()
+    finally:
+        fl.router.close()
+
+
+def test_close_fails_inflight_typed_and_is_idempotent(tmp_path):
+    fl = Fleet(tmp_path, {"replicas": 1, "max_replicas": 1}).start()
+    r1 = fl.router.submit([1], max_new_tokens=2)
+    fl.pump()
+    fl.router.close()
+    assert isinstance(r1.error, FleetClosedError)
+    with pytest.raises(FleetClosedError):
+        r1.result(timeout=1)
+    fl.router.close()  # idempotent
+    assert fl.fakes[0].proc.rc is not None  # replica torn down
+
+
+def test_fleet_events_ledger_and_heartbeat_age_metrics(tmp_path):
+    """The router's events.jsonl is the fleet's request ledger +
+    per-replica liveness export: every submit has a completion record,
+    and metrics records carry heartbeat_age_s{replica=...}."""
+    fl = Fleet(tmp_path, {"replicas": 1, "max_replicas": 1}).start()
+    try:
+        w = HeartbeatWriter(fl.router.fleet_dir, process_index=0)
+        w.beat(1, extra={"serve_active_slots": 0})
+        r1 = fl.router.submit([1], max_new_tokens=2)
+        deadline = time.monotonic() + 5
+        while not fl.fakes[0].submits and time.monotonic() < deadline:
+            fl.pump(1)
+        fl.fakes[0].admit(1)
+        fl.fakes[0].tokens(1, [4])
+        fl.fakes[0].done(1, total=1)
+        fl.pump()
+        assert r1.result(timeout=5) == [4]
+        fl.router._last_beats_read = 0.0
+        fl.router._last_metrics_write = 0.0
+        fl.router.poll(0.01)
+    finally:
+        fl.router.close()
+    recs = []
+    with open(os.path.join(fl.router.fleet_dir, "events.jsonl")) as f:
+        for line in f:
+            recs.append(json.loads(line))
+    kinds = [r["kind"] for r in recs]
+    assert "fleet_submit" in kinds and "fleet_request" in kinds
+    done = next(r for r in recs if r["kind"] == "fleet_request")
+    assert done["rid"] == 1 and done["error"] is None
+    assert done["queue_wait_s"] is not None
+    # the LAST metrics record: the first may predate the beat file
+    mrec = [r for r in recs if r["kind"] == "metrics"][-1]
+    ages = [m for m in mrec["metrics"]
+            if m["name"] == "heartbeat_age_s"]
+    assert ages and ages[0]["labels"]["replica"] == "0"
+    assert ages[0]["value"] is not None and ages[0]["value"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# diagnose: the fleet-directory post-mortem
+# ---------------------------------------------------------------------------
+
+
+def test_diagnose_fleet_directory_correlation(tmp_path, capsys):
+    from deepspeed_tpu.telemetry.cli import diagnose
+    d = tmp_path / "fleet"
+    (d / "replica_0").mkdir(parents=True)
+    (d / "replica_1").mkdir()
+    with open(d / "replica_0" / "flightrec_5.json", "w") as f:
+        json.dump({"version": 1, "reason": "serve poison", "step": 5,
+                   "error": "RuntimeError('boom')",
+                   "stages": {"serve": {"events": [
+                       {"t": 100.0, "kind": "poison",
+                        "error": "RuntimeError('boom')"}]}}}, f)
+    events = [
+        {"kind": "fleet_submit", "t": 99.0, "rid": 1},
+        {"kind": "fleet_submit", "t": 99.1, "rid": 2},
+        {"kind": "fleet_submit", "t": 99.2, "rid": 3},
+        {"kind": "replica_dead", "t": 100.5, "replica": 0,
+         "reason": "replica 0 exited rc=13", "failed_over": 1},
+        {"kind": "fleet_request", "t": 101.0, "rid": 1,
+         "error": "ReplicaFailure('mid-stream')", "started": True,
+         "failovers": 0},
+        {"kind": "fleet_request", "t": 101.5, "rid": 2, "error": None,
+         "started": True, "failovers": 1, "queue_wait_s": 0.3},
+    ]
+    with open(d / "events.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    report = diagnose(str(d))
+    out = capsys.readouterr().out
+    assert report["fleet_replica_dirs"] == 2
+    assert report["fleet_failover_count"] == 1
+    assert report["fleet_dangling_requests"] == 1   # rid 3 never done
+    assert report["fleet_failed_requests"] == 1
+    assert report["fleet_first_dead_replica"] == 0
+    assert report["fleet_first_failing_replica"] == "replica_0"
+    assert "failed over" in out and "DANGLING" in out
+    assert "replica_0" in out
+
+
+def test_diagnose_non_fleet_dir_unchanged(tmp_path, capsys):
+    """A plain telemetry dir must not grow fleet rows."""
+    from deepspeed_tpu.telemetry.cli import diagnose
+    with open(tmp_path / "events.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "step", "step": 1}) + "\n")
+    report = diagnose(str(tmp_path))
+    out = capsys.readouterr().out
+    assert "failed over" not in out and "DANGLING" not in out
+    assert "fleet_failover_count" not in report
+    assert "fleet_replica_dirs" not in report
+
+
+# ---------------------------------------------------------------------------
+# subprocess e2e: real replicas behind the router
+# ---------------------------------------------------------------------------
+
+
+def _e2e_config(replicas, *, slots=4, telemetry=False, **fleet_over):
+    return {
+        "serving": {"slots": slots, "max_seq_len": 64,
+                    "prefill_len": 8, "queue_capacity": 256,
+                    "flush_interval_ticks": 5},
+        "telemetry": {"enabled": telemetry},
+        "fleet": {"replicas": replicas, "min_replicas": 1,
+                  "max_replicas": max(replicas, 2),
+                  "slo_p99_s": 30.0, "scale_up_window_s": 5.0,
+                  "scale_down_window_s": 600.0,
+                  "spawn_timeout_s": 120.0, "backoff_base_s": 0.2,
+                  "heartbeat_timeout_s": 60.0, **fleet_over},
+        "fleet_model": {"vocab_size": 128, "n_positions": 64,
+                        "d_model": 32, "n_layer": 2, "n_head": 4,
+                        "attn_impl": "dense", "seed": 0},
+    }
+
+
+def _prompts(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [[int(t) for t in rng.integers(0, 128, (5,))]
+            for _ in range(n)]
+
+
+def test_e2e_single_replica_fleet_matches_bare_engine(tmp_path):
+    """The parity bar: a 1-replica fleet emits the SAME greedy stream
+    as a bare ServeEngine for the same request trace (the replica
+    builds identical params from the shared fleet_model seed), and the
+    replica's zero-recompile property survives the wire."""
+    from deepspeed_tpu.inference.replica import build_engine
+    cfg = _e2e_config(1, telemetry=True)
+    prompts = _prompts(6)
+
+    eng = build_engine(cfg, str(tmp_path / "bare"), 99)
+    bare = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_idle()
+    bare_toks = [r.tokens for r in bare]
+    bare_reasons = [r.finish_reason for r in bare]
+    eng.close()
+
+    d = str(tmp_path / "fleet")
+    router = FleetRouter(cfg, fleet_dir=d)
+    try:
+        router.start()
+        reqs = [router.submit(p, max_new_tokens=6) for p in prompts]
+        router.run_until_idle(max_s=120)
+        assert [r.tokens for r in reqs] == bare_toks
+        assert [r.finish_reason for r in reqs] == bare_reasons
+        assert all(r.queue_wait_s is not None for r in reqs)
+    finally:
+        router.close()
+    # the replica's telemetry landed in its own subdir; its compile
+    # tracking pins the decode program at zero recompiles through the
+    # whole mixed trace (the bare-engine contract, preserved per
+    # replica)
+    rep_dir = os.path.join(d, "replica_0")
+    assert os.path.isdir(rep_dir)
+    prom = os.path.join(rep_dir, "metrics.prom")
+    if os.path.isfile(prom):
+        with open(prom) as f:
+            for line in f:
+                if line.startswith("recompiles_total") \
+                        and "decode_step" in line:
+                    assert float(line.rsplit(None, 1)[1]) == 0.0
+
+
+def test_e2e_burst_larger_than_engine_queue_capacity(tmp_path):
+    """Overload regression: the router dispatches unbounded, but the
+    replica's engine queue is a BLOCKING bounded channel — a burst
+    beyond serving.queue_capacity must park in the replica's host-side
+    backlog and drain as the engine steps, never deadlock the
+    single-threaded replica loop."""
+    cfg = _e2e_config(1, slots=2)
+    cfg["serving"]["queue_capacity"] = 4
+    router = FleetRouter(cfg, fleet_dir=str(tmp_path / "fleet"))
+    try:
+        router.start()
+        reqs = [router.submit(p, max_new_tokens=4)
+                for p in _prompts(12, seed=5)]   # 3x the queue bound
+        router.run_until_idle(max_s=120)
+        assert all(r.error is None for r in reqs), \
+            [repr(r.error) for r in reqs if r.error]
+        assert all(len(r.tokens) == 4 for r in reqs)
+    finally:
+        router.close()
+
+
+def test_e2e_replica_kill_fails_over_unstarted(tmp_path,
+                                               monkeypatch):
+    """Kill one of two REAL replicas mid-stream: every queued-but-
+    unstarted request completes via failover (zero lost), mid-stream
+    casualties fail typed, and the ledger agrees."""
+    # slow the serving ticks so the kill reliably lands mid-stream
+    monkeypatch.setenv("DS_STAGE_DELAY_S", "serve:0.05")
+    reset_fault_injection()
+    cfg = _e2e_config(2, slots=2)
+    d = str(tmp_path / "fleet")
+    router = FleetRouter(cfg, fleet_dir=d)
+    try:
+        router.start()
+        initial = sorted(router.replicas)
+        reqs = [router.submit(p, max_new_tokens=8)
+                for p in _prompts(16, seed=3)]
+        # wait until both replicas are streaming (started requests on
+        # each), so the kill hits a mix of started + queued work
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            router.poll(0.02)
+            started_by = {rid: any(r.started and r.replica == rid
+                                   for r in reqs)
+                          for rid in initial}
+            if all(started_by.values()):
+                break
+        assert all(started_by.values()), "replicas never streamed"
+        victim = max(router.replicas.values(),
+                     key=lambda r: len(r.outstanding)).id
+        router.kill_replica(victim)
+        router.run_until_idle(max_s=120)
+        failed = [r for r in reqs if r.error is not None]
+        # zero queued-but-unstarted requests lost
+        assert all(r.started for r in failed)
+        assert all(isinstance(r.error, ReplicaFailure) for r in failed)
+        survivors = [r for r in reqs if r.error is None]
+        assert survivors and all(len(r.tokens) == 8 for r in survivors)
+        assert sum(r.failovers for r in reqs) > 0
+    finally:
+        router.close()
+    # the ledger agrees: every submit completed, failures all started
+    recs = []
+    with open(os.path.join(d, "events.jsonl")) as f:
+        for line in f:
+            recs.append(json.loads(line))
+    submits = [r for r in recs if r["kind"] == "fleet_submit"]
+    dones = {r["rid"]: r for r in recs
+             if r["kind"] == "fleet_request"}
+    assert len(dones) == len(submits)
+    assert all(r["started"] for r in dones.values() if r["error"])
+    assert any(r["kind"] == "replica_dead" and r["failed_over"] > 0
+               for r in recs)

@@ -54,6 +54,11 @@ METRIC_DIRECTIONS = {
     # nothing in the name says "speedup" (docs/serving.md "quantized
     # serving")
     "serve_quant_admitted_ratio": False,
+    # aggregate fleet tokens/s at 2 replicas vs 1 under identical
+    # injected per-tick device time: throughput scales with the
+    # replica count — HIGHER is better (docs/serving.md "serving
+    # fleet")
+    "fleet_scaling_tokens_ratio": False,
 }
 
 

@@ -392,6 +392,54 @@ class DeepSpeedStagesConfig:
                 f"degrades), got {self.max_stage_failures!r}")
 
 
+class DeepSpeedOffloadConfig:
+    """Offload-tier block (runtime/disk_offload.py, docs/stages.md):
+    selects which tier holds the fp32 master + Adam moments under the
+    host offload impl — host RAM ("host", the default) or per-leaf
+    CRC'd files on disk ("disk", the ZeRO-Infinity bottom tier).
+    Validates eagerly: a typo'd tier or a missing disk_dir must fail at
+    config parse, not as a mid-run surprise after the first step."""
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        off = param_dict.get(C.OFFLOAD) or {}
+        if not isinstance(off, dict):
+            raise DeepSpeedConfigError(
+                f"{C.OFFLOAD} must be a dict, got {type(off)}")
+        self.tier = get_scalar_param(
+            off, C.OFFLOAD_TIER, C.OFFLOAD_TIER_DEFAULT)
+        self.disk_dir = get_scalar_param(
+            off, C.OFFLOAD_DISK_DIR, C.OFFLOAD_DISK_DIR_DEFAULT)
+        self.io_depth = get_scalar_param(
+            off, C.OFFLOAD_IO_DEPTH, C.OFFLOAD_IO_DEPTH_DEFAULT)
+        self.fsync = get_scalar_param(
+            off, C.OFFLOAD_FSYNC, C.OFFLOAD_FSYNC_DEFAULT)
+        if self.tier not in ("host", "disk"):
+            raise DeepSpeedConfigError(
+                f"{C.OFFLOAD}.{C.OFFLOAD_TIER} must be 'host' or 'disk', "
+                f"got {self.tier!r}")
+        if (not isinstance(self.io_depth, int)
+                or isinstance(self.io_depth, bool) or self.io_depth < 1):
+            raise DeepSpeedConfigError(
+                f"{C.OFFLOAD}.{C.OFFLOAD_IO_DEPTH} must be an int >= 1 "
+                f"(bounded disk read-ahead/write-back depth), got "
+                f"{self.io_depth!r}")
+        if not isinstance(self.fsync, bool):
+            raise DeepSpeedConfigError(
+                f"{C.OFFLOAD}.{C.OFFLOAD_FSYNC} must be a bool, got "
+                f"{self.fsync!r}")
+        if self.tier == "disk":
+            if not isinstance(self.disk_dir, str) or not self.disk_dir:
+                raise DeepSpeedConfigError(
+                    f"{C.OFFLOAD}.{C.OFFLOAD_TIER}='disk' requires "
+                    f"{C.OFFLOAD}.{C.OFFLOAD_DISK_DIR} (the directory "
+                    "holding the per-leaf master/moment state files)")
+        elif self.disk_dir is not None and not isinstance(
+                self.disk_dir, str):
+            raise DeepSpeedConfigError(
+                f"{C.OFFLOAD}.{C.OFFLOAD_DISK_DIR} must be a string path, "
+                f"got {self.disk_dir!r}")
+
+
 class DeepSpeedServingConfig:
     """Serving block (docs/serving.md): the static slot pool the
     KV-cached decode engine compiles ONE program against.  Everything
@@ -790,6 +838,7 @@ class DeepSpeedConfig:
         self.data_prefetch_config = DeepSpeedDataPrefetchConfig(pd)
         self.checkpoint_config = DeepSpeedCheckpointConfig(pd)
         self.stages_config = DeepSpeedStagesConfig(pd)
+        self.offload_config = DeepSpeedOffloadConfig(pd)
         self.serving_config = DeepSpeedServingConfig(pd)
         self.fleet_config = DeepSpeedFleetConfig(pd)
         self.pipeline_config = DeepSpeedPipelineConfig(pd)
@@ -902,6 +951,17 @@ class DeepSpeedConfig:
                 raise DeepSpeedConfigError(
                     "param_streaming is an xla-tier capacity mode "
                     "(offload_impl 'xla' or 'auto')")
+        if self.offload_config.tier == "disk":
+            if not self.zero_config.cpu_offload:
+                raise DeepSpeedConfigError(
+                    "offload.tier='disk' requires "
+                    "zero_optimization.cpu_offload (the disk tier sits "
+                    "below the host offload plane)")
+            if self.zero_config.offload_impl == "xla":
+                raise DeepSpeedConfigError(
+                    "offload.tier='disk' is a host-impl structure "
+                    "(per-leaf C++ Adam over disk-resident state); "
+                    "offload_impl must be 'host' or 'auto'")
         if self.optimizer_name is not None and self.optimizer_name in (
                 C.ONEBIT_ADAM_OPTIMIZER,) and not (self.fp16_enabled or self.bf16_enabled):
             raise DeepSpeedConfigError("onebitadam requires fp16 or bf16")

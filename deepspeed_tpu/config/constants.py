@@ -428,6 +428,35 @@ STAGES_MAX_FAILURES = "max_stage_failures"
 STAGES_MAX_FAILURES_DEFAULT = 3
 
 #############################################
+# Offload tier selection (ZeRO-Infinity disk tier; docs/stages.md)
+#############################################
+# Which tier holds the fp32 master params + Adam moments under
+# cpu_offload with the host impl: "host" keeps them in host RAM (the
+# PR 3 host tier), "disk" streams them through per-leaf CRC'd files in
+# ``disk_dir`` (runtime/disk_offload.py) — host RAM then holds only a
+# bounded window of leaves, so trainable size is capped by disk, not
+# RAM.
+OFFLOAD = "offload"
+OFFLOAD_TIER = "tier"
+OFFLOAD_TIER_DEFAULT = "host"
+# directory for the disk tier's per-leaf state files (REQUIRED when
+# tier == "disk"; created if missing).
+OFFLOAD_DISK_DIR = "disk_dir"
+OFFLOAD_DISK_DIR_DEFAULT = None
+# bounded read-ahead/write-back depth of the disk pipeline: at most
+# io_depth leaf states are prefetched from disk (and at most io_depth
+# queued for write-back) while the C++ Adam runs — THE knob bounding
+# resident host bytes to ~(2*io_depth + 1) leaf states.
+OFFLOAD_IO_DEPTH = "io_depth"
+OFFLOAD_IO_DEPTH_DEFAULT = 2
+# per-file fsync before the atomic rename of each leaf-state write
+# (power-loss durability; the DS_CKPT_FSYNC discipline).  The
+# DS_DISK_FSYNC env var (default on; tests set 0) can force it off
+# without a config edit — see runtime/disk_offload.py.
+OFFLOAD_FSYNC = "fsync"
+OFFLOAD_FSYNC_DEFAULT = True
+
+#############################################
 # Serving / inference engine (TPU extension; docs/serving.md)
 #############################################
 # The KV-cached decode engine with static-shape continuous batching

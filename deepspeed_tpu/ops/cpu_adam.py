@@ -49,6 +49,13 @@ def is_adam_float(dtype) -> bool:
             or dt.name.startswith(("bfloat", "float8", "float4", "float6")))
 
 
+def lowp_np_kind(out_dtype: Optional[str]) -> int:
+    """None | 'bfloat16' | 'float16' → the kernel's lowp selector (the
+    mapping ``step_leaves`` and the disk tier share)."""
+    return {None: _LOWP_NONE, "bfloat16": _LOWP_BF16,
+            "float16": _LOWP_FP16}[out_dtype]
+
+
 def _np_ptr(a: np.ndarray, typ):
     return a.ctypes.data_as(typ)
 
@@ -121,6 +128,33 @@ class DeepSpeedCPUAdam:
         return (jax.tree.unflatten(treedef, outs)
                 if out_dtype is not None else None)
 
+    def apply_leaf(self, flat_p, flat_g, m, v, lr, lowp_kind):
+        """ONE leaf's fused Adam against caller-provided flat fp32
+        buffers (params/moments updated IN PLACE; ``self.step_count``
+        must already be advanced by the caller).  The single kernel
+        entry both ``step_leaves`` and the disk offload tier
+        (runtime/disk_offload.py) call — which is what makes the disk
+        tier's update BITWISE the host tier's: same native call, same
+        numpy fallback, no third implementation.  Returns the uint16
+        low-precision output buffer (empty when ``lowp_kind`` is
+        ``_LOWP_NONE``)."""
+        out = (np.empty(flat_p.shape, np.uint16)
+               if lowp_kind else np.empty(0, np.uint16))
+        if self._lib is not None:
+            fp = ctypes.POINTER(ctypes.c_float)
+            u16 = ctypes.POINTER(ctypes.c_uint16)
+            self._lib.ds_cpu_adam_step(
+                flat_p.size, _np_ptr(flat_p, fp),
+                _np_ptr(flat_g, fp),
+                _np_ptr(m, fp), _np_ptr(v, fp),
+                lr, self.betas[0], self.betas[1], self.eps,
+                self.weight_decay, int(self.adamw_mode),
+                int(self.bias_correction), self.step_count,
+                _np_ptr(out, u16), lowp_kind)
+        else:
+            self._numpy_step(flat_p, flat_g, m, v, lr, out, lowp_kind)
+        return out
+
     def step_leaves(self, params, grads, out_dtype=None, leaf_get=None,
                     leaf_span=None):
         """Per-leaf generator form of ``step``: yields ``(i, out_leaf)``
@@ -143,8 +177,7 @@ class DeepSpeedCPUAdam:
         p_leaves = jax.tree.leaves(params)
         g_leaves = jax.tree.leaves(grads)
         assert len(p_leaves) == len(g_leaves)
-        lowp_kind = {None: _LOWP_NONE, "bfloat16": _LOWP_BF16,
-                     "float16": _LOWP_FP16}[out_dtype]
+        lowp_kind = lowp_np_kind(out_dtype)
         for i, (p, g) in enumerate(zip(p_leaves, g_leaves)):
             if p.dtype != np.float32:
                 # non-floating state (step counters, int buffers): no Adam
@@ -163,22 +196,8 @@ class DeepSpeedCPUAdam:
                 flat_p = p.reshape(-1)
                 flat_g = np.ascontiguousarray(
                     np.asarray(leaf_get(g), dtype=np.float32).reshape(-1))
-                out = (np.empty(flat_p.shape, np.uint16)
-                       if lowp_kind else np.empty(0, np.uint16))
-                if self._lib is not None:
-                    fp = ctypes.POINTER(ctypes.c_float)
-                    u16 = ctypes.POINTER(ctypes.c_uint16)
-                    self._lib.ds_cpu_adam_step(
-                        flat_p.size, _np_ptr(flat_p, fp),
-                        _np_ptr(flat_g, fp),
-                        _np_ptr(m.reshape(-1), fp), _np_ptr(v.reshape(-1), fp),
-                        lr, self.betas[0], self.betas[1], self.eps,
-                        self.weight_decay, int(self.adamw_mode),
-                        int(self.bias_correction), self.step_count,
-                        _np_ptr(out, u16), lowp_kind)
-                else:
-                    self._numpy_step(flat_p, flat_g, m.reshape(-1),
-                                     v.reshape(-1), lr, out, lowp_kind)
+                out = self.apply_leaf(flat_p, flat_g, m.reshape(-1),
+                                      v.reshape(-1), lr, lowp_kind)
                 out_leaf = (out.view(lowp_np_dtype(out_dtype))
                             .reshape(p.shape) if lowp_kind else None)
             yield i, out_leaf

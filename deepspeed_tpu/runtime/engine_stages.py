@@ -13,11 +13,14 @@ THE drain order (rationale in docs/stages.md): stop producers of
 droppable work first, wait out durability consumers after, flush
 telemetry last so it still sees every stage's final spans/counters —
 
-    prefetch -> offload uploads -> ckpt writer -> telemetry flush
+    prefetch -> offload uploads -> disk write-back -> ckpt writer
+             -> telemetry flush
 
 Prefetched batches are droppable and uploads never outlive their step
-call; an in-flight checkpoint save is not droppable, so its stage
-drains (and surfaces failures) before anything flushes.
+call; the disk tier's write-back workers are joined before their step
+returns (a mid-step close aborts them and the step poisons); an
+in-flight checkpoint save is not droppable, so its stage drains (and
+surfaces failures) before anything flushes.
 """
 from __future__ import annotations
 
@@ -29,6 +32,8 @@ from .stages import Stage, StageGraph
 ENGINE_STAGES = (
     ("prefetch", "inline iteration"),
     ("offload_h2d", "the serial offload update"),
+    ("disk_read", "the serial read-update-write loop"),
+    ("disk_write", "the serial read-update-write loop"),
     ("ckpt_writer", "synchronous saves"),
 )
 
@@ -82,6 +87,9 @@ def wire_stage_plane(engine) -> None:
     graph.register("offload_uploads",
                    close=lambda: close_upload_stage(engine),
                    drain=lambda: None)  # never outlives its step call
+    graph.register("disk_writeback",
+                   close=lambda: close_disk_stage(engine),
+                   drain=lambda: None)  # joined before step returns
     graph.register("ckpt_writer",
                    close=lambda: close_ckpt_stage(engine),
                    drain=lambda: drain_ckpt_stage(engine))
@@ -163,6 +171,19 @@ def close_upload_stage(engine) -> None:
     up = getattr(engine, "_active_uploader", None)
     if up is not None:
         up.abort()
+
+
+def close_disk_stage(engine) -> None:
+    """Abort a mid-flight disk-tier read-ahead/write-back pipeline (a
+    close landing inside a step from another thread/signal handler):
+    the channels close, the step raises and poisons — per-leaf files
+    before the abort point hold step t, later ones t-1, which is
+    exactly the inconsistency ``load_state_tree`` (checkpoint restore)
+    heals by rewriting every leaf.  A between-steps close is a no-op:
+    the pipeline workers never outlive their ``step`` call."""
+    opt = getattr(engine, "_host_opt", None)
+    if opt is not None and hasattr(opt, "abort_inflight"):
+        opt.abort_inflight()
 
 
 def drain_ckpt_stage(engine) -> None:

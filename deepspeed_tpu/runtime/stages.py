@@ -69,11 +69,13 @@ Fault injection (one chaos harness for every stage boundary):
   ``offload_h2d``; ``DS_CKPT_DELAY_S`` == delay of stage ``ckpt``.
 
 Stage names and points currently wired: ``prefetch:place``,
-``offload_h2d:put``, ``offload_pull:pull``, ``ckpt_writer:job``, the
-``ckpt`` write points (leaf/shard_index/manifest/meta/rename/
-latest/read) that live inside ``runtime/checkpointing.py``, and the
-serving engine's ``serve:admit`` / ``serve:step``
-(deepspeed_tpu/inference/engine.py, docs/serving.md).
+``offload_h2d:put``, ``offload_pull:pull``, the disk offload tier's
+``disk_read:read`` / ``disk_write:write`` (runtime/disk_offload.py),
+``ckpt_writer:job``, the ``ckpt`` write points
+(leaf/shard_index/manifest/meta/rename/latest/read) that live inside
+``runtime/checkpointing.py``, and the serving engine's
+``serve:admit`` / ``serve:step`` (deepspeed_tpu/inference/engine.py,
+docs/serving.md).
 """
 from __future__ import annotations
 

@@ -69,6 +69,9 @@ def summarize(path: str, out=None) -> dict:
     synced: List[float] = []
     sps: List[float] = []
     overlap: List[float] = []
+    disk_overlap: List[float] = []
+    disk_read: List[float] = []
+    disk_write: List[float] = []
     pf_hits: List[float] = []
     pf_wait: List[float] = []
     ck_save: List[float] = []
@@ -129,6 +132,18 @@ def summarize(path: str, out=None) -> dict:
                     # must not count like a full one
                     overlap.extend([float(ov)]
                                    * int(rec.get("steps") or 1))
+                dv = scalars.get("offload_disk_overlap_ratio")
+                if dv is not None:
+                    # disk tier (runtime/disk_offload.py): same
+                    # step-count weighting as the H2D overlap row
+                    n = int(rec.get("steps") or 1)
+                    disk_overlap.extend([float(dv)] * n)
+                    if scalars.get("disk_read_s") is not None:
+                        disk_read.extend(
+                            [float(scalars["disk_read_s"])] * n)
+                    if scalars.get("disk_write_s") is not None:
+                        disk_write.extend(
+                            [float(scalars["disk_write_s"])] * n)
                 ph = scalars.get("prefetch_hit_ratio")
                 if ph is not None:
                     # async input pipeline: same step-count weighting
@@ -240,6 +255,11 @@ def summarize(path: str, out=None) -> dict:
     avg_sps = sum(sps) / len(sps) if sps else None
 
     avg_overlap = sum(overlap) / len(overlap) if overlap else None
+    avg_disk_overlap = (sum(disk_overlap) / len(disk_overlap)
+                        if disk_overlap else None)
+    avg_disk_read = sum(disk_read) / len(disk_read) if disk_read else None
+    avg_disk_write = (sum(disk_write) / len(disk_write)
+                      if disk_write else None)
     avg_pf_hit = sum(pf_hits) / len(pf_hits) if pf_hits else None
     avg_pf_wait = sum(pf_wait) / len(pf_wait) if pf_wait else None
     avg_ck_save = sum(ck_save) / len(ck_save) if ck_save else None
@@ -261,6 +281,9 @@ def summarize(path: str, out=None) -> dict:
         "p50_s": p50, "p95_s": p95, "p99_s": p99,
         "samples_per_sec": avg_sps,
         "offload_overlap_ratio": avg_overlap,
+        "offload_disk_overlap_ratio": avg_disk_overlap,
+        "disk_read_s": avg_disk_read,
+        "disk_write_s": avg_disk_write,
         "prefetch_hit_ratio": avg_pf_hit,
         "prefetch_wait_s": avg_pf_wait,
         "ckpt_save_s": avg_ck_save,
@@ -306,6 +329,16 @@ def summarize(path: str, out=None) -> dict:
         # fully hidden under the host Adam; 0 = serial (all tail)
         print(f"  offload H2D overlap {avg_overlap * 100:.0f}% hidden "
               "under host Adam", file=out)
+    if avg_disk_overlap is not None:
+        # disk tier: 1.0 = all per-leaf state reads/writes ran under
+        # the host Adam (three-tier pipeline); 0 = the serial
+        # read-update-write loop (degraded or DS_DISK_OFFLOAD_PIPELINE=0)
+        io_txt = ""
+        if avg_disk_read is not None and avg_disk_write is not None:
+            io_txt = (f"  (read {_fmt_s(avg_disk_read)} + write "
+                      f"{_fmt_s(avg_disk_write)})/step")
+        print(f"  disk tier          {avg_disk_overlap * 100:.0f}% of "
+              f"state I/O hidden under host Adam{io_txt}", file=out)
     if avg_pf_hit is not None:
         # async input pipeline: hit = batch already device-resident
         # when the step asked; wait = the exposed input stall per step

@@ -60,6 +60,10 @@ fi
 run r03 python bench.py
 run prefetch python bench.py --prefetch=ab
 run ckpt python bench.py --ckpt=ab
+# offload-tier A/B: ZeRO-Infinity disk tier vs host RAM — bitwise-loss
+# check plus the disk leg's state-I/O overlap ratio under injected
+# per-leaf disk latency (pure CPU-provable; docs/stages.md disk tier)
+run offload_disk python bench.py --offload-tier=ab
 # stage chaos: sticky injected faults at every async stage boundary;
 # training must complete degraded, bitwise-equal to the serial legs
 run stage_chaos python bench.py --stage-chaos

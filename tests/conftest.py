@@ -24,6 +24,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 # each fsync cost ~50ms/file — ~1.3s per tiny save.  The production
 # default stays ON; tests/test_resilience.py pins that default.
 os.environ.setdefault("DS_CKPT_FSYNC", "0")
+# Same rule for the disk offload tier's per-leaf state files: its
+# tmp+rename + CRC plane is what the tests exercise; the ~50ms/fsync 9p
+# cost is not.  Production default stays ON;
+# tests/test_disk_offload.py::test_fsync_on_by_default pins it.
+os.environ.setdefault("DS_DISK_FSYNC", "0")
 
 import jax  # noqa: E402
 

@@ -363,6 +363,7 @@ def test_dump_flight_record_on_demand_and_step_failure(tmp_path):
     doc = json.loads(open(path).read())
     assert doc["reason"] == "operator request"
     assert set(doc["stages"]) == {"prefetch", "offload_h2d",
+                                  "disk_read", "disk_write",
                                   "ckpt_writer"}
     # a failing train_batch dumps once (and only once)
     with pytest.raises((ValueError, IndexError, TypeError)):

@@ -287,6 +287,21 @@ def test_cli_list_rules_covers_all_ids():
         assert rule_id in proc.stdout
 
 
+def test_disk_offload_is_clean_with_empty_baseline():
+    """The disk offload tier (runtime/disk_offload.py) is JL001-JL007
+    clean WITHOUT any baseline entries — its bitwise-vs-host contract
+    depends on the stage runtime's thread discipline (JL007) and on
+    never timing a dispatch as a transfer (JL006), so no finding there
+    may ever be baselined (the serving-subsystem rule, applied to the
+    new module)."""
+    findings = lint_paths([os.path.join(REPO, "deepspeed_tpu", "runtime",
+                                        "disk_offload.py")])
+    assert not findings, "\n".join(f.render() for f in findings)
+    baseline = load_baseline()
+    prefix = os.path.join("deepspeed_tpu", "runtime", "disk_offload.py")
+    assert not [k for k in baseline if prefix in k]
+
+
 def test_serving_subsystem_is_clean_with_empty_baseline():
     """The serving engine (deepspeed_tpu/inference/) is JL001-JL007
     clean WITHOUT any baseline entries — the one-compiled-decode-

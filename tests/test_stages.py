@@ -17,8 +17,8 @@ these tests pin:
     completion bitwise-equal to the serial leg, with exactly ONE loud
     warning and one ``stage_degraded_total`` tick per degraded stage;
   - THE drain order: ``engine.close()`` drains prefetch -> offload
-    uploads -> ckpt writer -> telemetry flush, idempotently, with
-    everything mid-flight at once (satellite 1);
+    uploads -> disk write-back -> ckpt writer -> telemetry flush,
+    idempotently, with everything mid-flight at once (satellite 1);
   - a StreamingUploader failure after ``close()``/``abort()`` began is
     surfaced through the stage record into ``engine.last_stage_error``
     instead of vanishing with the daemon thread (satellite 2);
@@ -576,7 +576,8 @@ def test_engine_graph_registers_the_documented_order():
     eng = _plain_engine()
     try:
         assert eng._stage_graph.order == [
-            "prefetch", "offload_uploads", "ckpt_writer", "telemetry"]
+            "prefetch", "offload_uploads", "disk_writeback",
+            "ckpt_writer", "telemetry"]
     finally:
         eng.close()
 

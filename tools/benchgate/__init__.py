@@ -59,6 +59,11 @@ METRIC_DIRECTIONS = {
     # replica count — HIGHER is better (docs/serving.md "serving
     # fleet")
     "fleet_scaling_tokens_ratio": False,
+    # fraction of the disk tier's per-leaf state I/O hidden under the
+    # host Adam (three-tier streaming pipeline, injected disk latency):
+    # more overlap = the pipeline is doing its job — HIGHER is better
+    # (docs/stages.md "disk tier")
+    "offload_disk_overlap_ratio": False,
 }
 
 

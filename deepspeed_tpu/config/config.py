@@ -192,6 +192,39 @@ class DeepSpeedSparseAttentionConfig:
             if mode not in valid:
                 raise DeepSpeedConfigError(f"Invalid sparse attention mode {mode!r}")
             self.mode = mode
+            # Per-mode layout knobs — routed with their schema defaults so
+            # downstream kernels never re-spell fallback values.
+            self.block = get_scalar_param(
+                sa, C.SPARSE_BLOCK, C.SPARSE_BLOCK_DEFAULT)
+            self.different_layout_per_head = get_scalar_param(
+                sa, C.SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+                C.SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT)
+            self.num_local_blocks = get_scalar_param(
+                sa, C.SPARSE_NUM_LOCAL_BLOCKS, C.SPARSE_NUM_LOCAL_BLOCKS_DEFAULT)
+            self.num_global_blocks = get_scalar_param(
+                sa, C.SPARSE_NUM_GLOBAL_BLOCKS, C.SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT)
+            self.attention = get_scalar_param(
+                sa, C.SPARSE_ATTENTION_TYPE, C.SPARSE_ATTENTION_TYPE_DEFAULT)
+            self.horizontal_global_attention = get_scalar_param(
+                sa, C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+                C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT)
+            self.num_different_global_patterns = get_scalar_param(
+                sa, C.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS,
+                C.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS_DEFAULT)
+            self.num_random_blocks = get_scalar_param(
+                sa, C.SPARSE_NUM_RANDOM_BLOCKS, C.SPARSE_NUM_RANDOM_BLOCKS_DEFAULT)
+            self.local_window_blocks = get_scalar_param(
+                sa, C.SPARSE_LOCAL_WINDOW_BLOCKS,
+                C.SPARSE_LOCAL_WINDOW_BLOCKS_DEFAULT)
+            self.global_block_indices = get_scalar_param(
+                sa, C.SPARSE_GLOBAL_BLOCK_INDICES,
+                C.SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT)
+            self.global_block_end_indices = get_scalar_param(
+                sa, C.SPARSE_GLOBAL_BLOCK_END_INDICES,
+                C.SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT)
+            self.num_sliding_window_blocks = get_scalar_param(
+                sa, C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+                C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT)
         else:
             self.mode = None
 

@@ -22,17 +22,13 @@ GRADIENT_ACCUMULATION_STEPS_DEFAULT = None
 # Optimizer / scheduler blocks
 #############################################
 OPTIMIZER = "optimizer"
-OPTIMIZER_TYPE_DEFAULT = None
 OPTIMIZER_PARAMS = "params"
 TYPE = "type"
 LEGACY_FUSION = "legacy_fusion"
 LEGACY_FUSION_DEFAULT = False
 
 SCHEDULER = "scheduler"
-SCHEDULER_TYPE_DEFAULT = None
 SCHEDULER_PARAMS = "params"
-
-MAX_GRAD_NORM = "max_grad_norm"
 
 ADAM_OPTIMIZER = "adam"
 LAMB_OPTIMIZER = "lamb"

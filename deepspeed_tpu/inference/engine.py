@@ -897,6 +897,11 @@ class ServeEngine:
                               else int(eos_id)),
                       submit_t=time.perf_counter())
         self._begin_request_trace(req)
+        # Deliberate submission-side backpressure: submit() runs on the
+        # CALLER's thread, and a full queue must block the caller (and a
+        # closed one must reject) — that is the admission contract, and
+        # the result is checked on the next line.
+        # jaxlint: disable=JL008
         if not self.queue.put(req):
             err = self.queue.err
             rej = RuntimeError(

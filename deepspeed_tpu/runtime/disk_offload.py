@@ -672,6 +672,12 @@ class DiskOffloadOptimizer:
                     self._release(nbytes)
                     raise
                 if updated is not None:
+                    # Deliberate bounded-RAM backpressure: the streaming
+                    # step runs on the main thread and MUST stall when
+                    # the writer falls behind — unbounded buffering here
+                    # defeats the disk tier's memory ceiling.  The put
+                    # result is checked and the writer's error surfaced.
+                    # jaxlint: disable=JL008
                     if not wr_ch.put((i, updated["master"], updated["mu"],
                                       updated["nu"], nbytes)):
                         # writer poisoned/closed: surface ITS error

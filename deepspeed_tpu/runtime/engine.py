@@ -2927,8 +2927,12 @@ class DeepSpeedEngine:
             self.timers("train_batch_step").stop()
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
-        # dispatch-only delta by design — the _report path measures the
-        # synced interval; see the baselined jaxlint JL006 finding
+        # dispatch-only delta by design: _step_times records enqueue
+        # latency (syncing here would serialize the async-dispatch
+        # overlap); the synced ground truth comes from _report's
+        # report-interval wall time and telemetry's on_sync step-time
+        # histogram — see docs/observability.md
+        # jaxlint: disable=JL006
         dispatch_s = time.time() - t0
         self._step_times.append(dispatch_s)
         if self._heartbeat is not None:

@@ -69,6 +69,8 @@ def summarize(path: str, out=None) -> dict:
     synced: List[float] = []
     sps: List[float] = []
     overlap: List[float] = []
+    off_h2d: List[float] = []
+    off_adam: List[float] = []
     disk_overlap: List[float] = []
     disk_read: List[float] = []
     disk_write: List[float] = []
@@ -132,6 +134,15 @@ def summarize(path: str, out=None) -> dict:
                     # must not count like a full one
                     overlap.extend([float(ov)]
                                    * int(rec.get("steps") or 1))
+                    # attribution split for the overlap ratio: per-step
+                    # H2D upload and CPU-Adam time, same weighting
+                    n = int(rec.get("steps") or 1)
+                    if scalars.get("offload_h2d_s") is not None:
+                        off_h2d.extend(
+                            [float(scalars["offload_h2d_s"])] * n)
+                    if scalars.get("offload_cpu_adam_s") is not None:
+                        off_adam.extend(
+                            [float(scalars["offload_cpu_adam_s"])] * n)
                 dv = scalars.get("offload_disk_overlap_ratio")
                 if dv is not None:
                     # disk tier (runtime/disk_offload.py): same
@@ -255,6 +266,8 @@ def summarize(path: str, out=None) -> dict:
     avg_sps = sum(sps) / len(sps) if sps else None
 
     avg_overlap = sum(overlap) / len(overlap) if overlap else None
+    avg_off_h2d = sum(off_h2d) / len(off_h2d) if off_h2d else None
+    avg_off_adam = sum(off_adam) / len(off_adam) if off_adam else None
     avg_disk_overlap = (sum(disk_overlap) / len(disk_overlap)
                         if disk_overlap else None)
     avg_disk_read = sum(disk_read) / len(disk_read) if disk_read else None
@@ -281,6 +294,8 @@ def summarize(path: str, out=None) -> dict:
         "p50_s": p50, "p95_s": p95, "p99_s": p99,
         "samples_per_sec": avg_sps,
         "offload_overlap_ratio": avg_overlap,
+        "offload_h2d_s": avg_off_h2d,
+        "offload_cpu_adam_s": avg_off_adam,
         "offload_disk_overlap_ratio": avg_disk_overlap,
         "disk_read_s": avg_disk_read,
         "disk_write_s": avg_disk_write,
@@ -327,8 +342,12 @@ def summarize(path: str, out=None) -> dict:
     if avg_overlap is not None:
         # streaming offload pipeline: 1.0 = the H2D param re-upload is
         # fully hidden under the host Adam; 0 = serial (all tail)
+        io_txt = ""
+        if avg_off_h2d is not None and avg_off_adam is not None:
+            io_txt = (f"  (H2D {_fmt_s(avg_off_h2d)} vs Adam "
+                      f"{_fmt_s(avg_off_adam)})/step")
         print(f"  offload H2D overlap {avg_overlap * 100:.0f}% hidden "
-              "under host Adam", file=out)
+              f"under host Adam{io_txt}", file=out)
     if avg_disk_overlap is not None:
         # disk tier: 1.0 = all per-leaf state reads/writes ran under
         # the host Adam (three-tier pipeline); 0 = the serial

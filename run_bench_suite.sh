@@ -16,6 +16,17 @@ for a in "$@"; do
 done
 set -- "${ARGS[@]}"
 echo "=== suite start $(date -u +%H:%M:%S) gate=$GATE ===" >> bench_suite.log
+# jaxlint contract pre-flight (<10s, stdlib only): abort before burning
+# a hardware window when the stage/metric/config contract registries
+# drifted — a bench emitting metrics nothing summarizes (or gating on
+# an unpinned headline) produces an unusable artifact
+echo "=== jaxlint contracts pre-flight $(date -u +%H:%M:%S) ===" >> bench_suite.log
+if ! python -m tools.jaxlint --contracts-only deepspeed_tpu tools \
+    >> bench_suite.log 2>&1; then
+  echo "=== jaxlint contract pre-flight FAILED — aborting suite ===" \
+    | tee -a bench_suite.log >&2
+  exit 1
+fi
 gate() {
   name=$1
   if [ "$GATE" = "1" ] && [ -f "BENCH_${name}.json" ]; then

@@ -106,6 +106,27 @@ def _guarded_mesh_new(cls, *args, **kwargs):
 jax.sharding.Mesh.__new__ = _guarded_mesh_new
 
 
+def pytest_sessionstart(session):
+    """jaxlint --contracts-only pre-flight: the cross-artifact contract
+    rules (stages, metrics, fault points, config keys — JL102-JL104)
+    run in seconds and catch docs/code drift before the suite spends
+    minutes compiling.  DS_SKIP_LINT_PREFLIGHT=1 skips it (while
+    iterating on a fix the gate itself is pinning)."""
+    if os.environ.get("DS_SKIP_LINT_PREFLIGHT") == "1":
+        return
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "--contracts-only",
+         "deepspeed_tpu", "tools"],
+        cwd=repo, capture_output=True, text=True, timeout=60)
+    if proc.returncode != 0:
+        pytest.exit("jaxlint --contracts-only pre-flight failed "
+                    "(DS_SKIP_LINT_PREFLIGHT=1 to bypass):\n"
+                    + proc.stdout + proc.stderr, returncode=1)
+
+
 def pytest_runtest_logreport(report):
     if os.environ.get("TIER_GUARD") != "1":
         return

@@ -1,0 +1,5 @@
+LOWER_BETTER_HINTS = ("_seconds",)
+
+METRIC_DIRECTIONS = {
+    "fixture_speedup": False,
+}

@@ -1,0 +1,20 @@
+"""JL008 bad: blocking Channel.put outside any worker body (wedges the
+caller when the stage degrades) + a raw daemon thread hidden behind an
+assignment alias that JL007's import-alias tracking cannot see."""
+import threading
+
+from deepspeed_tpu.runtime.stages import Channel
+
+
+class Producer:
+    def __init__(self, capacity):
+        self.ch = Channel(capacity=capacity)
+
+    def submit(self, item):
+        # no worker drains self.ch when the stage is degraded: this
+        # blocks the submitting thread forever
+        return self.ch.put(item)
+
+
+T = threading.Thread
+worker = T(target=print, daemon=True)

@@ -1,0 +1,28 @@
+"""JL008 good: puts live inside the worker-body closure (directly or
+transitively), callers use the non-blocking force=True overflow policy,
+and the Thread alias never builds daemon threads."""
+import threading
+
+from deepspeed_tpu.runtime.stages import Channel, spawn
+
+
+class Producer:
+    def __init__(self, capacity):
+        self.ch = Channel(capacity=capacity)
+        spawn("producer", self._loop)
+
+    def _loop(self):
+        while True:
+            self._push()
+
+    def _push(self):
+        # transitively inside the worker body via _loop's call closure
+        self.ch.put(object())
+
+    def submit(self, item):
+        # caller-side path: explicit drop/overflow policy, never blocks
+        return self.ch.put(item, force=True)
+
+
+T = threading.Thread
+helper = T(target=print)  # non-daemon: not a stage-runtime bypass

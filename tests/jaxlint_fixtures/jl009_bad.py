@@ -1,0 +1,21 @@
+"""JL009 bad: step() donates self.params into the jitted update and
+never rebinds it; snapshot() later reads the deleted buffer — an error
+only on real TPU (CPU jit ignores donation), invisible in CI."""
+import jax
+
+
+def _adam_update(params, grads):
+    return jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+
+
+class Engine:
+    def __init__(self, params):
+        self.params = params
+        self._update = jax.jit(_adam_update, donate_argnums=(0,))
+
+    def step(self, grads):
+        new_params = self._update(self.params, grads)
+        return new_params
+
+    def snapshot(self):
+        return dict(self.params)

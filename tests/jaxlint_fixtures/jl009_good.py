@@ -1,0 +1,20 @@
+"""JL009 good: the donated self.params is rebound to the jitted call's
+result before anyone can read it — snapshot() sees the fresh buffer."""
+import jax
+
+
+def _adam_update(params, grads):
+    return jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+
+
+class Engine:
+    def __init__(self, params):
+        self.params = params
+        self._update = jax.jit(_adam_update, donate_argnums=(0,))
+
+    def step(self, grads):
+        self.params = self._update(self.params, grads)
+        return self.params
+
+    def snapshot(self):
+        return dict(self.params)

@@ -1,0 +1,19 @@
+"""JL010 bad: `scale` is a Python scalar closed over by a jitted
+callable; jit bakes it in as a constant at trace time, so the later
+rebinding silently never reaches the compiled code — the stale constant
+runs forever, no recompile, no error."""
+import jax
+
+
+def warmup_schedule(steps):
+    scale = 0.1
+
+    @jax.jit
+    def scaled_loss(x):
+        return x * scale
+
+    losses = []
+    for step in range(steps):
+        losses.append(scaled_loss(step))
+        scale = scale + 0.01
+    return losses

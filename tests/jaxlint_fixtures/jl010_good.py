@@ -1,0 +1,20 @@
+"""JL010 good: the schedule scalar is passed as an argument (static, so
+each value retraces) instead of being closed over — rebinding it between
+calls reaches the compiled code."""
+from functools import partial
+
+import jax
+
+
+def warmup_schedule(steps):
+    scale = 0.1
+
+    @partial(jax.jit, static_argnums=(1,))
+    def scaled_loss(x, s):
+        return x * s
+
+    losses = []
+    for step in range(steps):
+        losses.append(scaled_loss(step, scale))
+        scale = scale + 0.01
+    return losses

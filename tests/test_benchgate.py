@@ -105,3 +105,28 @@ def test_cli_no_committed_predecessor_passes(tmp_path, capsys):
     assert load_committed(fresh) is None
     assert main([fresh]) == 0
     assert "no committed predecessor" in capsys.readouterr().out
+
+
+def test_every_committed_artifact_has_a_direction(capsys):
+    """--list-unpinned reuses the jaxlint project registry's bench
+    scan: every committed BENCH_*.json headline metric must be pinned
+    in METRIC_DIRECTIONS or matched by a LOWER_BETTER_HINTS substring —
+    a gate judging direction by a heuristic that matched nothing is a
+    coin flip."""
+    assert main(["--list-unpinned"]) == 0
+    err = capsys.readouterr().err
+    assert "0 unpinned" in err
+
+
+def test_fresh_path_required_without_list_unpinned(capsys):
+    with pytest.raises(SystemExit):
+        main([])
+
+
+def test_new_headline_pins_are_higher_better():
+    """Pin the three throughput/boolean headlines the registry sweep
+    found unpinned (all higher-is-better; none name-hint matched)."""
+    for name in ("gpt2_124m_zero0_seq1024_tokens_per_sec_per_chip",
+                 "serve_continuous_batching_speedup",
+                 "stage_chaos_degraded_run"):
+        assert is_lower_better(name) is False, name

@@ -267,3 +267,36 @@ def test_checkpoint_bool_knobs_reject_truthy_strings(bad):
     with pytest.raises(DeepSpeedConfigError):
         DeepSpeedConfig({"train_batch_size": 1, "checkpoint": bad},
                         world_size=1)
+
+
+def test_sparse_attention_layout_knobs_route_with_defaults():
+    """The 12 per-mode sparse layout keys route through the config
+    block with their constants.py defaults (they were dead schema keys
+    before the jaxlint JL104 sweep)."""
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "sparse_attention": {"mode": "bigbird", "block": 32},
+    }, world_size=1)
+    sa = cfg.sparse_attention_config
+    assert sa.block == 32                       # explicit override
+    assert sa.different_layout_per_head is False
+    assert sa.num_local_blocks == 4
+    assert sa.num_global_blocks == 1
+    assert sa.attention == "bidirectional"
+    assert sa.horizontal_global_attention is False
+    assert sa.num_different_global_patterns == 1
+    assert sa.num_random_blocks == 0
+    assert sa.local_window_blocks == [4]
+    assert sa.global_block_indices == [0]
+    assert sa.global_block_end_indices is None
+    assert sa.num_sliding_window_blocks == 3
+
+
+def test_dead_schema_constants_removed():
+    """OPTIMIZER_TYPE_DEFAULT / SCHEDULER_TYPE_DEFAULT (defaults whose
+    keys never existed) and MAX_GRAD_NORM (a key nothing read) are gone
+    — jaxlint JL104 keeps them from coming back."""
+    from deepspeed_tpu.config import constants as C
+    for name in ("OPTIMIZER_TYPE_DEFAULT", "SCHEDULER_TYPE_DEFAULT",
+                 "MAX_GRAD_NORM"):
+        assert not hasattr(C, name), name

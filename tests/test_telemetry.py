@@ -530,3 +530,25 @@ def test_hub_close_idempotent(tmp_path):
     hub.on_sync(2)  # post-close: silently ignored
     assert os.path.isfile(tmp_path / "trace.json")
     assert os.path.isfile(tmp_path / "metrics.prom")
+
+
+def test_summarize_offload_attribution_split(tmp_path, capsys):
+    """The H2D-tier attribution scalars (offload_h2d_s /
+    offload_cpu_adam_s) get summarize rows like the disk tier's
+    read/write split — emitted-but-never-consumed was a jaxlint JL102
+    finding."""
+    p = tmp_path / "events.jsonl"
+    lines = [{"kind": "sync", "step": 10 * (i + 1), "interval_s": 1.0,
+              "steps": 10, "step_avg_s": 0.1,
+              "scalars": {"offload_overlap_ratio": r,
+                          "offload_h2d_s": 0.12,
+                          "offload_cpu_adam_s": 0.30}}
+             for i, r in enumerate((0.6, 0.8))]
+    p.write_text("\n".join(json.dumps(l) for l in lines) + "\n")
+    rep = summarize(str(p))
+    assert rep["offload_overlap_ratio"] == pytest.approx(0.7)
+    assert rep["offload_h2d_s"] == pytest.approx(0.12)
+    assert rep["offload_cpu_adam_s"] == pytest.approx(0.30)
+    out = capsys.readouterr().out
+    assert "offload H2D overlap" in out
+    assert "H2D" in out and "Adam" in out

@@ -64,6 +64,15 @@ METRIC_DIRECTIONS = {
     # more overlap = the pipeline is doing its job — HIGHER is better
     # (docs/stages.md "disk tier")
     "offload_disk_overlap_ratio": False,
+    # training throughput headline (tokens/s/chip): HIGHER is better;
+    # pinned because nothing in the name matches a direction hint
+    "gpt2_124m_zero0_seq1024_tokens_per_sec_per_chip": False,
+    # continuous vs static batching tokens/s ratio: HIGHER is better
+    # (docs/serving.md "continuous batching")
+    "serve_continuous_batching_speedup": False,
+    # boolean-as-1: the chaos run degraded and completed instead of
+    # wedging — 1 is the pass value, HIGHER is better
+    "stage_chaos_degraded_run": False,
 }
 
 
@@ -141,12 +150,43 @@ def load_committed(path: str, rev: str = "HEAD") -> Optional[dict]:
         return None
 
 
+def list_unpinned() -> int:
+    """Print committed headline metrics whose direction is neither
+    pinned in METRIC_DIRECTIONS nor inferable from LOWER_BETTER_HINTS —
+    the artifacts the gate would judge by a name heuristic that matched
+    nothing.  Reuses the jaxlint pass-1 project registry's bench scan
+    (one artifact-discovery implementation, two tools)."""
+    from tools.jaxlint.registry import ProjectRegistry, find_project_root
+    here = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    root = find_project_root([here])
+    if root is None:
+        print("benchgate: no project root found", file=sys.stderr)
+        return 2
+    reg = ProjectRegistry.build(root)
+    unpinned = sorted(
+        name for name in reg.bench_artifacts
+        if name.lower() not in METRIC_DIRECTIONS
+        and not any(h in name.lower() for h in LOWER_BETTER_HINTS))
+    for name in unpinned:
+        print(name)
+    print(f"benchgate: {len(unpinned)} unpinned headline metric(s) of "
+          f"{len(reg.bench_artifacts)} committed artifact(s)",
+          file=sys.stderr)
+    return 1 if unpinned else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.benchgate",
         description="fail (exit 1) when a fresh BENCH_*.json regressed "
                     "its committed predecessor's headline metric")
-    parser.add_argument("fresh", help="path to the fresh BENCH_*.json")
+    parser.add_argument("fresh", nargs="?", default=None,
+                        help="path to the fresh BENCH_*.json")
+    parser.add_argument("--list-unpinned", action="store_true",
+                        help="list committed headline metrics with no "
+                             "METRIC_DIRECTIONS pin and no name-hint "
+                             "match, then exit (1 when any exist)")
     parser.add_argument("--baseline",
                         help="explicit baseline file (default: the "
                              "committed predecessor via git show)")
@@ -165,6 +205,11 @@ def main(argv=None) -> int:
                            action="store_false",
                            help="force higher-is-better")
     args = parser.parse_args(argv)
+    if args.list_unpinned:
+        return list_unpinned()
+    if args.fresh is None:
+        parser.error("a fresh BENCH_*.json path is required unless "
+                     "--list-unpinned is given")
     try:
         with open(args.fresh) as f:
             fresh = json.load(f)

@@ -1,16 +1,23 @@
 """CLI: ``python -m tools.jaxlint [paths] [--format github] ...``.
 
 Exit status: 0 when every finding is baselined or suppressed, 1 when new
-findings exist, 2 on usage errors.  Stdlib only — runs on a clean
-checkout before any environment is built.
+findings exist, 2 on usage errors (including a missing or corrupt
+baseline file — see :class:`~.core.BaselineError`).  Stdlib only — runs
+on a clean checkout before any environment is built.
+
+v2 surface: ``--contracts-only`` runs just the project-level
+cross-artifact rules (JL102–JL104; the cheap CI pre-flight), and
+``--registry-dump`` prints the pass-1 :class:`ProjectRegistry` as JSON
+for tests and ``diagnose`` tooling.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
-from .core import (RULE_REGISTRY, default_baseline_path, lint_paths,
-                   load_baseline, write_baseline)
+from .core import (RULE_REGISTRY, BaselineError, default_baseline_path,
+                   lint_paths, load_baseline, write_baseline)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -18,13 +25,16 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m tools.jaxlint",
         description="Static analysis for JAX tracer-safety hazards "
                     "(host syncs, use-after-donation, sharding and "
-                    "recompilation bugs). See docs/jaxlint.md.")
+                    "recompilation bugs) plus project-wide "
+                    "cross-artifact contracts (stages, metrics, "
+                    "fault points, config keys). See docs/jaxlint.md.")
     p.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
                    help="files or directories to lint "
                         "(default: deepspeed_tpu)")
     p.add_argument("--format", choices=("text", "github"), default="text",
                    help="finding format; 'github' emits ::error workflow "
-                        "commands")
+                        "commands (paths are project-root-relative "
+                        "regardless of the invocation cwd)")
     p.add_argument("--baseline", default=None, metavar="PATH",
                    help=f"baseline file (default: {default_baseline_path()})")
     p.add_argument("--no-baseline", action="store_true",
@@ -36,6 +46,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated rule ids to run (default: all)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
+    p.add_argument("--contracts-only", action="store_true",
+                   help="run only the project-level contract rules "
+                        "(JL102-JL104) — the fast CI pre-flight")
+    p.add_argument("--registry-dump", action="store_true",
+                   help="print the pass-1 project registry as JSON "
+                        "and exit")
     return p
 
 
@@ -43,31 +59,51 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.list_rules:
-        for rule_id, cls in sorted(RULE_REGISTRY.items()):
+        from .contracts import PROJECT_RULE_REGISTRY
+        table = dict(RULE_REGISTRY)
+        table.update(PROJECT_RULE_REGISTRY)
+        for rule_id, cls in sorted(table.items()):
             print(f"{rule_id}  {cls.summary}")
+        return 0
+
+    if args.registry_dump:
+        from .registry import ProjectRegistry, find_project_root
+        root = find_project_root(args.paths)
+        if root is None:
+            print("jaxlint: no project root (docs/ + tools/) found "
+                  f"above {', '.join(args.paths)}", file=sys.stderr)
+            return 2
+        reg = ProjectRegistry.build(root)
+        print(json.dumps(reg.dump(), indent=2, sort_keys=True))
         return 0
 
     select = None
     if args.select:
+        from .contracts import PROJECT_RULE_REGISTRY
         select = [s.strip() for s in args.select.split(",") if s.strip()]
-        unknown = [s for s in select if s not in RULE_REGISTRY]
+        unknown = [s for s in select if s not in RULE_REGISTRY
+                   and s not in PROJECT_RULE_REGISTRY]
         if unknown:
             print(f"unknown rule id(s): {', '.join(unknown)}",
                   file=sys.stderr)
             return 2
 
     try:
-        findings = lint_paths(args.paths, rules=select)
+        findings = lint_paths(args.paths, rules=select,
+                              contracts_only=args.contracts_only)
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2
 
-    if args.write_baseline:
-        write_baseline(findings, args.baseline)
-        print(f"baseline written: {len(findings)} finding(s) accepted")
-        return 0
-
-    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    try:
+        if args.write_baseline:
+            write_baseline(findings, args.baseline)
+            print(f"baseline written: {len(findings)} finding(s) accepted")
+            return 0
+        baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    except BaselineError as e:
+        print(str(e), file=sys.stderr)
+        return 2
     fresh = [f for f in findings if f.key() not in baseline]
     for f in fresh:
         print(f.render(args.format))

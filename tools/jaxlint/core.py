@@ -30,6 +30,31 @@ def register(cls):
     return cls
 
 
+class BaselineError(RuntimeError):
+    """A baseline file that is missing or unparseable.  Typed (and
+    naming the file) so a misconfigured gate fails loudly instead of
+    silently linting against an empty baseline."""
+
+
+def suppressed_in_lines(lines, lineno: int, rule: str) -> bool:
+    """The one suppression definition (``# jaxlint: disable[=IDs]`` on
+    the flagged line, or comment-only on the line above), shared by the
+    per-file pass and the project-level contract pass."""
+    for ln in (lineno, lineno - 1):
+        text = lines[ln - 1] if 1 <= ln <= len(lines) else ""
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        if ln != lineno and text.lstrip()[:1] != "#":
+            continue  # line above counts only when comment-only
+        ids = m.group("ids")
+        if ids is None:
+            return True
+        if rule in {i.strip() for i in ids.split(",")}:
+            return True
+    return False
+
+
 @dataclasses.dataclass(frozen=True)
 class Finding:
     path: str
@@ -70,7 +95,7 @@ class Rule:
 class ModuleContext:
     """One parsed file plus the lazily-built jit analysis shared by rules."""
 
-    def __init__(self, path: str, source: str):
+    def __init__(self, path: str, source: str, project=None):
         self.path = path
         self.source = source
         self.lines = source.splitlines()
@@ -79,6 +104,10 @@ class ModuleContext:
             for child in ast.iter_child_nodes(node):
                 child._jaxlint_parent = node  # type: ignore[attr-defined]
         self._jit = None
+        #: the ProjectRegistry when linting inside a project tree (set
+        #: by lint_paths); rules needing interprocedural project
+        #: context (JL008's stage namespace) skip when it is None
+        self.project = project
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -96,19 +125,7 @@ class ModuleContext:
         return self._jit
 
     def suppressed(self, finding: Finding) -> bool:
-        for lineno in (finding.line, finding.line - 1):
-            text = self.line_text(lineno)
-            m = _SUPPRESS_RE.search(text)
-            if not m:
-                continue
-            if lineno != finding.line and text.lstrip()[:1] != "#":
-                continue  # line above counts only when comment-only
-            ids = m.group("ids")
-            if ids is None:
-                return True
-            if finding.rule in {i.strip() for i in ids.split(",")}:
-                return True
-        return False
+        return suppressed_in_lines(self.lines, finding.line, finding.rule)
 
 
 # ---------------------------------------------------------------------------
@@ -119,13 +136,30 @@ def default_baseline_path() -> str:
     return os.path.join(os.path.dirname(__file__), "baseline.json")
 
 
-def load_baseline(path: Optional[str] = None) -> Dict[str, str]:
-    """Baseline keys -> justification strings ('' when none recorded)."""
+def load_baseline(path: Optional[str] = None, *,
+                  missing_ok: bool = False) -> Dict[str, str]:
+    """Baseline keys -> justification strings ('' when none recorded).
+
+    A missing or corrupt baseline raises :class:`BaselineError` naming
+    the file — treating it as empty would silently re-report every
+    baselined finding (or worse, pass a gate that was meant to read a
+    baseline that a bad path argument skipped)."""
     path = path or default_baseline_path()
     if not os.path.exists(path):
-        return {}
-    with open(path) as f:
-        data = json.load(f)
+        if missing_ok:
+            return {}
+        raise BaselineError(f"jaxlint: baseline file not found: {path}")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except ValueError as e:
+        raise BaselineError(
+            f"jaxlint: corrupt baseline file {path}: {e}") from e
+    if not isinstance(data, dict) or not isinstance(
+            data.get("findings", []), list):
+        raise BaselineError(
+            f"jaxlint: corrupt baseline file {path}: expected an object "
+            "with a 'findings' list")
     entries = data.get("findings", [])
     out: Dict[str, str] = {}
     for e in entries:
@@ -138,7 +172,7 @@ def load_baseline(path: Optional[str] = None) -> Dict[str, str]:
 
 def write_baseline(findings: List[Finding], path: Optional[str] = None):
     path = path or default_baseline_path()
-    existing = load_baseline(path)  # keep recorded justifications
+    existing = load_baseline(path, missing_ok=True)  # keep justifications
     payload = {
         "version": 1,
         "comment": ("Accepted pre-existing findings. Every entry needs a "
@@ -177,16 +211,18 @@ def iter_python_files(paths: Iterable[str]) -> List[str]:
     return files
 
 
-def lint_file(path: str, rules: Optional[List[str]] = None) -> List[Finding]:
+def lint_file(path: str, rules: Optional[List[str]] = None,
+              project=None) -> List[Finding]:
     with open(path, encoding="utf-8") as f:
         source = f.read()
-    return lint_source(source, path=path, rules=rules)
+    return lint_source(source, path=path, rules=rules, project=project)
 
 
 def lint_source(source: str, path: str = "<string>",
-                rules: Optional[List[str]] = None) -> List[Finding]:
+                rules: Optional[List[str]] = None,
+                project=None) -> List[Finding]:
     try:
-        ctx = ModuleContext(path, source)
+        ctx = ModuleContext(path, source, project=project)
     except SyntaxError as e:
         return [Finding(path=path, line=e.lineno or 1, col=e.offset or 0,
                         rule="JL000", message=f"syntax error: {e.msg}",
@@ -203,8 +239,40 @@ def lint_source(source: str, path: str = "<string>",
 
 
 def lint_paths(paths: Iterable[str],
-               rules: Optional[List[str]] = None) -> List[Finding]:
+               rules: Optional[List[str]] = None,
+               contracts_only: bool = False) -> List[Finding]:
+    """The two-pass entry point.
+
+    Pass 1 builds the :class:`~.registry.ProjectRegistry` for the
+    enclosing project root (the nearest ancestor carrying ``docs/`` +
+    ``tools/``); pass 2 runs the per-file rules with that project
+    context plus the project-level contract rules (JL102–JL104).
+    Without a discoverable root the per-file pass still runs alone.
+    Finding paths are normalized project-root-relative so baselines
+    and ``--format=github`` output are invocation-cwd independent.
+    ``contracts_only`` skips the per-file pass (the cheap CI
+    pre-flight).
+    """
+    paths = list(paths)
+    files = iter_python_files(paths)
+    from .registry import ProjectRegistry, find_project_root
+    root = find_project_root(paths)
+    reg = ProjectRegistry.build(root) if root is not None else None
+
     findings: List[Finding] = []
-    for fp in iter_python_files(paths):
-        findings.extend(lint_file(fp, rules=rules))
+    if not contracts_only:
+        for fp in files:
+            with open(fp, encoding="utf-8") as f:
+                source = f.read()
+            display = fp
+            if root is not None:
+                ap = os.path.abspath(fp)
+                if ap.startswith(root + os.sep):
+                    display = os.path.relpath(ap, root)
+            findings.extend(lint_source(source, path=display,
+                                        rules=rules, project=reg))
+    if reg is not None:
+        from .contracts import run_project_rules
+        findings.extend(run_project_rules(reg, rules=rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

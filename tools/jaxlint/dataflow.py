@@ -1,0 +1,258 @@
+"""Interprocedural def-use helpers shared by JL008/JL009/JL010.
+
+All per-module and purely syntactic (module-local call resolution via
+the shared jit model's resolver — bare names and ``self.method``):
+enough to chain a donated ``self.attr`` from the donating method to a
+reader method (JL009), a jitted closure to the enclosing scope's later
+rebinding of a captured scalar (JL010), and a ``Channel.put`` to the
+worker-body closure it must live in (JL008).  Under-approximate, never
+guess: unresolvable receivers and dynamic dispatch are out of scope.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .jitmodel import dotted
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: call last-components that hand a callable to the stage runtime as a
+#: worker body (stages.spawn / StageWorker)
+_WORKER_WRAPPERS = {"spawn", "StageWorker"}
+
+
+def _callable_refs(call: ast.Call) -> List[ast.AST]:
+    """Name/Attribute arguments of a worker-wrapper call — the
+    candidate worker-body references."""
+    out = []
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(arg, (ast.Name, ast.Attribute)):
+            out.append(arg)
+    return out
+
+
+def worker_body_defs(ctx) -> Set[ast.AST]:
+    """Defs whose bodies run on a stage-runtime worker thread: passed
+    to ``spawn(...)``/``StageWorker(...)`` (by bare name or
+    ``self.method``), plus everything they call transitively in this
+    module."""
+    jit = ctx.jit
+    roots: Set[ast.AST] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        last = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if last not in _WORKER_WRAPPERS:
+            continue
+        scope = jit.enclosing_function(node)
+        for ref in _callable_refs(node):
+            text = dotted(ref)
+            if text is None:
+                continue
+            target = jit._resolve_ref(text, scope)
+            if target is not None:
+                roots.add(target)
+        # inline worker bodies: spawn(lambda: ...) keeps its lambda
+        for ref in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(ref, ast.Lambda):
+                roots.add(ref)
+    seen = set(roots)
+    work = list(roots)
+    while work:
+        fn = work.pop()
+        if isinstance(fn, ast.Lambda):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            target = jit.resolve_call(node, fn)
+            if target is not None and target not in seen:
+                seen.add(target)
+                work.append(target)
+    return seen
+
+
+def channel_targets(ctx) -> Set[str]:
+    """Dotted assignment targets bound to a ``Channel(...)``
+    construction anywhere in the module (including ternary/boolean
+    fallbacks whose value subtree contains the construction)."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        has_channel = any(
+            isinstance(sub, ast.Call) and (
+                (isinstance(sub.func, ast.Attribute)
+                 and sub.func.attr == "Channel")
+                or (isinstance(sub.func, ast.Name)
+                    and sub.func.id == "Channel"))
+            for sub in ast.walk(node.value))
+        if not has_channel:
+            continue
+        for tgt in node.targets:
+            text = dotted(tgt)
+            if text is not None:
+                out.add(text)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# attribute def-use across methods (JL009)
+# ---------------------------------------------------------------------------
+
+def _self_attr(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _assign_targets(stmt) -> Iterable[ast.AST]:
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            yield from ast.walk(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        yield from ast.walk(stmt.target)
+
+
+def attr_assigned_after(method, attr: str, lineno: int) -> bool:
+    """True when ``self.<attr>`` is (re)bound anywhere in ``method``
+    strictly after ``lineno`` — the donated buffer was replaced before
+    anyone else can read it."""
+    for stmt in ast.walk(method):
+        if getattr(stmt, "lineno", 0) <= lineno:
+            continue
+        for t in _assign_targets(stmt):
+            if _self_attr(t) == attr:
+                return True
+    return False
+
+
+def assigned_attr_of_call(ctx, call: ast.Call) -> Set[str]:
+    """``self.<attr>`` names the call's result is assigned to
+    (``self.p = f(self.p)`` republishes the donated buffer)."""
+    parent = ctx.parent(call)
+    out: Set[str] = set()
+    if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+        for t in _assign_targets(parent):
+            a = _self_attr(t)
+            if a is not None:
+                out.add(a)
+    return out
+
+
+def methods_reading_attr(cls: ast.ClassDef, attr: str,
+                         exclude) -> List[Tuple[ast.AST, ast.AST]]:
+    """(method, read node) pairs for every OTHER method of ``cls``
+    loading ``self.<attr>``."""
+    out = []
+    for stmt in cls.body:
+        if not isinstance(stmt, _FUNC_DEFS) or stmt is exclude:
+            continue
+        for node in ast.walk(stmt):
+            if _self_attr(node) == attr and \
+                    isinstance(getattr(node, "ctx", None), ast.Load):
+                out.append((stmt, node))
+                break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# closure capture of Python scalars (JL010)
+# ---------------------------------------------------------------------------
+
+def bound_names(fn) -> Set[str]:
+    """Names bound inside ``fn``: parameters plus every local store."""
+    names: Set[str] = set()
+    if not isinstance(fn, ast.Lambda):
+        a = fn.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+            names.add(arg.arg)
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, _FUNC_DEFS) and node is not fn:
+            names.add(node.name)
+    return names
+
+
+def free_reads(fn) -> Dict[str, ast.AST]:
+    """name -> first Load node for names read in ``fn`` but never
+    bound there (closure candidates)."""
+    bound = bound_names(fn)
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id not in bound and node.id not in out:
+            out[node.id] = node
+    return out
+
+
+def _is_scalar_const(node) -> bool:
+    return isinstance(node, ast.Constant) and \
+        isinstance(node.value, (int, float, bool))
+
+
+def _binding_names(target) -> Set[str]:
+    """Names a target BINDS.  ``self.x = v`` binds no name (the base
+    is only loaded), so it must not count as rebinding ``self``."""
+    if isinstance(target, ast.Name):
+        return {target.id}
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in target.elts:
+            out |= _binding_names(e)
+        return out
+    if isinstance(target, ast.Starred):
+        return _binding_names(target.value)
+    return set()
+
+
+def scalar_rebindings_after(enclosing, inner, name: str,
+                            jit) -> List[ast.AST]:
+    """Statements in ``enclosing`` (but not inside ``inner`` or any
+    other nested def) that rebind ``name`` AFTER ``inner`` is defined,
+    where some binding of ``name`` in the scope is Python-scalar-ish
+    (a scalar constant or an AugAssign) — the captured value is frozen
+    at trace time and these rebindings never reach the compiled code."""
+    first_line = inner.lineno
+    rebinds: List[ast.AST] = []
+    scalarish = False
+    for stmt in ast.walk(enclosing):
+        inside_nested = False
+        # skip statements owned by nested defs (their locals shadow)
+        parent = getattr(stmt, "_jaxlint_parent", None)
+        while parent is not None and parent is not enclosing:
+            if isinstance(parent, _FUNC_DEFS + (ast.Lambda,)):
+                inside_nested = True
+                break
+            parent = getattr(parent, "_jaxlint_parent", None)
+        if inside_nested:
+            continue
+        if isinstance(stmt, ast.Assign):
+            hit = any(name in _binding_names(tgt)
+                      for tgt in stmt.targets)
+            if hit:
+                if _is_scalar_const(stmt.value):
+                    scalarish = True
+                if stmt.lineno > first_line:
+                    rebinds.append(stmt)
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name) and \
+                    stmt.target.id == name:
+                scalarish = True
+                if stmt.lineno > first_line:
+                    rebinds.append(stmt)
+        elif isinstance(stmt, ast.For):
+            if name in _binding_names(stmt.target):
+                if stmt.lineno > first_line:
+                    rebinds.append(stmt)
+    return rebinds if scalarish else []

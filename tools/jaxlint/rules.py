@@ -715,3 +715,244 @@ class ConfigSchemaRule(Rule):
                 f"defaultless read of {alias}.{key_name}: constants.py "
                 f"defines {key_name}_DEFAULT — pass it so the schema has "
                 "one source of truth")
+
+
+# ---------------------------------------------------------------------------
+# JL008 — Stage/Channel protocol (interprocedural, project-aware)
+# ---------------------------------------------------------------------------
+
+@register
+class StageChannelProtocolRule(Rule):
+    id = "JL008"
+    summary = ("Stage/Channel protocol: unregistered Stage name, "
+               "blocking Channel.put outside a worker body, "
+               "assignment-aliased raw threads")
+
+    # Three ways the stage plane drifts out from under docs/stages.md:
+    # a Stage(...) whose literal name is in no registry (ENGINE_STAGES
+    # or the docs contract table) has no drain entry, no chaos spec,
+    # no degradation row; a blocking Channel.put outside a worker body
+    # deadlocks the step loop the moment the stage degrades (workers
+    # gone, nobody drains); and `T = threading.Thread` assignment
+    # aliases walk straight past JL007's import-alias tracking.
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        from . import dataflow
+        parts = os.path.normpath(ctx.path).split(os.sep)
+        exempt_runtime = tuple(parts[-3:]) == _JL007_EXEMPT_SUFFIX
+
+        # (a) Stage("<name>") not in the project's stage namespace
+        if ctx.project is not None:
+            known = ctx.project.known_stage_names()
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fn = node.func
+                last = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None)
+                if last != "Stage":
+                    continue
+                name = node.args[0]
+                if isinstance(name, ast.Constant) and \
+                        isinstance(name.value, str) and \
+                        name.value not in known:
+                    yield self.finding(
+                        ctx, node,
+                        f"Stage({name.value!r}) is not in the stage "
+                        "registry: no ENGINE_STAGES entry and no "
+                        "docs/stages.md contract row — it has no "
+                        "drain order, chaos spec, or degradation "
+                        "fallback")
+
+        # (b) blocking Channel.put outside a worker body
+        if not exempt_runtime:
+            channels = dataflow.channel_targets(ctx)
+            workers = dataflow.worker_body_defs(ctx) if channels else set()
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if not (isinstance(fn, ast.Attribute)
+                        and fn.attr == "put"):
+                    continue
+                recv = dotted(fn.value)
+                if recv is None or recv not in channels:
+                    continue
+                forced = any(
+                    kw.arg == "force" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True for kw in node.keywords) or (
+                    len(node.args) > 1
+                    and isinstance(node.args[1], ast.Constant)
+                    and node.args[1].value is True)
+                if forced:
+                    continue
+                scope = ctx.jit.enclosing_function(node)
+                in_worker = False
+                while scope is not None:
+                    if scope in workers:
+                        in_worker = True
+                        break
+                    scope = ctx.jit.enclosing_function(scope)
+                if not in_worker:
+                    yield self.finding(
+                        ctx, node,
+                        f"blocking Channel.put on '{recv}' outside a "
+                        "worker body: when the stage degrades its "
+                        "workers are gone and nothing drains the "
+                        "channel — this put wedges the caller; use "
+                        "force=True (drop/overflow policy) or move it "
+                        "into the worker closure")
+
+        # (c) raw daemon threads behind assignment aliases (JL007's gap)
+        if not exempt_runtime:
+            aliases: Set[str] = set()
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    text = dotted(node.value)
+                    if text is not None and \
+                            text.split(".")[-1] == "Thread" and (
+                            text == "threading.Thread"
+                            or text == "Thread"):
+                        aliases.add(node.targets[0].id)
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not (isinstance(node.func, ast.Name)
+                        and node.func.id in aliases):
+                    continue
+                daemon = next((kw for kw in node.keywords
+                               if kw.arg == "daemon"), None)
+                if daemon is not None and isinstance(
+                        daemon.value, ast.Constant) and \
+                        daemon.value.value is True:
+                    yield self.finding(
+                        ctx, node,
+                        "raw threading.Thread(daemon=True) behind an "
+                        "assignment alias: build workers from the "
+                        "shared stage runtime (deepspeed_tpu.runtime."
+                        "stages.spawn) — aliasing the class does not "
+                        "exempt it")
+
+
+# ---------------------------------------------------------------------------
+# JL009 — interprocedural use-after-donation (cross-method self.attr)
+# ---------------------------------------------------------------------------
+
+@register
+class CrossMethodDonationRule(Rule):
+    id = "JL009"
+    summary = ("donated self.<attr> read from another method without "
+               "a post-call rebind (cross-function use-after-donation)")
+
+    # JL002 catches donated-buffer reads in the SAME scope; the
+    # engine.py:1709 class of bug is the cross-function version: step()
+    # donates self.params into the jitted update and snapshot()/save()
+    # later reads self.params — a deleted-buffer error only on real
+    # TPU (CPU jit ignores donation), i.e. invisible in CI.
+
+    def _donated_args(self, site, call: ast.Call) -> List[ast.AST]:
+        out = []
+        for idx in site.donate_argnums:
+            if idx < len(call.args):
+                out.append(call.args[idx])
+        if site.donate_argnames:
+            params: List[str] = []
+            wrapped = site.wrapped
+            if wrapped is not None and not isinstance(wrapped, ast.Lambda):
+                a = wrapped.args
+                params = [x.arg for x in a.posonlyargs + a.args]
+            for name in site.donate_argnames:
+                for kw in call.keywords:
+                    if kw.arg == name:
+                        out.append(kw.value)
+                if name in params:
+                    i = params.index(name)
+                    if i < len(call.args):
+                        out.append(call.args[i])
+        return out
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        from . import dataflow
+        jit = ctx.jit
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for method in cls.body:
+                if not isinstance(method, _FUNC_DEFS):
+                    continue
+                for node in ast.walk(method):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    text = dotted(node.func)
+                    if text is None:
+                        continue
+                    site = jit.lookup_callable(
+                        text, jit.enclosing_function(node))
+                    if site is None or not site.donates:
+                        continue
+                    republished = dataflow.assigned_attr_of_call(ctx, node)
+                    for arg in self._donated_args(site, node):
+                        attr = dataflow._self_attr(arg)
+                        if attr is None:
+                            continue
+                        if attr in republished:
+                            continue
+                        if dataflow.attr_assigned_after(
+                                method, attr, node.lineno):
+                            continue
+                        readers = dataflow.methods_reading_attr(
+                            cls, attr, exclude=method)
+                        if readers:
+                            reader, read = readers[0]
+                            yield self.finding(
+                                ctx, node,
+                                f"self.{attr} is donated here and "
+                                f"never rebound in {method.name}(); "
+                                f"{reader.name}() (line "
+                                f"{read.lineno}) still reads it — a "
+                                "deleted-buffer error on TPU; rebind "
+                                "self attributes to the jitted "
+                                "call's result before returning")
+
+
+# ---------------------------------------------------------------------------
+# JL010 — frozen Python scalars closed over by jitted callables
+# ---------------------------------------------------------------------------
+
+@register
+class FrozenClosureScalarRule(Rule):
+    id = "JL010"
+    summary = ("Python scalar closed over by a jitted callable and "
+               "rebound afterwards — the traced value is frozen")
+
+    # Extends JL005 with a def-use chain: jit bakes closed-over Python
+    # scalars into the compiled program as constants at trace time.
+    # Rebinding the scalar afterwards (a schedule loop, a warmup
+    # counter) silently does nothing — no recompile, no error, the
+    # stale constant runs forever.  Pass the value as an argument
+    # (retrace per value via static_argnums, or a traced operand).
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        from . import dataflow
+        jit = ctx.jit
+        for fn in sorted(jit.jitted_defs,
+                         key=lambda n: getattr(n, "lineno", 0)):
+            enclosing = jit.enclosing_function(fn)
+            if enclosing is None:
+                continue
+            enclosing_locals = _local_names(enclosing)
+            for name, read in dataflow.free_reads(fn).items():
+                if name not in enclosing_locals:
+                    continue
+                rebinds = dataflow.scalar_rebindings_after(
+                    enclosing, fn, name, jit)
+                if rebinds:
+                    yield self.finding(
+                        ctx, rebinds[0],
+                        f"'{name}' was captured by jitted "
+                        f"'{getattr(fn, 'name', '<lambda>')}' (line "
+                        f"{fn.lineno}) at trace time; this rebinding "
+                        "never reaches the compiled function — pass "
+                        "it as an argument (static_argnums for "
+                        "shape-like values) instead of a closure")

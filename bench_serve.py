@@ -1,118 +1,41 @@
 #!/usr/bin/env python
-"""Serving bench: continuous batching vs sequential per-request decode,
-and (``--paged``) the paged-KV concurrency/prefix-reuse A/B.
+"""Serving bench entry point — a thin shim over the workload plane.
 
-The claims under test are SCHEDULING claims, so they are CPU-provable
-with the repo's established fault-injection idiom: ``DS_STAGE_DELAY_S=
-serve:<s>`` charges every serving tick (admission prefill + masked
-decode step) a synthetic device time, the way the prefetch/offload
-benches inject collate/H2D latency.  A slot pool of size S then retires
-up to S tokens per paid tick while the sequential leg (slots=1 — one
-request decoded start-to-finish at a time) pays one tick per token:
-wall-clock speedup ≈ S at saturation, which is exactly the
-continuous-batching win Orca measured on real GPUs (PAPERS.md).
+The five A/B legs (serve / paged / spec / quant / fleet) now live as
+scenario configs over the ONE open-loop replay harness in
+``tools/loadgen/`` (docs/serving.md "workload plane"); this file keeps
+the historical CLI and the ``run_*_ab`` import surface stable.  Each
+leg still writes its committed ``BENCH_*.json`` headline:
 
-Both legs drive a synthetic open-loop load (arrivals on a fixed
-schedule, independent of completions) through the telemetry hub;
-tokens/s and p50/p99 per-token latency come from the same
-``events.jsonl`` scalars the ``telemetry summarize`` serving row reads.
+    BENCH_serve.json        serve_continuous_batching_speedup
+    BENCH_serve_paged.json  serve_paged_admitted_ratio
+    BENCH_serve_spec.json   serve_spec_wall_per_token_ratio
+    BENCH_serve_quant.json  serve_quant_admitted_ratio
+    BENCH_fleet.json        fleet_scaling_tokens_ratio
 
-Emits BENCH_serve.json:
-    {"metric": "serve_continuous_batching_speedup", "value": ...,
-     "batched": {...}, "sequential": {...}}
-
-``--paged ab`` runs the PAGED A/B (docs/serving.md) instead:
-
-* **Admitted-slots-at-fixed-KV-bytes** (the headline): the same mixed
-  short/long open-loop workload against (a) the pre-page slot cache
-  whose ``slots × max_seq_len`` stride fills a fixed KV-byte budget and
-  (b) a page pool of the SAME bytes — max concurrently admitted
-  requests is a pure scheduling fact (no injected time needed); the
-  paged pool admits ≥2× because short requests hold pages, not strides.
-* **Prefix-reuse compute proof**: K requests sharing a prompt template
-  with unique suffixes, prefix cache on vs off, under injected
-  per-page prefill device time (the serve stage's delay unit in paged
-  mode) — total prefill time collapses from ``K × template`` to
-  ``1 template + K deltas``, read from the same tracer-timestamp
-  windows the ``serve/prefill`` spans cover.
-
-Emits BENCH_serve_paged.json:
-    {"metric": "serve_paged_admitted_ratio", "value": ...,
-     "paged": {...}, "legacy": {...}, "prefix": {...}}
-
-``--spec ab`` runs the SPECULATIVE-DECODING A/B (docs/serving.md)
-instead: the same workload served with ``speculate_k=k`` (draft params
-= target params — the distilled-draft stand-in, so acceptance runs
-near k) vs ``speculate_k=0``, under ``DS_STAGE_DELAY_S=serve:`` now
-charging one unit per TARGET PASS (spec mode verifies k+1 positions
-per pass; the non-spec leg pays one pass per token).  The headline is
-the wall-clock-per-token ratio spec/non-spec, LOWER better, expected
-to track ``1 / mean-accepted-length``; per-token time is proven from
-the per-request token timestamps in events.jsonl (the same stamps the
-``serve/verify_step``/``serve/decode_step`` tracer spans cover), and
-the two legs' token streams are asserted identical (greedy parity).
-
-Emits BENCH_serve_spec.json:
-    {"metric": "serve_spec_wall_per_token_ratio", "value": ...,
-     "spec": {...}, "baseline": {...}}
-
-``--fleet`` runs the SERVING-FLEET A/B (docs/serving.md "serving
-fleet") instead: the same open-loop workload against a 1-replica and a
-2-replica fleet (real ``inference.replica`` subprocesses behind the
-``inference/fleet.py`` router) under identical injected per-tick
-device time — aggregate tokens/s should scale with the replica count
-(the headline, expected >= 1.8x at 2 replicas) because each replica is
-a full slot pool paying its own ticks.  A second leg drives the
-replica-kill + autoscale-up trace: under sustained load one of two
-replicas is SIGKILLed mid-stream; the router fails over every
-queued-but-unstarted request (zero lost, asserted from the per-request
-completion records), the queue-wait p99 breaches ``fleet.slo_p99_s``,
-the autoscaler spawns a replacement, and the tail-phase p99 returns
-under the SLO.
-
-Emits BENCH_fleet.json:
-    {"metric": "fleet_scaling_tokens_ratio", "value": ...,
-     "one_replica": {...}, "two_replicas": {...}, "killtrace": {...}}
+The workload plane's own goodput headline
+(``BENCH_loadgen_goodput.json``) runs via
+``python -m tools.loadgen goodput``.
 """
-import contextlib
 import json
 import os
 import sys
-import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+# this file is loaded both as a script and via spec_from_file_location
+# (the bench tests) — anchor the repo root so ``tools.loadgen``
+# resolves regardless of the caller's cwd
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
 
-def _build_model():
-    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
-    cfg = GPT2Config(vocab_size=256, n_positions=64, d_model=64,
-                     n_layer=2, n_head=4, remat=None, attn_impl="dense")
-    return GPT2Model(cfg)
+from tools.loadgen.scenarios import (  # noqa: E402  (path anchor above)
+    run_ab, run_fleet_ab, run_goodput, run_paged_ab, run_quant_ab,
+    run_spec_ab)
 
-
-# ---------------------------------------------------------------------------
-# the shared leg harness (one copy, not one per mode)
-# ---------------------------------------------------------------------------
-
-
-@contextlib.contextmanager
-def _injected_delay(delay_s):
-    """Arm ``DS_STAGE_DELAY_S=serve:<s>`` for one leg and restore the
-    previous spec (re-parsing the cached spec both ways) — the
-    save/arm/restore dance every A/B leg used to hand-copy."""
-    from deepspeed_tpu.runtime.stages import reset_fault_injection
-    prev = os.environ.get("DS_STAGE_DELAY_S")
-    try:
-        if delay_s is not None:
-            os.environ["DS_STAGE_DELAY_S"] = f"serve:{delay_s}"
-            reset_fault_injection()
-        yield
-    finally:
-        if prev is None:
-            os.environ.pop("DS_STAGE_DELAY_S", None)
-        else:
-            os.environ["DS_STAGE_DELAY_S"] = prev
-        reset_fault_injection()
+__all__ = ["run_ab", "run_paged_ab", "run_spec_ab", "run_quant_ab",
+           "run_fleet_ab", "run_goodput"]
 
 
 def _mode_kwargs(args, **attr_to_kw):
@@ -128,756 +51,6 @@ def _mode_kwargs(args, **attr_to_kw):
         if v is not None:
             kw[name] = v
     return kw
-
-
-def _kv_budget_bytes(model, slots, max_seq_len):
-    """The fixed KV-byte budget: what ``slots`` legacy fp strides cost,
-    read from the cache spec (dtype itemsize included — fp16 and int8
-    legs report TRUE bytes, not a hardcoded 4 bytes/elem)."""
-    from deepspeed_tpu.inference.kv_cache import KVCacheSpec
-    import jax.numpy as jnp
-    cfg = model.config
-    return KVCacheSpec(layers=cfg.n_layer, slots=slots,
-                       heads=cfg.n_head, max_len=max_seq_len,
-                       head_dim=cfg.d_head, dtype=jnp.float32).bytes
-
-
-def _pages_for_budget(model, budget_bytes, page_len, quant=False):
-    """(pages, page_bytes): allocatable pages a byte budget buys (+1
-    for the scratch page, which spends no budget — it is masked-write
-    storage, not request capacity), from the paged spec's
-    ``page_bytes`` — the quant arm's sidecar-inclusive quantum, so the
-    int8 leg's extra pages are real bytes, never a 4-bytes/elem
-    assumption."""
-    from deepspeed_tpu.inference.kv_cache import PagedKVCacheSpec
-    import jax.numpy as jnp
-    cfg = model.config
-    spec = PagedKVCacheSpec(
-        layers=cfg.n_layer, slots=1, heads=cfg.n_head, pages=1,
-        page_len=page_len, head_dim=cfg.d_head, max_pages=1,
-        dtype=(jnp.int8 if quant else jnp.float32), quant=quant)
-    return budget_bytes // spec.page_bytes + 1, spec.page_bytes
-
-
-def run_leg(model, params, *, slots, n_requests, prompt_len, gen_tokens,
-            tick_delay_s, arrival_s, tag):
-    """One leg: serve ``n_requests`` arriving open-loop every
-    ``arrival_s`` seconds, every tick charged ``tick_delay_s`` of
-    synthetic device time through the serve stage's delay knob."""
-    import numpy as np
-    from deepspeed_tpu.inference import ServeEngine
-    from deepspeed_tpu.telemetry.cli import summarize
-
-    import shutil
-    import tempfile
-    tel_dir = tempfile.mkdtemp(prefix=f"bench_serve_tel_{tag}_")
-    eng = ServeEngine(model, {
-        "serving": {"slots": slots, "max_seq_len": 64,
-                    "prefill_len": max(prompt_len, 1),
-                    "flush_interval_ticks": 10},
-        "telemetry": {"enabled": True, "output_path": tel_dir,
-                      "memory": False},
-    }, params=params)
-    rng = np.random.default_rng(0)
-    prompts = [rng.integers(0, 256, (prompt_len,)).astype(np.int32)
-               for _ in range(n_requests)]
-    # warm up (compile prefill + decode) BEFORE arming the delay and
-    # the clock: the A/B measures scheduling, not XLA compile time
-    eng.submit(prompts[0], max_new_tokens=2)
-    eng.run_until_idle()
-    with _injected_delay(tick_delay_s):
-        t0 = time.perf_counter()
-        arrivals = [t0 + i * arrival_s for i in range(n_requests)]
-        reqs = []
-        nxt = 0
-        while nxt < n_requests or eng.scheduler.active or eng.queue.qsize():
-            now = time.perf_counter()
-            while nxt < n_requests and arrivals[nxt] <= now:
-                reqs.append(eng.submit(prompts[nxt],
-                                       max_new_tokens=gen_tokens))
-                nxt += 1
-            if not eng.scheduler.active and eng.queue.qsize() == 0:
-                time.sleep(min(0.002, arrival_s))
-                continue
-            eng.step()
-        wall = time.perf_counter() - t0
-    assert all(r.error is None for r in reqs)
-    tokens = sum(len(r.tokens) for r in reqs)
-    eng.close()
-    with open(os.devnull, "w") as devnull:
-        report = summarize(os.path.join(tel_dir, "events.jsonl"),
-                           out=devnull)
-    shutil.rmtree(tel_dir, ignore_errors=True)
-    return {
-        "slots": slots,
-        "requests": n_requests,
-        "tokens": tokens,
-        "wall_s": wall,
-        "tokens_per_s": tokens / wall,
-        "token_p50_s": report.get("serve_token_p50_s"),
-        "token_p99_s": report.get("serve_token_p99_s"),
-    }
-
-
-def run_ab(slots=8, n_requests=16, prompt_len=8, gen_tokens=16,
-           tick_delay_s=0.02, arrival_s=0.0, out_dir="."):
-    """Batched (slot pool) vs sequential (slots=1) under the same load
-    and the same injected per-tick device time."""
-    import jax
-    model = _build_model()
-    params = model.init(jax.random.PRNGKey(0))
-    common = dict(n_requests=n_requests, prompt_len=prompt_len,
-                  gen_tokens=gen_tokens, tick_delay_s=tick_delay_s,
-                  arrival_s=arrival_s)
-    batched = run_leg(model, params, slots=slots, tag="batched", **common)
-    sequential = run_leg(model, params, slots=1, tag="sequential",
-                         **common)
-    rec = {
-        "metric": "serve_continuous_batching_speedup",
-        "value": batched["tokens_per_s"] / sequential["tokens_per_s"],
-        "tick_delay_s": tick_delay_s,
-        "batched": batched,
-        "sequential": sequential,
-    }
-    with open(os.path.join(out_dir, "BENCH_serve.json"), "w") as f:
-        json.dump(rec, f, indent=1)
-    return rec
-
-
-# ---------------------------------------------------------------------------
-# --paged: page-table indirection + prefix reuse A/B (docs/serving.md)
-# ---------------------------------------------------------------------------
-
-
-def _run_mixed_leg(model, params, serving, requests, tag):
-    """Serve a mixed short/long workload (all submitted up front — the
-    saturation snapshot) and record the max concurrently ADMITTED
-    requests: the number the KV layout, not the wall clock, decides."""
-    from deepspeed_tpu.inference import ServeEngine
-    eng = ServeEngine(model, {"serving": serving}, params=params)
-    reqs = [eng.submit(p, max_new_tokens=g) for p, g in requests]
-    max_concurrent = 0
-    ticks = 0
-    while eng.scheduler.active or eng._pending or eng.queue.qsize():
-        eng.step()
-        ticks += 1
-        max_concurrent = max(max_concurrent, len(eng.scheduler.active))
-        assert ticks < 100_000
-    assert all(r.error is None for r in reqs), \
-        [r.error for r in reqs if r.error]
-    tokens = [r.tokens for r in reqs]
-    # TRUE device bytes from the engine's memory plane (spec itemsize +
-    # quant sidecars + param tree) — never recomputed by hand here, and
-    # cross-checked against the REAL array bytes so a spec-accounting
-    # bug (e.g. a sidecar miscount) cannot silently skew a fixed-byte
-    # headline
-    kv_bytes = eng.kv_bytes
-    data_bytes = sum(int(eng.cache[key].nbytes) for key in eng.cache
-                     if key != "lengths")
-    assert data_bytes == eng.cache_spec.bytes, \
-        (data_bytes, eng.cache_spec.bytes)
-    param_bytes = eng.param_bytes
-    truncated = sum(r.finish_reason == "kv_capacity" for r in reqs)
-    eng.close()
-    return {"tag": tag, "kv_bytes": kv_bytes,
-            "param_bytes": param_bytes,
-            "max_concurrent": max_concurrent, "ticks": ticks,
-            "requests": len(reqs),
-            "kv_capacity_finishes": truncated,
-            "tokens_total": sum(len(t) for t in tokens)}, tokens
-
-
-def _run_prefix_leg(model, params, serving, prompts, gen_tokens,
-                    tick_delay_s):
-    """Serve template-sharing prompts under injected per-page prefill
-    device time; total prefill seconds comes from the same windows the
-    ``serve/prefill`` tracer spans cover (req.prefill_s)."""
-    from deepspeed_tpu.inference import ServeEngine
-    eng = ServeEngine(model, {"serving": serving}, params=params)
-    # compile prefill/decode BEFORE arming the delay: the A/B
-    # measures scheduling, not XLA compile time
-    eng.submit(prompts[0][:1], max_new_tokens=1)
-    eng.run_until_idle()
-    with _injected_delay(tick_delay_s):
-        reqs = [eng.submit(p, max_new_tokens=gen_tokens) for p in prompts]
-        eng.run_until_idle()
-    assert all(r.error is None for r in reqs)
-    out = {
-        "prefill_total_s": sum(r.prefill_s for r in reqs),
-        "computed_tokens": [r.computed_len for r in reqs],
-        "shared_tokens": [r.shared_len for r in reqs],
-        "prefix_hits": eng.prefix.hits if eng.prefix else 0,
-    }
-    tokens = [r.tokens for r in reqs]
-    eng.close()
-    return out, tokens
-
-
-def run_paged_ab(kv_budget_slots=4, max_seq_len=64, page_len=8,
-                 n_requests=24, long_every=4, template_len=24,
-                 prefix_k=6, tick_delay_s=0.03, out_dir="."):
-    """The paged A/B: (1) admitted concurrency at a fixed KV-byte
-    budget under a short/long mix, (2) prefix-reuse prefill compute.
-    ``kv_budget_slots`` sets the budget: the slot count whose fixed
-    strides exactly spend it on the legacy arm."""
-    import jax
-    import numpy as np
-    model = _build_model()
-    params = model.init(jax.random.PRNGKey(0))
-    rng = np.random.default_rng(0)
-
-    # -- leg 1: admitted slots at fixed KV bytes ------------------------
-    # budget = kv_budget_slots full strides; the page pool spends the
-    # same BYTES as pages (+1 scratch page) — both sides read their
-    # dtype itemsize from the cache specs, never a 4-bytes/elem
-    # assumption (the fp16/int8 legs of --quant ride the same helper)
-    budget_bytes = _kv_budget_bytes(model, kv_budget_slots, max_seq_len)
-    pages, _ = _pages_for_budget(model, budget_bytes, page_len)
-    short = dict(prompt=4, gen=4)       # 8 live tokens -> 1 page
-    long = dict(prompt=template_len, gen=16)
-    requests = []
-    for i in range(n_requests):
-        spec = long if (i % long_every == long_every - 1) else short
-        requests.append((list(rng.integers(0, 256, (spec["prompt"],))),
-                         spec["gen"]))
-    legacy, tok_l = _run_mixed_leg(
-        model, params,
-        {"slots": kv_budget_slots, "max_seq_len": max_seq_len,
-         "prefill_len": template_len + page_len, "queue_capacity": 256},
-        requests, "legacy")
-    paged, tok_p = _run_mixed_leg(
-        model, params,
-        {"slots": 4 * kv_budget_slots, "max_seq_len": max_seq_len,
-         "prefill_len": template_len + page_len, "queue_capacity": 256,
-         "page_len": page_len, "pages": pages},
-        requests, "paged")
-    # over-subscribing the pool may TRUNCATE a long request at pool
-    # exhaustion (the pool-aware kv_capacity finish — the documented
-    # backpressure, docs/serving.md); it must never DIVERGE: every
-    # paged stream matches the legacy arm token for token up to its
-    # length
-    truncated = 0
-    for tl, tp in zip(tok_l, tok_p):
-        assert tp == tl[:len(tp)], "paged arm diverged from legacy"
-        truncated += tp != tl
-    paged["truncated"] = truncated
-
-    # -- leg 2: prefix reuse — compute ∝ 1 template + K deltas ----------
-    template = list(rng.integers(0, 256, (template_len,)))
-    prompts = [template + list(rng.integers(0, 256, (4,)))
-               for _ in range(prefix_k)]
-    serving = {"slots": 4, "max_seq_len": max_seq_len,
-               "prefill_len": template_len + page_len,
-               "page_len": page_len, "queue_capacity": 256}
-    on, tok_on = _run_prefix_leg(
-        model, params, {**serving, "prefix_cache": True}, prompts, 2,
-        tick_delay_s)
-    off, tok_off = _run_prefix_leg(
-        model, params, {**serving, "prefix_cache": False}, prompts, 2,
-        tick_delay_s)
-    assert tok_on == tok_off, "prefix cache changed the token streams"
-
-    rec = {
-        "metric": "serve_paged_admitted_ratio",
-        "value": paged["max_concurrent"] / legacy["max_concurrent"],
-        "page_len": page_len,
-        "paged": paged,
-        "legacy": legacy,
-        "prefix": {
-            "k": prefix_k,
-            "template_len": template_len,
-            "tick_delay_s": tick_delay_s,
-            "on": on,
-            "off": off,
-            "prefill_ratio": (on["prefill_total_s"]
-                              / max(off["prefill_total_s"], 1e-9)),
-        },
-    }
-    with open(os.path.join(out_dir, "BENCH_serve_paged.json"), "w") as f:
-        json.dump(rec, f, indent=1)
-    return rec
-
-
-# ---------------------------------------------------------------------------
-# --quant: int8 weights + int8 KV pages A/B (docs/serving.md)
-# ---------------------------------------------------------------------------
-
-
-def _token_agreement(a, b):
-    """Positionwise greedy-stream agreement over two request lists —
-    REPORTED, never asserted equal: quantization is a tolerance tier,
-    not a bitwise one (docs/serving.md)."""
-    total = same = 0
-    for ta, tb in zip(a, b):
-        for x, y in zip(ta, tb):
-            total += 1
-            same += x == y
-    return same / max(total, 1)
-
-
-def run_quant_ab(kv_budget_slots=4, max_seq_len=64, page_len=8,
-                 slots=64, n_requests=96, long_every=4, out_dir="."):
-    """The quantized-serving A/B (docs/serving.md "quantized serving").
-
-    **KV leg (the headline)**: the same mixed short/long workload
-    against fp pages and int8 pages whose pools spend the SAME byte
-    budget (``kv_budget_slots`` legacy fp strides, bytes via the cache
-    specs — sidecars included).  Request geometry is page-exact
-    (prompt+gen fills whole pages), so nothing ever appends past its
-    admission allocation: 0 truncations by construction, and the max
-    concurrently admitted count is a pure bytes-per-page fact.
-
-    **Weights leg**: the same workload with weights='int8' (fp pages)
-    — params HBM from the ``serve_param_bytes`` plane (the param-tree
-    bytes ``collect_memory_stats()`` would show on a device with
-    allocator stats; the raw snapshot rides along), expected >= 1.8x
-    smaller.  Greedy token agreement vs the fp leg is REPORTED for
-    every arm, never asserted equal."""
-    import jax
-    import numpy as np
-    from deepspeed_tpu.runtime.utils import collect_memory_stats
-    model = _build_model()
-    params = model.init(jax.random.PRNGKey(0))
-    rng = np.random.default_rng(0)
-
-    budget_bytes = _kv_budget_bytes(model, kv_budget_slots, max_seq_len)
-    pages_fp, _ = _pages_for_budget(model, budget_bytes, page_len)
-    pages_q, _ = _pages_for_budget(model, budget_bytes, page_len,
-                                   quant=True)
-    # page-exact geometry: short = 1 page live, long = 3 pages live —
-    # decode never crosses a page boundary, so the pool can never dry
-    # mid-request (0 kv_capacity finishes, asserted below); gen=4
-    # keeps every request alive across several ticks so the sampled
-    # max-concurrency sees the full admitted wave
-    short = dict(prompt=page_len - 4, gen=4)
-    long = dict(prompt=3 * page_len - 4, gen=4)
-    requests = []
-    for i in range(n_requests):
-        spec = long if (i % long_every == long_every - 1) else short
-        requests.append((list(rng.integers(0, 256, (spec["prompt"],))),
-                         spec["gen"]))
-    base = {"slots": slots, "max_seq_len": max_seq_len,
-            "prefill_len": long["prompt"], "queue_capacity": 256,
-            "page_len": page_len, "prefix_cache": False}
-    fp, tok_fp = _run_mixed_leg(
-        model, params, {**base, "pages": pages_fp}, requests, "fp")
-    q, tok_q = _run_mixed_leg(
-        model, params,
-        {**base, "pages": pages_q,
-         "quantization": {"kv": "int8"}}, requests, "int8")
-    # allocatable pages spend <= the budget by construction of
-    # _pages_for_budget; the REAL accounting guard is the per-leg
-    # array-bytes == spec-bytes assert in _run_mixed_leg, plus: the
-    # int8 pool (sidecar included) must not cost more device bytes
-    # than the fp pool it beats
-    assert q["kv_bytes"] <= fp["kv_bytes"], (q["kv_bytes"],
-                                             fp["kv_bytes"])
-    truncations = fp["kv_capacity_finishes"] + q["kv_capacity_finishes"]
-    assert truncations == 0, "page-exact workload truncated"
-
-    # weights leg: same workload, int8 weights over fp pages
-    w8, tok_w8 = _run_mixed_leg(
-        model, params,
-        {**base, "pages": pages_fp,
-         "quantization": {"weights": "int8"}}, requests, "weights_int8")
-    params_ratio = fp["param_bytes"] / w8["param_bytes"]
-
-    rec = {
-        "metric": "serve_quant_admitted_ratio",
-        "value": q["max_concurrent"] / fp["max_concurrent"],
-        "kv_budget_bytes": budget_bytes,
-        "page_len": page_len,
-        "truncations": truncations,
-        "int8": q,
-        "fp": fp,
-        "weights": {
-            "leg": w8,
-            "param_bytes_fp": fp["param_bytes"],
-            "param_bytes_int8": w8["param_bytes"],
-            "params_hbm_ratio": params_ratio,
-            # allocator-stats snapshot (empty device list on the CPU
-            # oracle; real HBM on TPU) — the same plane
-            # collect_memory_stats() feeds the telemetry gauges
-            "collect_memory_stats": collect_memory_stats(),
-        },
-        "token_agreement_vs_fp": {
-            "kv_int8": _token_agreement(tok_fp, tok_q),
-            "weights_int8": _token_agreement(tok_fp, tok_w8),
-        },
-    }
-    with open(os.path.join(out_dir, "BENCH_serve_quant.json"), "w") as f:
-        json.dump(rec, f, indent=1)
-    return rec
-
-
-# ---------------------------------------------------------------------------
-# --spec: draft-verify speculative decoding A/B (docs/serving.md)
-# ---------------------------------------------------------------------------
-
-
-def _run_spec_leg(model, params, serving, draft_params, prompts,
-                  gen_tokens, pass_delay_s, tag):
-    """Serve the workload under injected per-PASS device time; wall
-    per token comes from the per-request token timestamps the
-    events.jsonl serve_request records carry (the tracer-span window),
-    mean accepted length from the engine's speculation scalars."""
-    from deepspeed_tpu.inference import ServeEngine
-
-    import shutil
-    import tempfile
-    tel_dir = tempfile.mkdtemp(prefix=f"bench_serve_spec_{tag}_")
-    eng = ServeEngine(model, {
-        "serving": serving,
-        "telemetry": {"enabled": True, "output_path": tel_dir,
-                      "memory": False},
-    }, params=params, draft_params=draft_params)
-    # compile every program BEFORE arming the delay: the A/B
-    # measures scheduling, not XLA compile time
-    warm = eng.submit(prompts[0][:4], max_new_tokens=2)
-    eng.run_until_idle()
-    # the warmup's truncated pass must not contaminate the
-    # measured statistics: reset the speculation counters and
-    # remember its rid so the events.jsonl scan below skips it
-    warm_rid = warm.rid
-    eng._spec_passes = 0
-    eng._spec_accepted_n = 0
-    eng._spec_proposed_n = 0
-    with _injected_delay(pass_delay_s):
-        t0 = time.perf_counter()
-        reqs = [eng.submit(p, max_new_tokens=gen_tokens)
-                for p in prompts]
-        eng.run_until_idle()
-        wall = time.perf_counter() - t0
-    assert all(r.error is None for r in reqs)
-    tokens = [r.tokens for r in reqs]
-    n_tokens = sum(len(t) for t in tokens)
-    passes = eng._spec_passes
-    mal = ((eng._spec_accepted_n + passes) / passes
-           if passes else 1.0)
-    eng.close()
-    # per-token decode time from the completion records' timestamps —
-    # the same windows the decode/verify spans cover (PR 9
-    # attribution).  STEADY-STATE only: a request's first decode
-    # interval absorbs the co-admitted requests' prefill delay (every
-    # admission charges one unit in BOTH legs), so counting starts at
-    # the second nonzero interval — a spec block is one nonzero
-    # interval followed by its burst of zero-stamped tokens, so this
-    # drops exactly the first (polluted) block on either leg
-    dec_s = dec_n = 0.0
-    with open(os.path.join(tel_dir, "events.jsonl")) as f:
-        for line in f:
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if rec.get("kind") == "serve_request" and rec.get("tokens") \
-                    and rec.get("rid") != warm_rid:
-                nonzero = 0
-                for t in rec.get("token_times_s") or []:
-                    if t > 0:
-                        nonzero += 1
-                    if nonzero >= 2:
-                        dec_s += float(t)
-                        dec_n += 1
-    shutil.rmtree(tel_dir, ignore_errors=True)
-    return {
-        "tag": tag,
-        "requests": len(tokens),
-        "tokens": n_tokens,
-        "wall_s": wall,
-        "wall_per_token_s": wall / max(n_tokens, 1),
-        "decode_s_per_token": dec_s / max(dec_n, 1),
-        "mean_accepted_len": mal,
-    }, tokens
-
-
-def run_spec_ab(k=4, slots=6, n_requests=6, prompt_len=8,
-                gen_tokens=None, pass_delay_s=0.25, out_dir="."):
-    """Speculative vs plain decode under the same injected per-pass
-    device time.  The draft shares the target's params (acceptance
-    ~= k), so wall/token should collapse toward 1/(k+1); the headline
-    ratio is expected ∝ 1/mean-accepted-length.
-
-    Geometry keeps the proof clean: slots cover the whole workload
-    (every admission — whose prefill delay is identical in both legs —
-    lands before the first decode tick, so the decode-phase intervals
-    are pure per-pass time) and the DEFAULT generation budget is
-    derived block-aligned from the given k (``gen_tokens - 1``
-    divisible by ``k + 1``: no half-used final pass skewing the mean
-    accepted length)."""
-    if gen_tokens is None:
-        gen_tokens = 4 * (k + 1) + 1
-    import jax
-    import numpy as np
-    model = _build_model()
-    params = model.init(jax.random.PRNGKey(0))
-    rng = np.random.default_rng(0)
-    prompts = [rng.integers(0, 256, (prompt_len,)).astype(np.int32)
-               for _ in range(n_requests)]
-    base_serving = {"slots": slots, "max_seq_len": 64,
-                    "prefill_len": max(prompt_len, 4),
-                    "queue_capacity": 256,
-                    "flush_interval_ticks": 10}
-    spec_serving = dict(base_serving)
-    spec_serving.update({
-        "speculate_k": k,
-        # the draft IS the target config here: with shared params the
-        # proposals match and acceptance runs near k — the CPU stand-in
-        # for a distilled draft
-        "draft": {"d_model": 64, "n_layer": 2, "n_head": 4},
-    })
-    spec, tok_s = _run_spec_leg(model, params, spec_serving, params,
-                                prompts, gen_tokens, pass_delay_s,
-                                "spec")
-    base, tok_b = _run_spec_leg(model, params, base_serving, None,
-                                prompts, gen_tokens, pass_delay_s,
-                                "baseline")
-    # greedy parity: speculation must never change what is emitted
-    assert tok_s == tok_b, "speculative stream diverged from baseline"
-    rec = {
-        # headline: decode-phase wall per token from the per-request
-        # token timestamps (prefill admission pays the same one unit
-        # per request in both legs and is excluded by construction —
-        # it is reported inside each leg's wall_s)
-        "metric": "serve_spec_wall_per_token_ratio",
-        "value": (spec["decode_s_per_token"]
-                  / max(base["decode_s_per_token"], 1e-9)),
-        "speculate_k": k,
-        "pass_delay_s": pass_delay_s,
-        "expected_ratio_1_over_mal": 1.0 / spec["mean_accepted_len"],
-        "total_wall_ratio": (spec["wall_per_token_s"]
-                             / base["wall_per_token_s"]),
-        "spec": spec,
-        "baseline": base,
-    }
-    with open(os.path.join(out_dir, "BENCH_serve_spec.json"), "w") as f:
-        json.dump(rec, f, indent=1)
-    return rec
-
-
-# ---------------------------------------------------------------------------
-# --fleet: router + replicated engines + SLO autoscaling A/B
-# ---------------------------------------------------------------------------
-
-
-def _fleet_config(replicas, *, min_replicas=1, max_replicas=None,
-                  slots=4, slo_p99_s=30.0, up_window_s=1.0,
-                  down_window_s=600.0):
-    """One fleet ds_config: tiny deterministic model (every replica
-    inits identical params from the shared seed), short hysteresis
-    windows sized for a CPU bench, scale-down effectively off (the
-    legs measure throughput/failover, not retirement)."""
-    return {
-        "serving": {"slots": slots, "max_seq_len": 64,
-                    "prefill_len": 8, "queue_capacity": 512,
-                    "flush_interval_ticks": 10},
-        "telemetry": {"enabled": False},
-        "fleet": {"replicas": replicas, "min_replicas": min_replicas,
-                  "max_replicas": max_replicas or max(replicas, 1),
-                  "slo_p99_s": slo_p99_s,
-                  "scale_up_window_s": up_window_s,
-                  "scale_down_window_s": down_window_s,
-                  "spawn_timeout_s": 120.0, "backoff_base_s": 0.2,
-                  "heartbeat_timeout_s": 60.0},
-        "fleet_model": {"vocab_size": 256, "n_positions": 64,
-                        "d_model": 64, "n_layer": 2, "n_head": 4,
-                        "attn_impl": "dense", "seed": 0},
-    }
-
-
-def _fleet_prompts(n, prompt_len=6, seed=0):
-    import numpy as np
-    rng = np.random.default_rng(seed)
-    return [[int(t) for t in rng.integers(0, 256, (prompt_len,))]
-            for _ in range(n)]
-
-
-def _run_fleet_leg(n_replicas, n_requests, gen_tokens, tick_delay_s,
-                   tag):
-    """One scaling leg: spawn the fleet, warm every replica (compile
-    happens off the clock), then serve the saturation workload (all
-    requests submitted up front) under injected per-tick device time.
-    Aggregate tokens/s comes from the router-side completion stream;
-    the wall window starts at the first measured submit."""
-    import shutil
-    import tempfile
-    from deepspeed_tpu.inference.fleet import FleetRouter
-    d = tempfile.mkdtemp(prefix=f"bench_fleet_{tag}_")
-    prompts = _fleet_prompts(n_requests)
-    with _injected_delay(tick_delay_s):
-        router = FleetRouter(_fleet_config(n_replicas), fleet_dir=d)
-        try:
-            router.start()
-            # one warm request per replica: JSQ spreads them, so every
-            # replica compiles prefill+decode before the clock starts
-            for _ in range(n_replicas):
-                router.submit(prompts[0], max_new_tokens=2)
-            router.run_until_idle(max_s=180)
-            t0 = time.perf_counter()
-            reqs = [router.submit(p, max_new_tokens=gen_tokens)
-                    for p in prompts]
-            router.run_until_idle(max_s=600)
-            wall = time.perf_counter() - t0
-            assert all(r.error is None for r in reqs), \
-                [repr(r.error) for r in reqs if r.error]
-            tokens = sum(len(r.tokens) for r in reqs)
-            p99 = router.queue_wait_p99(window_s=1e9)
-        finally:
-            router.close()
-            shutil.rmtree(d, ignore_errors=True)
-    return {"replicas": n_replicas, "requests": n_requests,
-            "tokens": tokens, "wall_s": wall,
-            "tokens_per_s": tokens / wall,
-            "queue_wait_p99_s": p99}
-
-
-def _read_fleet_records(fleet_dir):
-    from deepspeed_tpu.telemetry.cli import _read_jsonl_tolerant
-    records, _ = _read_jsonl_tolerant(
-        os.path.join(fleet_dir, "events.jsonl"))
-    return records
-
-
-def _run_fleet_killtrace(slo_p99_s, n_requests, arrival_s, gen_tokens,
-                         tick_delay_s, kill_after_s):
-    """The replica-kill + autoscale-up trace: 2 replicas under open-
-    loop load sized ABOVE one replica's capacity, one replica
-    SIGKILLed mid-stream.  Queued-but-unstarted requests fail over
-    (zero lost — asserted from the completion records), queue-wait p99
-    breaches the SLO while one replica carries everything, the
-    autoscaler spawns a replacement, and the tail-phase p99 lands back
-    under the SLO."""
-    import shutil
-    import tempfile
-    from deepspeed_tpu.inference.fleet import FleetRouter
-    d = tempfile.mkdtemp(prefix="bench_fleet_kill_")
-    prompts = _fleet_prompts(n_requests, seed=1)
-    cfg = _fleet_config(2, min_replicas=1, max_replicas=3, slots=2,
-                        slo_p99_s=slo_p99_s, up_window_s=0.5)
-    with _injected_delay(tick_delay_s):
-        router = FleetRouter(cfg, fleet_dir=d)
-        try:
-            router.start()
-            initial_ids = sorted(router.replicas)
-            for _ in range(2):
-                router.submit(prompts[0], max_new_tokens=2)
-            router.run_until_idle(max_s=180)
-            t0 = time.perf_counter()
-            reqs = []
-            submit_ts = []
-            killed = None
-            recover_t = None
-            nxt = 0
-            while nxt < n_requests or not router.idle():
-                now = time.perf_counter() - t0
-                while nxt < n_requests and nxt * arrival_s <= now:
-                    reqs.append(router.submit(
-                        prompts[nxt], max_new_tokens=gen_tokens))
-                    submit_ts.append(now)
-                    nxt += 1
-                if killed is None and now >= kill_after_s:
-                    # kill the busier initial replica: guaranteed
-                    # queued-but-unstarted work to fail over
-                    victims = [r for r in router.replicas.values()
-                               if r.id in initial_ids
-                               and r.state == "ready"]
-                    victims.sort(key=lambda r: -len(r.outstanding))
-                    killed = victims[0].id
-                    router.kill_replica(killed)
-                if recover_t is None and any(
-                        rid not in initial_ids
-                        and router.replicas[rid].state == "ready"
-                        for rid in router.replicas):
-                    recover_t = time.perf_counter() - t0
-                router.poll(0.01)
-            wall = time.perf_counter() - t0
-            records = _read_fleet_records(d)
-        finally:
-            router.close()
-            shutil.rmtree(d, ignore_errors=True)
-
-    # zero queued-but-unstarted requests lost: asserted from the
-    # per-request completion records — every failed record must have
-    # started=True (its tokens were already streaming: typed
-    # ReplicaFailure, not silently-retriable work)
-    completions = {r["rid"]: r for r in records
-                   if r.get("kind") == "fleet_request"}
-    submits = [r for r in records if r.get("kind") == "fleet_submit"]
-    assert len(completions) == len(submits), \
-        f"dangling requests: {len(submits) - len(completions)}"
-    lost = [r for r in completions.values()
-            if r.get("error") and not r.get("started")]
-    assert not lost, f"queued-but-unstarted requests lost: {lost}"
-    failovers = sum(int(r.get("failed_over") or 0) for r in records
-                    if r.get("kind") == "replica_dead")
-    assert failovers > 0, "the kill never hit queued work"
-    midstream = [r for r in completions.values() if r.get("error")]
-    # p99 attribution by phase: degraded = submitted after the kill
-    # while only one replica served; recovered = submitted after the
-    # autoscaled replacement came up.  The SLO claim is about the tail.
-    assert recover_t is not None, "autoscale never spawned"
-
-    from deepspeed_tpu.inference.fleet import _p99
-
-    def _phase_p99(lo, hi):
-        return _p99([
-            completions[r.rid]["queue_wait_s"]
-            for r, t in zip(reqs, submit_ts)
-            if lo <= t < hi and r.rid in completions
-            and completions[r.rid].get("queue_wait_s") is not None])
-
-    p99_degraded = _phase_p99(kill_after_s, recover_t)
-    # the recovered phase starts one backlog-drain grace after the
-    # replacement came up (the surplus capacity needs a moment to eat
-    # the degraded phase's queue); the claim is the TAIL holds the SLO
-    drain_grace_s = min(2.0, (wall - recover_t) / 3)
-    p99_recovered = _phase_p99(recover_t + drain_grace_s, 1e9)
-    assert p99_recovered is not None and p99_recovered < slo_p99_s, \
-        (p99_recovered, slo_p99_s)
-    return {
-        "slo_p99_s": slo_p99_s,
-        "requests": n_requests,
-        "arrival_s": arrival_s,
-        "tick_delay_s": tick_delay_s,
-        "killed_replica": killed,
-        "kill_after_s": kill_after_s,
-        "recover_after_s": recover_t,
-        "wall_s": wall,
-        "failovers": failovers,
-        "midstream_failed": len(midstream),
-        "unstarted_lost": 0,
-        "queue_wait_p99_degraded_s": p99_degraded,
-        "queue_wait_p99_recovered_s": p99_recovered,
-    }
-
-
-def run_fleet_ab(n_requests=16, gen_tokens=16, tick_delay_s=0.04,
-                 slo_p99_s=1.5, out_dir="."):
-    """The fleet A/B: aggregate tokens/s at 1 vs 2 replicas under
-    identical injected per-tick device time (the headline, >= 1.8x
-    expected — each replica is an independent slot pool paying its own
-    ticks), plus the replica-kill + autoscale-up trace."""
-    one = _run_fleet_leg(1, n_requests, gen_tokens, tick_delay_s,
-                         "one")
-    two = _run_fleet_leg(2, n_requests, gen_tokens, tick_delay_s,
-                         "two")
-    # 100 requests at 0.12s spacing = a 12s open-loop window: the kill
-    # lands early, the autoscaled replacement comes up mid-window, and
-    # the tail requests measure the RECOVERED fleet's queue wait
-    kill = _run_fleet_killtrace(
-        slo_p99_s=slo_p99_s, n_requests=100, arrival_s=0.12,
-        gen_tokens=9, tick_delay_s=tick_delay_s, kill_after_s=1.2)
-    rec = {
-        "metric": "fleet_scaling_tokens_ratio",
-        "value": two["tokens_per_s"] / one["tokens_per_s"],
-        "tick_delay_s": tick_delay_s,
-        "one_replica": one,
-        "two_replicas": two,
-        "killtrace": kill,
-    }
-    with open(os.path.join(out_dir, "BENCH_fleet.json"), "w") as f:
-        json.dump(rec, f, indent=1)
-    return rec
 
 
 def main():

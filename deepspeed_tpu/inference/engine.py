@@ -511,6 +511,10 @@ class ServeEngine:
 
             self.stage.counter_fn = _stage_counter
 
+        #: perf_counter epoch for the completion records' ``arrival_s``
+        #: stamps — submit times made record-relative, so open-loop
+        #: queueing is reconstructible from events.jsonl alone
+        self._epoch_t = time.perf_counter()
         self._rid = 0
         self._ticks = 0
         self._closed = False
@@ -768,6 +772,10 @@ class ServeEngine:
         rec = {
             "rid": req.rid,
             "prompt_len": len(req.prompt),
+            # submit time relative to the engine's epoch: the open-loop
+            # arrival schedule, reconstructible offline (goodput.py);
+            # readers tolerate its absence in pre-PR-17 artifacts
+            "arrival_s": round(req.submit_t - self._epoch_t, 6),
             "tokens": len(req.tokens),
             "finish_reason": req.finish_reason,
             "error": repr(req.error) if req.error is not None else None,

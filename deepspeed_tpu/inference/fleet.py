@@ -301,8 +301,15 @@ class FleetRouter:
             pass  # a full disk must not take the router down
 
     def _write_request_record(self, fr: FleetRequest) -> None:
+        # arrival_s: submit time relative to the router's start — the
+        # open-loop schedule, reconstructible from the ledger alone
+        # (telemetry/goodput.py; readers tolerate pre-PR-17 records
+        # without it)
+        epoch = self._started_t if self._started_t is not None \
+            else fr.submit_t
         self._record(
             "fleet_request", rid=fr.rid, replica=fr.replica,
+            arrival_s=round(fr.submit_t - epoch, 6),
             tokens=len(fr.tokens), finish_reason=fr.finish_reason,
             error=repr(fr.error) if fr.error is not None else None,
             queue_wait_s=fr.queue_wait_s, ttft_s=fr.ttft_s,

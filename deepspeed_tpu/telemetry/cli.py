@@ -40,6 +40,19 @@ def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
     return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
 
 
+def _slo_ok(ttft: Optional[float], tpot: Optional[float],
+            slo_ttft_s: float, slo_tpot_s: float) -> bool:
+    """THE goodput verdict (docs/serving.md "workload plane"): a
+    request is good only if its first token landed within the TTFT SLO
+    and its decode cadence held the TPOT SLO.  A request that never
+    produced a token fails; a one-token request has no decode phase
+    and passes TPOT vacuously.  One copy — telemetry/goodput.py and
+    the record-derived goodput row below share it."""
+    if ttft is None or ttft > slo_ttft_s:
+        return False
+    return tpot is None or tpot <= slo_tpot_s
+
+
 def _fmt_s(v: Optional[float]) -> str:
     if v is None:
         return "n/a"
@@ -90,6 +103,13 @@ def summarize(path: str, out=None) -> dict:
     sv_spec_mal: Optional[float] = None
     sv_param_bytes: Optional[float] = None
     sv_kv_bytes: Optional[float] = None
+    # goodput plane (docs/serving.md "workload plane"): the SLOs and
+    # the live tracker's verdict arrive as sync scalars; the
+    # per-request phases below recompute the same verdict offline
+    sv_goodput: Optional[float] = None
+    sv_goodput_n: Optional[float] = None
+    sv_slo_ttft: Optional[float] = None
+    sv_slo_tpot: Optional[float] = None
     # per-request serving records (kind: serve_request) — the
     # queue/prefill/decode latency attribution split
     sv_requests = 0
@@ -97,6 +117,12 @@ def summarize(path: str, out=None) -> dict:
     sv_queue_wait: List[float] = []
     sv_ttft: List[float] = []
     sv_decode: List[float] = []
+    sv_tpot: List[float] = []
+    #: (ttft, tpot, errored) per request for the record-derived
+    #: goodput row; arrival_s is optional (absent in pre-PR-17
+    #: artifacts — everything here tolerates that)
+    sv_phases: List[tuple] = []
+    sv_arrivals: List[float] = []
     stragglers: Optional[float] = None
     #: last metrics snapshot's heartbeat_age_s gauges (liveness row)
     beat_ages: Dict[str, float] = {}
@@ -219,6 +245,20 @@ def summarize(path: str, out=None) -> dict:
                 kb = scalars.get("serve_kv_bytes")
                 if kb is not None:
                     sv_kv_bytes = float(kb)
+                # goodput scalars (telemetry/goodput.py flush): all
+                # cumulative — the LAST flush is the run's answer
+                gp = scalars.get("serve_goodput")
+                if gp is not None:
+                    sv_goodput = float(gp)
+                gn = scalars.get("serve_goodput_requests")
+                if gn is not None:
+                    sv_goodput_n = float(gn)
+                gt = scalars.get("serve_slo_ttft_s")
+                if gt is not None:
+                    sv_slo_ttft = float(gt)
+                gd = scalars.get("serve_slo_tpot_s")
+                if gd is not None:
+                    sv_slo_tpot = float(gd)
                 sg = scalars.get("straggler_detected_total")
                 if sg is not None:
                     # cumulative counter: the last/maximum value is the
@@ -234,6 +274,22 @@ def summarize(path: str, out=None) -> dict:
                     sv_ttft.append(float(rec["ttft_s"]))
                 for t in rec.get("token_times_s") or []:
                     sv_decode.append(float(t))
+                # phase attribution for the goodput row: mean time per
+                # output token over the request's decode phase, plus
+                # the open-loop arrival stamp (optional — pre-PR-17
+                # records don't carry arrival_s and must still parse)
+                tpot = None
+                dn = rec.get("decode_tokens")
+                if dn:
+                    tpot = float(rec.get("decode_s_sum") or 0.0) \
+                        / int(dn)
+                    sv_tpot.append(tpot)
+                ttft = rec.get("ttft_s")
+                sv_phases.append(
+                    (float(ttft) if ttft is not None else None,
+                     tpot, bool(rec.get("error"))))
+                if rec.get("arrival_s") is not None:
+                    sv_arrivals.append(float(rec["arrival_s"]))
             elif kind == "metrics":
                 # liveness: keep the LAST snapshot's per-host beat ages
                 ages = {m["labels"].get("host", "?"): float(m["value"])
@@ -287,6 +343,25 @@ def summarize(path: str, out=None) -> dict:
     sv_queue_wait.sort()
     sv_ttft.sort()
     sv_decode.sort()
+    sv_tpot.sort()
+    # record-derived goodput: when the SLO scalars are present, rescore
+    # every completion record with the same verdict the live tracker
+    # used — the two must agree, and an artifact with records but no
+    # tracker flush still gets a goodput answer
+    rec_goodput = None
+    ttft_miss = tpot_miss = None
+    if sv_slo_ttft is not None and sv_slo_tpot is not None and sv_phases:
+        good = 0
+        ttft_miss = tpot_miss = 0
+        for ttft, tpot, errored in sv_phases:
+            if ttft is None or ttft > sv_slo_ttft:
+                ttft_miss += 1
+            if tpot is not None and tpot > sv_slo_tpot:
+                tpot_miss += 1
+            if not errored and _slo_ok(ttft, tpot, sv_slo_ttft,
+                                       sv_slo_tpot):
+                good += 1
+        rec_goodput = good / len(sv_phases)
 
     report = {
         "steps": steps,
@@ -314,6 +389,17 @@ def summarize(path: str, out=None) -> dict:
         "serve_ttft_p99_s": _percentile(sv_ttft, 0.99),
         "serve_decode_p50_s": _percentile(sv_decode, 0.50),
         "serve_decode_p99_s": _percentile(sv_decode, 0.99),
+        "serve_tpot_p50_s": _percentile(sv_tpot, 0.50),
+        "serve_tpot_p99_s": _percentile(sv_tpot, 0.99),
+        "serve_goodput": sv_goodput,
+        "serve_goodput_requests": sv_goodput_n,
+        "serve_goodput_from_records": rec_goodput,
+        "serve_slo_ttft_s": sv_slo_ttft,
+        "serve_slo_tpot_s": sv_slo_tpot,
+        "serve_slo_ttft_miss": ttft_miss,
+        "serve_slo_tpot_miss": tpot_miss,
+        "serve_arrival_span_s": (max(sv_arrivals) - min(sv_arrivals)
+                                 if sv_arrivals else None),
         "serve_page_utilization": (sum(sv_page_util) / len(sv_page_util)
                                    if sv_page_util else None),
         "serve_free_pages": sv_free_pages,
@@ -396,6 +482,33 @@ def summarize(path: str, out=None) -> dict:
         print(f"    decode/tok  p50 "
               f"{_fmt_s(report['serve_decode_p50_s'])}  p99 "
               f"{_fmt_s(report['serve_decode_p99_s'])}", file=out)
+    goodput = sv_goodput if sv_goodput is not None else rec_goodput
+    if goodput is not None:
+        # goodput (docs/serving.md "workload plane"): fraction of
+        # requests meeting BOTH phase SLOs, with the per-phase tails
+        # and miss counts that say WHICH SLO the load broke
+        slo_txt = ""
+        if sv_slo_ttft is not None and sv_slo_tpot is not None:
+            slo_txt = (f" (ttft<={_fmt_s(sv_slo_ttft)}, "
+                       f"tpot<={_fmt_s(sv_slo_tpot)})")
+        n_txt = int(sv_goodput_n) if sv_goodput_n is not None \
+            else len(sv_phases)
+        print(f"  goodput            {goodput * 100:.0f}% of {n_txt} "
+              f"requests met both SLOs{slo_txt}", file=out)
+        miss_txt = (f"  (miss {ttft_miss})"
+                    if ttft_miss is not None else "")
+        print(f"    ttft        p50 {_fmt_s(report['serve_ttft_p50_s'])}"
+              f"  p99 {_fmt_s(report['serve_ttft_p99_s'])}{miss_txt}",
+              file=out)
+        miss_txt = (f"  (miss {tpot_miss})"
+                    if tpot_miss is not None else "")
+        print(f"    tpot        p50 {_fmt_s(report['serve_tpot_p50_s'])}"
+              f"  p99 {_fmt_s(report['serve_tpot_p99_s'])}{miss_txt}",
+              file=out)
+        if report["serve_arrival_span_s"] is not None:
+            print(f"    arrivals    span "
+                  f"{_fmt_s(report['serve_arrival_span_s'])} "
+                  "(open-loop, from record arrival_s)", file=out)
     if report["serve_page_utilization"] is not None:
         # paged KV pool: mean fraction of allocatable pages in use; the
         # free count is the last flush's headroom (docs/serving.md)

@@ -63,6 +63,13 @@ if [ "$1" = "--serve" ]; then
   run serve_spec python bench_serve.py --spec ab
   run serve_quant python bench_serve.py --quant ab
   run fleet python bench_serve.py --fleet ab
+  run loadgen_goodput python -m tools.loadgen goodput
+  exit 0
+fi
+# --loadgen: just the workload plane's goodput/chaos headline (pure
+# CPU — uniform vs burst arrival over the one replay harness)
+if [ "$1" = "--loadgen" ]; then
+  run loadgen_goodput python -m tools.loadgen goodput
   exit 0
 fi
 # capacity runs LAST: its probes are subprocesses killed on timeout,
@@ -101,6 +108,12 @@ run serve_quant python bench_serve.py --quant ab
 # time, plus the replica-kill + autoscale-up SLO-recovery trace (pure
 # CPU subprocess supervision — see docs/serving.md "serving fleet")
 run fleet python bench_serve.py --fleet ab
+# workload-plane goodput A/B: the SAME payload under uniform vs
+# heavy-tailed burst arrival at the same mean rate — throughput stays
+# flat, goodput (both-phase SLO attainment) collapses; plus the fleet
+# chaos leg (replica kill + autoscale mid-burst, zero lost requests
+# asserted from the ledger) — docs/serving.md "workload plane"
+run loadgen_goodput python -m tools.loadgen goodput
 run bert python bench_bert.py
 run sparse python bench_sparse.py
 run flash python bench_flash.py

@@ -1,0 +1,259 @@
+"""Workload plane: deterministic loadgen + goodput/SLO accounting.
+
+Covers the contracts docs/serving.md "workload plane" commits to:
+
+* ``Workload.build(seed)`` is byte-deterministic, and two workloads
+  differing ONLY in arrival shape serve byte-identical payloads.
+* The goodput reader reconstructs per-request phases from completion
+  records alone — including pre-PR-17 records without ``arrival_s``
+  (regression-pinned) and fleet-ledger records — and tolerates the
+  torn final line of a killed run with the skipped count reported.
+* The live ``GoodputTracker`` exports through every hub plane, and
+  ``telemetry summarize`` reads the verdict back from events.jsonl.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tools.loadgen.workload import (ArrivalSpec, LengthSpec, Workload,
+                                    load_trace, schedule_fingerprint)
+from deepspeed_tpu.telemetry.goodput import (GoodputTracker,
+                                             phases_from_record,
+                                             read_goodput, score)
+from deepspeed_tpu.telemetry.cli import summarize
+
+
+# ---------------------------------------------------------------------------
+# workload generation: determinism + the arrival/length algebra
+# ---------------------------------------------------------------------------
+
+
+def test_workload_build_is_byte_deterministic():
+    w = Workload(24, arrival=ArrivalSpec("poisson", rate=20.0),
+                 prompt_len=LengthSpec("lognormal", median=6.0),
+                 gen_tokens=LengthSpec("choice",
+                                       choices=((4, 1.0), (12, 3.0))))
+    fp = schedule_fingerprint(w.build(seed=7))
+    assert fp == schedule_fingerprint(w.build(seed=7))
+    assert fp != schedule_fingerprint(w.build(seed=8))
+
+
+def test_arrival_shape_never_changes_the_payload():
+    """The two-generator contract: uniform and burst schedules with
+    the same seed serve byte-identical prompts/budgets, so a goodput
+    A/B isolates arrival shape and nothing else."""
+    kw = dict(prompt_len=LengthSpec("lognormal", median=5.0),
+              gen_tokens=LengthSpec(value=6))
+    uni = Workload(16, arrival=ArrivalSpec("uniform", period=0.1),
+                   **kw).build(seed=3)
+    burst = Workload(16, arrival=ArrivalSpec("gamma_burst", rate=10.0,
+                                             cv=6.0), **kw).build(seed=3)
+    assert [i.prompt for i in uni] == [i.prompt for i in burst]
+    assert [i.max_new_tokens for i in uni] \
+        == [i.max_new_tokens for i in burst]
+    assert [i.at_s for i in uni] != [i.at_s for i in burst]
+
+
+def test_arrival_kinds():
+    rng = np.random.default_rng(0)
+    assert ArrivalSpec("uniform", period=0.5).offsets(3, rng) \
+        == [0.0, 0.5, 1.0]
+    offs = ArrivalSpec("poisson", rate=100.0).offsets(
+        50, np.random.default_rng(0))
+    assert offs[0] == 0.0 and offs == sorted(offs)
+    # trace offsets are normalized to first-arrival-at-t0
+    tr = ArrivalSpec("trace", trace=(2.0, 2.5, 4.0))
+    assert tr.offsets(3, rng) == [0.0, 0.5, 2.0]
+    with pytest.raises(ValueError):
+        tr.offsets(4, rng)
+    with pytest.raises(ValueError):
+        ArrivalSpec("weibull").offsets(1, rng)
+
+
+def test_gamma_burst_clumps():
+    """cv >> 1 must actually produce clumping: many near-zero gaps and
+    a max gap far above the mean (that is the entire point of the
+    arrival-shape A/B)."""
+    offs = ArrivalSpec("gamma_burst", rate=10.0, cv=6.0).offsets(
+        200, np.random.default_rng(1))
+    gaps = np.diff(offs)
+    assert (gaps < 0.01).mean() > 0.5
+    assert gaps.max() > 5 * 0.1
+
+
+def test_mix_template_and_session_gaps():
+    w = Workload(8, arrival=ArrivalSpec("uniform", period=0.1),
+                 mix=((3, 2), (3, 2), (10, 4)),
+                 session_len=4, idle_gap_s=1.0)
+    items = w.build(seed=0)
+    assert [len(i.prompt) for i in items] == [3, 3, 10] * 2 + [3, 3]
+    assert [i.max_new_tokens for i in items] == [2, 2, 4] * 2 + [2, 2]
+    # one idle gap inserted at the session boundary, sessions labelled
+    assert [i.session for i in items] == [0] * 4 + [1] * 4
+    assert items[4].at_s == pytest.approx(0.4 + 1.0)
+    # template mix: every prompt starts with the shared prefix
+    tw = Workload(6, prompt_len=LengthSpec(value=12),
+                  template_ratio=1.0, template_len=8).build(seed=0)
+    heads = {i.prompt[:8] for i in tw}
+    assert len(heads) == 1
+    assert len({i.prompt for i in tw}) == 6   # unique suffixes
+
+
+def test_load_trace_tolerates_torn_lines(tmp_path):
+    p = tmp_path / "trace.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"at_s": 0.0, "prompt_len": 4}) + "\n")
+        f.write(json.dumps({"at_s": 0.25}) + "\n")
+        f.write('{"at_s": 0.5, "prompt_')        # torn final line
+    arrival, records = load_trace(str(p))
+    assert arrival.kind == "trace" and arrival.trace == (0.0, 0.25)
+    assert len(records) == 2
+    items = Workload(2, arrival=arrival,
+                     prompt_len=LengthSpec(value=4)).build(seed=0)
+    assert [i.at_s for i in items] == [0.0, 0.25]
+
+
+# ---------------------------------------------------------------------------
+# goodput: phase attribution + SLO scoring from records alone
+# ---------------------------------------------------------------------------
+
+
+def _serve_rec(rid, ttft, tpot, tokens=5, **extra):
+    rec = {"kind": "serve_request", "rid": rid, "tokens": tokens,
+           "queue_wait_s": 0.01, "ttft_s": ttft,
+           "decode_tokens": tokens - 1,
+           "decode_s_sum": tpot * (tokens - 1)}
+    rec.update(extra)
+    return rec
+
+
+def test_score_verdicts():
+    phases = [phases_from_record(r) for r in [
+        _serve_rec(1, ttft=0.05, tpot=0.02),            # good
+        _serve_rec(2, ttft=0.50, tpot=0.02),            # ttft miss
+        _serve_rec(3, ttft=0.05, tpot=0.30),            # tpot miss
+        _serve_rec(4, ttft=0.05, tpot=0.0, tokens=1,
+                   decode_tokens=0, decode_s_sum=0.0),  # vacuous tpot
+        _serve_rec(5, ttft=0.05, tpot=0.02,
+                   error="ReplicaFailure('boom')"),     # errored
+    ]]
+    rep = score(phases, slo_ttft_s=0.1, slo_tpot_s=0.1)
+    assert rep["requests"] == 5 and rep["failed"] == 1
+    assert rep["ttft_miss"] == 1 and rep["tpot_miss"] == 1
+    # good = {1, 4}: one-token request passes TPOT vacuously; the
+    # errored request counts in n but can never be good
+    assert rep["goodput"] == pytest.approx(2 / 5)
+    assert rep["ttft_p99_s"] == pytest.approx(0.5, rel=0.05)
+
+
+def test_phases_from_fleet_ledger_record():
+    """Fleet-ledger completions carry no decode_s_sum; TPOT is
+    reconstructed as (total - queue_wait - ttft) / (tokens - 1)."""
+    ph = phases_from_record({
+        "kind": "fleet_request", "rid": 9, "tokens": 5,
+        "queue_wait_s": 0.2, "ttft_s": 0.1, "total_s": 0.7,
+        "failovers": 1, "started": True})
+    assert ph["tpot_s"] == pytest.approx(0.4 / 4)
+    assert ph["queue_wait_s"] == pytest.approx(0.2)
+    # other ledger kinds are not requests
+    assert phases_from_record({"kind": "fleet_submit", "rid": 9}) is None
+    assert phases_from_record({"kind": "replica_dead"}) is None
+
+
+def test_read_goodput_tolerates_torn_tail(tmp_path):
+    """A killed run tears its final events.jsonl line mid-write; the
+    reader skips it and REPORTS the skip, never silently drops it."""
+    p = tmp_path / "events.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps(_serve_rec(1, ttft=0.05, tpot=0.02)) + "\n")
+        f.write(json.dumps(_serve_rec(2, ttft=0.50, tpot=0.02)) + "\n")
+        f.write(json.dumps(_serve_rec(3, ttft=0.05, tpot=0.02))[:37])
+    rep = read_goodput(str(p), slo_ttft_s=0.1, slo_tpot_s=0.1)
+    assert rep["skipped_lines"] == 1
+    assert rep["requests"] == 2
+    assert rep["goodput"] == pytest.approx(0.5)
+
+
+def test_summarize_tolerates_records_without_arrival_s(tmp_path):
+    """Regression pin: pre-PR-17 serve_request records carry no
+    arrival_s — summarize must still parse them, report the goodput
+    row from the SLO scalars, and leave the arrival span None."""
+    p = tmp_path / "events.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({
+            "kind": "sync", "step": 10,
+            "scalars": {"serve_goodput": 0.5,
+                        "serve_goodput_requests": 2.0,
+                        "serve_slo_ttft_s": 0.1,
+                        "serve_slo_tpot_s": 0.1}}) + "\n")
+        for rec in (_serve_rec(1, ttft=0.05, tpot=0.02),
+                    _serve_rec(2, ttft=0.50, tpot=0.02)):
+            rec.pop("arrival_s", None)
+            f.write(json.dumps(rec) + "\n")
+    rep = summarize(str(p))
+    assert rep["serve_arrival_span_s"] is None
+    assert rep["serve_goodput"] == pytest.approx(0.5)
+    # the record-derived verdict independently agrees with the scalar
+    assert rep["serve_goodput_from_records"] == pytest.approx(0.5)
+    assert rep["serve_slo_ttft_miss"] == 1
+    assert rep["serve_slo_tpot_miss"] == 0
+    assert rep["serve_tpot_p99_s"] == pytest.approx(0.02)
+
+
+def test_goodput_tracker_round_trips_through_the_hub(tmp_path):
+    """Live tracker -> hub planes -> events.jsonl -> summarize: the
+    counters/gauge land in the registry, the scalar flush lands in the
+    artifact, and the summarize goodput section reads it back."""
+    from deepspeed_tpu.telemetry import TelemetryHub
+    hub = TelemetryHub(str(tmp_path), compile_events=False,
+                       memory=False)
+    tracker = GoodputTracker(0.1, 0.1, hub=hub)
+    assert tracker.observe(phases_from_record(
+        _serve_rec(1, ttft=0.05, tpot=0.02))) is True
+    assert tracker.observe(phases_from_record(
+        _serve_rec(2, ttft=0.50, tpot=0.02))) is False
+    rep = tracker.flush(step=2)
+    assert rep["goodput"] == pytest.approx(0.5)
+    assert hub.registry.counter(
+        "serve_slo_ttft_miss_total").value() == 1
+    assert hub.registry.gauge("serve_goodput_ratio").value() \
+        == pytest.approx(0.5)
+    hub.close()
+    out = summarize(os.path.join(str(tmp_path), "events.jsonl"))
+    assert out["serve_goodput"] == pytest.approx(0.5)
+    assert out["serve_goodput_requests"] == 2
+    assert out["serve_slo_ttft_s"] == pytest.approx(0.1)
+
+
+def test_engine_records_carry_arrival_s(tmp_path):
+    """Post-PR-17 engines stamp the open-loop submit offset into every
+    completion record, so queueing is reconstructible from the
+    artifact alone."""
+    from deepspeed_tpu.inference import ServeEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    model = GPT2Model(GPT2Config(
+        vocab_size=128, n_positions=64, d_model=32, n_layer=2,
+        n_head=4, remat=None, attn_impl="dense"))
+    eng = ServeEngine(model, {
+        "serving": {"slots": 2, "max_seq_len": 32, "prefill_len": 4},
+        "telemetry": {"enabled": True, "output_path": str(tmp_path),
+                      "memory": False}})
+    eng.submit([1, 2, 3], max_new_tokens=2)
+    eng.submit([4, 5], max_new_tokens=2)
+    eng.run_until_idle()
+    eng.close()
+    rep = read_goodput(os.path.join(str(tmp_path), "events.jsonl"),
+                       slo_ttft_s=60.0, slo_tpot_s=60.0)
+    assert rep["requests"] == 2 and rep["goodput"] == 1.0
+    arrivals = [r["arrival_s"] for r in _records(tmp_path)
+                if r.get("kind") == "serve_request"]
+    assert len(arrivals) == 2
+    assert all(a is not None and a >= 0.0 for a in arrivals)
+    assert arrivals == sorted(arrivals)
+
+
+def _records(tel_dir):
+    with open(os.path.join(str(tel_dir), "events.jsonl")) as f:
+        return [json.loads(line) for line in f if line.strip()]

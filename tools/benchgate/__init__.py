@@ -73,6 +73,12 @@ METRIC_DIRECTIONS = {
     # boolean-as-1: the chaos run degraded and completed instead of
     # wedging — 1 is the pass value, HIGHER is better
     "stage_chaos_degraded_run": False,
+    # goodput gap, uniform minus burst arrival at the same mean rate:
+    # the gate guards that the bench keeps RESOLVING the phenomenon
+    # (goodput collapses under burst while throughput stays flat) —
+    # a shrinking gap means the workload plane went blind, so HIGHER
+    # is better (docs/serving.md "workload plane")
+    "loadgen_goodput_burst_gap": False,
 }
 
 

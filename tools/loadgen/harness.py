@@ -1,0 +1,290 @@
+"""ONE open-loop replay harness (docs/serving.md "workload plane").
+
+Every serving bench leg used to hand-copy the same drive loop; this is
+the single implementation.  :func:`replay_engine` replays a built
+workload schedule against a bare ``ServeEngine``;
+:func:`replay_fleet` replays it against a ``FleetRouter`` fleet, with
+optional mid-trace chaos (replica kill) and autoscale-recovery
+watching.  Both are OPEN-LOOP: arrivals fire on the wall clock
+regardless of completions, so queue wait is a measured fact, not an
+artifact of the driver.
+
+The CPU-provable idiom rides along unchanged: warm up (compile) BEFORE
+arming ``DS_STAGE_DELAY_S=serve:<s>`` injected device time, measure
+inside the armed window, restore the previous spec afterwards.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import shutil
+import tempfile
+import time
+from typing import Callable, List, Optional, Sequence
+
+from .workload import WorkloadItem
+
+
+@contextlib.contextmanager
+def injected_delay(delay_s: Optional[float]):
+    """Arm ``DS_STAGE_DELAY_S=serve:<s>`` for one leg and restore the
+    previous spec (re-parsing the cached spec both ways) — the
+    save/arm/restore dance every A/B leg used to hand-copy."""
+    from deepspeed_tpu.runtime.stages import reset_fault_injection
+    prev = os.environ.get("DS_STAGE_DELAY_S")
+    try:
+        if delay_s is not None:
+            os.environ["DS_STAGE_DELAY_S"] = f"serve:{delay_s}"
+            reset_fault_injection()
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("DS_STAGE_DELAY_S", None)
+        else:
+            os.environ["DS_STAGE_DELAY_S"] = prev
+        reset_fault_injection()
+
+
+@dataclasses.dataclass
+class EngineRun:
+    """What one engine replay measured.  ``requests`` are the live
+    ``Request`` objects (tokens, finish reasons, prefill/shared
+    spans); ``records``/``report`` come from the telemetry dir's
+    events.jsonl when telemetry was on; ``stats`` is whatever the
+    scenario's ``collect(engine)`` snapshotted before close."""
+    requests: list
+    wall_s: float
+    ticks: int
+    max_concurrent: int
+    warm_rid: Optional[int] = None
+    report: Optional[dict] = None
+    records: Optional[list] = None
+    skipped_lines: int = 0
+    goodput: Optional[dict] = None
+    stats: Optional[dict] = None
+
+    @property
+    def tokens(self) -> int:
+        return sum(len(r.tokens) for r in self.requests)
+
+
+def replay_engine(model, params, serving: dict,
+                  items: Sequence[WorkloadItem], *,
+                  telemetry: bool = False,
+                  warmup: Optional[tuple] = None,
+                  delay_s: Optional[float] = None,
+                  reset_spec_counters: bool = False,
+                  slo: Optional[tuple] = None,
+                  allow_errors: bool = False,
+                  collect: Optional[Callable] = None,
+                  draft_params=None,
+                  max_ticks: int = 100_000,
+                  tag: str = "leg") -> EngineRun:
+    """Replay a workload schedule against one ``ServeEngine``.
+
+    ``warmup``  (prompt, tokens) submitted and drained BEFORE the
+                delay is armed — compiles off the clock; its rid is
+                returned so record scans can exclude it.
+    ``slo``     (slo_ttft_s, slo_tpot_s): attach a live
+                ``GoodputTracker`` to the engine's hub — per-request
+                verdicts during the run, one scalar flush at the end
+                (requires ``telemetry=True``).
+    ``collect`` called with the still-open engine after the drain —
+                the scenario's seam for cache-byte asserts, spec
+                counters, prefix stats.
+    """
+    from deepspeed_tpu.inference import ServeEngine
+    from deepspeed_tpu.telemetry.cli import (_read_jsonl_tolerant,
+                                             summarize)
+    from deepspeed_tpu.telemetry.goodput import (GoodputTracker,
+                                                 phases_from_request)
+
+    tel_dir = None
+    cfg = {"serving": serving}
+    if telemetry:
+        tel_dir = tempfile.mkdtemp(prefix=f"loadgen_{tag}_")
+        cfg["telemetry"] = {"enabled": True, "output_path": tel_dir,
+                            "memory": False}
+    eng = ServeEngine(model, cfg, params=params,
+                      draft_params=draft_params)
+    warm_rid = None
+    try:
+        if warmup is not None:
+            warm_prompt, warm_tokens = warmup
+            warm = eng.submit(warm_prompt, max_new_tokens=warm_tokens)
+            eng.run_until_idle()
+            warm_rid = warm.rid
+            if reset_spec_counters:
+                # the warmup's truncated pass must not contaminate the
+                # measured speculation statistics
+                eng._spec_passes = 0
+                eng._spec_accepted_n = 0
+                eng._spec_proposed_n = 0
+        n = len(items)
+        reqs: list = []
+        ticks = 0
+        max_concurrent = 0
+        with injected_delay(delay_s):
+            t0 = time.perf_counter()
+            arrivals = [t0 + it.at_s for it in items]
+            nxt = 0
+            while nxt < n or eng.scheduler.active or eng._pending \
+                    or eng.queue.qsize():
+                now = time.perf_counter()
+                while nxt < n and arrivals[nxt] <= now:
+                    reqs.append(eng.submit(
+                        items[nxt].prompt,
+                        max_new_tokens=items[nxt].max_new_tokens))
+                    nxt += 1
+                if not eng.scheduler.active and not eng._pending \
+                        and eng.queue.qsize() == 0:
+                    # idle but arrivals pending: wait for the next one
+                    time.sleep(min(0.002,
+                                   max(arrivals[nxt] - now, 0.0)))
+                    continue
+                eng.step()
+                ticks += 1
+                max_concurrent = max(max_concurrent,
+                                     len(eng.scheduler.active))
+                assert ticks < max_ticks, \
+                    f"leg {tag!r} exceeded {max_ticks} ticks"
+            wall = time.perf_counter() - t0
+        if not allow_errors:
+            assert all(r.error is None for r in reqs), \
+                [repr(r.error) for r in reqs if r.error]
+        goodput = None
+        if slo is not None:
+            tracker = GoodputTracker(slo[0], slo[1],
+                                     hub=eng.telemetry)
+            for r in reqs:
+                tracker.observe(phases_from_request(r))
+            goodput = tracker.flush(step=eng._ticks)
+        stats = collect(eng) if collect is not None else None
+    finally:
+        eng.close()
+    report = None
+    records = None
+    skipped = 0
+    if tel_dir is not None:
+        events = os.path.join(tel_dir, "events.jsonl")
+        with open(os.devnull, "w") as devnull:
+            report = summarize(events, out=devnull)
+        records, skipped = _read_jsonl_tolerant(events)
+        shutil.rmtree(tel_dir, ignore_errors=True)
+    return EngineRun(requests=reqs, wall_s=wall, ticks=ticks,
+                     max_concurrent=max_concurrent, warm_rid=warm_rid,
+                     report=report, records=records,
+                     skipped_lines=skipped, goodput=goodput,
+                     stats=stats)
+
+
+@dataclasses.dataclass
+class FleetRun:
+    """One fleet replay: live ``FleetRequest`` objects, their relative
+    submit times, the router ledger (tolerantly read), and the chaos
+    trace facts when a kill was scheduled."""
+    requests: list
+    submit_ts: List[float]
+    wall_s: float
+    records: list
+    skipped_lines: int
+    queue_wait_p99_s: Optional[float]
+    killed: Optional[int] = None
+    recover_after_s: Optional[float] = None
+
+    @property
+    def tokens(self) -> int:
+        return sum(len(r.tokens) for r in self.requests)
+
+
+def replay_fleet(config: dict, items: Sequence[WorkloadItem], *,
+                 delay_s: Optional[float] = None,
+                 warm_per_replica: bool = True,
+                 kill_after_s: Optional[float] = None,
+                 kill_min_outstanding: int = 0,
+                 max_s: float = 600.0,
+                 tag: str = "fleet") -> FleetRun:
+    """Replay a workload schedule against a ``FleetRouter`` fleet.
+
+    With ``kill_after_s`` set, the busier INITIAL replica is SIGKILLed
+    once the trace clock passes it AND that replica holds at least
+    ``kill_min_outstanding`` requests (guaranteed queued-but-unstarted
+    work to fail over — under bursty arrival a fixed kill time can
+    land in a quiet gap), and the run watches for the autoscaled
+    replacement (``recover_after_s`` = first non-initial replica
+    ready).  The ledger is read back tolerantly BEFORE teardown, so
+    zero-lost-requests invariants are asserted from completion
+    records, never from in-memory state.
+    """
+    from deepspeed_tpu.inference.fleet import FleetRouter
+    from deepspeed_tpu.telemetry.cli import _read_jsonl_tolerant
+
+    d = tempfile.mkdtemp(prefix=f"loadgen_{tag}_")
+    n = len(items)
+    with injected_delay(delay_s):
+        router = FleetRouter(config, fleet_dir=d)
+        try:
+            router.start()
+            initial_ids = sorted(router.replicas)
+            if warm_per_replica:
+                # one warm request per replica: JSQ spreads them, so
+                # every replica compiles prefill+decode off the clock
+                for _ in range(len(initial_ids)):
+                    router.submit(items[0].prompt, max_new_tokens=2)
+                router.run_until_idle(max_s=180)
+            t0 = time.perf_counter()
+            reqs: list = []
+            submit_ts: List[float] = []
+            killed = None
+            recover_t = None
+            nxt = 0
+            while nxt < n or not router.idle():
+                now = time.perf_counter() - t0
+                assert now < max_s, \
+                    f"fleet leg {tag!r} exceeded {max_s}s"
+                while nxt < n and items[nxt].at_s <= now:
+                    reqs.append(router.submit(
+                        items[nxt].prompt,
+                        max_new_tokens=items[nxt].max_new_tokens))
+                    submit_ts.append(now)
+                    nxt += 1
+                if kill_after_s is not None and killed is None \
+                        and now >= kill_after_s:
+                    # kill the busier initial replica: guaranteed
+                    # queued-but-unstarted work to fail over
+                    victims = [r for r in router.replicas.values()
+                               if r.id in initial_ids
+                               and r.state == "ready"]
+                    victims.sort(key=lambda r: -len(r.outstanding))
+                    if victims and len(victims[0].outstanding) \
+                            >= kill_min_outstanding:
+                        killed = victims[0].id
+                        router.kill_replica(killed)
+                if killed is not None and recover_t is None and any(
+                        rid not in initial_ids
+                        and router.replicas[rid].state == "ready"
+                        for rid in router.replicas):
+                    recover_t = time.perf_counter() - t0
+                router.poll(0.01)
+            wall = time.perf_counter() - t0
+            # slow-machine guard: if the backlog drained before the
+            # autoscaled replacement finished booting, keep polling so
+            # recover_after_s reports a fact, not a race with spawn
+            while killed is not None and recover_t is None \
+                    and time.perf_counter() - t0 < max_s:
+                router.poll(0.05)
+                if any(rid not in initial_ids
+                       and router.replicas[rid].state == "ready"
+                       for rid in router.replicas):
+                    recover_t = time.perf_counter() - t0
+            p99 = router.queue_wait_p99(window_s=1e9)
+            records, skipped = _read_jsonl_tolerant(
+                os.path.join(d, "events.jsonl"))
+        finally:
+            router.close()
+            shutil.rmtree(d, ignore_errors=True)
+    return FleetRun(requests=reqs, submit_ts=submit_ts, wall_s=wall,
+                    records=records, skipped_lines=skipped,
+                    queue_wait_p99_s=p99, killed=killed,
+                    recover_after_s=recover_t)

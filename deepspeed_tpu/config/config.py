@@ -505,6 +505,9 @@ class DeepSpeedServingConfig:
             sv, C.SERVING_SPECULATE_K, C.SERVING_SPECULATE_K_DEFAULT)
         self.temperature = get_scalar_param(
             sv, C.SERVING_TEMPERATURE, C.SERVING_TEMPERATURE_DEFAULT)
+        self.prefill_chunk_len = get_scalar_param(
+            sv, C.SERVING_PREFILL_CHUNK_LEN,
+            C.SERVING_PREFILL_CHUNK_LEN_DEFAULT)
         self.draft = self._validate_draft(sv.get(C.SERVING_DRAFT))
         self.quantization = self._validate_quantization(
             sv.get(C.SERVING_QUANTIZATION), self.page_len)
@@ -513,6 +516,8 @@ class DeepSpeedServingConfig:
                             (C.SERVING_PREFILL_LEN, self.prefill_len, 0),
                             (C.SERVING_PAGE_LEN, self.page_len, 0),
                             (C.SERVING_PAGES, self.pages, 0),
+                            (C.SERVING_PREFILL_CHUNK_LEN,
+                             self.prefill_chunk_len, 0),
                             (C.SERVING_QUEUE_CAPACITY,
                              self.queue_capacity, 1),
                             (C.SERVING_FLUSH_INTERVAL,
@@ -551,6 +556,13 @@ class DeepSpeedServingConfig:
                 f"serving.{C.SERVING_PAGES}={self.pages} is too small: "
                 "page 0 is the reserved scratch page, so a usable pool "
                 "needs at least 2 pages (0 = auto-size)")
+        if self.prefill_chunk_len and not self.page_len:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_PREFILL_CHUNK_LEN}="
+                f"{self.prefill_chunk_len} needs serving."
+                f"{C.SERVING_PAGE_LEN} > 0: chunked prefill rides the "
+                "delta-aware paged prefill program (the slot layout "
+                "prefills whole prompts)")
         if not isinstance(self.speculate_k, int) \
                 or isinstance(self.speculate_k, bool) \
                 or self.speculate_k < 0:
@@ -707,6 +719,23 @@ class DeepSpeedFleetConfig:
             fl, C.FLEET_SPAWN_TIMEOUT_S, C.FLEET_SPAWN_TIMEOUT_S_DEFAULT)
         self.term_grace_s = get_scalar_param(
             fl, C.FLEET_TERM_GRACE_S, C.FLEET_TERM_GRACE_S_DEFAULT)
+        self.slo_ttft_s = get_scalar_param(
+            fl, C.FLEET_SLO_TTFT_S, C.FLEET_SLO_TTFT_S_DEFAULT)
+        self.slo_tpot_s = get_scalar_param(
+            fl, C.FLEET_SLO_TPOT_S, C.FLEET_SLO_TPOT_S_DEFAULT)
+        self.roles = self._validate_roles(
+            fl.get(C.FLEET_ROLES, C.FLEET_ROLES_DEFAULT))
+        if self.roles is not None:
+            # roles size the fleet; an explicit replicas count that
+            # disagrees is a config contradiction, not a tiebreak
+            if C.FLEET_REPLICAS in fl \
+                    and fl[C.FLEET_REPLICAS] != sum(self.roles.values()):
+                raise DeepSpeedConfigError(
+                    f"fleet.{C.FLEET_REPLICAS}={fl[C.FLEET_REPLICAS]} "
+                    f"contradicts fleet.{C.FLEET_ROLES}={self.roles} "
+                    f"(role counts sum to {sum(self.roles.values())}); "
+                    "drop one of them")
+            self.replicas = sum(self.roles.values())
         for name, v in ((C.FLEET_REPLICAS, self.replicas),
                         (C.FLEET_MIN_REPLICAS, self.min_replicas),
                         (C.FLEET_MAX_REPLICAS, self.max_replicas)):
@@ -744,6 +773,48 @@ class DeepSpeedFleetConfig:
                 f"fleet.{C.FLEET_MAX_RESTARTS} must be an int >= 0 "
                 f"(consecutive no-progress replica failures before the "
                 f"typed give-up), got {self.max_restarts!r}")
+        for name, v in ((C.FLEET_SLO_TTFT_S, self.slo_ttft_s),
+                        (C.FLEET_SLO_TPOT_S, self.slo_tpot_s)):
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or v < 0:
+                raise DeepSpeedConfigError(
+                    f"fleet.{name} must be a number >= 0 (0 = fall "
+                    f"back to the queue-wait SLO), got {v!r}")
+
+    @staticmethod
+    def _validate_roles(roles):
+        """Eager validation of ``fleet.roles`` (docs/serving.md
+        "disaggregated fleet"): role name -> initial replica count.
+        None = the homogeneous fleet (every replica "mixed").  A typo'd
+        role must fail at config parse, not as a router that never
+        finds a decode replica to migrate to."""
+        if roles is None:
+            return None
+        if not isinstance(roles, dict) or not roles:
+            raise DeepSpeedConfigError(
+                f"fleet.{C.FLEET_ROLES} must be a non-empty dict of "
+                f"role -> replica count (or omitted for a homogeneous "
+                f"fleet), got {roles!r}")
+        allowed = {"prefill", "decode", "mixed"}
+        unknown = set(roles) - allowed
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"fleet.{C.FLEET_ROLES} has unknown role(s) "
+                f"{sorted(unknown)}; allowed: {sorted(allowed)}")
+        for role, count in roles.items():
+            if not isinstance(count, int) or isinstance(count, bool) \
+                    or count < 1:
+                raise DeepSpeedConfigError(
+                    f"fleet.{C.FLEET_ROLES}.{role} must be an int >= 1 "
+                    f"replica, got {count!r}")
+        # a prefill-only replica can never decode, so its migrations
+        # need somewhere to land (mixed replicas can adopt too)
+        if "prefill" in roles and not ({"decode", "mixed"} & set(roles)):
+            raise DeepSpeedConfigError(
+                f"fleet.{C.FLEET_ROLES}={dict(roles)} has prefill "
+                "replicas but nowhere to migrate finished prefills: "
+                "add a 'decode' (or 'mixed') role")
+        return dict(roles)
 
 
 class DeepSpeedPipelineConfig:

@@ -555,6 +555,15 @@ SERVING_QUANT_WEIGHTS_DEFAULT = "fp16"
 # page_len > 0 (the slot layout keeps the master dtype).
 SERVING_QUANT_KV = "kv"
 SERVING_QUANT_KV_DEFAULT = "fp16"
+# chunked prefill (Sarathi-Serve, PAPERS.md; docs/serving.md
+# "disaggregated fleet"): prompts whose delta is longer than this are
+# prefilled one fixed-size chunk per engine step, co-scheduled with
+# decode ticks, so a long admission never stalls in-flight decodes.
+# prefix_len/delta_len are traced, so every chunk reuses the ONE
+# compiled prefill program.  0 = chunking OFF (whole-delta prefill at
+# admission).  Requires page_len > 0.
+SERVING_PREFILL_CHUNK_LEN = "prefill_chunk_len"
+SERVING_PREFILL_CHUNK_LEN_DEFAULT = 0
 
 #############################################
 # Serving fleet (TPU extension; docs/serving.md "serving fleet")
@@ -614,6 +623,27 @@ FLEET_SPAWN_TIMEOUT_S_DEFAULT = 120.0
 # SIGTERM -> grace -> SIGKILL teardown window per replica
 FLEET_TERM_GRACE_S = "term_grace_s"
 FLEET_TERM_GRACE_S_DEFAULT = 5.0
+# disaggregated prefill/decode roles (DistServe/Splitwise, PAPERS.md;
+# docs/serving.md "disaggregated fleet"): a mapping of role name ->
+# initial replica count, keys from {"prefill", "decode", "mixed"}.
+# None (the default) = every replica is "mixed" — the homogeneous
+# fleet, byte-identical to the pre-role router.  With prefill+decode
+# roles set, the router steers admissions to prefill replicas and
+# migrates finished prefills' KV pages to decode replicas over binary
+# wire frames; fleet.replicas, when given alongside roles, must equal
+# the sum of the role counts.
+FLEET_ROLES = "roles"
+FLEET_ROLES_DEFAULT = None
+# per-phase SLOs the role-aware autoscaler defends SEPARATELY:
+# slo_ttft_s bounds time-to-first-token (prefill-role capacity; 0 =
+# fall back to slo_p99_s) and slo_tpot_s bounds time-per-output-token
+# p99 read from the decode replicas' heartbeat gauges (0 = TPOT
+# scaling off).  Homogeneous fleets ignore both and keep the
+# queue-wait SLO above.
+FLEET_SLO_TTFT_S = "slo_ttft_s"
+FLEET_SLO_TTFT_S_DEFAULT = 0.0
+FLEET_SLO_TPOT_S = "slo_tpot_s"
+FLEET_SLO_TPOT_S_DEFAULT = 0.0
 
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 PLD_ENABLED = "enabled"

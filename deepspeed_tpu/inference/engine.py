@@ -215,6 +215,12 @@ class ServeEngine:
         kv_dtype = wte.dtype if wte is not None else jnp.float32
         self.page_len = cfg.serving.page_len
         self.paged = self.page_len > 0
+        #: chunked prefill (Sarathi-Serve, PAPERS.md; docs/serving.md
+        #: "disaggregated fleet"): > 0 = prompts with a longer uncached
+        #: delta admit immediately and prefill one chunk per step(),
+        #: co-scheduled with decode ticks (config requires paged)
+        self.prefill_chunk_len = (cfg.serving.prefill_chunk_len
+                                  if self.paged else 0)
         if self.quant_kv and not self.paged:
             raise ValueError(
                 "serving.quantization.kv='int8' requires a paged cache "
@@ -279,6 +285,9 @@ class ServeEngine:
         # -- compiled programs -------------------------------------------
         rep = NamedSharding(mesh, P())
         self._copy_fn = None
+        self._page_out_fn = None
+        self._page_in_fn = None
+        self._set_len_fn = None
         # the one shared next-token rule (inference/speculative.py):
         # greedy at temperature 0 — bitwise the argmax these programs
         # used to inline — sampling otherwise.  Programs take a
@@ -352,6 +361,51 @@ class ServeEngine:
 
             self._copy_fn = jax.jit(copy_fn, donate_argnums=(0,),
                                     out_shardings=self._cache_shardings)
+
+            # KV-page export/import (disaggregated fleet, docs/
+            # serving.md): one page's pool rows out to the host / back
+            # in, every pool-shaped leaf in _copy_fn's fixed order —
+            # on the quantized cache that includes the scale sidecars,
+            # or an imported page would dequantize with the wrong
+            # scales.  The page index is TRACED like _copy_fn's
+            # src/dst, so any page migrates on one compiled pair.
+            def page_out_fn(cache, page):
+                out = []
+                for key in ("k", "v", "k_scale", "v_scale"):
+                    if key not in cache:
+                        continue
+                    out.append(jax.lax.dynamic_slice_in_dim(
+                        cache[key], page, 1, axis=1))
+                return tuple(out)
+
+            def page_in_fn(cache, page, *leaves):
+                out = dict(cache)
+                i = 0
+                for key in ("k", "v", "k_scale", "v_scale"):
+                    if key not in cache:
+                        continue
+                    out[key] = jax.lax.dynamic_update_slice_in_dim(
+                        cache[key], leaves[i], page, axis=1)
+                    i += 1
+                return out
+
+            # adoption rebuilds a migrated slot's cache length without
+            # a prefill pass (slot + length traced)
+            def set_len_fn(cache, slot, length):
+                out = dict(cache)
+                out["lengths"] = jax.lax.dynamic_update_slice(
+                    cache["lengths"],
+                    jnp.reshape(length, (1,)).astype(jnp.int32),
+                    (slot,))
+                return out
+
+            self._page_out_fn = jax.jit(page_out_fn)
+            self._page_in_fn = jax.jit(
+                page_in_fn, donate_argnums=(0,),
+                out_shardings=self._cache_shardings)
+            self._set_len_fn = jax.jit(
+                set_len_fn, donate_argnums=(0,),
+                out_shardings=self._cache_shardings)
         else:
             def prefill_fn(params, cache, tokens, length, slot, *rng):
                 logits, ks, vs = self.model.prefill(params, tokens)
@@ -442,6 +496,9 @@ class ServeEngine:
             self.telemetry.track_program("prefill", self._prefill_fn)
             if self._copy_fn is not None:
                 self.telemetry.track_program("copy_page", self._copy_fn)
+                self.telemetry.track_program("page_out",
+                                             self._page_out_fn)
+                self.telemetry.track_program("page_in", self._page_in_fn)
             if self.spec_k:
                 self.telemetry.track_program("verify_step",
                                              self._verify_fn)
@@ -523,6 +580,9 @@ class ServeEngine:
         #: admission order is preserved under exhaustion)
         self._pending: deque = deque()
         self._latencies: deque = deque(maxlen=8192)
+        #: decode-phase (post-first-token) latencies only — the TPOT
+        #: plane the per-role autoscaler reads off the heartbeat gauge
+        self._tpot_lat: deque = deque(maxlen=2048)
         self._flush_every = cfg.serving.flush_interval_ticks
         self._last_flush_t = time.perf_counter()
         self._last_flush_tokens = 0
@@ -846,6 +906,9 @@ class ServeEngine:
         if p50 is not None:
             scalars["serve_token_p50_s"] = p50
             scalars["serve_token_p99_s"] = p99
+        tpot = self.tpot_p99()
+        if tpot is not None:
+            scalars["serve_tpot_p99_s"] = tpot
         if self.paged:
             usable = self.cache_spec.pages - 1
             scalars["serve_free_pages"] = float(self.pool.free_count)
@@ -871,12 +934,27 @@ class ServeEngine:
         self._last_flush_t = now
         self._last_flush_tokens = self._tokens_seen
 
+    def tpot_p99(self) -> Optional[float]:
+        """Decode-phase p99 latency per token (TPOT) over the recent
+        window — the gauge a decode-role replica beats for the
+        per-role autoscaler (docs/serving.md "disaggregated fleet")."""
+        if not self._tpot_lat:
+            return None
+        return _percentile(sorted(self._tpot_lat), 0.99)
+
     # -- request intake ---------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 16,
-               eos_id: Optional[int] = None) -> Request:
+               eos_id: Optional[int] = None,
+               detach_kv: bool = False) -> Request:
         """Enqueue one generation request (blocks on a full queue — the
         open-loop backpressure point).  Greedy decoding; the first
-        generated token comes from the prefill logits."""
+        generated token comes from the prefill logits.
+
+        ``detach_kv`` (paged only) marks a KV-migration source: when
+        the request finishes, its pages stay alive for
+        :meth:`export_pages` instead of freeing — the disaggregated
+        fleet's prefill leg (``release_detached`` frees them after the
+        transfer)."""
         if self._closed:
             raise RuntimeError("ServeEngine is closed")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
@@ -898,12 +976,17 @@ class ServeEngine:
                     f"has {usable} allocatable pages "
                     f"(serving.pages={self.cache_spec.pages}, page 0 "
                     "reserved); it could never be admitted")
+        if detach_kv and not self.paged:
+            raise ValueError(
+                "detach_kv (KV-migration handoff) requires the paged "
+                "layout (serving.page_len > 0)")
         self._rid += 1
         req = Request(rid=self._rid, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
                       eos_id=(self.eos_id_default if eos_id is None
                               else int(eos_id)),
                       submit_t=time.perf_counter())
+        req.detach_kv = bool(detach_kv)
         self._begin_request_trace(req)
         # Deliberate submission-side backpressure: submit() runs on the
         # CALLER's thread, and a full queue must block the caller (and a
@@ -972,11 +1055,14 @@ class ServeEngine:
         if chunks > 1:
             time.sleep(d * (chunks - 1))
 
-    def _draft_prefill(self, req: Request) -> None:
+    def _draft_prefill(self, req: Request,
+                       slot: Optional[int] = None) -> None:
         """Mirror the admitted prompt into the DRAFT's slot cache so
         next tick's proposals start from the same history the target
         holds.  The prefill logits are discarded — the tick's first
-        pending token is the TARGET's emission."""
+        pending token is the TARGET's emission.  ``slot`` overrides
+        the next-free-slot peek for requests already admitted (chunked
+        prefill's final chunk, KV adoption)."""
         dtokens = np.zeros((1, self.prefill_len), np.int32)
         dtokens[0, :len(req.prompt)] = req.prompt
         with self._span("serve/draft_prefill", rid=req.rid):
@@ -984,7 +1070,8 @@ class ServeEngine:
                 self._draft_cache = self._draft_prefill_fn(
                     self.draft_params, self._draft_cache, dtokens,
                     np.int32(len(req.prompt)),
-                    np.int32(self.scheduler.free[0]))
+                    np.int32(self.scheduler.free[0]
+                             if slot is None else slot))
 
     def _admit_one_paged(self, req: Request) -> bool:
         total_pages = -(-len(req.prompt) // self.page_len)
@@ -1024,6 +1111,33 @@ class ServeEngine:
                 row = list(spages)
             row.extend(fresh[fi:])
             delta = req.prompt[shared_len:]
+            if self.prefill_chunk_len \
+                    and len(delta) > self.prefill_chunk_len:
+                # chunked prefill (Sarathi-Serve, PAPERS.md): admit
+                # the slot NOW with zero device work — step() feeds
+                # the delta one chunk per tick under the
+                # prefill_chunk stage point, so in-flight decodes
+                # never stall behind this prompt.  prefix.insert is
+                # deferred to the final chunk: a mid-prefill page
+                # must never be matched by a concurrent sharer.
+                now = time.perf_counter()
+                slot = self.scheduler.admit(req, now=now)
+                if self.prefix is not None:
+                    self.prefix.note_admission(shared_len)
+                    if cow:
+                        self.prefix.cow += 1
+                    if self.telemetry is not None:
+                        (self._prefix_hits if shared_len
+                         else self._prefix_misses).inc()
+                req.pages = row
+                req.shared_len = shared_len
+                req.computed_len = len(delta)
+                req.kv_len = shared_len
+                req.prefilling = True
+                req.chunk_pos = 0
+                self._table[slot, :] = 0
+                self._table[slot, :len(row)] = row
+                return True
             tokens = np.zeros((1, self.prefill_len), np.int32)
             tokens[0, :len(delta)] = delta
             row_np = np.zeros((self.max_pages,), np.int32)
@@ -1195,9 +1309,13 @@ class ServeEngine:
         req = self.scheduler.release(slot, reason)
         if self.paged:
             # eviction = page frees + a zeroed table row (scratch): the
-            # freed pages are immediately admissible capacity
+            # freed pages are immediately admissible capacity — except
+            # a KV-migration source (detach_kv), whose pages stay held
+            # for export_pages; release_detached frees them after the
+            # transfer
             self._table[slot, :] = 0
-            self._release_pages(req)
+            if not req.detach_kv:
+                self._release_pages(req)
         # record + trace close BEFORE done.set(): a waiter released by
         # result() must find the completed artifacts already written
         self._write_request_record(req)
@@ -1206,9 +1324,78 @@ class ServeEngine:
         if self.telemetry is not None:
             self._requests_total.inc()
 
+    # -- chunked prefill --------------------------------------------------
+    def _prefill_chunk_tick(self) -> int:
+        """One chunk of the OLDEST mid-prefill slot (Sarathi-Serve's
+        co-scheduling policy, FIFO over prefilling slots): the same
+        delta-aware compiled prefill program with ``prefix_len``
+        advanced to the chunk boundary — same prefill_len bucket,
+        traced prefix/delta lengths and page row, so N chunks cost
+        zero recompiles.  Intermediate chunk logits are discarded; the
+        FINAL chunk's next-token is the request's first token (TTFT
+        stamps here).  Returns tokens produced (0 until the final
+        chunk)."""
+        req = None
+        for r in self.scheduler.active.values():
+            if r.prefilling:
+                req = r
+                break
+        if req is None:
+            return 0
+        slot = req.slot
+        delta = req.prompt[req.shared_len:]
+        pos = req.chunk_pos
+        chunk = delta[pos:pos + self.prefill_chunk_len]
+        final = pos + len(chunk) >= len(delta)
+        tokens = np.zeros((1, self.prefill_len), np.int32)
+        tokens[0, :len(chunk)] = chunk
+        with self._span("serve/prefill_chunk", rid=req.rid, pos=pos,
+                        chunk=len(chunk)):
+            tr = self._tracer
+            if final and tr is not None and req.ctx is not None:
+                tr.flow_start("serve/request", req.ctx, cat="serve",
+                              rid=req.rid)
+            with self._pallas_scope():
+                self.cache, first = self._prefill_fn(
+                    self.params, self.cache, tokens,
+                    np.int32(len(chunk)),
+                    np.int32(req.shared_len + pos),
+                    self._table[slot], np.int32(slot),
+                    *self._maybe_key())
+            first = int(np.asarray(jax.block_until_ready(first)))
+        req.chunk_pos = pos + len(chunk)
+        req.kv_len = req.shared_len + req.chunk_pos
+        if not final:
+            return 0
+        now = time.perf_counter()
+        req.prefilling = False
+        req.prefill_s = now - req.admit_t
+        req.kv_len = len(req.prompt)
+        if self.prefix is not None:
+            # the pages are fully written now — register them for
+            # future sharers (deferred from admission)
+            self.prefix.insert(req.prompt, req.pages)
+        if self.spec_k:
+            self._draft_prefill(req, slot=slot)
+        req.tokens.append(first)
+        req.token_times.append(now - req.submit_t)
+        req.last_token = first
+        req.last_t = now
+        self._count_token(now - req.submit_t)
+        if self.telemetry is not None:
+            self._ttft_hist.observe(now - req.submit_t)
+        reason = self.scheduler.finish_reason(req, first,
+                                              self.max_seq_len)
+        if reason is not None:
+            self._finish(slot, reason)
+        return 1
+
     # -- the decode tick --------------------------------------------------
     def _decode_tick(self) -> int:
-        active_map = dict(self.scheduler.active)
+        # mid-prefill slots ride masked: they have no last token to
+        # feed and their KV is a partial prefix (chunked prefill)
+        active_map = {s: r for s, r in self.scheduler.active.items()
+                      if not r.prefilling}
         if self.paged:
             # page-boundary appends allocate BEFORE the tick; a dry
             # pool (even after prefix-cache eviction) finishes the
@@ -1262,6 +1449,7 @@ class ServeEngine:
             req.tokens.append(tok)
             req.token_times.append(now - req.last_t)
             self._count_token(now - req.last_t)
+            self._tpot_lat.append(now - req.last_t)
             req.last_t = now
             req.last_token = tok
             produced += 1
@@ -1282,7 +1470,8 @@ class ServeEngine:
         admission/eviction; rejection rollback masks lengths back
         (unpaged) or frees the speculated pages (paged)."""
         W = self.spec_k + 1
-        active_map = dict(self.scheduler.active)
+        active_map = {s: r for s, r in self.scheduler.active.items()
+                      if not r.prefilling}
         if self.paged:
             # allocate the whole speculative block's pages up front: a
             # pool too dry to hold W more rows (even after prefix-leaf
@@ -1363,6 +1552,7 @@ class ServeEngine:
                 first_of_block = False
                 req.token_times.append(lat)
                 self._count_token(lat)
+                self._tpot_lat.append(lat)
                 produced += 1
                 used += 1
                 reason = self.scheduler.finish_reason(
@@ -1420,7 +1610,18 @@ class ServeEngine:
             raise RuntimeError("ServeEngine is closed")
         self._admit()
         try:
-            n = self.stage.call(
+            n = 0
+            if self.prefill_chunk_len and any(
+                    r.prefilling
+                    for r in self.scheduler.active.values()):
+                # chunked-prefill co-scheduling: ONE chunk rides this
+                # tick next to the decode pass, and the stage point
+                # charges one injected delay unit per CHUNK
+                # (docs/stages.md) — the bounded-stall guarantee the
+                # disagg bench proves
+                n += self.stage.call("prefill_chunk",
+                                     self._prefill_chunk_tick)
+            n += self.stage.call(
                 "step",
                 self._spec_tick if self.spec_k else self._decode_tick)
         except BaseException as e:
@@ -1449,6 +1650,135 @@ class ServeEngine:
             f"({len(self.scheduler.active)} active, "
             f"{len(self._pending)} pending, "
             f"{self.queue.qsize()} queued)")
+
+    # -- KV-page migration (disaggregated fleet) --------------------------
+    def _page_leaves(self) -> List[str]:
+        """Pool-shaped cache leaves in the fixed wire order (mirrors
+        _copy_fn: scales ride along on the quantized pool)."""
+        return [k for k in ("k", "v", "k_scale", "v_scale")
+                if k in self.cache]
+
+    def page_leaf_nbytes(self) -> List[int]:
+        """Per-leaf byte lengths inside ONE exported page payload —
+        the binary frame header's validation contract (both ends of a
+        migration run the same config, so these must agree)."""
+        return [int(self.cache[k].nbytes) // int(self.cache[k].shape[1])
+                for k in self._page_leaves()]
+
+    def export_pages(self, req: Request) -> List[bytes]:
+        """A finished ``detach_kv`` request's KV pages as raw bytes,
+        one payload per page: the page's leaf slices concatenated in
+        ``_page_leaves`` order.  Whole pages ship (the bounded page
+        copy — a partial tail's dead rows are masked by lengths on the
+        importing side); the page index is traced, so N exports ride
+        one compiled program.  Call :meth:`release_detached` after the
+        payloads hit the wire."""
+        if not self.paged or not req.pages:
+            raise RuntimeError(
+                "export_pages needs a paged engine and a finished "
+                "detach_kv request still holding its pages")
+        out = []
+        for pid in req.pages:
+            with self._span("serve/page_out", rid=req.rid, page=pid):
+                with self._pallas_scope():
+                    slices = self._page_out_fn(self.cache,
+                                               np.int32(pid))
+                slices = jax.block_until_ready(slices)
+            out.append(b"".join(np.asarray(s).tobytes()
+                                for s in slices))
+        return out
+
+    def release_detached(self, req: Request) -> None:
+        """Drop the pages a ``detach_kv`` finish kept alive — the
+        export's payloads are on the wire, the pages are admissible
+        capacity again."""
+        self._release_pages(req)
+
+    def adopt_request(self, prompt, first_token: int,
+                      max_new_tokens: int,
+                      eos_id: Optional[int],
+                      page_payloads: List[bytes]) -> Optional[Request]:
+        """Adopt a migrated request mid-decode (docs/serving.md
+        "disaggregated fleet"): import its exported KV pages into
+        freshly allocated local pages (page ids are replica-local —
+        the table is rebuilt), restore the slot's cache length, and
+        resume decoding from ``first_token`` on the next tick.
+        Identical params + imported KV ⇒ the continued stream is
+        bitwise the single-replica stream (the parity bar).  Returns
+        None when no slot or pages are free yet — the caller parks and
+        retries, the same backpressure contract as admission."""
+        if not self.paged:
+            raise RuntimeError("KV adoption requires the paged layout")
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        need = -(-len(prompt) // self.page_len)
+        if need != len(page_payloads):
+            raise ValueError(
+                f"migrated request ships {len(page_payloads)} pages "
+                f"but a {len(prompt)}-token prompt needs {need}")
+        if not self.scheduler.has_free():
+            return None
+        pages = self._alloc_pages(need)
+        if pages is None:
+            return None
+        self._rid += 1
+        now = time.perf_counter()
+        req = Request(rid=self._rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      eos_id=(self.eos_id_default if eos_id is None
+                              else int(eos_id)),
+                      submit_t=now)
+        req.admit_t = now
+        try:
+            leaf_refs = [self.cache[k] for k in self._page_leaves()]
+            for pid, payload in zip(pages, page_payloads):
+                leaves, off = [], 0
+                for ref in leaf_refs:
+                    nb = int(ref.nbytes) // int(ref.shape[1])
+                    shape = ref.shape[:1] + (1,) + ref.shape[2:]
+                    leaves.append(np.frombuffer(
+                        payload, dtype=np.dtype(ref.dtype),
+                        count=nb // ref.dtype.itemsize,
+                        offset=off).reshape(shape))
+                    off += nb
+                if off != len(payload):
+                    raise ValueError(
+                        f"migrated page payload is {len(payload)} "
+                        f"bytes; this pool's page is {off} (config "
+                        "mismatch between migration endpoints)")
+                with self._span("serve/page_in", rid=req.rid,
+                                page=pid):
+                    with self._pallas_scope():
+                        self.cache = self._page_in_fn(
+                            self.cache, np.int32(pid), *leaves)
+        except BaseException:
+            for p in pages:
+                self.pool.deref(p)
+            raise
+        slot = self.scheduler.admit(req, now=now)
+        req.pages = list(pages)
+        req.shared_len = 0
+        req.computed_len = len(prompt)
+        req.kv_len = len(prompt)
+        self._table[slot, :] = 0
+        self._table[slot, :len(pages)] = pages
+        with self._pallas_scope():
+            self.cache = self._set_len_fn(self.cache, np.int32(slot),
+                                          np.int32(len(prompt)))
+        if self.spec_k:
+            # the draft has no imported pages — mirror the prompt into
+            # its slot cache the ordinary way (draft prefill is cheap)
+            self._draft_prefill(req, slot=slot)
+        # the first token was generated (and latency-counted) on the
+        # prefill replica; record it here without double-counting
+        req.tokens.append(int(first_token))
+        req.token_times.append(0.0)
+        req.last_token = int(first_token)
+        req.last_t = now
+        reason = self.scheduler.finish_reason(req, int(first_token),
+                                              self.max_seq_len)
+        if reason is not None:
+            self._finish(slot, reason)
+        return req
 
     # -- failure + shutdown ----------------------------------------------
     def _fail_request(self, req: Request, err: BaseException) -> None:

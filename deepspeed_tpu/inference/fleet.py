@@ -50,6 +50,26 @@ inside :meth:`FleetRouter.poll` (called by ``run_until_idle`` /
 ``FleetRequest.result``), so the JSQ/failover/autoscale logic needs no
 locks and stays deterministic under test — and JL007 (no stray daemon
 threads) holds without exemptions.
+
+Disaggregated prefill/decode (``fleet.roles``, docs/serving.md
+"disaggregated fleet"): with a ``roles`` map the fleet specializes by
+phase — admissions steer to ``prefill``/``mixed`` replicas, and when a
+prefill-role replica finishes a request's prefill (one token,
+``detach_kv``) its KV pages migrate over binary wire frames to a
+``decode``/``mixed`` replica that adopts the request mid-stream.  The
+router is the custody ledger: a request's KV blob is owned by exactly
+one of {prefill replica, router, decode replica} at any instant, every
+transition is an ``events.jsonl`` ``migration`` record, and a replica
+death at ANY phase loses zero requests — prefill-phase deaths requeue
+the request unstarted (its first token was never surrendered to the
+caller), router-custody blobs re-dispatch to another decode replica,
+and decode-phase deaths follow the existing started-request
+:class:`ReplicaFailure` semantics.  Autoscaling splits per role:
+prefill defends TTFT (``fleet.slo_ttft_s``, admission-wait signal),
+decode defends TPOT (``fleet.slo_tpot_s``, the ``serve_tpot_p99_s``
+heartbeat gauge + migration backlog), each with its own hysteresis
+clocks.  Without ``roles`` every path below is byte-for-byte the
+homogeneous fleet of PR 13.
 """
 from __future__ import annotations
 
@@ -71,7 +91,8 @@ from ..launcher.supervise import (backoff_delay, dump_supervisor_flightrec,
                                   terminate_with_grace)
 from ..telemetry.heartbeat import read_heartbeats
 from ..utils.logging import logger
-from .wire import FrameReader, drain_socket, send_frame
+from .wire import (BinaryFrame, FrameReader, drain_socket,
+                   send_binary_frame, send_frame)
 
 #: scale-down hysteresis factor: slack means p99 under THIS fraction of
 #: the SLO (or no waiters at all) — retiring at 0.99×SLO would flap
@@ -143,6 +164,20 @@ class FleetRequest:
     failovers: int = 0
     queue_wait_s: Optional[float] = None
     ttft_s: Optional[float] = None
+    #: True once dispatched to a prefill-role replica with the migrate
+    #: flag — this request will change replicas mid-stream.  The first
+    #: token a PREFILL replica streams does NOT flip ``started``: until
+    #: the decode replica takes custody, a death anywhere on the
+    #: migration path requeues the request from scratch (the caller
+    #: never saw the token, so there is no duplicate-answer hazard).
+    migrated: bool = False
+    prefill_replica: Optional[int] = None
+    decode_replica: Optional[int] = None
+    #: router-custody KV blob: (migrate_out header, [page payloads]) —
+    #: held from blob completion until the decode replica streams, so a
+    #: decode-replica death before its first token re-sends the blob
+    _migration: Optional[tuple] = dataclasses.field(
+        default=None, repr=False)
     _router: Optional["FleetRouter"] = dataclasses.field(
         default=None, repr=False)
 
@@ -176,14 +211,20 @@ class _Replica:
     removed.  A replica id is never reused — heartbeat files and
     telemetry dirs stay unambiguous across respawns."""
 
-    def __init__(self, rid: int, proc, spawned_t: float):
+    def __init__(self, rid: int, proc, spawned_t: float,
+                 role: str = "mixed"):
         self.id = rid
         self.proc = proc
         self.spawned_t = spawned_t
+        self.role = role
         self.state = "starting"
         self.sock: Optional[socket.socket] = None
         self.reader: Optional[FrameReader] = None
         self.outstanding: "OrderedDict[int, FleetRequest]" = OrderedDict()
+        #: in-flight migrate_out receptions: rid → {"header", "pages"}
+        #: (custody still THIS replica's until the last page lands — a
+        #: death mid-blob discards the partial and requeues the rid)
+        self.migrating: Dict[int, dict] = {}
         self.shutdown_sent = False
         #: wall time the replica went ready — the staleness clock's
         #: floor for a replica whose beats never land (beat writes
@@ -268,6 +309,22 @@ class FleetRouter:
         self._wait_samples: deque = deque()
         self._breach_since: Optional[float] = None
         self._slack_since: Optional[float] = None
+        #: router-custody requests awaiting a decode replica: the KV
+        #: blob arrived in full but no decode/mixed replica could take
+        #: it yet (or its decode replica died pre-stream)
+        self._migrate_queue: deque = deque()
+        #: per-role replica targets (disaggregated fleets only): the
+        #: supervision floor AND the autoscaler's moving setpoint —
+        #: scale-up bumps a role's target, scale-down lowers it (never
+        #: below 1: a role's last replica wedges its whole phase)
+        self._role_target: Dict[str, int] = (
+            dict(self.cfg.roles) if self.cfg.roles else {})
+        self._breach_since_role: Dict[str, float] = {}
+        self._slack_since_role: Dict[str, float] = {}
+        #: role handed to the NEXT spawn_fn call (the spawn_fn seam
+        #: keeps its (replica_id, attempt) signature)
+        self._spawn_role = "mixed"
+        self.migrations = 0
         self._started_t: Optional[float] = None
         #: consecutive replica failures with no completed request in
         #: between (the give-up budget); ``restarts`` counts every
@@ -314,7 +371,9 @@ class FleetRouter:
             error=repr(fr.error) if fr.error is not None else None,
             queue_wait_s=fr.queue_wait_s, ttft_s=fr.ttft_s,
             total_s=self._now() - fr.submit_t,
-            failovers=fr.failovers, started=fr.started)
+            failovers=fr.failovers, started=fr.started,
+            migrated=fr.migrated, prefill_replica=fr.prefill_replica,
+            decode_replica=fr.decode_replica)
 
     def _write_metrics(self) -> None:
         """Per-replica liveness made operator-visible: the same
@@ -331,10 +390,12 @@ class FleetRouter:
                 "name": "heartbeat_age_s",
                 "labels": {"replica": str(rep.id),
                            "host": f"replica_{rep.id}",
-                           "state": rep.state},
+                           "state": rep.state,
+                           "role": rep.role},
                 "value": age})
         metrics.append({"name": "fleet_queue_depth", "labels": {},
-                        "value": len(self._queue)})
+                        "value": len(self._queue)
+                        + len(self._migrate_queue)})
         metrics.append({"name": "fleet_live_replicas", "labels": {},
                         "value": len(self._live())})
         self._record("metrics", metrics=metrics)
@@ -350,7 +411,8 @@ class FleetRouter:
                "--router", f"{self.addr[0]}:{self.addr[1]}",
                "--replica-id", str(replica_id),
                "--fleet-dir", self.fleet_dir,
-               "--config", self._config_path]
+               "--config", self._config_path,
+               "--role", self._spawn_role]
         with open(log_path, "ab") as log:
             return subprocess.Popen(cmd, stdout=log,
                                     stderr=subprocess.STDOUT)
@@ -361,12 +423,14 @@ class FleetRouter:
         return [r for r in self.replicas.values()
                 if r.state in ("starting", "ready")]
 
-    def _spawn(self, reason: str) -> Optional[_Replica]:
+    def _spawn(self, reason: str,
+               role: str = "mixed") -> Optional[_Replica]:
         now = self._now()
         if now < self._next_spawn_t:
             return None
         rid = self._next_replica_id
         self._next_replica_id += 1
+        self._spawn_role = role
         try:
             # attempt = the current consecutive-failure count, so a
             # spawn_fn varying behavior by attempt (the test seam)
@@ -376,13 +440,23 @@ class FleetRouter:
             self._note_replica_failure(f"spawn of replica {rid} "
                                        f"raised: {e!r}")
             return None
-        rep = _Replica(rid, proc, now)
+        rep = _Replica(rid, proc, now, role=role)
         self.replicas[rid] = rep
-        self._record("spawn", replica=rid, reason=reason,
+        self._record("spawn", replica=rid, reason=reason, role=role,
                      live=len(self._live()))
-        logger.info("fleet: spawned replica %d (%s), %d live", rid,
-                    reason, len(self._live()))
+        logger.info("fleet: spawned replica %d (%s, %s), %d live", rid,
+                    reason, role, len(self._live()))
         return rep
+
+    def _role_deficit(self) -> Optional[str]:
+        """First role (fixed order — deterministic) whose live count
+        sits below its target; None when the fleet stands at width."""
+        for role in ("prefill", "decode", "mixed"):
+            tgt = self._role_target.get(role, 0)
+            if tgt and sum(1 for r in self._live()
+                           if r.role == role) < tgt:
+                return role
+        return None
 
     def start(self, wait_ready: bool = True) -> "FleetRouter":
         """Launch the configured initial replicas; with ``wait_ready``
@@ -390,10 +464,21 @@ class FleetRouter:
         backoff/give-up discipline inside :meth:`poll`)."""
         self._started_t = self._now()
         sweep_heartbeat_files(self.fleet_dir)
-        for _ in range(self.cfg.replicas):
-            self._spawn("initial")
+        if self.cfg.roles:
+            for role in ("prefill", "decode", "mixed"):
+                for _ in range(self._role_target.get(role, 0)):
+                    self._spawn("initial", role)
+        else:
+            for _ in range(self.cfg.replicas):
+                self._spawn("initial")
         while wait_ready and not self._closed:
-            if len(self._live()) < self.cfg.replicas:
+            if self.cfg.roles:
+                missing = self._role_deficit()
+                if missing is not None:
+                    self._spawn("initial", missing)
+                elif all(r.state == "ready" for r in self._live()):
+                    break
+            elif len(self._live()) < self.cfg.replicas:
                 # a failed initial spawn retries under the backoff/
                 # give-up discipline until the configured width stands
                 self._spawn("initial")
@@ -435,38 +520,98 @@ class FleetRouter:
               + int(beat.get("serve_active_slots") or 0))
         return max(len(rep.outstanding), hb)
 
-    def _pick_replica(self) -> Optional[_Replica]:
+    def _pick_replica(self, roles=None) -> Optional[_Replica]:
         """JSQ with DETERMINISTIC tie-breaking: equal loads go to the
         lowest replica id (tested — a tie must not depend on dict
-        order)."""
+        order).  ``roles`` restricts the candidate set (disaggregated
+        steering); None considers every ready replica."""
         best = None
         for rep in self.replicas.values():
             if rep.state != "ready":
+                continue
+            if roles is not None and rep.role not in roles:
                 continue
             key = (self._replica_load(rep), rep.id)
             if best is None or key < best[0]:
                 best = (key, rep)
         return best[1] if best else None
 
+    def _admission_roles(self):
+        """Where new prompts go: prefill+mixed when the fleet has a
+        prefill phase at all; otherwise any replica (a roles map
+        without ``prefill`` is labels, not disaggregation)."""
+        if self.cfg.roles and "prefill" in self.cfg.roles:
+            return ("prefill", "mixed")
+        return None
+
     def _dispatch(self) -> None:
+        roles = self._admission_roles()
         while self._queue:
-            rep = self._pick_replica()
+            rep = self._pick_replica(roles)
             if rep is None:
                 return
             fr = self._queue.popleft()
             fr.replica = rep.id
+            # a prefill-only replica never decodes: flag the submit so
+            # the replica runs ONE token with detach_kv and hands the
+            # pages back for migration.  max_new_tokens == 1 requests
+            # are already pure prefill — they serve in place.
+            migrate = rep.role == "prefill" and fr.max_new_tokens > 1
+            if migrate:
+                fr.migrated = True
+                fr.prefill_replica = rep.id
             rep.outstanding[fr.rid] = fr
             try:
                 send_frame(rep.sock, {
                     "kind": "submit", "rid": fr.rid,
                     "prompt": fr.prompt,
                     "max_new_tokens": fr.max_new_tokens,
-                    "eos_id": fr.eos_id})
+                    "eos_id": fr.eos_id,
+                    **({"migrate": True} if migrate else {})})
             except OSError as e:
                 # the failover path requeues fr (it is unstarted by
                 # construction — nothing was ever streamed back)
                 self._fail_replica(rep, f"submit send to replica "
                                         f"{rep.id} failed: {e}")
+
+    def _dispatch_migrations(self) -> None:
+        """Hand router-custody KV blobs to decode/mixed replicas —
+        header frame first, then the page frames, then custody flips to
+        the decode replica (its death before streaming puts the blob
+        right back here)."""
+        while self._migrate_queue:
+            rep = self._pick_replica(("decode", "mixed"))
+            if rep is None:
+                return
+            fr = self._migrate_queue.popleft()
+            hdr, pages = fr._migration
+            fr.replica = rep.id
+            fr.decode_replica = rep.id
+            rep.outstanding[fr.rid] = fr
+            try:
+                send_frame(rep.sock, {
+                    "kind": "migrate_in", "rid": fr.rid,
+                    "prompt": fr.prompt,
+                    "first_token": hdr.get("first_token"),
+                    "kv_len": hdr.get("kv_len"),
+                    "pages": len(pages),
+                    "max_new_tokens": fr.max_new_tokens,
+                    "eos_id": fr.eos_id})
+                for seq, payload in enumerate(pages):
+                    send_binary_frame(rep.sock, {
+                        "kind": "page", "rid": fr.rid, "seq": seq,
+                        "leaves": hdr.get("leaves")}, payload)
+            except OSError as e:
+                # fr._migration is still set, so the failover path
+                # returns it to the migrate queue, not the front door
+                self._fail_replica(rep, f"migrate_in send to replica "
+                                        f"{rep.id} failed: {e}")
+                continue
+            self.migrations += 1
+            self._record("migration", rid=fr.rid, custody="decode",
+                         src=fr.prefill_replica, dst=rep.id,
+                         pages=len(pages),
+                         bytes=sum(len(p) for p in pages))
 
     # -- frame handling --------------------------------------------------
     def _complete(self, fr: FleetRequest, rep: Optional[_Replica]) -> None:
@@ -490,10 +635,29 @@ class FleetRouter:
             self._wait_samples.append((now, fr.queue_wait_s))
         elif kind == "token":
             toks = frame.get("toks") or []
-            if toks and not fr.started:
-                fr.started = True
-                fr.ttft_s = now - fr.submit_t
+            if toks:
+                if fr.ttft_s is None:
+                    fr.ttft_s = now - fr.submit_t
+                # a PREFILL replica's token does not flip the failover
+                # boundary: the caller hasn't seen it, so a death
+                # anywhere before decode custody requeues cleanly
+                if not fr.started and not (
+                        fr.migrated and rep.id == fr.prefill_replica):
+                    fr.started = True
+                    fr._migration = None  # decode streaming: blob done
             fr.tokens.extend(int(t) for t in toks)
+        elif kind == "migrate_out":
+            # the prefill replica finished rid's prefill: its page
+            # frames follow on this same socket.  Custody stays with
+            # the replica until the LAST page lands.
+            rep.migrating[rid] = {"header": frame, "pages": []}
+        elif kind == "page":
+            entry = rep.migrating.get(rid)
+            if entry is not None and isinstance(frame, BinaryFrame):
+                entry["pages"].append(frame.payload)
+                if len(entry["pages"]) >= int(
+                        entry["header"].get("pages", 0)):
+                    self._take_custody(rep, fr, entry)
         elif kind == "done":
             fr.finish_reason = frame.get("reason")
             total = frame.get("tokens_total")
@@ -509,6 +673,26 @@ class FleetRouter:
                 f"replica {rep.id} failed rid={rid}: "
                 f"{frame.get('error')}")
             self._complete(fr, rep)
+
+    def _take_custody(self, rep: _Replica, fr: FleetRequest,
+                      entry: dict) -> None:
+        """The last page of rid's KV blob landed: custody moves prefill
+        replica → router.  The prefill replica is done with the rid
+        (its pages are already released engine-side)."""
+        hdr = entry["header"]
+        rep.migrating.pop(fr.rid, None)
+        rep.outstanding.pop(fr.rid, None)
+        fr.replica = None
+        if not fr.tokens and hdr.get("first_token") is not None:
+            # belt-and-braces: the replica streams the first token as a
+            # normal token frame before migrate_out, but the header
+            # carries it too so a blob is self-contained
+            fr.tokens.append(int(hdr["first_token"]))
+        fr._migration = (hdr, entry["pages"])
+        self._migrate_queue.append(fr)
+        self._record("migration", rid=fr.rid, custody="router",
+                     src=rep.id, pages=len(entry["pages"]),
+                     bytes=sum(len(p) for p in entry["pages"]))
 
     def _pump_replicas(self) -> None:
         for rep in list(self.replicas.values()):
@@ -639,18 +823,41 @@ class FleetRouter:
                     f"({reason}) after {len(fr.tokens)} token(s)",
                     replica=rep.id)
                 self._complete(fr, None)
+            elif fr._migration is not None:
+                # router custody: the decode replica died before it
+                # streamed a token, but the KV blob is still ours —
+                # re-dispatch it to another decode replica, losing
+                # nothing and re-running nothing
+                fr.replica = None
+                fr.decode_replica = None
+                fr.failovers += 1
+                self._migrate_queue.append(fr)
+                self._record("migration", rid=fr.rid, custody="router",
+                             src=rep.id, requeued=True)
+                failed_over += 1
             else:
                 # reset to pre-dispatch state; rid order preserved at
                 # the FRONT of the queue (they waited longest).  The
                 # wait stamp resets too: an admitted-but-unstarted
                 # request must stay visible to the oldest-wait wedge
-                # detector until its NEW replica admits it
+                # detector until its NEW replica admits it.  A migrated
+                # request dying in its PREFILL phase lands here: the
+                # partial blob (if any) died with the replica and the
+                # first token was never surrendered, so it restarts
+                # from scratch — tokens and stamps cleared
                 fr.replica = None
                 fr.queue_wait_s = None
                 fr.failovers += 1
+                if fr.migrated:
+                    fr.tokens.clear()
+                    fr.ttft_s = None
+                    fr.migrated = False
+                    fr.prefill_replica = None
+                    fr.decode_replica = None
                 self._queue.appendleft(fr)
                 failed_over += 1
         rep.outstanding.clear()
+        rep.migrating.clear()
         self._record("replica_dead", replica=rep.id, reason=reason,
                      failed_over=failed_over,
                      live=len(self._live()))
@@ -742,7 +949,122 @@ class FleetRouter:
         return _p99([s for t, s in self._wait_samples
                      if now - t <= w])
 
+    def _decode_tpot_p99(self) -> Optional[float]:
+        """The decode phase's SLO signal: worst ``serve_tpot_p99_s``
+        gauge any live decode/mixed replica last beat (a fleet is as
+        slow as its slowest decode replica — averaging would hide one
+        wedged member behind healthy peers)."""
+        worst = None
+        for rep in self.replicas.values():
+            if rep.role not in ("decode", "mixed"):
+                continue
+            beat = self._beats.get(rep.id) or {}
+            v = beat.get("serve_tpot_p99_s")
+            if v is None:
+                continue
+            v = float(v)
+            worst = v if worst is None else max(worst, v)
+        return worst
+
+    def _role_signals(self, role: str):
+        """(breach, slack, detail) for one role.  Prefill defends TTFT
+        through the admission-wait signal (queue waits ARE the TTFT
+        budget a prompt burns before its first prefill step); decode
+        defends TPOT through the replica-reported decode-latency gauge
+        plus the migration backlog (blobs parked at the router mean
+        decode capacity, not prefill, is the bottleneck)."""
+        cfg = self.cfg
+        if role == "decode":
+            slo = cfg.slo_tpot_s or 0.0
+            tpot = self._decode_tpot_p99()
+            backlog = len(self._migrate_queue)
+            breach = bool(backlog) or (
+                bool(slo) and tpot is not None and tpot > slo)
+            slack = not backlog and (
+                not slo or tpot is None or tpot < slo * SLACK_FACTOR)
+            return breach, slack, {"tpot_p99_s": tpot,
+                                   "migrate_backlog": backlog,
+                                   "slo_tpot_s": slo}
+        slo = (cfg.slo_ttft_s or cfg.slo_p99_s) if role == "prefill" \
+            else cfg.slo_p99_s
+        p99_up = self.queue_wait_p99(cfg.scale_up_window_s)
+        oldest = self._oldest_wait()
+        breach = ((p99_up is not None and p99_up > slo)
+                  or (oldest is not None and oldest > slo))
+        p99_down = self.queue_wait_p99(cfg.scale_down_window_s)
+        slack = (not self._queue
+                 and (p99_down is None
+                      or p99_down < slo * SLACK_FACTOR))
+        return breach, slack, {"p99_s": p99_up, "oldest_wait_s": oldest,
+                               "slo_s": slo}
+
+    def _autoscale_roles(self) -> None:
+        """Per-role scale decisions with per-role hysteresis clocks.
+        The role targets are the supervision floor: a role running
+        below its target respawns on supervision grounds alone, so a
+        dead prefill replica comes back AS prefill (a fleet that
+        backfilled roles arbitrarily would silently de-specialize)."""
+        now = self._now()
+        cfg = self.cfg
+        keep = max(cfg.scale_up_window_s, cfg.scale_down_window_s)
+        while self._wait_samples and \
+                now - self._wait_samples[0][0] > keep:
+            self._wait_samples.popleft()
+        live = self._live()
+        missing = self._role_deficit()
+        if missing is not None:
+            self._spawn("role floor", missing)
+            self._breach_since_role.pop(missing, None)
+            self._slack_since_role.pop(missing, None)
+            return
+        for role in ("prefill", "decode", "mixed"):
+            if not self._role_target.get(role, 0):
+                continue
+            breach, slack, detail = self._role_signals(role)
+            if breach:
+                self._slack_since_role.pop(role, None)
+                since = self._breach_since_role.get(role)
+                if since is None:
+                    self._breach_since_role[role] = now
+                elif now - since >= cfg.scale_up_window_s \
+                        and len(live) < cfg.max_replicas:
+                    rep = self._spawn("slo_breach", role)
+                    if rep is not None:
+                        self._role_target[role] += 1
+                        self._record("scale_up", replica=rep.id,
+                                     role=role, live=len(self._live()),
+                                     **detail)
+                        self._breach_since_role.pop(role, None)
+                        live = self._live()
+                continue
+            self._breach_since_role.pop(role, None)
+            if not slack:
+                self._slack_since_role.pop(role, None)
+                continue
+            since = self._slack_since_role.get(role)
+            if since is None:
+                self._slack_since_role[role] = now
+                continue
+            ready = [r for r in live
+                     if r.state == "ready" and r.role == role]
+            if now - since >= cfg.scale_down_window_s \
+                    and len(live) > cfg.min_replicas \
+                    and self._role_target[role] > 1 and ready:
+                rep = max(ready, key=lambda r: r.id)
+                rep.state = "draining"
+                self._role_target[role] -= 1
+                self._record("scale_down", replica=rep.id, role=role,
+                             live=len(self._live()), **detail)
+                logger.info("fleet: retiring %s replica %d (slack)",
+                            role, rep.id)
+                self._breach_since_role.pop(role, None)
+                self._slack_since_role.pop(role, None)
+                live = self._live()
+
     def _autoscale(self) -> None:
+        if self.cfg.roles:
+            self._autoscale_roles()
+            return
         now = self._now()
         cfg = self.cfg
         keep = max(cfg.scale_up_window_s, cfg.scale_down_window_s)
@@ -837,6 +1159,7 @@ class FleetRouter:
         self._check_replicas()
         self._reap()
         self._dispatch()
+        self._dispatch_migrations()
         self._drive_draining()
         self._autoscale()
         if timeout > 0:
@@ -849,7 +1172,7 @@ class FleetRouter:
                 pass
 
     def idle(self) -> bool:
-        return not self._queue and not any(
+        return not self._queue and not self._migrate_queue and not any(
             r.outstanding for r in self.replicas.values())
 
     def run_until_idle(self, max_s: float = 300.0) -> None:
@@ -922,12 +1245,13 @@ class FleetRouter:
                     self._write_request_record(fr)
                     fr.done.set()
             rep.outstanding.clear()
-        for fr in self._queue:
+        for fr in list(self._queue) + list(self._migrate_queue):
             if not fr.done.is_set():
                 fr.error = err
                 self._write_request_record(fr)
                 fr.done.set()
         self._queue.clear()
+        self._migrate_queue.clear()
         self.replicas.clear()
         for sock, _, _ in self._greeting:
             try:

@@ -30,13 +30,26 @@ one single-threaded loop:
                router stamps queue wait — the SLO signal), ``token``
                for newly generated ids, ``done``/``error`` on finish.
 
+Disaggregated roles (docs/serving.md "disaggregated fleet"): the
+router spawns each replica with ``--role`` (prefill / decode / mixed).
+A ``submit`` frame carrying ``migrate: true`` runs the prefill leg
+only — one token with ``detach_kv``, then the finished request's KV
+pages stream back as ``migrate_out`` + binary page frames and the
+local pages free.  A ``migrate_in`` frame (+ its page frames) adopts
+a migrated request mid-decode; adoption backpressures host-side like
+any admission.  The role itself steers nothing here — the ROUTER
+decides who prefills and who decodes; the replica just executes both
+halves of the handoff.
+
 Liveness + load: every loop writes a heartbeat into the shared fleet
 dir (``telemetry/heartbeat.py``) carrying the serving gauges the
-router's join-shortest-queue balancer reads — ``serve_active_slots``,
-request-queue depth, ``serve_free_pages`` (paged), the speculation
-accept ratio.  Telemetry (when enabled) lands in
-``<fleet_dir>/replica_<id>/`` so ``python -m deepspeed_tpu.telemetry
-diagnose <fleet_dir>`` can correlate the whole fleet post-mortem.
+router's join-shortest-queue balancer and per-role autoscaler read —
+``role``, ``serve_active_slots``, request-queue depth,
+``serve_free_pages`` (paged), ``serve_tpot_p99_s`` (the decode-SLO
+gauge), the speculation accept ratio.  Telemetry (when enabled) lands
+in ``<fleet_dir>/replica_<id>/`` so ``python -m deepspeed_tpu.
+telemetry diagnose <fleet_dir>`` can correlate the whole fleet
+post-mortem.
 """
 from __future__ import annotations
 
@@ -61,12 +74,18 @@ BEAT_INTERVAL_S = 0.1
 
 class _Tracked:
     """Router-rid → engine-request bridge: how many tokens were already
-    streamed, and whether admission was reported."""
+    streamed, and whether admission was reported.  ``migrate`` marks a
+    prefill-leg request whose finish exports KV pages instead of a
+    ``done`` frame; adopted requests start with the first token already
+    streamed by their prefill replica (``sent=1``, admission already
+    stamped)."""
 
-    def __init__(self, req):
+    def __init__(self, req, migrate: bool = False, sent: int = 0,
+                 admit_sent: bool = False):
         self.req = req
-        self.sent = 0
-        self.admit_sent = False
+        self.sent = sent
+        self.admit_sent = admit_sent
+        self.migrate = migrate
 
 
 def build_engine(cfg: dict, fleet_dir: str, replica_id: int):
@@ -104,9 +123,13 @@ def build_engine(cfg: dict, fleet_dir: str, replica_id: int):
                        seed=int(mspec.get("seed", 0)))
 
 
-def _beat_extra(eng, replica_id: int, backlog_n: int = 0) -> dict:
+def _beat_extra(eng, replica_id: int, backlog_n: int = 0,
+                role: str = "mixed") -> dict:
     extra = {
         "replica": replica_id,
+        #: the per-role autoscaler's grouping dimension (and the
+        #: heartbeat_age_s{role=} label in the router's metrics)
+        "role": role,
         "serve_active_slots": len(eng.scheduler.active),
         # the JSQ load gauge counts EVERY queued request this replica
         # holds: engine channel + parked admissions + the socket-side
@@ -118,13 +141,19 @@ def _beat_extra(eng, replica_id: int, backlog_n: int = 0) -> dict:
         extra["serve_free_pages"] = eng.pool.free_count
     if eng.spec_k:
         extra["spec_accept_ratio"] = eng._spec_ratio()
+    tpot = eng.tpot_p99()
+    if tpot is not None:
+        # the decode-phase SLO gauge the per-role autoscaler defends
+        # (docs/serving.md "disaggregated fleet")
+        extra["serve_tpot_p99_s"] = round(tpot, 6)
     return extra
 
 
 def serve(router_addr, replica_id: int, fleet_dir: str,
-          cfg: dict) -> int:
+          cfg: dict, role: str = "mixed") -> int:
     from ..telemetry.heartbeat import HeartbeatWriter
-    from .wire import FrameReader, drain_socket, send_frame
+    from .wire import (BinaryFrame, FrameReader, drain_socket,
+                       send_binary_frame, send_frame)
 
     eng = build_engine(cfg, fleet_dir, replica_id)
     hb = HeartbeatWriter(fleet_dir, process_index=replica_id)
@@ -144,11 +173,17 @@ def serve(router_addr, replica_id: int, fleet_dir: str,
     eng.run_until_idle()
     assert warm.error is None, f"warmup failed: {warm.error!r}"
     send_frame(sock, {"kind": "hello", "replica": replica_id,
-                      "pid": os.getpid()})
-    hb.beat(0, extra=_beat_extra(eng, replica_id))
+                      "pid": os.getpid(), "role": role})
+    hb.beat(0, extra=_beat_extra(eng, replica_id, role=role))
     last_beat = time.monotonic()
 
     live: Dict[int, _Tracked] = {}
+    #: migrate_in transfers still collecting their binary page frames:
+    #: rid -> (header, payload list)
+    inbound: Dict[int, tuple] = {}
+    #: complete transfers waiting for a free slot/pages — adoption
+    #: backpressure parks here, FIFO like the engine's _pending
+    adoptions: deque = deque()
     #: submit frames not yet handed to the engine: the engine's
     #: request Channel is a BLOCKING bounded queue, and a single-
     #: threaded replica that blocks in submit() can never step the
@@ -174,12 +209,57 @@ def serve(router_addr, replica_id: int, fleet_dir: str,
                 if req.error is not None:
                     send_frame(sock, {"kind": "error", "rid": rid,
                                       "error": repr(req.error)})
+                elif tr.migrate:
+                    # prefill leg complete: export the detached KV
+                    # pages as one bounded binary frame per page, then
+                    # free them — custody passes to the router the
+                    # moment migrate_out and every page frame are on
+                    # the wire (a death mid-export leaves the router
+                    # holding a partial blob it discards)
+                    payloads = eng.export_pages(req)
+                    leaves = eng.page_leaf_nbytes()
+                    send_frame(sock, {
+                        "kind": "migrate_out", "rid": rid,
+                        "first_token": req.tokens[0],
+                        "kv_len": len(req.prompt),
+                        "pages": len(payloads),
+                        "page_bytes": sum(len(p) for p in payloads)})
+                    for seq, payload in enumerate(payloads):
+                        send_binary_frame(
+                            sock, {"kind": "page", "rid": rid,
+                                   "seq": seq, "leaves": leaves},
+                            payload)
+                    eng.release_detached(req)
                 else:
                     send_frame(sock, {
                         "kind": "done", "rid": rid,
                         "reason": req.finish_reason,
                         "tokens_total": len(req.tokens)})
                 del live[rid]
+
+    def try_adopt() -> None:
+        """Admit parked migrate_in transfers while capacity allows —
+        the engine returns None under slot/page pressure and the head
+        transfer stays parked (admission order preserved)."""
+        while adoptions:
+            hdr, payloads = adoptions[0]
+            rid = hdr["rid"]
+            try:
+                req = eng.adopt_request(
+                    hdr["prompt"], hdr["first_token"],
+                    hdr.get("max_new_tokens", 16), hdr.get("eos_id"),
+                    payloads)
+            except Exception as e:
+                adoptions.popleft()
+                send_frame(sock, {"kind": "error", "rid": rid,
+                                  "error": repr(e)})
+                continue
+            if req is None:
+                return
+            adoptions.popleft()
+            # the prefill replica already streamed the first token and
+            # the router stamped admission at the ORIGINAL prefill
+            live[rid] = _Tracked(req, sent=1, admit_sent=True)
 
     try:
         while True:
@@ -192,6 +272,18 @@ def serve(router_addr, replica_id: int, fleet_dir: str,
                 kind = frame.get("kind")
                 if kind == "submit" and not shutting_down:
                     backlog.append(frame)
+                elif kind == "migrate_in" and not shutting_down:
+                    # header first; its binary page frames follow on
+                    # the same socket (ordered — TCP)
+                    inbound[frame["rid"]] = (frame, [])
+                elif kind == "page":
+                    entry = inbound.get(frame.get("rid"))
+                    if entry is not None and isinstance(frame,
+                                                        BinaryFrame):
+                        entry[1].append(frame.payload)
+                        if len(entry[1]) >= entry[0].get("pages", 0):
+                            del inbound[frame.get("rid")]
+                            adoptions.append(entry)
                 elif kind == "shutdown":
                     shutting_down = True
             # hand backlog to the engine only while its bounded queue
@@ -200,20 +292,28 @@ def serve(router_addr, replica_id: int, fleet_dir: str,
             while backlog and eng.queue.qsize() < qcap:
                 frame = backlog.popleft()
                 rid = frame["rid"]
+                migrate = bool(frame.get("migrate"))
                 try:
+                    # a migrating submit is the PREFILL LEG only: one
+                    # token (TTFT), pages detached for export — the
+                    # router gave the decode budget to whoever adopts
                     req = eng.submit(
                         frame["prompt"],
-                        max_new_tokens=frame.get("max_new_tokens", 16),
-                        eos_id=frame.get("eos_id"))
+                        max_new_tokens=(1 if migrate else
+                                        frame.get("max_new_tokens",
+                                                  16)),
+                        eos_id=frame.get("eos_id"),
+                        detach_kv=migrate)
                 except Exception as e:
                     # per-request isolation: a bad prompt answers
                     # typed, the pool keeps serving
                     send_frame(sock, {"kind": "error", "rid": rid,
                                       "error": repr(e)})
                     continue
-                live[rid] = _Tracked(req)
+                live[rid] = _Tracked(req, migrate=migrate)
+            try_adopt()
             busy = (eng.scheduler.active or eng._pending
-                    or eng.queue.qsize() or backlog)
+                    or eng.queue.qsize() or backlog or adoptions)
             if busy:
                 try:
                     eng.step()
@@ -224,14 +324,14 @@ def serve(router_addr, replica_id: int, fleet_dir: str,
                     # sort started from unstarted
                     return POISON_EXIT_CODE
             flush_outputs()
-            if shutting_down and not live and not busy:
+            if shutting_down and not live and not busy and not inbound:
                 break
             now = time.monotonic()
             if now - last_beat >= BEAT_INTERVAL_S:
                 last_beat = now
                 hb.beat(eng._ticks,
                         extra=_beat_extra(eng, replica_id,
-                                          len(backlog)))
+                                          len(backlog), role=role))
             if not busy:
                 try:
                     select.select([sock], [], [], 0.02)
@@ -267,12 +367,17 @@ def main(argv=None) -> int:
     parser.add_argument("--config", required=True,
                         help="ds_config.json with serving/telemetry/"
                              "fleet_model blocks")
+    parser.add_argument("--role", default="mixed",
+                        choices=("prefill", "decode", "mixed"),
+                        help="phase specialization (disaggregated "
+                             "fleet; the router decides who prefills "
+                             "and who decodes)")
     args = parser.parse_args(argv)
     host, _, port = args.router.rpartition(":")
     with open(args.config) as f:
         cfg = json.load(f)
     return serve((host, int(port)), args.replica_id, args.fleet_dir,
-                 cfg)
+                 cfg, role=args.role)
 
 
 if __name__ == "__main__":

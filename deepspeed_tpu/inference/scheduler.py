@@ -84,6 +84,16 @@ class Request:
     #: of the uneven per-slot progress the masked slot machinery
     #: absorbs (docs/serving.md "speculative decoding")
     spec_accepted: List[int] = dataclasses.field(default_factory=list)
+    #: chunked prefill (serving.prefill_chunk_len > 0): while True the
+    #: slot is mid-prefill — decode ticks mask it out and step() feeds
+    #: it one chunk at a time; chunk_pos = prompt tokens prefilled so
+    #: far past shared_len
+    prefilling: bool = False
+    chunk_pos: int = 0
+    #: KV-migration handoff (disaggregated fleet): finish without
+    #: releasing the slot's pages — the replica loop exports them over
+    #: the wire, then drops them explicitly
+    detach_kv: bool = False
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until the request finishes; raises its error if it

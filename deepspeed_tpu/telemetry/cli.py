@@ -745,6 +745,53 @@ def diagnose(directory: str, out=None) -> dict:
             report["fleet_first_failing_replica"] = rname
             print(f"  first failing      {rname} (stage {sname!r}): "
                   f"{ferr}", file=out)
+        # per-role breakdown (disaggregated fleets, docs/serving.md
+        # "disaggregated fleet"): spawn records carry the role, and the
+        # migration records ARE the custody ledger — which phase of the
+        # fleet was dying, and where every migrated KV blob ended up
+        role_of = {r.get("replica"): r.get("role") for r in records
+                   if r.get("kind") == "spawn" and r.get("role")}
+        migrations = [r for r in records
+                      if r.get("kind") == "migration"]
+        if any(v != "mixed" for v in role_of.values()) or migrations:
+            by_role: dict = {}
+            for repid, role in sorted(
+                    (k, v) for k, v in role_of.items()
+                    if k is not None):
+                by_role.setdefault(role, []).append(repid)
+            report["fleet_roles"] = {k: len(v)
+                                     for k, v in by_role.items()}
+            for role in sorted(by_role):
+                ids = by_role[role]
+                role_deaths = [d for d in deaths
+                               if d.get("replica") in ids]
+                line = (f"  role {role:<13} {len(ids)} replica(s) "
+                        f"spawned, {len(role_deaths)} death(s)")
+                if role_deaths:
+                    d0 = min(role_deaths, key=lambda r: r.get("t", 0))
+                    report.setdefault("fleet_role_first_dead",
+                                      {})[role] = d0.get("replica")
+                    line += (f"; first dead replica "
+                             f"{d0.get('replica')} — "
+                             f"{d0.get('reason')}")
+                print(line, file=out)
+            if migrations:
+                taken = sum(1 for m in migrations
+                            if m.get("custody") == "router"
+                            and not m.get("requeued"))
+                handed = sum(1 for m in migrations
+                             if m.get("custody") == "decode")
+                requeued = sum(1 for m in migrations
+                               if m.get("requeued"))
+                report["fleet_migrations"] = handed
+                report["fleet_migration_requeued"] = requeued
+                line = (f"  migrations         {taken} KV blob(s) "
+                        f"into router custody, {handed} handed to "
+                        "decode replicas")
+                if requeued:
+                    line += (f", {requeued} re-dispatched after a "
+                             "decode-replica death")
+                print(line, file=out)
         if midstream:
             m0 = midstream[0]
             print(f"  mid-stream failed  {len(midstream)} request(s) "

@@ -63,6 +63,7 @@ if [ "$1" = "--serve" ]; then
   run serve_spec python bench_serve.py --spec ab
   run serve_quant python bench_serve.py --quant ab
   run fleet python bench_serve.py --fleet ab
+  run fleet_disagg python -m tools.loadgen fleet_disagg
   run loadgen_goodput python -m tools.loadgen goodput
   exit 0
 fi
@@ -108,6 +109,12 @@ run serve_quant python bench_serve.py --quant ab
 # time, plus the replica-kill + autoscale-up SLO-recovery trace (pure
 # CPU subprocess supervision — see docs/serving.md "serving fleet")
 run fleet python bench_serve.py --fleet ab
+# disaggregated-fleet A/B: prefill/decode role split + chunked prefill
+# vs a homogeneous fleet on the same mixed long-prompt/short-decode
+# trace — the decode-cadence tail (TPOT p99) stays flat under prefill
+# interference (pure CPU, injected per-chunk device time —
+# docs/serving.md "disaggregated fleet")
+run fleet_disagg python -m tools.loadgen fleet_disagg
 # workload-plane goodput A/B: the SAME payload under uniform vs
 # heavy-tailed burst arrival at the same mean rate — throughput stays
 # flat, goodput (both-phase SLO attainment) collapses; plus the fleet

@@ -18,8 +18,11 @@ from deepspeed_tpu.config.config import DeepSpeedFleetConfig
 from deepspeed_tpu.inference.fleet import (FleetClosedError,
                                            FleetGiveUpError,
                                            FleetRouter, ReplicaFailure)
-from deepspeed_tpu.inference.wire import (FrameReader, WireError,
-                                          drain_socket, encode_frame,
+from deepspeed_tpu.inference.wire import (BinaryFrame, FrameReader,
+                                          WireError, drain_socket,
+                                          encode_binary_frame,
+                                          encode_frame,
+                                          send_binary_frame,
                                           send_frame)
 from deepspeed_tpu.runtime.stages import reset_fault_injection
 from deepspeed_tpu.telemetry.heartbeat import (HeartbeatWriter,
@@ -93,6 +96,78 @@ def test_wire_socket_pair_drain():
 
 
 # ---------------------------------------------------------------------------
+# wire binary page frames (KV migration transport)
+# ---------------------------------------------------------------------------
+
+
+def test_wire_binary_frame_torn_read_resumption():
+    """A binary page frame torn ANYWHERE — including mid page
+    payload — reassembles byte-identically, interleaved with JSON
+    frames on the same stream."""
+    payload = bytes(range(256)) * 16
+    blob = (encode_frame({"kind": "migrate_out", "rid": 7, "pages": 1})
+            + encode_binary_frame({"kind": "page", "rid": 7, "seq": 0},
+                                  payload)
+            + encode_frame({"kind": "done", "rid": 7}))
+    r = FrameReader()
+    out = []
+    for i in range(len(blob)):       # worst-case torn reads
+        out.extend(r.feed(blob[i:i + 1]))
+    assert [f.get("kind") for f in out] == ["migrate_out", "page",
+                                            "done"]
+    bf = out[1]
+    assert isinstance(bf, BinaryFrame)
+    assert bf.payload == payload
+    assert bf.get("seq") == 0 and bf.kind == "page"
+    # and in one gulp
+    out2 = FrameReader().feed(blob)
+    assert isinstance(out2[1], BinaryFrame)
+    assert out2[1].payload == payload
+
+
+def test_wire_binary_frame_crc_mismatch_is_connection_fatal():
+    """A flipped payload byte fails the CRC with a typed WireError —
+    the connection dies, it never resyncs (a corrupt KV page must not
+    be silently adopted)."""
+    good = bytearray(encode_binary_frame(
+        {"kind": "page", "rid": 1, "seq": 0}, b"\x55" * 128))
+    good[-10] ^= 0x01                # flip one payload bit
+    r = FrameReader()
+    with pytest.raises(WireError, match="CRC"):
+        r.feed(bytes(good))
+    # corrupt header length inside a CRC-valid body is also typed
+    import struct as _struct
+    import zlib as _zlib
+    body = _struct.pack(">I", 9999) + b"xx"
+    body += _struct.pack(">I", _zlib.crc32(body) & 0xFFFFFFFF)
+    with pytest.raises(WireError, match="overruns"):
+        FrameReader().feed(
+            _struct.pack(">I", 0x80000000 | len(body)) + body)
+
+
+def test_wire_binary_and_json_interleave_on_one_socket():
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, {"kind": "migrate_out", "rid": 3, "pages": 2})
+        send_binary_frame(a, {"kind": "page", "rid": 3, "seq": 0},
+                          b"A" * 64)
+        send_frame(a, {"kind": "token", "rid": 9, "toks": [1]})
+        send_binary_frame(a, {"kind": "page", "rid": 3, "seq": 1},
+                          b"B" * 64)
+        reader = FrameReader()
+        frames, closed = drain_socket(b, reader)
+        assert not closed
+        assert [f.get("kind") for f in frames] == [
+            "migrate_out", "page", "token", "page"]
+        assert frames[1].payload == b"A" * 64
+        assert frames[3].payload == b"B" * 64
+        assert frames[2] == {"kind": "token", "rid": 9, "toks": [1]}
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
 # heartbeat serving gauges (the fleet's JSQ payload)
 # ---------------------------------------------------------------------------
 
@@ -137,6 +212,33 @@ def test_fleet_config_defaults_and_validation():
                 {"scale_up_window_s": -1}, {"max_restarts": -1},
                 {"heartbeat_timeout_s": -2}, {"replicas": True},
                 {"backoff_base_s": "fast"}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedFleetConfig({"fleet": bad})
+
+
+def test_fleet_roles_config_validation():
+    cfg = DeepSpeedFleetConfig(
+        {"fleet": {"roles": {"prefill": 1, "decode": 2},
+                   "max_replicas": 4}})
+    assert cfg.roles == {"prefill": 1, "decode": 2}
+    assert cfg.replicas == 3          # roles size the fleet
+    # an explicit matching replicas count is redundant but legal
+    cfg = DeepSpeedFleetConfig(
+        {"fleet": {"roles": {"prefill": 1, "mixed": 1},
+                   "replicas": 2}})
+    assert cfg.replicas == 2
+    assert DeepSpeedFleetConfig({}).roles is None
+    for bad in (
+            # replicas contradicting the role sum
+            {"roles": {"prefill": 1, "decode": 1}, "replicas": 3},
+            # prefill with nowhere to migrate to
+            {"roles": {"prefill": 2}},
+            {"roles": {"prefill": 1, "frontend": 1}},  # unknown role
+            {"roles": {}},                             # empty map
+            {"roles": {"decode": 0}},                  # count < 1
+            {"roles": "prefill"},                      # not a dict
+            {"slo_ttft_s": -1},
+            {"slo_tpot_s": "fast"}):
         with pytest.raises(DeepSpeedConfigError):
             DeepSpeedFleetConfig({"fleet": bad})
 
@@ -622,6 +724,297 @@ def test_diagnose_non_fleet_dir_unchanged(tmp_path, capsys):
     assert "fleet_replica_dirs" not in report
 
 
+def test_diagnose_fleet_per_role_breakdown_and_custody(tmp_path,
+                                                       capsys):
+    """A disaggregated fleet dir: diagnose breaks replicas down per
+    role (first dead replica per role) and summarizes the migration
+    custody ledger — taken into router custody, handed to decode,
+    re-dispatched after a decode-replica death."""
+    from deepspeed_tpu.telemetry.cli import diagnose
+    d = tmp_path / "fleet"
+    d.mkdir()
+    events = [
+        {"kind": "spawn", "t": 1.0, "replica": 0, "role": "prefill"},
+        {"kind": "spawn", "t": 1.1, "replica": 1, "role": "decode"},
+        {"kind": "spawn", "t": 9.0, "replica": 2, "role": "decode"},
+        {"kind": "fleet_submit", "t": 10.0, "rid": 1},
+        {"kind": "migration", "t": 10.5, "rid": 1,
+         "custody": "router", "src": 0, "pages": 2, "bytes": 128},
+        {"kind": "migration", "t": 10.6, "rid": 1,
+         "custody": "decode", "dst": 1, "pages": 2, "bytes": 128},
+        {"kind": "replica_dead", "t": 11.0, "replica": 1,
+         "reason": "replica 1 exited rc=-9", "failed_over": 0},
+        {"kind": "migration", "t": 11.0, "rid": 1,
+         "custody": "router", "requeued": True, "src": 1},
+        {"kind": "migration", "t": 11.2, "rid": 1,
+         "custody": "decode", "dst": 2, "pages": 2, "bytes": 128},
+        {"kind": "fleet_request", "t": 12.0, "rid": 1, "error": None,
+         "started": True, "migrated": True, "prefill_replica": 0,
+         "decode_replica": 2},
+    ]
+    with open(d / "events.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    report = diagnose(str(d))
+    out = capsys.readouterr().out
+    assert report["fleet_roles"] == {"prefill": 1, "decode": 2}
+    assert report["fleet_role_first_dead"] == {"decode": 1}
+    assert report["fleet_migrations"] == 2        # handed to decode
+    assert report["fleet_migration_requeued"] == 1
+    assert "role prefill" in out and "role decode" in out
+    assert "first dead replica 1" in out
+    assert "re-dispatched after a decode-replica death" in out
+    # a homogeneous (all-mixed, no migrations) ledger grows no role rows
+    d2 = tmp_path / "homog"
+    d2.mkdir()
+    with open(d2 / "events.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "spawn", "t": 1.0, "replica": 0,
+                            "role": "mixed"}) + "\n")
+    report2 = diagnose(str(d2))
+    out2 = capsys.readouterr().out
+    assert "fleet_roles" not in report2
+    assert "role mixed" not in out2
+
+
+# ---------------------------------------------------------------------------
+# disaggregated roles: steering, migration custody, per-role autoscale
+# (fake socket replicas — custody transitions are deterministic here)
+# ---------------------------------------------------------------------------
+
+
+def _wait_for(cond, pump, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not cond() and time.monotonic() < deadline:
+        pump()
+    assert cond(), "condition never held"
+
+
+def test_roles_admissions_steer_to_prefill_with_migrate_flag(tmp_path):
+    fl = Fleet(tmp_path, {"roles": {"prefill": 1, "decode": 1},
+                          "max_replicas": 2}).start()
+    try:
+        assert {r.id: r.role for r in fl.router.replicas.values()} \
+            == {0: "prefill", 1: "decode"}
+        fl.router.submit([1, 2], max_new_tokens=4)
+        fl.router.submit([3], max_new_tokens=1)
+        _wait_for(lambda: len(fl.fakes[0].submits) == 2,
+                  lambda: fl.pump(1))
+        # both admissions went to the prefill replica; the multi-token
+        # one carries the migrate flag, the single-token one serves in
+        # place (its generation IS its prefill)
+        flags = {f["rid"]: f.get("migrate") for f in fl.fakes[0].submits}
+        assert flags == {1: True, 2: None}
+        assert not fl.fakes[1].submits
+    finally:
+        fl.router.close()
+
+
+def test_migration_custody_handoff_and_completion(tmp_path):
+    """The happy-path custody chain: prefill replica streams the first
+    token + KV blob, the router takes custody, hands blob + request to
+    the decode replica byte-intact, and the decode replica finishes the
+    stream — ledger transitions agree."""
+    fl = Fleet(tmp_path, {"roles": {"prefill": 1, "decode": 1},
+                          "max_replicas": 2}).start()
+    d = fl.router.fleet_dir
+    try:
+        r = fl.router.submit([1, 2, 3], max_new_tokens=4)
+        _wait_for(lambda: fl.fakes[0].submits, lambda: fl.pump(1))
+        p0 = fl.fakes[0]
+        p0.admit(1)
+        p0.tokens(1, [42])
+        send_frame(p0.sock, {"kind": "migrate_out", "rid": 1,
+                             "first_token": 42, "kv_len": 3,
+                             "pages": 2, "page_bytes": 128})
+        send_binary_frame(p0.sock, {"kind": "page", "rid": 1,
+                                    "seq": 0}, b"A" * 64)
+        send_binary_frame(p0.sock, {"kind": "page", "rid": 1,
+                                    "seq": 1}, b"B" * 64)
+        got = []
+
+        def _pump_decode():
+            fl.router.poll(0.01)
+            got.extend(fl.fakes[1].pump())
+        _wait_for(lambda: sum(1 for f in got
+                              if f.get("kind") == "page") == 2,
+                  _pump_decode)
+        assert [f.get("kind") for f in got] == ["migrate_in", "page",
+                                               "page"]
+        mi = got[0]
+        assert mi["prompt"] == [1, 2, 3]
+        assert mi["first_token"] == 42
+        assert mi["max_new_tokens"] == 4   # the ORIGINAL budget
+        assert isinstance(got[1], BinaryFrame)
+        assert got[1].payload == b"A" * 64
+        assert got[2].payload == b"B" * 64
+        # a PREFILL token never flips the failover boundary
+        assert r.tokens == [42] and not r.started
+        assert r.migrated and r.prefill_replica == 0 \
+            and r.decode_replica == 1
+        fl.fakes[1].tokens(1, [43, 44, 45])
+        fl.fakes[1].done(1, total=4)
+        assert r.result(timeout=5) == [42, 43, 44, 45]
+        assert r.started and r.error is None
+    finally:
+        fl.router.close()
+    recs = [json.loads(line) for line in open(
+        os.path.join(d, "events.jsonl"))]
+    mig = [x for x in recs if x["kind"] == "migration"]
+    assert [m["custody"] for m in mig] == ["router", "decode"]
+    assert mig[0]["src"] == 0 and mig[1]["dst"] == 1
+    assert mig[1]["pages"] == 2 and mig[1]["bytes"] == 128
+    req_recs = [x for x in recs if x["kind"] == "fleet_request"]
+    assert req_recs[-1]["migrated"] is True
+    assert req_recs[-1]["prefill_replica"] == 0
+    assert req_recs[-1]["decode_replica"] == 1
+
+
+def test_migration_prefill_death_mid_blob_requeues_from_scratch(
+        tmp_path):
+    """Kill the prefill replica while its KV blob is HALF received:
+    the partial blob is discarded, the request requeues unstarted with
+    its stream stamps cleared (the caller never saw the first token),
+    and the role floor respawns a PREFILL replica that re-runs it."""
+    fl = Fleet(tmp_path, {"roles": {"prefill": 1, "decode": 1},
+                          "max_replicas": 3}).start()
+    try:
+        r = fl.router.submit([5, 6], max_new_tokens=4)
+        _wait_for(lambda: fl.fakes[0].submits, lambda: fl.pump(1))
+        p0 = fl.fakes[0]
+        p0.admit(1)
+        p0.tokens(1, [42])
+        send_frame(p0.sock, {"kind": "migrate_out", "rid": 1,
+                             "first_token": 42, "kv_len": 2,
+                             "pages": 2, "page_bytes": 128})
+        send_binary_frame(p0.sock, {"kind": "page", "rid": 1,
+                                    "seq": 0}, b"A" * 64)
+        fl.pump()
+        assert r.tokens == [42] and not r.started
+        p0.die(9)
+        fl.advance(1.0)          # past the respawn backoff
+
+        def _pump():
+            fl.advance(0.05)
+            fl.pump(1)
+        _wait_for(lambda: any(i >= 2 and fl.fakes[i].submits
+                              for i in fl.fakes), _pump, timeout=10)
+        (new_id,) = [i for i in fl.fakes if i >= 2]
+        assert fl.router.replicas[new_id].role == "prefill"
+        resub = fl.fakes[new_id].submits[0]
+        assert resub["rid"] == 1 and resub.get("migrate") is True
+        # restarted from scratch: no leaked tokens/stamps, failover
+        # counted, nothing lost
+        assert r.tokens == [] and r.ttft_s is None
+        assert r.failovers == 1 and not r.done.is_set()
+        assert not fl.router._migrate_queue
+    finally:
+        fl.router.close()
+
+
+def test_migration_decode_death_reships_blob_zero_lost(tmp_path):
+    """Kill the decode replica AFTER the blob was handed over but
+    before it streamed: custody snaps back to the router, which
+    re-ships the SAME bytes to the replacement decode replica — the
+    request completes with its prefill work intact (never re-run)."""
+    fl = Fleet(tmp_path, {"roles": {"prefill": 1, "decode": 1},
+                          "max_replicas": 3}).start()
+    d = fl.router.fleet_dir
+    try:
+        r = fl.router.submit([7, 8, 9], max_new_tokens=3)
+        _wait_for(lambda: fl.fakes[0].submits, lambda: fl.pump(1))
+        p0 = fl.fakes[0]
+        p0.admit(1)
+        p0.tokens(1, [10])
+        send_frame(p0.sock, {"kind": "migrate_out", "rid": 1,
+                             "first_token": 10, "kv_len": 3,
+                             "pages": 1, "page_bytes": 32})
+        send_binary_frame(p0.sock, {"kind": "page", "rid": 1,
+                                    "seq": 0}, b"K" * 32)
+        got1 = []
+
+        def _pump1():
+            fl.router.poll(0.01)
+            got1.extend(fl.fakes[1].pump())
+        _wait_for(lambda: any(f.get("kind") == "page" for f in got1),
+                  _pump1)
+        fl.fakes[1].die(9)
+        fl.advance(1.0)
+        got2 = []
+
+        def _pump2():
+            fl.advance(0.05)
+            fl.router.poll(0.01)
+            for i, f in list(fl.fakes.items()):
+                if f.proc.rc is not None:
+                    continue
+                frames = f.pump()
+                if i >= 2:
+                    got2.extend(frames)
+        _wait_for(lambda: any(f.get("kind") == "page" for f in got2),
+                  _pump2, timeout=10)
+        (new_id,) = [i for i in fl.fakes if i >= 2]
+        assert fl.router.replicas[new_id].role == "decode"
+        pages = [f for f in got2 if f.get("kind") == "page"]
+        assert pages[0].payload == b"K" * 32    # the SAME bytes
+        assert r.failovers == 1 and r.tokens == [10]
+        fl.fakes[new_id].tokens(1, [11, 12])
+        fl.fakes[new_id].done(1, total=3)
+        assert r.result(timeout=5) == [10, 11, 12]
+    finally:
+        fl.router.close()
+    recs = [json.loads(line) for line in open(
+        os.path.join(d, "events.jsonl"))]
+    mig = [x for x in recs if x["kind"] == "migration"]
+    assert [m["custody"] for m in mig] == ["router", "decode",
+                                           "router", "decode"]
+    assert mig[2].get("requeued") is True
+    req_recs = [x for x in recs if x["kind"] == "fleet_request"]
+    assert req_recs[-1]["error"] is None       # zero lost
+
+
+def test_roles_autoscale_decode_tpot_breach_spawns_decode(tmp_path):
+    """Decode replicas beating a TPOT p99 over fleet.slo_tpot_s for a
+    sustained window scale the DECODE role up — prefill stays put."""
+    fl = Fleet(tmp_path, {"roles": {"prefill": 1, "decode": 1},
+                          "max_replicas": 4, "slo_tpot_s": 0.1,
+                          "scale_up_window_s": 5.0,
+                          "scale_down_window_s": 600.0}).start()
+    try:
+        w = HeartbeatWriter(fl.router.fleet_dir, process_index=1)
+        w.beat(1, extra={"serve_tpot_p99_s": 0.5})
+        fl.router._last_beats_read = 0.0
+        fl.router.poll(0.01)           # breach clock starts
+        fl.advance(6.0)
+        w.beat(2, extra={"serve_tpot_p99_s": 0.5})
+        fl.router._last_beats_read = 0.0
+        fl.router.poll(0.01)           # sustained past the window
+        new = [r for r in fl.router.replicas.values() if r.id >= 2]
+        assert [r.role for r in new] == ["decode"]
+        assert fl.router._role_target == {"prefill": 1, "decode": 2}
+    finally:
+        fl.router.close()
+
+
+def test_roles_autoscale_prefill_breach_spawns_prefill(tmp_path):
+    """Admission-wait p99 over the TTFT SLO scales the PREFILL role —
+    the phase that admissions actually queue behind."""
+    fl = Fleet(tmp_path, {"roles": {"prefill": 1, "decode": 1},
+                          "max_replicas": 4, "slo_ttft_s": 1.0,
+                          "scale_up_window_s": 5.0,
+                          "scale_down_window_s": 600.0}).start()
+    try:
+        fl.router._wait_samples.append((fl.router._now(), 5.0))
+        fl.router.poll(0.01)
+        fl.advance(6.0)
+        fl.router._wait_samples.append((fl.router._now(), 5.0))
+        fl.router.poll(0.01)
+        new = [r for r in fl.router.replicas.values() if r.id >= 2]
+        assert [r.role for r in new] == ["prefill"]
+        assert fl.router._role_target == {"prefill": 2, "decode": 1}
+    finally:
+        fl.router.close()
+
+
 # ---------------------------------------------------------------------------
 # subprocess e2e: real replicas behind the router
 # ---------------------------------------------------------------------------
@@ -766,3 +1159,132 @@ def test_e2e_replica_kill_fails_over_unstarted(tmp_path,
     assert all(r["started"] for r in dones.values() if r["error"])
     assert any(r["kind"] == "replica_dead" and r["failed_over"] > 0
                for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# subprocess e2e: disaggregated prefill/decode fleet
+# ---------------------------------------------------------------------------
+
+
+def _disagg_config(*, telemetry=False, chunk=4, **fleet_over):
+    """Paged + chunked serving over a prefill/decode role split —
+    prompts longer than prefill_len/2 exercise multi-page blobs."""
+    cfg = _e2e_config(2, telemetry=telemetry,
+                      roles={"prefill": 1, "decode": 1}, **fleet_over)
+    cfg["serving"].update({"prefill_len": 16, "page_len": 4,
+                           "pages": 64, "prefill_chunk_len": chunk})
+    return cfg
+
+
+def _long_prompts(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [[int(t) for t in rng.integers(0, 128, (11,))]
+            for _ in range(n)]
+
+
+def test_e2e_disagg_stream_parity_and_custody_ledger(tmp_path):
+    """THE disaggregation parity bar: a prefill/decode fleet with
+    chunked prefill emits the SAME greedy stream as a bare ServeEngine
+    — every request migrated over binary page frames, TTFT stamped at
+    the prefill replica, and the custody ledger balanced."""
+    from deepspeed_tpu.inference.replica import build_engine
+    cfg = _disagg_config(telemetry=True)
+    prompts = _long_prompts(8)
+
+    eng = build_engine(cfg, str(tmp_path / "bare"), 99)
+    bare = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_idle()
+    bare_toks = [r.tokens for r in bare]
+    eng.close()
+
+    d = str(tmp_path / "fleet")
+    router = FleetRouter(cfg, fleet_dir=d)
+    try:
+        router.start()
+        assert sorted(r.role for r in router.replicas.values()) \
+            == ["decode", "prefill"]
+        reqs = [router.submit(p, max_new_tokens=6) for p in prompts]
+        router.run_until_idle(max_s=120)
+        assert [r.tokens for r in reqs] == bare_toks
+        assert all(r.error is None for r in reqs)
+        assert all(r.migrated for r in reqs)
+        assert all(r.ttft_s is not None for r in reqs)
+        assert router.migrations == len(prompts)
+    finally:
+        router.close()
+    recs = []
+    with open(os.path.join(d, "events.jsonl")) as f:
+        for line in f:
+            recs.append(json.loads(line))
+    mig = [r for r in recs if r["kind"] == "migration"]
+    # every request: exactly one router-custody + one decode-custody
+    assert sum(1 for m in mig if m["custody"] == "router") \
+        == len(prompts)
+    assert sum(1 for m in mig if m["custody"] == "decode") \
+        == len(prompts)
+    done = [r for r in recs if r["kind"] == "fleet_request"]
+    assert all(r["migrated"] and r["error"] is None for r in done)
+    assert {r["prefill_replica"] for r in done} == {0}
+    assert {r["decode_replica"] for r in done} == {1}
+    # zero recompiles survive the wire on BOTH phases: one compiled
+    # prefill program across chunked admissions, one decode program
+    # across adopted requests
+    for rid in (0, 1):
+        prom = os.path.join(d, f"replica_{rid}", "metrics.prom")
+        if os.path.isfile(prom):
+            with open(prom) as f:
+                for line in f:
+                    if line.startswith("recompiles_total") and (
+                            "prefill" in line or "decode_step" in line):
+                        assert float(line.rsplit(None, 1)[1]) == 0.0, \
+                            line
+
+
+def test_e2e_disagg_decode_kill_zero_lost(tmp_path, monkeypatch):
+    """Chaos-kill the DECODE replica mid-run: router-custody blobs
+    re-ship to the respawned decode replica, started casualties fail
+    typed, and the ledger shows zero dangling requests."""
+    monkeypatch.setenv("DS_STAGE_DELAY_S", "serve:0.05")
+    reset_fault_injection()
+    cfg = _disagg_config(max_replicas=3)
+    d = str(tmp_path / "fleet")
+    router = FleetRouter(cfg, fleet_dir=d)
+    try:
+        router.start()
+        reqs = [router.submit(p, max_new_tokens=8)
+                for p in _long_prompts(10, seed=3)]
+        # wait for the decode phase to hold real work (custody handed
+        # over), then kill it
+        deadline = time.monotonic() + 60
+        victim = None
+        while time.monotonic() < deadline:
+            router.poll(0.02)
+            decode = [r for r in router.replicas.values()
+                      if r.role == "decode" and r.state == "ready"]
+            if decode and decode[0].outstanding:
+                victim = decode[0].id
+                break
+        assert victim is not None, "decode replica never took work"
+        router.kill_replica(victim)
+        router.run_until_idle(max_s=120)
+        failed = [r for r in reqs if r.error is not None]
+        assert all(r.started for r in failed)       # zero lost
+        assert all(isinstance(r.error, ReplicaFailure)
+                   for r in failed)
+        survivors = [r for r in reqs if r.error is None]
+        assert survivors and all(len(r.tokens) == 8
+                                 for r in survivors)
+    finally:
+        router.close()
+    recs = []
+    with open(os.path.join(d, "events.jsonl")) as f:
+        for line in f:
+            recs.append(json.loads(line))
+    submits = {r["rid"] for r in recs if r["kind"] == "fleet_submit"}
+    dones = {r["rid"] for r in recs if r["kind"] == "fleet_request"}
+    assert submits == dones                         # nothing dangling
+    assert any(r["kind"] == "replica_dead" for r in recs)
+    # the respawn honored the role floor: a DECODE replica came back
+    respawns = [r for r in recs if r["kind"] == "spawn"
+                and r["reason"] != "initial"]
+    assert any(r.get("role") == "decode" for r in respawns)

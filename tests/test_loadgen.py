@@ -116,6 +116,81 @@ def test_load_trace_tolerates_torn_lines(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# trace converter: Azure CSV / Mooncake JSONL -> load_trace shape
+# ---------------------------------------------------------------------------
+
+
+_DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+def test_convert_azure_csv_to_trace(tmp_path):
+    """Azure LLM-inference CSV rows (ISO timestamps at 7-digit
+    precision, blank length cells, a torn timestamp) convert
+    tolerantly into the replayable shape load_trace reads."""
+    from tools.loadgen.convert import convert_trace
+    dst = tmp_path / "azure.jsonl"
+    summary = convert_trace(os.path.join(_DATA, "azure_llm_sample.csv"),
+                            str(dst))
+    assert summary["format"] == "azure"
+    assert summary["rows"] == 5 and summary["skipped"] == 1
+    arrival, records = load_trace(str(dst))
+    assert arrival.kind == "trace" and len(arrival.trace) == 5
+    assert arrival.trace[0] == 0.0
+    assert list(arrival.trace) == sorted(arrival.trace)
+    assert arrival.trace[-1] == pytest.approx(3.27, abs=1e-3)
+    assert records[0]["prompt_len"] == 448
+    assert records[0]["gen_tokens"] == 84
+    assert "prompt_len" not in records[3]      # blank cell dropped
+    assert records[3]["gen_tokens"] == 25
+    assert "gen_tokens" not in records[4]
+
+
+def test_convert_mooncake_jsonl_to_trace(tmp_path):
+    """Mooncake open-trace JSONL (millisecond timestamps, torn lines,
+    rows without lengths) converts tolerantly, and converting the
+    OUTPUT again is byte-idempotent (native rows pass through)."""
+    from tools.loadgen.convert import convert_trace, detect_format
+    src = os.path.join(_DATA, "mooncake_sample.jsonl")
+    assert detect_format(src) == "mooncake"
+    dst = tmp_path / "mooncake.jsonl"
+    summary = convert_trace(src, str(dst))
+    assert summary["format"] == "mooncake"
+    assert summary["rows"] == 4 and summary["skipped"] == 2
+    arrival, records = load_trace(str(dst))
+    assert arrival.trace == (0.0, 21.5, 31.0, 45.0)
+    assert records[0]["prompt_len"] == 655
+    assert records[0]["gen_tokens"] == 52
+    assert records[2]["prompt_len"] == 88
+    assert "gen_tokens" not in records[2]
+    dst2 = tmp_path / "again.jsonl"
+    convert_trace(str(dst), str(dst2))
+    assert dst2.read_bytes() == dst.read_bytes()
+
+
+def test_convert_cli_subcommand(tmp_path, monkeypatch, capsys):
+    """``python -m tools.loadgen convert`` dispatches past the
+    scenario parser; --limit truncates after the time sort."""
+    from tools.loadgen.__main__ import main
+    dst = tmp_path / "out.jsonl"
+    monkeypatch.setattr("sys.argv", [
+        "loadgen", "convert",
+        os.path.join(_DATA, "mooncake_sample.jsonl"), str(dst),
+        "--format", "mooncake", "--limit", "2"])
+    main()
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["rows"] == 2
+    arrival, _ = load_trace(str(dst))
+    assert arrival.trace == (0.0, 21.5)
+
+
+def test_convert_unknown_format_raises(tmp_path):
+    from tools.loadgen.convert import convert_trace
+    with pytest.raises(ValueError, match="unknown trace format"):
+        convert_trace(os.path.join(_DATA, "mooncake_sample.jsonl"),
+                      str(tmp_path / "x.jsonl"), fmt="splitwise")
+
+
+# ---------------------------------------------------------------------------
 # goodput: phase attribution + SLO scoring from records alone
 # ---------------------------------------------------------------------------
 

@@ -286,6 +286,171 @@ def test_paged_submit_validation():
 
 
 # ---------------------------------------------------------------------------
+# chunked prefill: parity, co-scheduling, zero recompiles, KV migration
+# ---------------------------------------------------------------------------
+
+
+#: prompt lengths covering the chunk/page boundary matrix for
+#: prefill_chunk_len=4 on page_len=8: sub-chunk, == chunk, chunk
+#: boundary inside a page, == page, and final chunks landing inside,
+#: at, and across page boundaries
+CHUNK_PROMPTS = [1, 3, 4, 8, 11, 17, 20]
+
+
+def test_chunked_prefill_stream_parity_across_boundaries():
+    """Acceptance bar: splitting prefill into fixed-size chunks changes
+    WHEN the prompt's KV is computed, never WHAT — token streams are
+    bitwise the unchunked paged streams at every chunk/page-boundary
+    class."""
+    model = GPT2Model(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    prompts = [list(_tokens(n, seed=40 + i))
+               for i, n in enumerate(CHUNK_PROMPTS)]
+
+    def run(extra):
+        eng = ServeEngine(model, _serve_cfg(page_len=8, **extra),
+                          params=params)
+        rs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        eng.run_until_idle()
+        assert all(r.error is None for r in rs)
+        toks = [list(r.tokens) for r in rs]
+        eng.close()
+        return toks
+
+    assert run({}) == run({"prefill_chunk_len": 4})
+
+
+def test_chunked_prefill_coschedules_decode_ticks():
+    """While a long prompt is mid-chunks, decode-phase slots keep
+    producing a token EVERY tick — chunked prefill bounds the decode
+    stall to one chunk per step instead of a whole-prompt prefill
+    (Sarathi-Serve co-scheduling, docs/serving.md)."""
+    eng = ServeEngine(GPT2Model(TINY), _serve_cfg(
+        slots=2, page_len=8, prefill_chunk_len=4))
+    short = eng.submit(list(_tokens(2, seed=1)), max_new_tokens=24)
+    eng.step()
+    assert len(short.tokens) >= 1          # short is decoding
+    long = eng.submit(list(_tokens(20, seed=2)), max_new_tokens=4)
+    eng.step()                             # admits long + chunk 1
+    assert long.prefilling                 # 20 tokens = 5 chunks
+    stalls = 0
+    while long.prefilling:
+        before = len(short.tokens)
+        eng.step()
+        stalls += (len(short.tokens) == before)
+    assert stalls == 0                     # decode never starved
+    assert long.tokens                     # final chunk stamped TTFT
+    eng.run_until_idle()
+    assert short.error is None and long.error is None
+    assert long.finish_reason == "length" and len(long.tokens) == 4
+    eng.close()
+
+
+def test_chunked_prefill_zero_recompiles_mixed_lengths(tmp_path):
+    """One compiled prefill program serves EVERY chunk: varying prompt
+    lengths, chunk counts, and final-chunk widths cost zero recompiles
+    — the chunk position rides the traced prefix_len, not a shape."""
+    eng = ServeEngine(GPT2Model(TINY), _serve_cfg(
+        slots=3, page_len=8, prefill_chunk_len=4,
+        telemetry_path=tmp_path))
+    rng = np.random.default_rng(11)
+    reqs = []
+    for wave in range(3):
+        for i in range(5):
+            n = int(rng.integers(1, 24))   # 1..6 chunks per prompt
+            reqs.append(eng.submit(
+                list(_tokens(n, seed=200 * wave + i)),
+                max_new_tokens=int(rng.integers(1, 9))))
+        eng.run_until_idle()
+    assert all(r.error is None for r in reqs)
+    eng.telemetry.compile_monitor.sample()
+    reg = eng.telemetry.registry
+    for prog in ("decode_step", "prefill", "copy_page"):
+        assert reg.counter("recompiles_total").value(program=prog) == 0
+    assert eng._prefill_fn._cache_size() == 1
+    assert eng._decode_fn._cache_size() == 1
+    eng.close()
+
+
+def test_kv_migration_export_adopt_stream_parity():
+    """Engine-level disaggregation parity: prefill on engine A with
+    ``detach_kv`` (1 token), ship the exported page payloads into
+    engine B via ``adopt_request``, and the combined stream is bitwise
+    what a single engine produces — at page-boundary-covering prompt
+    lengths."""
+    model = GPT2Model(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    prompts = [list(_tokens(n, seed=60 + i))
+               for i, n in enumerate([3, 8, 11])]
+    budget = 10
+
+    def single():
+        eng = ServeEngine(model, _serve_cfg(page_len=8),
+                          params=params)
+        rs = [eng.submit(p, max_new_tokens=budget) for p in prompts]
+        eng.run_until_idle()
+        assert all(r.error is None for r in rs)
+        toks = [list(r.tokens) for r in rs]
+        eng.close()
+        return toks
+
+    def migrated():
+        a = ServeEngine(model, _serve_cfg(page_len=8), params=params)
+        b = ServeEngine(model, _serve_cfg(page_len=8), params=params)
+        assert a.page_leaf_nbytes() == b.page_leaf_nbytes()
+        out = []
+        for p in prompts:
+            r = a.submit(p, max_new_tokens=1, detach_kv=True)
+            a.run_until_idle()
+            assert r.error is None and r.pages is not None
+            payloads = a.export_pages(r)
+            a.release_detached(r)
+            assert r.pages is None         # capacity returned
+            rb = b.adopt_request(p, r.tokens[0], budget, None,
+                                 payloads)
+            assert rb is not None
+            b.run_until_idle()
+            assert rb.error is None
+            out.append(list(rb.tokens))
+        a.close()
+        b.close()
+        return out
+
+    assert single() == migrated()
+
+
+def test_kv_adoption_backpressure_returns_none():
+    """adopt_request under slot/page pressure parks instead of raising
+    — the router's retry contract."""
+    model = GPT2Model(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    a = ServeEngine(model, _serve_cfg(page_len=8), params=params)
+    p = list(_tokens(9, seed=5))
+    r = a.submit(p, max_new_tokens=1, detach_kv=True)
+    a.run_until_idle()
+    payloads = a.export_pages(r)
+    a.release_detached(r)
+    # slot pressure: a 1-slot engine mid-request has no free slot
+    b = ServeEngine(model, _serve_cfg(slots=1, page_len=8),
+                    params=params)
+    held = b.submit(list(_tokens(2, seed=6)), max_new_tokens=30)
+    b.step()
+    assert b.adopt_request(p, r.tokens[0], 4, None, payloads) is None
+    b.run_until_idle()
+    assert held.error is None
+    # page-count mismatch is a config error, not backpressure
+    with pytest.raises(ValueError, match="pages"):
+        b.adopt_request(p, r.tokens[0], 4, None, payloads[:-1])
+    a.close()
+    b.close()
+
+
+def test_chunked_prefill_config_needs_paged_layout():
+    with pytest.raises(DeepSpeedConfigError, match="page_len"):
+        ServeEngine(GPT2Model(TINY), _serve_cfg(prefill_chunk_len=4))
+
+
+# ---------------------------------------------------------------------------
 # prefix cache: shared templates, COW, eviction, accounting
 # ---------------------------------------------------------------------------
 

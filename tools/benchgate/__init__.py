@@ -73,6 +73,12 @@ METRIC_DIRECTIONS = {
     # boolean-as-1: the chaos run degraded and completed instead of
     # wedging — 1 is the pass value, HIGHER is better
     "stage_chaos_degraded_run": False,
+    # disagg decode-tail ratio (disagg decode TPOT p99 / homogeneous,
+    # same mixed trace): phase separation defending the decode cadence
+    # — LOWER is better; pinned explicitly rather than riding the
+    # "_p99" name hint because the headline is a RATIO of p99s, not a
+    # latency (docs/serving.md "disaggregated fleet")
+    "fleet_disagg_decode_p99_ratio": True,
     # goodput gap, uniform minus burst arrival at the same mean rate:
     # the gate guards that the bench keeps RESOLVING the phenomenon
     # (goodput collapses under burst while throughput stays flat) —

@@ -5,15 +5,23 @@ writes its ``BENCH_*.json`` next to ``--out-dir`` and prints the
 record.  ``goodput`` is the workload plane's own headline (uniform vs
 burst arrival at the same mean rate + the chaos leg); the other five
 are the legacy ``bench_serve.py`` legs.
+
+``python -m tools.loadgen convert <src> <dst>`` is the trace
+converter: public Azure/Mooncake trace rows → the replayable
+``load_trace`` JSONL shape (tools/loadgen/convert.py).
 """
 import argparse
 import json
 import os
+import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "convert":
+        from tools.loadgen.convert import main as convert_main
+        return convert_main(sys.argv[2:])
     from tools.loadgen.scenarios import SCENARIOS
     ap = argparse.ArgumentParser(
         prog="python -m tools.loadgen",

@@ -1,0 +1,194 @@
+"""Convert public LLM-serving traces into the loadgen ``trace`` shape.
+
+``python -m tools.loadgen convert <src> <dst>`` turns one row of a
+published trace into one JSONL line of the replayable shape
+:func:`tools.loadgen.workload.load_trace` reads::
+
+    {"at_s": <seconds from the first row>, "prompt_len": N,
+     "gen_tokens": M}
+
+Two source dialects, auto-detected (``--format`` overrides):
+
+``azure``
+    The Azure LLM inference trace CSVs: a header row naming (at
+    least) ``TIMESTAMP``, ``ContextTokens``, ``GeneratedTokens``.
+    Timestamps are ISO datetimes (any fractional precision) or plain
+    epoch seconds.
+
+``mooncake``
+    The Mooncake open-trace JSONL: one object per line with
+    ``timestamp`` (milliseconds), ``input_length``,
+    ``output_length``.  Lines already in the native ``at_s`` shape
+    pass through normalized, so converting a converted file is
+    idempotent.
+
+The reader is TOLERANT, matching the summarize idiom: torn lines,
+missing timestamps, and unparseable fields are skipped (counted, not
+fatal) — public traces ship with ragged tails.  Rows are re-sorted by
+time and rebased so the first kept row lands at ``at_s == 0.0``.
+"""
+import argparse
+import csv
+import datetime
+import json
+import re
+from typing import List, Optional, Tuple
+
+__all__ = ["convert_trace", "detect_format", "main"]
+
+#: (at_s, prompt_len-or-None, gen_tokens-or-None)
+Row = Tuple[float, Optional[int], Optional[int]]
+
+_FRACTION = re.compile(r"\.(\d+)")
+
+
+def _clamp_fraction(m: "re.Match") -> str:
+    # fromisoformat (py3.10) wants exactly 3 or 6 fractional digits;
+    # traces ship anything from 1 to 7 — normalize to microseconds
+    return "." + m.group(1)[:6].ljust(6, "0")
+
+
+def _parse_timestamp(raw) -> Optional[float]:
+    """Seconds from a trace timestamp cell: plain numbers are epoch
+    seconds; anything else is tried as an ISO datetime with the
+    fraction clamped to microseconds (Azure ships 7 digits, which
+    ``fromisoformat`` rejects)."""
+    if raw is None:
+        return None
+    text = str(raw).strip()
+    if not text:
+        return None
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    try:
+        dt = datetime.datetime.fromisoformat(
+            _FRACTION.sub(_clamp_fraction, text.replace("Z", "+00:00")))
+    except ValueError:
+        return None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return dt.timestamp()
+
+
+def _parse_len(raw) -> Optional[int]:
+    try:
+        n = int(float(raw))
+    except (TypeError, ValueError):
+        return None
+    return n if n >= 0 else None
+
+
+def detect_format(path: str) -> str:
+    """``azure`` | ``mooncake`` by sniffing the first non-empty line:
+    a JSON object is mooncake-dialect JSONL, anything else is tried as
+    headered CSV."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            return "mooncake" if line.startswith("{") else "azure"
+    return "azure"
+
+
+def _read_azure(path: str) -> Tuple[List[Row], int]:
+    rows: List[Row] = []
+    skipped = 0
+    with open(path, newline="") as f:
+        for rec in csv.DictReader(f):
+            # header names vary across trace releases in case only
+            low = {(k or "").strip().lower(): v
+                   for k, v in rec.items()}
+            at = _parse_timestamp(low.get("timestamp"))
+            if at is None:
+                skipped += 1
+                continue
+            rows.append((at, _parse_len(low.get("contexttokens")),
+                         _parse_len(low.get("generatedtokens"))))
+    return rows, skipped
+
+
+def _read_mooncake(path: str) -> Tuple[List[Row], int]:
+    rows: List[Row] = []
+    skipped = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if not isinstance(rec, dict):
+                skipped += 1
+                continue
+            if rec.get("at_s") is not None:       # already native
+                at = _parse_timestamp(rec["at_s"])
+                plen = _parse_len(rec.get("prompt_len"))
+                gen = _parse_len(rec.get("gen_tokens"))
+            else:
+                ms = _parse_timestamp(rec.get("timestamp"))
+                at = None if ms is None else ms / 1000.0
+                plen = _parse_len(rec.get("input_length"))
+                gen = _parse_len(rec.get("output_length"))
+            if at is None:
+                skipped += 1
+                continue
+            rows.append((at, plen, gen))
+    return rows, skipped
+
+
+_READERS = {"azure": _read_azure, "mooncake": _read_mooncake}
+
+
+def convert_trace(src: str, dst: str, fmt: str = "auto",
+                  limit: Optional[int] = None) -> dict:
+    """Convert ``src`` → ``dst`` (loadgen trace JSONL).  Returns a
+    summary dict: rows written, rows skipped, detected format, span."""
+    if fmt == "auto":
+        fmt = detect_format(src)
+    if fmt not in _READERS:
+        raise ValueError(f"unknown trace format {fmt!r}; "
+                         f"expected one of {sorted(_READERS)}")
+    rows, skipped = _READERS[fmt](src)
+    rows.sort(key=lambda r: r[0])
+    if limit is not None:
+        rows = rows[:limit]
+    t0 = rows[0][0] if rows else 0.0
+    with open(dst, "w") as f:
+        for at, plen, gen in rows:
+            rec = {"at_s": round(at - t0, 6)}
+            if plen is not None:
+                rec["prompt_len"] = plen
+            if gen is not None:
+                rec["gen_tokens"] = gen
+            f.write(json.dumps(rec) + "\n")
+    return {"format": fmt, "rows": len(rows), "skipped": skipped,
+            "span_s": round(rows[-1][0] - t0, 6) if rows else 0.0}
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.loadgen convert",
+        description="convert a public serving trace into the loadgen "
+                    "trace JSONL shape (load_trace format)")
+    ap.add_argument("src", help="source trace (Azure CSV or Mooncake "
+                                "JSONL)")
+    ap.add_argument("dst", help="output JSONL path")
+    ap.add_argument("--format", default="auto",
+                    choices=("auto", "azure", "mooncake"),
+                    help="source dialect (default: sniff the file)")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="keep only the first N rows after sorting")
+    args = ap.parse_args(argv)
+    print(json.dumps(convert_trace(args.src, args.dst,
+                                   fmt=args.format,
+                                   limit=args.limit)))
+
+
+if __name__ == "__main__":
+    main()

@@ -511,6 +511,8 @@ class DeepSpeedServingConfig:
         self.draft = self._validate_draft(sv.get(C.SERVING_DRAFT))
         self.quantization = self._validate_quantization(
             sv.get(C.SERVING_QUANTIZATION), self.page_len)
+        self.lora = self._validate_lora(
+            sv.get(C.SERVING_LORA), self.page_len)
         for name, v, lo in ((C.SERVING_SLOTS, self.slots, 1),
                             (C.SERVING_MAX_SEQ_LEN, self.max_seq_len, 0),
                             (C.SERVING_PREFILL_LEN, self.prefill_len, 0),
@@ -680,6 +682,82 @@ class DeepSpeedServingConfig:
                 f"serving.{C.SERVING_PAGE_LEN} <= 128 (one scale lane "
                 f"per page row in the fused-dequant kernels), got "
                 f"{page_len}")
+        return out
+
+    @staticmethod
+    def _validate_lora(lora, page_len: int) -> Dict[str, Any]:
+        """Eager validation of ``serving.lora`` (docs/serving.md
+        "multi-tenant serving"): a typo'd rank or target must fail at
+        config parse, not as a shape error inside the first decode tick
+        under live multi-tenant traffic.  Returns the block with
+        defaults filled (rank=0 = lora OFF — no pool, no extra
+        operands, bitwise-unchanged programs)."""
+        if lora is None:
+            lora = {}
+        if not isinstance(lora, dict):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_LORA} must be a dict "
+                f"(rank/alpha/max_adapters/hbm_adapter_slots/targets), "
+                f"got {lora!r}")
+        allowed = {C.SERVING_LORA_RANK, C.SERVING_LORA_ALPHA,
+                   C.SERVING_LORA_MAX_ADAPTERS, C.SERVING_LORA_HBM_SLOTS,
+                   C.SERVING_LORA_TARGETS}
+        unknown = set(lora) - allowed
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_LORA} has unknown key(s) "
+                f"{sorted(unknown)}; allowed: {sorted(allowed)}")
+        out = {
+            C.SERVING_LORA_RANK: get_scalar_param(
+                lora, C.SERVING_LORA_RANK, C.SERVING_LORA_RANK_DEFAULT),
+            C.SERVING_LORA_ALPHA: get_scalar_param(
+                lora, C.SERVING_LORA_ALPHA,
+                C.SERVING_LORA_ALPHA_DEFAULT),
+            C.SERVING_LORA_MAX_ADAPTERS: get_scalar_param(
+                lora, C.SERVING_LORA_MAX_ADAPTERS,
+                C.SERVING_LORA_MAX_ADAPTERS_DEFAULT),
+            C.SERVING_LORA_HBM_SLOTS: get_scalar_param(
+                lora, C.SERVING_LORA_HBM_SLOTS,
+                C.SERVING_LORA_HBM_SLOTS_DEFAULT),
+            C.SERVING_LORA_TARGETS: tuple(lora.get(
+                C.SERVING_LORA_TARGETS, C.SERVING_LORA_TARGETS_DEFAULT)),
+        }
+        rank = out[C.SERVING_LORA_RANK]
+        if not isinstance(rank, int) or isinstance(rank, bool) \
+                or rank < 0:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_LORA}.{C.SERVING_LORA_RANK} must "
+                f"be an int >= 0 (0 = lora off), got {rank!r}")
+        alpha = out[C.SERVING_LORA_ALPHA]
+        if isinstance(alpha, bool) \
+                or not isinstance(alpha, (int, float)) or alpha <= 0:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_LORA}.{C.SERVING_LORA_ALPHA} must "
+                f"be a number > 0 (the alpha/rank delta scale), got "
+                f"{alpha!r}")
+        out[C.SERVING_LORA_ALPHA] = float(alpha)
+        for key in (C.SERVING_LORA_MAX_ADAPTERS,
+                    C.SERVING_LORA_HBM_SLOTS):
+            v = out[key]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise DeepSpeedConfigError(
+                    f"serving.{C.SERVING_LORA}.{key} must be an int "
+                    f">= 1, got {v!r}")
+        target_names = ("qkv_w", "out_w", "fc_w", "proj_w")
+        targets = out[C.SERVING_LORA_TARGETS]
+        if not targets or any(t not in target_names for t in targets) \
+                or len(set(targets)) != len(targets):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_LORA}.{C.SERVING_LORA_TARGETS} "
+                f"must be a non-empty list of distinct block-param "
+                f"names from {list(target_names)}, got "
+                f"{list(targets)!r}")
+        if rank and not page_len:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_LORA}.{C.SERVING_LORA_RANK}="
+                f"{rank} requires serving.{C.SERVING_PAGE_LEN} > 0: "
+                "the adapter pool rides the paged serving plane (its "
+                "residency slots are managed exactly like KV pages)")
         return out
 
 

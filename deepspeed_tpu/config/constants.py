@@ -564,6 +564,43 @@ SERVING_QUANT_KV_DEFAULT = "fp16"
 # admission).  Requires page_len > 0.
 SERVING_PREFILL_CHUNK_LEN = "prefill_chunk_len"
 SERVING_PREFILL_CHUNK_LEN_DEFAULT = 0
+# multi-tenant LoRA serving (S-LoRA / Punica, PAPERS.md;
+# docs/serving.md "multi-tenant serving"): per-tenant low-rank
+# adapters batched HETEROGENEOUSLY over one base model — each
+# decode/prefill/verify pass gathers per-slot adapter weights by a
+# traced int32 adapter-table indirection (the page-table idiom applied
+# to weights) and fuses y += (x·A)·B · (alpha/rank) next to the base
+# matmul, so requests for different tenants ride the SAME compiled
+# tick.  Adapters live in a refcounted host/HBM residency pool managed
+# exactly like KV pages (inference/adapters.py).
+SERVING_LORA = "lora"
+# the shared low-rank dimension r of every adapter (STATIC — it is a
+# compiled shape).  0 = lora OFF: no pool, no extra operands, programs
+# bitwise-unchanged vs the pre-lora engine.
+SERVING_LORA_RANK = "rank"
+SERVING_LORA_RANK_DEFAULT = 0
+# the LoRA scaling numerator: deltas apply as (alpha / rank) · BAx.
+# Static — baked into the compiled programs at trace time.
+SERVING_LORA_ALPHA = "alpha"
+SERVING_LORA_ALPHA_DEFAULT = 16.0
+# registry capacity: distinct tenant adapters the HOST tier holds
+# (cheap numpy copies — the S-LoRA main-memory tier)
+SERVING_LORA_MAX_ADAPTERS = "max_adapters"
+SERVING_LORA_MAX_ADAPTERS_DEFAULT = 64
+# HBM residency slots: adapters resident on device simultaneously.
+# Slot 0 is the reserved all-zero adapter (requests without a tenant
+# gather it — a masked no-op, like the KV scratch page), so the device
+# pool allocates hbm_adapter_slots + 1 slots.  Cold tenants LRU-evict
+# refcount-0 residents; when every slot is referenced, admission PARKS
+# (the page-pool backpressure contract).
+SERVING_LORA_HBM_SLOTS = "hbm_adapter_slots"
+SERVING_LORA_HBM_SLOTS_DEFAULT = 8
+# which base matmuls carry adapters, by block-param name: any subset
+# of qkv_w / out_w (attention) and fc_w / proj_w (MLP).  The default
+# adapts the attention projections — the S-LoRA/Punica headline
+# targets; widening to the MLP pair scales cost, not mechanism.
+SERVING_LORA_TARGETS = "targets"
+SERVING_LORA_TARGETS_DEFAULT = ("qkv_w", "out_w")
 
 #############################################
 # Serving fleet (TPU extension; docs/serving.md "serving fleet")

@@ -92,7 +92,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..config.config import (DeepSpeedConfig, DeepSpeedServingConfig,
                              DeepSpeedStagesConfig,
                              DeepSpeedTelemetryConfig)
-from ..parallel.mesh import DATA_AXIS, build_mesh
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, build_mesh
 from ..runtime.stages import Channel, Stage, StageGraph, injected_delay
 from ..utils.logging import logger
 from .kv_cache import (KVCacheSpec, PagedKVCacheSpec, cache_shardings,
@@ -266,6 +266,92 @@ class ServeEngine:
             self.cache = shard_cache(init_cache(self.cache_spec), mesh,
                                      self._cache_shardings)
 
+        # -- multi-tenant LoRA adapter plane (serving.lora, docs/
+        # serving.md "multi-tenant serving"; S-LoRA / Punica,
+        # PAPERS.md): per-tenant low-rank adapters live in a host
+        # registry; hbm_adapter_slots+1 device slots (0 = the reserved
+        # zero adapter) hold the hot ones, refcounted + LRU-evicted
+        # exactly like KV pages; the compiled programs gather each
+        # slot's adapter by a TRACED int32 table, so tenant mixes ride
+        # the same tick.  rank=0 (default): no pools, no extra
+        # operands — every program bitwise-unchanged.
+        lcfg = cfg.serving.lora
+        self.lora_rank = int(lcfg["rank"])
+        self.lora = self.lora_rank > 0
+        self.lora_scale = (float(lcfg["alpha"]) / self.lora_rank
+                           if self.lora else 1.0)
+        self.adapters = None
+        self.adapter_bytes = 0
+        self._adapter_table = None
+        self._adapter_hits_seen = 0
+        self._adapter_faults_seen = 0
+        if self.lora:
+            from .adapters import (AdapterPool, AdapterRegistry,
+                                   adapter_param_shapes)
+            self.lora_targets = tuple(lcfg["targets"])
+            n_aslots = int(lcfg["hbm_adapter_slots"])
+            self._lora_shapes = adapter_param_shapes(
+                mcfg.n_layer, mcfg.d_model, self.lora_rank,
+                self.lora_targets)
+            # TP layout mirrors the base matmuls' Megatron split
+            # (models/gpt2.py param_partition_specs): column-parallel
+            # targets shard B's output features, row-parallel targets
+            # shard A's input features; the rank dim is tiny and stays
+            # replicated.  Pool axes: A [L, N, d_in, r], B [L, N, r,
+            # *out] with N = hbm_adapter_slots + 1.
+            mx = MODEL_AXIS
+            lora_specs = {
+                "qkv_w": (P(), P(None, None, None, None, mx)),
+                "out_w": (P(None, None, mx, None), P()),
+                "fc_w": (P(), P(None, None, None, mx)),
+                "proj_w": (P(None, None, mx, None), P()),
+            }
+            self._lora_shardings = {
+                t: tuple(NamedSharding(mesh, s) for s in lora_specs[t])
+                for t in self.lora_targets}
+            pools = {}
+            for t in self.lora_targets:
+                a_shape, b_shape = self._lora_shapes[t]
+                pa = jnp.zeros((a_shape[0], n_aslots + 1) + a_shape[1:],
+                               kv_dtype)
+                pb = jnp.zeros((b_shape[0], n_aslots + 1) + b_shape[1:],
+                               kv_dtype)
+                sa, sb = self._lora_shardings[t]
+                pools[t] = (jax.device_put(pa, sa),
+                            jax.device_put(pb, sb))
+            self._lora_pools = pools
+            self.adapter_bytes = sum(int(a.nbytes) + int(b.nbytes)
+                                     for a, b in pools.values())
+
+            # slot-traced donated upload: N uploads, one compiled
+            # program (the _copy_fn discipline applied to weights)
+            def adapter_upload_fn(pools, slot, new):
+                out = {}
+                for t in sorted(pools):
+                    ap, bp = pools[t]
+                    an, bn = new[t]
+                    out[t] = (ap.at[:, slot].set(an.astype(ap.dtype)),
+                              bp.at[:, slot].set(bn.astype(bp.dtype)))
+                return out
+
+            self._adapter_upload_fn = jax.jit(
+                adapter_upload_fn, donate_argnums=(0,),
+                out_shardings=self._lora_shardings)
+            self.adapter_registry = AdapterRegistry(
+                int(lcfg["max_adapters"]), self._lora_shapes)
+            self.adapter_stage = Stage(
+                "adapter_fetch",
+                max_failures=cfg.stages.max_stage_failures,
+                fallback="synchronous host->HBM adapter copy "
+                         "(injection plane bypassed)")
+            self.adapters = AdapterPool(
+                n_aslots, self.adapter_registry, self._upload_adapter,
+                stage=self.adapter_stage)
+            #: host-owned per-slot adapter table — one more TRACED
+            #: decode/verify operand (dead slots hold 0: the zero
+            #: adapter's delta is mathematically zero)
+            self._adapter_table = np.zeros((self.slots,), np.int32)
+
         # -- pallas interpret + ambient mesh scope (the engine idiom) ----
         from ..ops.pallas.runtime import (interpret_scope,
                                           mesh_wants_interpret)
@@ -307,14 +393,29 @@ class ServeEngine:
                 return {"k_scale": cache["k_scale"],
                         "v_scale": cache["v_scale"]}
 
+            # multi-tenant lora threads (pools, slot-table) as two
+            # extra TRACED operands ahead of the rng tail; lora off
+            # leaves both signatures and traces byte-identical
+            lora_on = self.lora
+            lora_scale = self.lora_scale
+
+            def split_lora(extra):
+                """(lora kwargs, rng tail) of a program's *extra."""
+                if not lora_on:
+                    return {}, extra
+                return ({"lora": extra[0], "adapter_slots": extra[1],
+                         "lora_scale": lora_scale}, extra[2:])
+
             # delta-aware prefill over the page pool: page_row,
             # prefix_len and delta_len are TRACED, so one program
             # serves full prefills AND prefix-hit deltas
             def prefill_fn(params, cache, tokens, delta_len, prefix_len,
-                           page_row, slot, *rng):
+                           page_row, slot, *extra):
+                lkw, rng = split_lora(extra)
                 out = self.model.prefill_paged(
                     params, tokens, delta_len, prefix_len, page_row,
-                    cache["k"], cache["v"], **cache_scales(cache))
+                    cache["k"], cache["v"], **lkw,
+                    **cache_scales(cache))
                 logits, kp, vp = out[0], out[1], out[2]
                 total = jnp.reshape(prefix_len + delta_len,
                                     (1,)).astype(jnp.int32)
@@ -330,11 +431,12 @@ class ServeEngine:
                 return newc, first_tok
 
             def decode_fn(params, cache, tokens, active, page_table,
-                          *rng):
+                          *extra):
+                lkw, rng = split_lora(extra)
                 out = self.model.decode_step_paged(
                     params, tokens, cache["k"], cache["v"], page_table,
                     cache["lengths"], active, impl=self.decode_impl,
-                    **cache_scales(cache))
+                    **lkw, **cache_scales(cache))
                 logits, k, v, new_len = out[0], out[1], out[2], out[-1]
                 next_tok = select_next_token(logits, temp,
                                              rng[0] if rng else None)
@@ -506,6 +608,9 @@ class ServeEngine:
                                              self._propose_fn)
                 self.telemetry.track_program("draft_prefill",
                                              self._draft_prefill_fn)
+            if self.lora:
+                self.telemetry.track_program("adapter_upload",
+                                             self._adapter_upload_fn)
             reg = self.telemetry.registry
             self._tokens_total = reg.counter(
                 "serve_tokens_total", "generated tokens")
@@ -562,11 +667,26 @@ class ServeEngine:
                     "serve_spec_accepted_len",
                     "tokens emitted per verify pass (accepted draft "
                     "prefix + the bonus token)")
+            if self.lora:
+                self._adapters_resident_gauge = reg.gauge(
+                    "serve_adapters_resident",
+                    "tenant adapters resident in HBM pool slots "
+                    "(pinned + cold-evictable; excludes the reserved "
+                    "zero adapter)")
+                self._adapter_hits_ctr = reg.counter(
+                    "serve_adapter_hits_total",
+                    "admissions whose adapter was already HBM-resident")
+                self._adapter_faults_ctr = reg.counter(
+                    "serve_adapter_faults_total",
+                    "cold-adapter admissions that fetched host->HBM "
+                    "(the adapter_fetch stage point)")
 
             def _stage_counter(name, help, n):
                 reg.counter(name, help).inc(n)
 
             self.stage.counter_fn = _stage_counter
+            if self.lora:
+                self.adapter_stage.counter_fn = _stage_counter
 
         #: perf_counter epoch for the completion records' ``arrival_s``
         #: stamps — submit times made record-relative, so open-loop
@@ -691,7 +811,8 @@ class ServeEngine:
             return dcache, ys[:k_spec].T
 
         def verify_core(params, cache, cur, proposals, active,
-                        page_table, qprobs, key):
+                        page_table, qprobs, key, lora=None,
+                        adapter_slots=None):
             tokens_w = jnp.concatenate(
                 [cur[:, None].astype(jnp.int32),
                  proposals.astype(jnp.int32)], axis=1)
@@ -700,10 +821,13 @@ class ServeEngine:
                 scales = ({"k_scale": cache["k_scale"],
                            "v_scale": cache["v_scale"]}
                           if self.quant_kv else {})
+                lkw = ({"lora": lora, "adapter_slots": adapter_slots,
+                        "lora_scale": self.lora_scale}
+                       if lora is not None else {})
                 out = self.model.verify_step_paged(
                     params, tokens_w, cache["k"], cache["v"],
                     page_table, cache["lengths"], active,
-                    impl=self.decode_impl, **scales)
+                    impl=self.decode_impl, **lkw, **scales)
                 logits, kc, vc = out[0], out[1], out[2]
                 if self.quant_kv:
                     newc["k_scale"], newc["v_scale"] = out[3], out[4]
@@ -721,12 +845,19 @@ class ServeEngine:
             return newc, out_tok, accepted
 
         if self.paged:
+            lora_on = self.lora
+
             def verify_fn(params, cache, cur, proposals, active,
                           page_table, *s):
+                lora, aslots = None, None
+                if lora_on:
+                    lora, aslots = s[0], s[1]
+                    s = s[2:]
                 return verify_core(params, cache, cur, proposals,
                                    active, page_table,
                                    s[0] if s else None,
-                                   s[1] if s else None)
+                                   s[1] if s else None,
+                                   lora=lora, adapter_slots=aslots)
         else:
             def verify_fn(params, cache, cur, proposals, active, *s):
                 return verify_core(params, cache, cur, proposals,
@@ -751,6 +882,35 @@ class ServeEngine:
             return ()
         self._rng_n += 1
         return (jax.random.fold_in(self._rng_base, self._rng_n),)
+
+    # -- adapter plane (multi-tenant LoRA) ------------------------------
+    def _upload_adapter(self, slot: int, weights) -> None:
+        """Host->HBM copy of one adapter into pool slot `slot`.
+
+        Runs through the jitted donated upload program so the pool
+        arrays keep their shardings and the copy is a slot-traced
+        `at[:, slot].set` — no recompile per (slot, tenant) pair.
+        """
+        new = {t: (jnp.asarray(weights[t][0]), jnp.asarray(weights[t][1]))
+               for t in self.lora_targets}
+        self._lora_pools = self._adapter_upload_fn(
+            self._lora_pools, np.int32(slot), new)
+
+    def register_adapter(self, adapter_id: int, weights=None):
+        """Register a tenant adapter (host-side).  `weights=None`
+        synthesizes deterministic factors from the adapter id, so every
+        replica in a fleet derives identical weights for the same
+        tenant without shipping bytes."""
+        if not self.lora:
+            raise ValueError("serving.lora.rank is 0 — adapters disabled")
+        if weights is None:
+            return self.adapter_registry.get(adapter_id)
+        return self.adapter_registry.register(adapter_id, weights)
+
+    def hot_adapters(self):
+        """Adapter ids currently resident in HBM slots (for heartbeat
+        affinity gauges)."""
+        return self.adapters.hot_ids() if self.lora else []
 
     def _spec_ratio(self) -> float:
         """The live draft-acceptance ratio — ONE formula shared by the
@@ -930,6 +1090,23 @@ class ServeEngine:
             scalars["serve_spec_mean_accepted_len"] = (
                 (self._spec_accepted_n + self._spec_passes)
                 / self._spec_passes)
+        if self.lora:
+            pool = self.adapters
+            scalars["serve_adapters_resident"] = float(pool.resident())
+            scalars["serve_adapter_bytes"] = float(self.adapter_bytes)
+            scalars["serve_adapter_hits_total"] = float(pool.hits)
+            scalars["serve_adapter_faults_total"] = float(pool.faults)
+            scalars["serve_adapter_evictions_total"] = \
+                float(pool.evictions)
+            self._adapters_resident_gauge.set(pool.resident())
+            # counters advance by the pool's deltas since last flush —
+            # cumulative scalars above stay the summarize source
+            self._adapter_hits_ctr.inc(
+                pool.hits - self._adapter_hits_seen)
+            self._adapter_faults_ctr.inc(
+                pool.faults - self._adapter_faults_seen)
+            self._adapter_hits_seen = pool.hits
+            self._adapter_faults_seen = pool.faults
         self.telemetry.on_sync(step=self._ticks, scalars=scalars)
         self._last_flush_t = now
         self._last_flush_tokens = self._tokens_seen
@@ -945,7 +1122,8 @@ class ServeEngine:
     # -- request intake ---------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 16,
                eos_id: Optional[int] = None,
-               detach_kv: bool = False) -> Request:
+               detach_kv: bool = False,
+               adapter_id: int = 0) -> Request:
         """Enqueue one generation request (blocks on a full queue — the
         open-loop backpressure point).  Greedy decoding; the first
         generated token comes from the prefill logits.
@@ -954,7 +1132,12 @@ class ServeEngine:
         the request finishes, its pages stay alive for
         :meth:`export_pages` instead of freeing — the disaggregated
         fleet's prefill leg (``release_detached`` frees them after the
-        transfer)."""
+        transfer).
+
+        ``adapter_id`` selects the tenant's LoRA adapter (0 = base
+        model).  Admission resolves it to an HBM pool slot, parking on
+        pool-dry exactly like a pages-dry admission; requires a
+        ``serving.lora`` block with ``rank > 0``."""
         if self._closed:
             raise RuntimeError("ServeEngine is closed")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
@@ -980,6 +1163,13 @@ class ServeEngine:
             raise ValueError(
                 "detach_kv (KV-migration handoff) requires the paged "
                 "layout (serving.page_len > 0)")
+        adapter_id = int(adapter_id)
+        if adapter_id < 0:
+            raise ValueError("adapter_id must be >= 0 (0 = base model)")
+        if adapter_id > 0 and not self.lora:
+            raise ValueError(
+                f"adapter_id={adapter_id} but multi-tenant LoRA is off "
+                "(set serving.lora.rank > 0)")
         self._rid += 1
         req = Request(rid=self._rid, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
@@ -987,6 +1177,7 @@ class ServeEngine:
                               else int(eos_id)),
                       submit_t=time.perf_counter())
         req.detach_kv = bool(detach_kv)
+        req.adapter_id = adapter_id
         self._begin_request_trace(req)
         # Deliberate submission-side backpressure: submit() runs on the
         # CALLER's thread, and a full queue must block the caller (and a
@@ -1075,8 +1266,13 @@ class ServeEngine:
 
     def _admit_one_paged(self, req: Request) -> bool:
         total_pages = -(-len(req.prompt) // self.page_len)
+        # tenant namespace: adapter A's KV pages must never be matched
+        # by adapter B (or the base model) — the LoRA delta makes the
+        # caches semantically different even for identical prompts.
+        # "" keeps the no-lora digest chain bitwise unchanged.
+        ns = f"adapter:{req.adapter_id}" if req.adapter_id else ""
         if self.prefix is not None:
-            shared_len, spages, cow = self.prefix.match(req.prompt)
+            shared_len, spages, cow = self.prefix.match(req.prompt, ns)
         else:
             shared_len, spages, cow = 0, [], False
         need = total_pages - len(spages) + (1 if cow else 0)
@@ -1085,6 +1281,22 @@ class ServeEngine:
             if self.prefix is not None:
                 self.prefix.release(spages)
             return False
+        aslot = 0
+        if self.lora and req.adapter_id:
+            # resolve tenant -> HBM adapter slot AFTER the page alloc so
+            # a pages-dry park never holds an adapter pin; pool-dry
+            # parks the request exactly like a pages-dry admission
+            try:
+                got = self.adapters.acquire(req.adapter_id)
+            except BaseException:
+                for p in list(spages) + fresh:
+                    self.pool.deref(p)
+                raise
+            if got is None:
+                for p in list(spages) + fresh:
+                    self.pool.deref(p)
+                return False
+            aslot = got
         held = list(spages) + fresh
         try:
             # queue wait ends HERE, before any device work: the COW
@@ -1137,6 +1349,9 @@ class ServeEngine:
                 req.chunk_pos = 0
                 self._table[slot, :] = 0
                 self._table[slot, :len(row)] = row
+                if self.lora:
+                    req.adapter_slot = aslot
+                    self._adapter_table[slot] = aslot
                 return True
             tokens = np.zeros((1, self.prefill_len), np.int32)
             tokens[0, :len(delta)] = delta
@@ -1155,6 +1370,8 @@ class ServeEngine:
                         self.params, self.cache, tokens,
                         np.int32(len(delta)), np.int32(shared_len),
                         row_np, np.int32(self.scheduler.free[0]),
+                        *((self._lora_pools, np.int32(aslot))
+                          if self.lora else ()),
                         *self._maybe_key())
                 first = int(np.asarray(jax.block_until_ready(first)))
             if self.spec_k:
@@ -1165,6 +1382,8 @@ class ServeEngine:
             # roll back every page this admission still holds a ref on
             for p in held:
                 self.pool.deref(p)
+            if aslot:
+                self.adapters.release(req.adapter_id)
             raise
         now = time.perf_counter()
         req.prefill_s = now - req.admit_t
@@ -1185,10 +1404,13 @@ class ServeEngine:
         req.computed_len = len(delta)
         self._table[slot, :] = 0
         self._table[slot, :len(row)] = row
+        if self.lora:
+            req.adapter_slot = aslot
+            self._adapter_table[slot] = aslot
         if self.prefix is not None:
             # register the freshly computed pages for future sharers
             # (full pages of prompt[:-1] + the partial tail)
-            self.prefix.insert(req.prompt, row)
+            self.prefix.insert(req.prompt, row, ns)
         req.kv_len = len(req.prompt)
         req.tokens.append(first)
         req.token_times.append(now - req.submit_t)
@@ -1316,6 +1538,13 @@ class ServeEngine:
             self._table[slot, :] = 0
             if not req.detach_kv:
                 self._release_pages(req)
+        if self.lora and req.adapter_id:
+            # unpin the tenant's adapter (refcount 0 keeps it RESIDENT
+            # and evictable — the next request is a free hit) and point
+            # the dead slot at the reserved zero adapter
+            self.adapters.release(req.adapter_id)
+            self._adapter_table[slot] = 0
+            req.adapter_slot = 0
         # record + trace close BEFORE done.set(): a waiter released by
         # result() must find the completed artifacts already written
         self._write_request_record(req)
@@ -1361,6 +1590,8 @@ class ServeEngine:
                     np.int32(len(chunk)),
                     np.int32(req.shared_len + pos),
                     self._table[slot], np.int32(slot),
+                    *((self._lora_pools, np.int32(req.adapter_slot))
+                      if self.lora else ()),
                     *self._maybe_key())
             first = int(np.asarray(jax.block_until_ready(first)))
         req.chunk_pos = pos + len(chunk)
@@ -1373,8 +1604,11 @@ class ServeEngine:
         req.kv_len = len(req.prompt)
         if self.prefix is not None:
             # the pages are fully written now — register them for
-            # future sharers (deferred from admission)
-            self.prefix.insert(req.prompt, req.pages)
+            # future sharers (deferred from admission), under the same
+            # tenant namespace the admission matched with
+            self.prefix.insert(
+                req.prompt, req.pages,
+                f"adapter:{req.adapter_id}" if req.adapter_id else "")
         if self.spec_k:
             self._draft_prefill(req, slot=slot)
         req.tokens.append(first)
@@ -1433,7 +1667,10 @@ class ServeEngine:
                 if self.paged:
                     self.cache, next_tok = self._decode_fn(
                         self.params, self.cache, tokens, active,
-                        self._table, *self._maybe_key())
+                        self._table,
+                        *((self._lora_pools, self._adapter_table)
+                          if self.lora else ()),
+                        *self._maybe_key())
                 else:
                     self.cache, next_tok = self._decode_fn(
                         self.params, self.cache, tokens, active,
@@ -1525,7 +1762,10 @@ class ServeEngine:
                 if self.paged:
                     self.cache, out_tok, accepted = self._verify_fn(
                         self.params, self.cache, tokens, proposals,
-                        active, self._table, *extra)
+                        active, self._table,
+                        *((self._lora_pools, self._adapter_table)
+                          if self.lora else ()),
+                        *extra)
                 else:
                     self.cache, out_tok, accepted = self._verify_fn(
                         self.params, self.cache, tokens, proposals,
@@ -1697,7 +1937,8 @@ class ServeEngine:
     def adopt_request(self, prompt, first_token: int,
                       max_new_tokens: int,
                       eos_id: Optional[int],
-                      page_payloads: List[bytes]) -> Optional[Request]:
+                      page_payloads: List[bytes],
+                      adapter_id: int = 0) -> Optional[Request]:
         """Adopt a migrated request mid-decode (docs/serving.md
         "disaggregated fleet"): import its exported KV pages into
         freshly allocated local pages (page ids are replica-local —
@@ -1720,6 +1961,28 @@ class ServeEngine:
         pages = self._alloc_pages(need)
         if pages is None:
             return None
+        adapter_id = int(adapter_id)
+        if adapter_id > 0 and not self.lora:
+            raise ValueError(
+                f"migrated request carries adapter_id={adapter_id} but "
+                "multi-tenant LoRA is off on this replica")
+        aslot = 0
+        if self.lora and adapter_id:
+            # same ordering as admission: adapter pin AFTER page alloc,
+            # pool-dry parks (deterministic synthesis means this
+            # replica derives the identical weights locally — no
+            # adapter bytes ride the migration payload)
+            try:
+                got = self.adapters.acquire(adapter_id)
+            except BaseException:
+                for p in pages:
+                    self.pool.deref(p)
+                raise
+            if got is None:
+                for p in pages:
+                    self.pool.deref(p)
+                return None
+            aslot = got
         self._rid += 1
         now = time.perf_counter()
         req = Request(rid=self._rid, prompt=prompt,
@@ -1728,6 +1991,7 @@ class ServeEngine:
                               else int(eos_id)),
                       submit_t=now)
         req.admit_t = now
+        req.adapter_id = adapter_id
         try:
             leaf_refs = [self.cache[k] for k in self._page_leaves()]
             for pid, payload in zip(pages, page_payloads):
@@ -1753,6 +2017,8 @@ class ServeEngine:
         except BaseException:
             for p in pages:
                 self.pool.deref(p)
+            if aslot:
+                self.adapters.release(adapter_id)
             raise
         slot = self.scheduler.admit(req, now=now)
         req.pages = list(pages)
@@ -1761,6 +2027,9 @@ class ServeEngine:
         req.kv_len = len(prompt)
         self._table[slot, :] = 0
         self._table[slot, :len(pages)] = pages
+        if self.lora:
+            req.adapter_slot = aslot
+            self._adapter_table[slot] = aslot
         with self._pallas_scope():
             self.cache = self._set_len_fn(self.cache, np.int32(slot),
                                           np.int32(len(prompt)))
@@ -1806,6 +2075,9 @@ class ServeEngine:
             if self.paged:
                 self._table[slot, :] = 0
                 self._release_pages(req)
+            if self.lora and req.adapter_id:
+                self.adapters.release(req.adapter_id)
+                self._adapter_table[slot] = 0
             self._fail_request(req, err)
         # backpressure-parked requests are in flight too — fail them
         # with the same original exception, never strand their waiters

@@ -161,6 +161,10 @@ class FleetRequest:
     #: True once the first token frame arrived — the failover boundary:
     #: started requests fail typed, unstarted ones re-dispatch
     started: bool = False
+    #: tenant LoRA adapter id (0 = base model) — steers tenant
+    #: affinity in :meth:`FleetRouter._pick_replica` and rides the
+    #: submit frame to the replica engine
+    adapter_id: int = 0
     failovers: int = 0
     queue_wait_s: Optional[float] = None
     ttft_s: Optional[float] = None
@@ -489,7 +493,8 @@ class FleetRouter:
 
     # -- request intake --------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 16,
-               eos_id: Optional[int] = None) -> FleetRequest:
+               eos_id: Optional[int] = None,
+               adapter_id: int = 0) -> FleetRequest:
         if self._closed:
             raise RuntimeError("FleetRouter is closed")
         prompt = [int(t) for t in prompt]
@@ -497,11 +502,13 @@ class FleetRouter:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if int(adapter_id) < 0:
+            raise ValueError("adapter_id must be >= 0 (0 = base model)")
         self._next_rid += 1
         fr = FleetRequest(rid=self._next_rid, prompt=prompt,
                           max_new_tokens=int(max_new_tokens),
                           eos_id=eos_id, submit_t=self._now(),
-                          _router=self)
+                          adapter_id=int(adapter_id), _router=self)
         self._reqs[fr.rid] = fr
         self._queue.append(fr)
         self._record("fleet_submit", rid=fr.rid,
@@ -520,12 +527,27 @@ class FleetRouter:
               + int(beat.get("serve_active_slots") or 0))
         return max(len(rep.outstanding), hb)
 
-    def _pick_replica(self, roles=None) -> Optional[_Replica]:
+    #: tenant affinity's bounded imbalance: a replica whose heartbeat
+    #: shows the tenant's adapter already HBM-resident may win over the
+    #: JSQ minimum only while its load is within this many requests of
+    #: it — affinity saves cold-adapter faults but never starves JSQ
+    ADAPTER_AFFINITY_SLACK = 2
+
+    def _pick_replica(self, roles=None,
+                      adapter_id: int = 0) -> Optional[_Replica]:
         """JSQ with DETERMINISTIC tie-breaking: equal loads go to the
         lowest replica id (tested — a tie must not depend on dict
         order).  ``roles`` restricts the candidate set (disaggregated
-        steering); None considers every ready replica."""
+        steering); None considers every ready replica.
+
+        ``adapter_id > 0`` adds tenant affinity on top of JSQ: among
+        candidates advertising the adapter in their ``adapters_hot``
+        heartbeat gauge, the least-loaded wins IF its load is within
+        :data:`ADAPTER_AFFINITY_SLACK` of the JSQ minimum; otherwise
+        pure JSQ (bounded imbalance — a hot tenant cannot pile onto
+        one replica while the rest idle)."""
         best = None
+        aff = None
         for rep in self.replicas.values():
             if rep.state != "ready":
                 continue
@@ -534,7 +556,17 @@ class FleetRouter:
             key = (self._replica_load(rep), rep.id)
             if best is None or key < best[0]:
                 best = (key, rep)
-        return best[1] if best else None
+            if adapter_id:
+                hot = (self._beats.get(rep.id) or {}).get(
+                    "adapters_hot") or ()
+                if adapter_id in hot and (aff is None or key < aff[0]):
+                    aff = (key, rep)
+        if best is None:
+            return None
+        if aff is not None and \
+                aff[0][0] <= best[0][0] + self.ADAPTER_AFFINITY_SLACK:
+            return aff[1]
+        return best[1]
 
     def _admission_roles(self):
         """Where new prompts go: prefill+mixed when the fleet has a
@@ -547,7 +579,8 @@ class FleetRouter:
     def _dispatch(self) -> None:
         roles = self._admission_roles()
         while self._queue:
-            rep = self._pick_replica(roles)
+            rep = self._pick_replica(roles,
+                                     adapter_id=self._queue[0].adapter_id)
             if rep is None:
                 return
             fr = self._queue.popleft()
@@ -567,6 +600,8 @@ class FleetRouter:
                     "prompt": fr.prompt,
                     "max_new_tokens": fr.max_new_tokens,
                     "eos_id": fr.eos_id,
+                    **({"adapter_id": fr.adapter_id}
+                       if fr.adapter_id else {}),
                     **({"migrate": True} if migrate else {})})
             except OSError as e:
                 # the failover path requeues fr (it is unstarted by
@@ -580,7 +615,9 @@ class FleetRouter:
         the decode replica (its death before streaming puts the blob
         right back here)."""
         while self._migrate_queue:
-            rep = self._pick_replica(("decode", "mixed"))
+            rep = self._pick_replica(
+                ("decode", "mixed"),
+                adapter_id=self._migrate_queue[0].adapter_id)
             if rep is None:
                 return
             fr = self._migrate_queue.popleft()
@@ -596,7 +633,9 @@ class FleetRouter:
                     "kv_len": hdr.get("kv_len"),
                     "pages": len(pages),
                     "max_new_tokens": fr.max_new_tokens,
-                    "eos_id": fr.eos_id})
+                    "eos_id": fr.eos_id,
+                    **({"adapter_id": fr.adapter_id}
+                       if fr.adapter_id else {})})
                 for seq, payload in enumerate(pages):
                     send_binary_frame(rep.sock, {
                         "kind": "page", "rid": fr.rid, "seq": seq,

@@ -139,6 +139,11 @@ def _beat_extra(eng, replica_id: int, backlog_n: int = 0,
     }
     if eng.paged:
         extra["serve_free_pages"] = eng.pool.free_count
+    if eng.lora:
+        # the router's tenant-affinity signal: adapters this replica
+        # already holds in HBM slots (a dispatch here skips the
+        # cold-adapter host->HBM fetch)
+        extra["adapters_hot"] = eng.hot_adapters()
     if eng.spec_k:
         extra["spec_accept_ratio"] = eng._spec_ratio()
     tpot = eng.tpot_p99()
@@ -248,7 +253,7 @@ def serve(router_addr, replica_id: int, fleet_dir: str,
                 req = eng.adopt_request(
                     hdr["prompt"], hdr["first_token"],
                     hdr.get("max_new_tokens", 16), hdr.get("eos_id"),
-                    payloads)
+                    payloads, adapter_id=hdr.get("adapter_id", 0))
             except Exception as e:
                 adoptions.popleft()
                 send_frame(sock, {"kind": "error", "rid": rid,
@@ -303,7 +308,8 @@ def serve(router_addr, replica_id: int, fleet_dir: str,
                                         frame.get("max_new_tokens",
                                                   16)),
                         eos_id=frame.get("eos_id"),
-                        detach_kv=migrate)
+                        detach_kv=migrate,
+                        adapter_id=frame.get("adapter_id", 0))
                 except Exception as e:
                     # per-request isolation: a bad prompt answers
                     # typed, the pool keeps serving

@@ -94,6 +94,13 @@ class Request:
     #: releasing the slot's pages — the replica loop exports them over
     #: the wire, then drops them explicitly
     detach_kv: bool = False
+    #: tenant adapter id (0 = base model, no LoRA delta).  The engine
+    #: resolves this to an HBM pool slot at admission and parks on
+    #: pool-dry exactly like a pages-dry admission
+    adapter_id: int = 0
+    #: resolved HBM adapter-pool slot (0 = the reserved zero adapter);
+    #: engine-owned, valid only while the request holds a batch slot
+    adapter_slot: int = 0
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until the request finishes; raises its error if it
@@ -290,9 +297,16 @@ class PrefixCache:
         return len(self.full) + sum(len(d) for d in self.partials.values())
 
     # -- lookup ----------------------------------------------------------
-    def match(self, prompt: Sequence[int]) -> Tuple[int, List[int], bool]:
+    def match(self, prompt: Sequence[int],
+              namespace: str = "") -> Tuple[int, List[int], bool]:
         """Longest cached prefix of ``prompt`` (never the whole prompt:
         at least one token is left for the delta prefill).
+
+        ``namespace`` partitions the cache: digests chain from it as the
+        root parent, so two tenants with identical prompts but different
+        adapters can never share KV pages (the LoRA delta makes their
+        caches semantically different).  ``""`` keeps digests bitwise
+        identical to the un-namespaced cache.
 
         Returns ``(shared_len, pages, cow)`` with one pool ref taken on
         every returned page (the caller owns them now — roll back with
@@ -301,7 +315,7 @@ class PrefixCache:
         entry is a shared PARTIAL page the caller must copy-on-write
         before its first append (``shared_len`` ends inside it)."""
         limit = len(prompt) - 1
-        parent = ""
+        parent = namespace
         pages: List[int] = []
         pos = 0
         while pos + self.page_len <= limit:
@@ -350,14 +364,15 @@ class PrefixCache:
 
     # -- registration ----------------------------------------------------
     def insert(self, prompt: Sequence[int],
-               pages: Sequence[int]) -> int:
+               pages: Sequence[int], namespace: str = "") -> int:
         """Register a just-prefilled prompt's pages: full pages of
         ``prompt[:-1]`` chain in as :class:`_FullEntry`, a nonempty
         partial tail as :class:`_PartialEntry`.  Pages already cached
         (the request's own prefix hit) are skipped; each NEW entry
-        takes one pool ref on its page.  Returns entries added."""
+        takes one pool ref on its page.  ``namespace`` must match the
+        one used at :meth:`match` time.  Returns entries added."""
         limit = len(prompt) - 1
-        parent = ""
+        parent = namespace
         added = 0
         pos = 0
         i = 0
@@ -368,7 +383,7 @@ class PrefixCache:
                 self.pool.ref(pages[i])
                 self.full[d] = _FullEntry(page=pages[i], parent=parent,
                                           last_hit=self._tick())
-                if parent:
+                if parent in self.full:
                     self.full[parent].children += 1
                 added += 1
             parent = d
@@ -382,7 +397,7 @@ class PrefixCache:
                 bucket[tail] = _PartialEntry(tokens=tail, page=pages[i],
                                              parent=parent,
                                              last_hit=self._tick())
-                if parent:
+                if parent in self.full:
                     self.full[parent].children += 1
                 added += 1
         return added
@@ -415,12 +430,12 @@ class PrefixCache:
                 pe = self.partials[key].pop(sub)
                 if not self.partials[key]:
                     del self.partials[key]
-                if pe.parent:
+                if pe.parent in self.full:
                     self.full[pe.parent].children -= 1
                 self.pool.deref(pe.page)
             else:
                 fe = self.full.pop(key)
-                if fe.parent:
+                if fe.parent in self.full:
                     self.full[fe.parent].children -= 1
                 self.pool.deref(fe.page)
             evicted += 1

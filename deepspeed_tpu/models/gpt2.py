@@ -227,24 +227,31 @@ class GPT2Model(TrainModule):
 
     def prefill_paged(self, params, tokens, delta_len, prefix_len,
                       page_row, k_pool, v_pool, k_scale=None,
-                      v_scale=None):
+                      v_scale=None, lora=None, adapter_slots=None,
+                      lora_scale: float = 1.0):
         """Delta-aware prefill into a paged KV pool — see
         ``gpt2_prefill_paged``."""
         return gpt2_prefill_paged(self.config, params, tokens,
                                   delta_len, prefix_len, page_row,
                                   k_pool, v_pool, k_scale=k_scale,
-                                  v_scale=v_scale)
+                                  v_scale=v_scale, lora=lora,
+                                  adapter_slots=adapter_slots,
+                                  lora_scale=lora_scale)
 
     def decode_step_paged(self, params, tokens, k_pool, v_pool,
                           page_table, lengths, active,
                           impl: Optional[str] = None, k_scale=None,
-                          v_scale=None):
+                          v_scale=None, lora=None, adapter_slots=None,
+                          lora_scale: float = 1.0):
         """One masked decode tick over the paged KV pool — see
         ``gpt2_decode_step_paged``."""
         return gpt2_decode_step_paged(self.config, params, tokens,
                                       k_pool, v_pool, page_table,
                                       lengths, active, impl=impl,
-                                      k_scale=k_scale, v_scale=v_scale)
+                                      k_scale=k_scale, v_scale=v_scale,
+                                      lora=lora,
+                                      adapter_slots=adapter_slots,
+                                      lora_scale=lora_scale)
 
     def verify_step(self, params, tokens, k_cache, v_cache, lengths,
                     active, impl: Optional[str] = None):
@@ -256,13 +263,17 @@ class GPT2Model(TrainModule):
     def verify_step_paged(self, params, tokens, k_pool, v_pool,
                           page_table, lengths, active,
                           impl: Optional[str] = None, k_scale=None,
-                          v_scale=None):
+                          v_scale=None, lora=None, adapter_slots=None,
+                          lora_scale: float = 1.0):
         """The paged twin of ``verify_step`` — see
         ``gpt2_verify_step_paged``."""
         return gpt2_verify_step_paged(self.config, params, tokens,
                                       k_pool, v_pool, page_table,
                                       lengths, active, impl=impl,
-                                      k_scale=k_scale, v_scale=v_scale)
+                                      k_scale=k_scale, v_scale=v_scale,
+                                      lora=lora,
+                                      adapter_slots=adapter_slots,
+                                      lora_scale=lora_scale)
 
     # ---------------- param-streaming declaration ----------------
     def streaming_param_spec(self, params):
@@ -354,14 +365,58 @@ def _wscale(y, bp, name: str):
     return y if s is None else y * s.astype(y.dtype)
 
 
+def _lora_delta(x, bp, name: str):
+    """Heterogeneous batched LoRA delta (serving.lora, docs/serving.md
+    "multi-tenant serving"): a lora-bound tree carries a
+    ``<name>_lora`` sibling of PER-ROW gathered factors
+    ``(A [B, d_in, r], B [B, r, *out], alpha/r)`` — each batch row's
+    own tenant adapter, gathered by the traced adapter-slot table
+    (:func:`_lora_bind`) — and the delta ``(x·A)·B · (alpha/r)`` is
+    computed fused next to the base matmul (S-LoRA/Punica, PAPERS.md).
+    Trees without lora entries (every training path, the default
+    serving config) return None: their trace is byte-identical to the
+    pre-lora code, the ``_wscale`` discipline applied to adapters."""
+    lo = bp.get(name + "_lora")
+    if lo is None:
+        return None
+    a, b, scale = lo
+    u = jnp.einsum("btd,bdr->btr", x, a.astype(x.dtype))
+    delta = jnp.einsum("btr,br...->bt...", u, b.astype(x.dtype))
+    return delta * jnp.asarray(scale, x.dtype)
+
+
+def _lora_bind(bp, lora_layer, adapter_slots, scale):
+    """Bind one layer's adapter-slot pools into the block-param dict:
+    gather every target's per-row factors by the TRACED int32
+    ``adapter_slots`` (the PR 11 scalar-prefetch idiom applied to
+    weights — slot 0 is the reserved zero adapter, so no-tenant rows
+    compute a mathematically-zero delta through the SAME program).
+    ``lora_layer`` is ``{target: (A [N, d_in, r], B [N, r, *out])}``;
+    returns a shallow copy of ``bp`` with ``<target>_lora`` entries."""
+    if lora_layer is None:
+        return bp
+    bp = dict(bp)
+    for t in sorted(lora_layer):
+        a, b = lora_layer[t]
+        bp[t + "_lora"] = (a[adapter_slots], b[adapter_slots], scale)
+    return bp
+
+
 def gpt2_ffn(bp, h):
     """fc → gelu → proj over already-normalized input (dense FFN body,
     shared with the MoE flavor's dense blocks)."""
-    h = _wscale(h @ bp["fc_w"].astype(h.dtype), bp, "fc_w") \
+    y = _wscale(h @ bp["fc_w"].astype(h.dtype), bp, "fc_w") \
         + bp["fc_b"].astype(h.dtype)
-    h = jax.nn.gelu(h, approximate=True)
-    return _wscale(h @ bp["proj_w"].astype(h.dtype), bp, "proj_w") \
+    d = _lora_delta(h, bp, "fc_w")
+    if d is not None:
+        y = y + d
+    h = jax.nn.gelu(y, approximate=True)
+    z = _wscale(h @ bp["proj_w"].astype(h.dtype), bp, "proj_w") \
         + bp["proj_b"].astype(h.dtype)
+    d = _lora_delta(h, bp, "proj_w")
+    if d is not None:
+        z = z + d
+    return z
 
 
 def gpt2_qkv_heads(cfg: GPT2Config, bp, x):
@@ -377,6 +432,9 @@ def gpt2_qkv_heads(cfg: GPT2Config, bp, x):
     qkv = (_wscale(jnp.einsum("btd,dke->btke", h,
                               bp["qkv_w"].astype(h.dtype)), bp, "qkv_w")
            + bp["qkv_b"].astype(h.dtype))
+    d = _lora_delta(h, bp, "qkv_w")                 # [B, T, 3, E]
+    if d is not None:
+        qkv = qkv + d
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
     def heads(t):
@@ -390,9 +448,12 @@ def gpt2_attn_project(bp, x, attn, drop: float, rng):
     shared with the serving paths; ``rng`` may be None when drop=0)."""
     B, H, T, Dh = attn.shape
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
-    attn = _wscale(attn @ bp["out_w"].astype(x.dtype), bp, "out_w") \
+    y = _wscale(attn @ bp["out_w"].astype(x.dtype), bp, "out_w") \
         + bp["out_b"].astype(x.dtype)
-    return x + _dropout(attn, drop, rng)
+    d = _lora_delta(attn, bp, "out_w")
+    if d is not None:
+        y = y + d
+    return x + _dropout(y, drop, rng)
 
 
 def gpt2_attn_sublayer(cfg: GPT2Config, bp, x, rng, train: bool):
@@ -758,12 +819,16 @@ def gpt2_block_verify_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
 def gpt2_verify_step_paged(cfg: GPT2Config, params, tokens, k_pool,
                            v_pool, page_table, lengths, active,
                            impl: Optional[str] = None,
-                           k_scale=None, v_scale=None):
+                           k_scale=None, v_scale=None,
+                           lora=None, adapter_slots=None,
+                           lora_scale: float = 1.0):
     """The paged twin of ``gpt2_verify_step`` — same contract over the
     page pool; the engine must have allocated pages covering all W
     speculative rows before the pass (rollback frees the ones the
     acceptance didn't keep).  With the int8 pool's scale sidecars the
-    return grows to (logits, k_pool, v_pool, k_scale, v_scale)."""
+    return grows to (logits, k_pool, v_pool, k_scale, v_scale).
+    ``lora``/``adapter_slots``/``lora_scale`` follow
+    ``gpt2_decode_step_paged``'s multi-tenant contract."""
     if impl is None:
         impl = _decode_attn_impl(cfg)
     quant = k_scale is not None
@@ -776,17 +841,26 @@ def gpt2_verify_step_paged(cfg: GPT2Config, params, tokens, k_pool,
     block_params = params["blocks"]
     if cfg.scan_layers:
         def body(x, xs):
-            bp, kc, vc, ks, vs = xs
+            bp, kc, vc, ks, vs = xs[:5]
+            if lora is not None:
+                bp = _lora_bind(bp, xs[5], adapter_slots, lora_scale)
             x, kc, vc, ks, vs = gpt2_block_verify_paged(
                 cfg, bp, x, kc, vc, page_table, positions, row_valid,
                 row_lens, impl, k_scale=ks, v_scale=vs)
             return x, (kc, vc, ks, vs)
+        xs = (block_params, k_pool, v_pool, k_scale, v_scale)
+        if lora is not None:
+            xs = xs + (lora,)
         x, (k_pool, v_pool, k_scale, v_scale) = jax.lax.scan(
-            body, x, (block_params, k_pool, v_pool, k_scale, v_scale))
+            body, x, xs)
     else:
         kc_l, vc_l, ks_l, vs_l = [], [], [], []
         for i in range(cfg.n_layer):
             bp = jax.tree.map(lambda a, i=i: a[i], block_params)
+            if lora is not None:
+                bp = _lora_bind(
+                    bp, jax.tree.map(lambda a, i=i: a[i], lora),
+                    adapter_slots, lora_scale)
             x, kc, vc, ks, vs = gpt2_block_verify_paged(
                 cfg, bp, x, k_pool[i], v_pool[i], page_table, positions,
                 row_valid, row_lens, impl,
@@ -886,7 +960,9 @@ def gpt2_block_decode_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
 def gpt2_decode_step_paged(cfg: GPT2Config, params, tokens, k_pool,
                            v_pool, page_table, lengths, active,
                            impl: Optional[str] = None,
-                           k_scale=None, v_scale=None):
+                           k_scale=None, v_scale=None,
+                           lora=None, adapter_slots=None,
+                           lora_scale: float = 1.0):
     """One decode tick for every slot at once over the paged pool —
     the paged twin of ``gpt2_decode_step`` (same masked-no-op contract,
     same traced-operand zero-recompile contract; the page table is one
@@ -901,7 +977,16 @@ def gpt2_decode_step_paged(cfg: GPT2Config, params, tokens, k_pool,
     scale sidecars ``k_scale``/``v_scale`` [L, P, H, page_len] — the
     return grows to (logits, k_pool, v_pool, k_scale, v_scale,
     new_lengths); they are one more scan carry, still traced, still
-    zero-recompile."""
+    zero-recompile.
+
+    Multi-tenant LoRA (serving.lora, docs/serving.md): ``lora`` is the
+    layer-stacked adapter-slot pools
+    ``{target: (A [L, N, d_in, r], B [L, N, r, *out])}`` and
+    ``adapter_slots`` [S] int32 maps each slot to its tenant's HBM
+    adapter slot (0 = the reserved zero adapter).  Both are TRACED
+    operands — tenant mixes change the table contents, never the
+    program.  ``lora=None`` (the default) leaves the trace
+    byte-identical to the pre-lora code."""
     if impl is None:
         impl = _decode_attn_impl(cfg)
     quant = k_scale is not None
@@ -915,17 +1000,26 @@ def gpt2_decode_step_paged(cfg: GPT2Config, params, tokens, k_pool,
     block_params = params["blocks"]
     if cfg.scan_layers:
         def body(x, xs):
-            bp, kc, vc, ks, vs = xs
+            bp, kc, vc, ks, vs = xs[:5]
+            if lora is not None:
+                bp = _lora_bind(bp, xs[5], adapter_slots, lora_scale)
             x, kc, vc, ks, vs = gpt2_block_decode_paged(
                 cfg, bp, x, kc, vc, page_table, positions, att_len,
                 active, impl, k_scale=ks, v_scale=vs)
             return x, (kc, vc, ks, vs)
+        xs = (block_params, k_pool, v_pool, k_scale, v_scale)
+        if lora is not None:
+            xs = xs + (lora,)
         x, (k_pool, v_pool, k_scale, v_scale) = jax.lax.scan(
-            body, x, (block_params, k_pool, v_pool, k_scale, v_scale))
+            body, x, xs)
     else:
         kc_l, vc_l, ks_l, vs_l = [], [], [], []
         for i in range(cfg.n_layer):
             bp = jax.tree.map(lambda a, i=i: a[i], block_params)
+            if lora is not None:
+                bp = _lora_bind(
+                    bp, jax.tree.map(lambda a, i=i: a[i], lora),
+                    adapter_slots, lora_scale)
             x, kc, vc, ks, vs = gpt2_block_decode_paged(
                 cfg, bp, x, k_pool[i], v_pool[i], page_table,
                 positions, att_len, active, impl,
@@ -1024,7 +1118,9 @@ def gpt2_block_prefill_paged(cfg: GPT2Config, bp, x, k_pool, v_pool,
 
 def gpt2_prefill_paged(cfg: GPT2Config, params, tokens, delta_len,
                        prefix_len, page_row, k_pool, v_pool,
-                       k_scale=None, v_scale=None):
+                       k_scale=None, v_scale=None,
+                       lora=None, adapter_slots=None,
+                       lora_scale: float = 1.0):
     """Delta-aware prefill into the paged pool (ONE compiled program
     for full prefills AND prefix-hit deltas — ``prefix_len``,
     ``delta_len`` and ``page_row`` are all traced).
@@ -1042,7 +1138,12 @@ def gpt2_prefill_paged(cfg: GPT2Config, params, tokens, delta_len,
     (their K/V scatter is masked to the scratch page).
 
     Quantized pool: pass ``k_scale``/``v_scale`` [L, P, H, page_len];
-    the return grows to (logits, k_pool, v_pool, k_scale, v_scale)."""
+    the return grows to (logits, k_pool, v_pool, k_scale, v_scale).
+
+    Multi-tenant LoRA: ``adapter_slots`` is the requesting tenant's
+    HBM adapter slot — a TRACED scalar (or [1]) int32, one slot per
+    prefill — gathered from the same layer-stacked ``lora`` pools as
+    the decode tick (``gpt2_decode_step_paged``'s contract)."""
     B, Tq = tokens.shape
     if Tq > cfg.n_positions:
         raise ValueError(
@@ -1053,20 +1154,34 @@ def gpt2_prefill_paged(cfg: GPT2Config, params, tokens, delta_len,
     pos = jnp.clip(prefix_len + jnp.arange(Tq, dtype=jnp.int32), 0,
                    cfg.n_positions - 1)
     x = params["wte"][tokens] + params["wpe"][pos][None]
+    if lora is not None:
+        # one tenant per prefill: a length-1 slot table so the batched
+        # per-row gather (`_lora_delta`) is the SAME einsum as decode
+        adapter_slots = jnp.atleast_1d(
+            jnp.asarray(adapter_slots, jnp.int32))
     block_params = params["blocks"]
     if cfg.scan_layers:
         def body(x, xs):
-            bp, kc, vc, ks, vs = xs
+            bp, kc, vc, ks, vs = xs[:5]
+            if lora is not None:
+                bp = _lora_bind(bp, xs[5], adapter_slots, lora_scale)
             x, kc, vc, ks, vs = gpt2_block_prefill_paged(
                 cfg, bp, x, kc, vc, page_row, prefix_len, delta_len,
                 k_scale=ks, v_scale=vs)
             return x, (kc, vc, ks, vs)
+        xs = (block_params, k_pool, v_pool, k_scale, v_scale)
+        if lora is not None:
+            xs = xs + (lora,)
         x, (k_pool, v_pool, k_scale, v_scale) = jax.lax.scan(
-            body, x, (block_params, k_pool, v_pool, k_scale, v_scale))
+            body, x, xs)
     else:
         kc_l, vc_l, ks_l, vs_l = [], [], [], []
         for i in range(cfg.n_layer):
             bp = jax.tree.map(lambda a, i=i: a[i], block_params)
+            if lora is not None:
+                bp = _lora_bind(
+                    bp, jax.tree.map(lambda a, i=i: a[i], lora),
+                    adapter_slots, lora_scale)
             x, kc, vc, ks, vs = gpt2_block_prefill_paged(
                 cfg, bp, x, k_pool[i], v_pool[i], page_row, prefix_len,
                 delta_len,

@@ -73,9 +73,12 @@ Stage names and points currently wired: ``prefetch:place``,
 ``disk_read:read`` / ``disk_write:write`` (runtime/disk_offload.py),
 ``ckpt_writer:job``, the ``ckpt`` write points
 (leaf/shard_index/manifest/meta/rename/latest/read) that live inside
-``runtime/checkpointing.py``, and the serving engine's
+``runtime/checkpointing.py``, the serving engine's
 ``serve:admit`` / ``serve:step`` (deepspeed_tpu/inference/engine.py,
-docs/serving.md).
+docs/serving.md), and the multi-tenant adapter pool's
+``adapter_fetch:fetch`` — one cold adapter's host->HBM upload
+(deepspeed_tpu/inference/adapters.py, docs/serving.md "multi-tenant
+serving").
 """
 from __future__ import annotations
 

@@ -103,6 +103,14 @@ def summarize(path: str, out=None) -> dict:
     sv_spec_mal: Optional[float] = None
     sv_param_bytes: Optional[float] = None
     sv_kv_bytes: Optional[float] = None
+    # multi-tenant adapter plane (docs/serving.md "multi-tenant
+    # serving"): residency is a gauge (last flush = the run's answer);
+    # hits/faults/evictions are cumulative counters
+    sv_adapters_resident: Optional[float] = None
+    sv_adapter_bytes: Optional[float] = None
+    sv_adapter_hits: Optional[float] = None
+    sv_adapter_faults: Optional[float] = None
+    sv_adapter_evictions: Optional[float] = None
     # goodput plane (docs/serving.md "workload plane"): the SLOs and
     # the live tracker's verdict arrive as sync scalars; the
     # per-request phases below recompute the same verdict offline
@@ -245,6 +253,25 @@ def summarize(path: str, out=None) -> dict:
                 kb = scalars.get("serve_kv_bytes")
                 if kb is not None:
                     sv_kv_bytes = float(kb)
+                # adapter pool (docs/serving.md "multi-tenant
+                # serving"): last flush is the run's answer for all
+                # five — residency is a point-in-time gauge, the rest
+                # are cumulative
+                ar = scalars.get("serve_adapters_resident")
+                if ar is not None:
+                    sv_adapters_resident = float(ar)
+                ab = scalars.get("serve_adapter_bytes")
+                if ab is not None:
+                    sv_adapter_bytes = float(ab)
+                ah = scalars.get("serve_adapter_hits_total")
+                if ah is not None:
+                    sv_adapter_hits = float(ah)
+                af = scalars.get("serve_adapter_faults_total")
+                if af is not None:
+                    sv_adapter_faults = float(af)
+                ae = scalars.get("serve_adapter_evictions_total")
+                if ae is not None:
+                    sv_adapter_evictions = float(ae)
                 # goodput scalars (telemetry/goodput.py flush): all
                 # cumulative — the LAST flush is the run's answer
                 gp = scalars.get("serve_goodput")
@@ -410,6 +437,11 @@ def summarize(path: str, out=None) -> dict:
         "serve_spec_mean_accepted_len": sv_spec_mal,
         "serve_param_bytes": sv_param_bytes,
         "serve_kv_bytes": sv_kv_bytes,
+        "serve_adapters_resident": sv_adapters_resident,
+        "serve_adapter_bytes": sv_adapter_bytes,
+        "serve_adapter_hits_total": sv_adapter_hits,
+        "serve_adapter_faults_total": sv_adapter_faults,
+        "serve_adapter_evictions_total": sv_adapter_evictions,
         "liveness_hosts": len(beat_ages) or None,
         "liveness_max_age_s": (max(beat_ages.values())
                                if beat_ages else None),
@@ -548,6 +580,20 @@ def summarize(path: str, out=None) -> dict:
         print(f"  serving memory     params "
               f"{_fmt_bytes(sv_param_bytes)}  kv "
               f"{_fmt_bytes(sv_kv_bytes)}", file=out)
+    if sv_adapters_resident is not None:
+        # multi-tenant adapter plane: HBM slot residency + the pool's
+        # hit/fault/eviction ledger — faults are host->HBM fetches (a
+        # cold tenant's admission stall), evictions mean the hot set
+        # outgrew hbm_adapter_slots (docs/serving.md)
+        bytes_txt = (f" ({_fmt_bytes(sv_adapter_bytes)})"
+                     if sv_adapter_bytes else "")
+        ledger = ", ".join(
+            f"{name} {int(v)}" for name, v in
+            (("hits", sv_adapter_hits), ("faults", sv_adapter_faults),
+             ("evictions", sv_adapter_evictions)) if v is not None)
+        print(f"  adapters           {int(sv_adapters_resident)} "
+              f"resident{bytes_txt}"
+              f"{'  ' + ledger if ledger else ''}", file=out)
     if beat_ages:
         # liveness (docs/elastic.md): supervisor-visible staleness made
         # operator-visible — last beat age per host at the final sync

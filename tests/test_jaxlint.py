@@ -319,6 +319,22 @@ def test_serving_subsystem_is_clean_with_empty_baseline():
     assert not [k for k in baseline if inference_prefix in k]
 
 
+def test_adapter_plane_is_clean_with_empty_baseline():
+    """The multi-tenant adapter plane (inference/adapters.py) is
+    JL001-JL007 clean WITHOUT any baseline entries — its zero-recompile
+    contract (traced adapter-table indirection, docs/serving.md
+    "multi-tenant serving") depends on the same JL005/JL006 discipline
+    as the rest of the serving subsystem, and its host->HBM fetch must
+    stay on the stage runtime's thread plane (JL007), so no finding
+    there may ever be baselined."""
+    findings = lint_paths([os.path.join(REPO, "deepspeed_tpu",
+                                        "inference", "adapters.py")])
+    assert not findings, "\n".join(f.render() for f in findings)
+    baseline = load_baseline()
+    prefix = os.path.join("deepspeed_tpu", "inference", "adapters.py")
+    assert not [k for k in baseline if prefix in k]
+
+
 # ---------------------------------------------------------------------------
 # v2: interprocedural rules + the cross-artifact contract registry
 # ---------------------------------------------------------------------------

@@ -332,3 +332,56 @@ def test_engine_records_carry_arrival_s(tmp_path):
 def _records(tel_dir):
     with open(os.path.join(str(tel_dir), "events.jsonl")) as f:
         return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant dimension (docs/serving.md "multi-tenant serving")
+# ---------------------------------------------------------------------------
+
+
+def test_tenants_leave_payload_and_arrivals_bitwise_unchanged():
+    """The third-generator contract: enabling TenantSpec draws tenant
+    ids from their own rng stream, so the prompts, budgets, AND
+    arrival offsets of a tenantless build stay byte for byte what
+    they were — a lora A/B isolates the tenant dimension."""
+    from tools.loadgen.workload import TenantSpec
+    kw = dict(arrival=ArrivalSpec("gamma_burst", rate=10.0, cv=4.0),
+              prompt_len=LengthSpec("lognormal", median=5.0),
+              gen_tokens=LengthSpec(value=6))
+    off = Workload(24, **kw).build(seed=5)
+    on = Workload(24, tenants=TenantSpec(n_tenants=6), **kw).build(seed=5)
+    assert [i.prompt for i in off] == [i.prompt for i in on]
+    assert [i.max_new_tokens for i in off] == [i.max_new_tokens for i in on]
+    assert [i.at_s for i in off] == [i.at_s for i in on]
+    assert all(i.tenant == 0 for i in off)
+    assert all(1 <= i.tenant <= 6 for i in on)
+
+
+def test_tenant_sequence_is_arrival_shape_independent():
+    """Same seed, different arrival process: the tenant draw must not
+    move — it rides its own stream, like the payload."""
+    from tools.loadgen.workload import TenantSpec
+    kw = dict(tenants=TenantSpec(n_tenants=8, s=1.2),
+              prompt_len=LengthSpec(value=5),
+              gen_tokens=LengthSpec(value=4))
+    uni = Workload(32, arrival=ArrivalSpec("uniform", period=0.1),
+                   **kw).build(seed=9)
+    burst = Workload(32, arrival=ArrivalSpec("gamma_burst", rate=5.0,
+                                             cv=6.0), **kw).build(seed=9)
+    assert [i.tenant for i in uni] == [i.tenant for i in burst]
+
+
+def test_tenant_zipf_shape_and_determinism():
+    """The Zipf draw is deterministic per seed and actually skewed:
+    tenant 1 is the modal tenant and every id is in range."""
+    from tools.loadgen.workload import TenantSpec
+    w = Workload(300, arrival=ArrivalSpec("uniform", period=0.0),
+                 prompt_len=LengthSpec(value=4),
+                 gen_tokens=LengthSpec(value=4),
+                 tenants=TenantSpec(n_tenants=10, s=1.5))
+    ten = [i.tenant for i in w.build(seed=2)]
+    assert ten == [i.tenant for i in w.build(seed=2)]
+    assert set(ten) <= set(range(1, 11))
+    counts = {t: ten.count(t) for t in set(ten)}
+    assert max(counts, key=counts.get) == 1
+    assert counts[1] > counts.get(10, 0)

@@ -85,6 +85,11 @@ METRIC_DIRECTIONS = {
     # a shrinking gap means the workload plane went blind, so HIGHER
     # is better (docs/serving.md "workload plane")
     "loadgen_goodput_burst_gap": False,
+    # admitted tenants per HBM adapter byte, heterogeneous LoRA batch
+    # vs one merged model copy per tenant: the multi-tenant capacity
+    # headline — HIGHER is better (docs/serving.md "multi-tenant
+    # serving")
+    "serve_lora_tenants_per_byte": False,
 }
 
 

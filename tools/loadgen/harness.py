@@ -135,7 +135,8 @@ def replay_engine(model, params, serving: dict,
                 while nxt < n and arrivals[nxt] <= now:
                     reqs.append(eng.submit(
                         items[nxt].prompt,
-                        max_new_tokens=items[nxt].max_new_tokens))
+                        max_new_tokens=items[nxt].max_new_tokens,
+                        adapter_id=items[nxt].tenant))
                     nxt += 1
                 if not eng.scheduler.active and not eng._pending \
                         and eng.queue.qsize() == 0:
@@ -246,7 +247,8 @@ def replay_fleet(config: dict, items: Sequence[WorkloadItem], *,
                 while nxt < n and items[nxt].at_s <= now:
                     reqs.append(router.submit(
                         items[nxt].prompt,
-                        max_new_tokens=items[nxt].max_new_tokens))
+                        max_new_tokens=items[nxt].max_new_tokens,
+                        adapter_id=items[nxt].tenant))
                     submit_ts.append(now)
                     nxt += 1
                 if kill_after_s is not None and killed is None \

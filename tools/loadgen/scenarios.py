@@ -27,7 +27,7 @@ from typing import List, Optional
 import numpy as np
 
 from .harness import replay_engine, replay_fleet
-from .workload import ArrivalSpec, LengthSpec, Workload
+from .workload import ArrivalSpec, LengthSpec, TenantSpec, Workload
 
 
 def _write_bench(out_dir, name, rec):
@@ -742,6 +742,167 @@ def run_fleet_disagg(n_requests=36, arrival_s=0.08, gen_tokens=16,
 
 
 # ---------------------------------------------------------------------------
+# lora: multi-tenant adapter serving vs one-merged-model-per-tenant
+# ---------------------------------------------------------------------------
+
+
+def _lora_serving(slots, prefill_len, rank, n_tenants, hbm_slots,
+                  targets):
+    return {"slots": slots, "max_seq_len": 64,
+            "prefill_len": prefill_len, "page_len": 8, "pages": 128,
+            "queue_capacity": 256, "flush_interval_ticks": 10,
+            "lora": {"rank": rank, "alpha": 2.0 * rank,
+                     "max_adapters": max(2 * n_tenants, 16),
+                     "hbm_adapter_slots": hbm_slots,
+                     "targets": list(targets)}}
+
+
+def _pool_stats(eng):
+    return {"adapter_bytes": eng.adapter_bytes,
+            "param_bytes": eng.param_bytes,
+            "resident": eng.adapters.resident(),
+            "hits": eng.adapters.hits,
+            "faults": eng.adapters.faults,
+            "evictions": eng.adapters.evictions,
+            "decode_programs": eng._decode_fn._cache_size(),
+            "scale": eng.lora_scale}
+
+
+def _ttft_p99(requests):
+    from deepspeed_tpu.telemetry.cli import _percentile
+    return _percentile(sorted(r.token_times[0] for r in requests
+                              if r.token_times), 0.99)
+
+
+def run_lora(n_tenants=12, hbm_slots=4, rank=4, n_requests=48,
+             prompt_len=8, gen_tokens=8, slots=8, zipf_s=1.2,
+             targets=("qkv_w", "out_w"), out_dir="."):
+    """The multi-tenant LoRA headline (BENCH_serve_lora.json,
+    docs/serving.md "multi-tenant serving"): one base model + a paged
+    HBM adapter pool serves ``n_tenants`` tenants for
+    ``adapter_pool_bytes`` extra HBM; the baseline serves each tenant
+    with a dense-MERGED param copy (``W + BA``, the S-LoRA strawman)
+    for ``n_tenants * param_bytes``.  The pinned headline is the
+    admitted-tenants-per-HBM-byte ratio (>= 10x asserted here AND by
+    the benchgate pin).
+
+    Rides along: (1) per-tenant CORRECTNESS — the hottest tenant's
+    heterogeneous-batch streams replayed against its merged-model
+    engine, token for token; (2) the zero-recompile contract over the
+    Zipf tenant mix (decode compiles ONE program); (3) the
+    cold-adapter tail — TTFT p99 with every admission faulting +
+    evicting (more tenants than HBM slots) vs the all-hit leg."""
+    import dataclasses as _dc
+    from deepspeed_tpu.inference.adapters import (adapter_param_shapes,
+                                                  merge_adapter,
+                                                  synth_adapter)
+
+    model, params = _init_model()
+    serving = _lora_serving(slots, 2 * prompt_len, rank, n_tenants,
+                            hbm_slots, targets)
+    wl = Workload(n_requests,
+                  prompt_len=LengthSpec(value=prompt_len),
+                  gen_tokens=LengthSpec(value=gen_tokens),
+                  tenants=TenantSpec(n_tenants=n_tenants, s=zipf_s))
+    items = wl.build(seed=0)
+    assert len({it.tenant for it in items}) > 1, "degenerate Zipf draw"
+    run = replay_engine(model, params, serving, items,
+                        warmup=(items[0].prompt, 2),
+                        collect=_pool_stats, tag="lora")
+    stats = run.stats
+    assert stats["decode_programs"] == 1, \
+        f"tenant mix recompiled decode: {stats['decode_programs']}"
+    streams = {}
+    for it, r in zip(items, run.requests):
+        streams.setdefault(it.tenant, []).append((it, r.tokens))
+
+    # -- correctness arm: hottest tenant vs its dense-merged engine ----
+    hot = max(streams, key=lambda t: len(streams[t]))
+    shapes = adapter_param_shapes(model.config.n_layer,
+                                  model.config.d_model, rank,
+                                  tuple(targets))
+    merged_params = merge_adapter(params, synth_adapter(hot, shapes),
+                                  2.0 * rank / rank)
+    merged_serving = {k: v for k, v in serving.items() if k != "lora"}
+    merged_items = [_dc.replace(it, tenant=0)
+                    for it, _ in streams[hot]]
+    merged = replay_engine(model, merged_params, merged_serving,
+                           merged_items, warmup=(items[0].prompt, 2),
+                           tag="merged")
+    for (_, toks), ref in zip(streams[hot], merged.requests):
+        assert toks == ref.tokens, \
+            "heterogeneous tenant stream diverged from merged model"
+
+    # -- the headline: admitted tenants per HBM byte -------------------
+    # lora arm: n_tenants served for adapter_pool_bytes extra HBM.
+    # merged arm: each tenant costs a FULL param copy resident in HBM.
+    param_bytes = stats["param_bytes"]
+    adapter_bytes = stats["adapter_bytes"]
+    tenants_per_byte_lora = n_tenants / adapter_bytes
+    tenants_per_byte_merged = n_tenants / (n_tenants * param_bytes)
+    value = tenants_per_byte_lora / tenants_per_byte_merged
+    assert value >= 10.0, (value, param_bytes, adapter_bytes)
+
+    # -- cold-adapter tail under eviction pressure ---------------------
+    # every request a FRESH tenant (> hbm slots: each admission faults
+    # and evicts an LRU resident) vs every request the SAME tenant
+    # (one fault, then pure hits)
+    n_cold = 2 * hbm_slots + 4
+    cold_serving = _lora_serving(slots, 2 * prompt_len, rank,
+                                 n_cold, hbm_slots, targets)
+    base_items = Workload(
+        n_cold, prompt_len=LengthSpec(value=prompt_len),
+        gen_tokens=LengthSpec(value=gen_tokens)).build(seed=1)
+    cold_items = [_dc.replace(it, tenant=i + 1)
+                  for i, it in enumerate(base_items)]
+    hot_items = [_dc.replace(it, tenant=1) for it in base_items]
+    cold = replay_engine(model, params, cold_serving, cold_items,
+                         warmup=(base_items[0].prompt, 2),
+                         collect=_pool_stats, tag="cold")
+    hotleg = replay_engine(model, params, cold_serving, hot_items,
+                           warmup=(base_items[0].prompt, 2),
+                           collect=_pool_stats, tag="hot")
+    assert cold.stats["evictions"] > 0, "cold leg never evicted"
+    assert hotleg.stats["faults"] == 1, hotleg.stats["faults"]
+
+    rec = {
+        "metric": "serve_lora_tenants_per_byte",
+        "value": value,
+        "rank": rank,
+        "targets": list(targets),
+        "n_tenants": n_tenants,
+        "hbm_adapter_slots": hbm_slots,
+        "zipf_s": zipf_s,
+        "param_bytes": param_bytes,
+        "adapter_pool_bytes": adapter_bytes,
+        "tenants_per_hbm_byte": {
+            "lora": tenants_per_byte_lora,
+            "merged_per_tenant": tenants_per_byte_merged,
+        },
+        "zipf_leg": {
+            "requests": n_requests,
+            "tokens": run.tokens,
+            "wall_s": run.wall_s,
+            "distinct_tenants": len(streams),
+            "decode_programs": stats["decode_programs"],
+            "pool": {k: stats[k] for k in
+                     ("resident", "hits", "faults", "evictions")},
+            "ttft_p99_s": _ttft_p99(run.requests),
+        },
+        "parity_tenant": hot,
+        "cold_fault": {
+            "tenants": n_cold,
+            "evictions": cold.stats["evictions"],
+            "faults": cold.stats["faults"],
+            "ttft_p99_s": _ttft_p99(cold.requests),
+            "hot_ttft_p99_s": _ttft_p99(hotleg.requests),
+        },
+    }
+    _write_bench(out_dir, "BENCH_serve_lora.json", rec)
+    return rec
+
+
+# ---------------------------------------------------------------------------
 # goodput: uniform vs burst arrival at the same mean rate (the workload
 # plane's own headline) + the chaos leg
 # ---------------------------------------------------------------------------
@@ -926,4 +1087,5 @@ SCENARIOS = {
     "fleet": run_fleet_ab,
     "fleet_disagg": run_fleet_disagg,
     "goodput": run_goodput,
+    "lora": run_lora,
 }

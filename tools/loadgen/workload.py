@@ -35,6 +35,9 @@ class WorkloadItem:
     prompt: Tuple[int, ...]
     max_new_tokens: int
     session: int = 0
+    #: tenant LoRA adapter id (0 = base model) — forwarded to
+    #: ``submit(adapter_id=...)`` by the harness
+    tenant: int = 0
 
 
 @dataclasses.dataclass
@@ -113,6 +116,23 @@ class LengthSpec:
 
 
 @dataclasses.dataclass
+class TenantSpec:
+    """The multi-tenant dimension: each request draws a tenant (LoRA
+    adapter id ``1..n_tenants``) from a Zipf-like power law of
+    exponent ``s`` — a few hot tenants and a long cold tail, the
+    S-LoRA/Punica serving regime (PAPERS.md).  Adapter id 0 (the base
+    model) is expressed by leaving ``Workload.tenants`` unset, never
+    drawn."""
+    n_tenants: int = 8
+    s: float = 1.2
+
+    def sample(self, rng: np.random.Generator) -> int:
+        ranks = np.arange(1, self.n_tenants + 1, dtype=float)
+        w = ranks ** -float(self.s)
+        return int(rng.choice(self.n_tenants, p=w / w.sum())) + 1
+
+
+@dataclasses.dataclass
 class Workload:
     """The full spec.  ``mix`` (when non-empty) overrides the two
     LengthSpecs with a deterministic per-index cycle of
@@ -137,10 +157,15 @@ class Workload:
     templates: int = 1
     session_len: int = 0
     idle_gap_s: float = 0.0
+    tenants: Optional[TenantSpec] = None
 
     def build(self, seed: int = 0) -> List[WorkloadItem]:
         arr_rng = np.random.default_rng([int(seed), 0])
         pay_rng = np.random.default_rng([int(seed), 1])
+        # the tenant draw rides its own payload-side stream: arrival
+        # shape never changes the tenant sequence, and enabling tenants
+        # leaves lengths/prompts (pay_rng's draws) bitwise unchanged
+        ten_rng = np.random.default_rng([int(seed), 2])
         offs = self.arrival.offsets(self.n_requests, arr_rng)
         tmpl = [
             [int(t) for t in pay_rng.integers(0, self.vocab,
@@ -170,7 +195,9 @@ class Workload:
                 at_s=round(float(at) + gap, 6),
                 prompt=tuple(prompt),
                 max_new_tokens=int(gen),
-                session=session))
+                session=session,
+                tenant=(self.tenants.sample(ten_rng)
+                        if self.tenants else 0)))
         return items
 
 

@@ -513,6 +513,8 @@ class DeepSpeedServingConfig:
             sv.get(C.SERVING_QUANTIZATION), self.page_len)
         self.lora = self._validate_lora(
             sv.get(C.SERVING_LORA), self.page_len)
+        self.kv_tier = self._validate_kv_tier(
+            sv.get(C.SERVING_KV_TIER), self.page_len)
         for name, v, lo in ((C.SERVING_SLOTS, self.slots, 1),
                             (C.SERVING_MAX_SEQ_LEN, self.max_seq_len, 0),
                             (C.SERVING_PREFILL_LEN, self.prefill_len, 0),
@@ -758,6 +760,71 @@ class DeepSpeedServingConfig:
                 f"{rank} requires serving.{C.SERVING_PAGE_LEN} > 0: "
                 "the adapter pool rides the paged serving plane (its "
                 "residency slots are managed exactly like KV pages)")
+        return out
+
+    @staticmethod
+    def _validate_kv_tier(kv, page_len: int) -> Dict[str, Any]:
+        """Eager validation of ``serving.kv_tier`` (docs/serving.md
+        "KV tiering"): a typo'd budget or park threshold must fail at
+        config parse, not as a silently-never-parking tier under live
+        traffic.  Returns the block with defaults filled
+        (idle_park_ticks=0 = tiering OFF — no tier object, no extra
+        host copies, engine behavior bitwise unchanged)."""
+        if kv is None:
+            kv = {}
+        if not isinstance(kv, dict):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_KV_TIER} must be a dict "
+                f"(idle_park_ticks/host_budget_pages/disk_dir/fsync), "
+                f"got {kv!r}")
+        allowed = {C.SERVING_KV_TIER_IDLE_PARK_TICKS,
+                   C.SERVING_KV_TIER_HOST_BUDGET_PAGES,
+                   C.SERVING_KV_TIER_DISK_DIR,
+                   C.SERVING_KV_TIER_FSYNC}
+        unknown = set(kv) - allowed
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_KV_TIER} has unknown key(s) "
+                f"{sorted(unknown)}; allowed: {sorted(allowed)}")
+        out = {
+            C.SERVING_KV_TIER_IDLE_PARK_TICKS: get_scalar_param(
+                kv, C.SERVING_KV_TIER_IDLE_PARK_TICKS,
+                C.SERVING_KV_TIER_IDLE_PARK_TICKS_DEFAULT),
+            C.SERVING_KV_TIER_HOST_BUDGET_PAGES: get_scalar_param(
+                kv, C.SERVING_KV_TIER_HOST_BUDGET_PAGES,
+                C.SERVING_KV_TIER_HOST_BUDGET_PAGES_DEFAULT),
+            C.SERVING_KV_TIER_DISK_DIR: get_scalar_param(
+                kv, C.SERVING_KV_TIER_DISK_DIR,
+                C.SERVING_KV_TIER_DISK_DIR_DEFAULT),
+            C.SERVING_KV_TIER_FSYNC: get_scalar_param(
+                kv, C.SERVING_KV_TIER_FSYNC,
+                C.SERVING_KV_TIER_FSYNC_DEFAULT),
+        }
+        for key in (C.SERVING_KV_TIER_IDLE_PARK_TICKS,
+                    C.SERVING_KV_TIER_HOST_BUDGET_PAGES):
+            v = out[key]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                raise DeepSpeedConfigError(
+                    f"serving.{C.SERVING_KV_TIER}.{key} must be an "
+                    f"int >= 0, got {v!r}")
+        if not isinstance(out[C.SERVING_KV_TIER_DISK_DIR], str):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_KV_TIER}."
+                f"{C.SERVING_KV_TIER_DISK_DIR} must be a string "
+                f"directory path ('' = no disk tier), got "
+                f"{out[C.SERVING_KV_TIER_DISK_DIR]!r}")
+        if not isinstance(out[C.SERVING_KV_TIER_FSYNC], bool):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_KV_TIER}.{C.SERVING_KV_TIER_FSYNC} "
+                f"must be a bool, got {out[C.SERVING_KV_TIER_FSYNC]!r}")
+        if out[C.SERVING_KV_TIER_IDLE_PARK_TICKS] and not page_len:
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_KV_TIER}."
+                f"{C.SERVING_KV_TIER_IDLE_PARK_TICKS}="
+                f"{out[C.SERVING_KV_TIER_IDLE_PARK_TICKS]} requires "
+                f"serving.{C.SERVING_PAGE_LEN} > 0: the KV tier parks "
+                "prefix-cache pages, which exist only on the paged "
+                "serving plane")
         return out
 
 

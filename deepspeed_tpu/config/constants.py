@@ -603,6 +603,37 @@ SERVING_LORA_TARGETS = "targets"
 SERVING_LORA_TARGETS_DEFAULT = ("qkv_w", "out_w")
 
 #############################################
+# KV tiering (TPU extension; docs/serving.md "KV tiering")
+#############################################
+# Park idle sessions' KV pages off HBM (inference/kv_tier.py): cold
+# prefix-cache pages spill HBM -> host RAM -> disk and stream back on
+# session resume as a prefix-cache hit.  Rides the paged serving plane
+# (serving.page_len > 0 with the prefix cache on).
+SERVING_KV_TIER = "kv_tier"
+# a prefix-cache leaf idle for this many engine TICKS is parked:
+# exported to the host tier, CRC-stamped, then evicted from the page
+# pool.  0 = KV tiering off (the default: no tier, no extra host
+# copies, engine behavior bitwise unchanged).
+SERVING_KV_TIER_IDLE_PARK_TICKS = "idle_park_ticks"
+SERVING_KV_TIER_IDLE_PARK_TICKS_DEFAULT = 0
+# parked page payloads kept in host RAM; beyond this the OLDEST parked
+# pages write back to the disk tier (or, with no disk_dir, are dropped
+# — resume recomputes them from the prompt).  0 = write-through: every
+# parked page goes straight to disk.
+SERVING_KV_TIER_HOST_BUDGET_PAGES = "host_budget_pages"
+SERVING_KV_TIER_HOST_BUDGET_PAGES_DEFAULT = 256
+# directory of the disk tier's parked-page files (PR 15's magic/JSON-
+# header/section-CRC format, tmp+rename).  "" = no disk tier: the host
+# budget is the tier's total capacity.
+SERVING_KV_TIER_DISK_DIR = "disk_dir"
+SERVING_KV_TIER_DISK_DIR_DEFAULT = ""
+# fsync parked-page files before rename (crash durability for the disk
+# tier; DS_DISK_FSYNC=0 force-disables, same switch as the optimizer
+# disk tier)
+SERVING_KV_TIER_FSYNC = "fsync"
+SERVING_KV_TIER_FSYNC_DEFAULT = True
+
+#############################################
 # Serving fleet (TPU extension; docs/serving.md "serving fleet")
 #############################################
 # Router + replicated ServeEngines + SLO autoscaling

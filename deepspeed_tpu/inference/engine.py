@@ -92,8 +92,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..config.config import (DeepSpeedConfig, DeepSpeedServingConfig,
                              DeepSpeedStagesConfig,
                              DeepSpeedTelemetryConfig)
+from ..config import constants as C
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, build_mesh
-from ..runtime.stages import Channel, Stage, StageGraph, injected_delay
+from ..runtime.engine_stages import wire_serve_stage_plane
+from ..runtime.stages import Channel, Stage, injected_delay
 from ..utils.logging import logger
 from .kv_cache import (KVCacheSpec, PagedKVCacheSpec, cache_shardings,
                        init_cache, init_paged_cache,
@@ -501,7 +503,10 @@ class ServeEngine:
                     (slot,))
                 return out
 
-            self._page_out_fn = jax.jit(page_out_fn)
+            # exported page slices are host-bound bytes: replicated
+            # output (identity on one device) so every host sees the
+            # full page, like the other pinned siblings
+            self._page_out_fn = jax.jit(page_out_fn, out_shardings=rep)
             self._page_in_fn = jax.jit(
                 page_in_fn, donate_argnums=(0,),
                 out_shardings=self._cache_shardings)
@@ -563,11 +568,29 @@ class ServeEngine:
             self.stage.depth_fn = self.queue.qsize
         self.stage.on_degrade = lambda st: self.dump_flight_record(
             reason=f"stage {st.name!r} degraded to {st.fallback}")
-        self._graph = StageGraph()
-        self._graph.register("serve_queue", close=self._close_queue,
-                             drain=lambda: None)
-        self._graph.register("telemetry", close=self._close_telemetry,
-                             drain=self._flush)
+
+        # -- KV tiering (docs/serving.md "KV tiering"): park idle
+        # sessions' prefix-cache pages on host/disk and stream them
+        # back on resume.  Off by default (idle_park_ticks=0) — the
+        # engine is bitwise what it was without it.
+        self.kv_tier = None
+        kvt = cfg.serving.kv_tier
+        if self.paged and self.prefix is not None \
+                and kvt[C.SERVING_KV_TIER_IDLE_PARK_TICKS] > 0:
+            from ..runtime.disk_offload import disk_fsync_enabled
+            from .kv_tier import KVTier
+            self.kv_tier = KVTier(
+                page_len=self.page_len, pool=self.pool,
+                prefix=self.prefix,
+                exporter=self._export_page_bytes,
+                importer=self._import_page_bytes,
+                idle_park_ticks=kvt[C.SERVING_KV_TIER_IDLE_PARK_TICKS],
+                host_budget_pages=kvt[
+                    C.SERVING_KV_TIER_HOST_BUDGET_PAGES],
+                disk_dir=kvt[C.SERVING_KV_TIER_DISK_DIR] or None,
+                fsync=disk_fsync_enabled(kvt[C.SERVING_KV_TIER_FSYNC]),
+                max_failures=cfg.stages.max_stage_failures)
+        wire_serve_stage_plane(self)
 
         # -- memory planes (docs/serving.md "quantized serving"): the
         # device bytes the params and KV cache claim, from the param
@@ -681,12 +704,31 @@ class ServeEngine:
                     "cold-adapter admissions that fetched host->HBM "
                     "(the adapter_fetch stage point)")
 
+            if self.kv_tier is not None:
+                self._kv_parked_gauge = reg.gauge(
+                    "serve_kv_parked_sessions",
+                    "idle sessions parked off HBM in the host/disk KV "
+                    "tier (parked digest-chain tails)")
+                self._kv_spill_ctr = reg.counter(
+                    "serve_kv_spill_bytes_total",
+                    "KV page bytes exported HBM -> host/disk by the "
+                    "kv_spill stage")
+                self._kv_fetch_ctr = reg.counter(
+                    "serve_kv_fetch_bytes_total",
+                    "parked KV page bytes streamed back on session "
+                    "resume by the kv_fetch stage")
+                self._kv_spill_seen = 0
+                self._kv_fetch_seen = 0
+
             def _stage_counter(name, help, n):
                 reg.counter(name, help).inc(n)
 
             self.stage.counter_fn = _stage_counter
             if self.lora:
                 self.adapter_stage.counter_fn = _stage_counter
+            if self.kv_tier is not None:
+                self.kv_tier.spill_stage.counter_fn = _stage_counter
+                self.kv_tier.fetch_stage.counter_fn = _stage_counter
 
         #: perf_counter epoch for the completion records' ``arrival_s``
         #: stamps — submit times made record-relative, so open-loop
@@ -1107,6 +1149,26 @@ class ServeEngine:
                 pool.faults - self._adapter_faults_seen)
             self._adapter_hits_seen = pool.hits
             self._adapter_faults_seen = pool.faults
+        if self.kv_tier is not None:
+            tier = self.kv_tier
+            scalars["serve_kv_parked_sessions"] = \
+                float(tier.parked_sessions)
+            scalars["serve_kv_spill_bytes_total"] = \
+                float(tier.spill_bytes)
+            scalars["serve_kv_fetch_bytes_total"] = \
+                float(tier.fetch_bytes)
+            p99r = tier.resume_p99_s()
+            if p99r is not None:
+                scalars["serve_kv_resume_p99_s"] = p99r
+            self._kv_parked_gauge.set(tier.parked_sessions)
+            # same delta discipline as the adapter pool above: the
+            # cumulative scalars stay the summarize source
+            self._kv_spill_ctr.inc(
+                tier.spill_bytes - self._kv_spill_seen)
+            self._kv_fetch_ctr.inc(
+                tier.fetch_bytes - self._kv_fetch_seen)
+            self._kv_spill_seen = tier.spill_bytes
+            self._kv_fetch_seen = tier.fetch_bytes
         self.telemetry.on_sync(step=self._ticks, scalars=scalars)
         self._last_flush_t = now
         self._last_flush_tokens = self._tokens_seen
@@ -1275,11 +1337,28 @@ class ServeEngine:
             shared_len, spages, cow = self.prefix.match(req.prompt, ns)
         else:
             shared_len, spages, cow = 0, [], False
-        need = total_pages - len(spages) + (1 if cow else 0)
+        tpages: List[int] = []
+        if self.kv_tier is not None and not cow \
+                and shared_len % self.page_len == 0 \
+                and self.pool.free_count >= total_pages - len(spages):
+            # session resume (docs/serving.md "KV tiering"): continue
+            # the digest chain into the parked tier — fetched pages
+            # extend the prefix-cache match and insert() below
+            # re-registers them, so a resume IS a prefix hit.  Gated
+            # on enough free pages for the whole admission so consumed
+            # one-shot records are not spent on a request that then
+            # parks; tier failures never raise out of resume — they
+            # fall back to the recompute (delta prefill) path below.
+            shared_len, tpages = self.kv_tier.resume(
+                req.prompt, ns, shared_len, self._alloc_pages)
+        need = total_pages - len(spages) - len(tpages) \
+            + (1 if cow else 0)
         fresh = self._alloc_pages(need)
         if fresh is None:
             if self.prefix is not None:
                 self.prefix.release(spages)
+            for p in tpages:
+                self.pool.deref(p)
             return False
         aslot = 0
         if self.lora and req.adapter_id:
@@ -1289,15 +1368,15 @@ class ServeEngine:
             try:
                 got = self.adapters.acquire(req.adapter_id)
             except BaseException:
-                for p in list(spages) + fresh:
+                for p in list(spages) + tpages + fresh:
                     self.pool.deref(p)
                 raise
             if got is None:
-                for p in list(spages) + fresh:
+                for p in list(spages) + tpages + fresh:
                     self.pool.deref(p)
                 return False
             aslot = got
-        held = list(spages) + fresh
+        held = list(spages) + tpages + fresh
         try:
             # queue wait ends HERE, before any device work: the COW
             # copy below (and its first-use compile) is compute and
@@ -1320,7 +1399,7 @@ class ServeEngine:
                 row = spages[:-1] + fresh[:1]
                 fi = 1
             else:
-                row = list(spages)
+                row = list(spages) + tpages
             row.extend(fresh[fi:])
             delta = req.prompt[shared_len:]
             if self.prefill_chunk_len \
@@ -1848,6 +1927,10 @@ class ServeEngine:
         block — over the whole pool.  Returns tokens produced."""
         if self._closed:
             raise RuntimeError("ServeEngine is closed")
+        if self.kv_tier is not None:
+            # park BEFORE admission so pages freed by parking are
+            # immediately allocatable this very tick
+            self.kv_tier.park_tick(self._ticks)
         self._admit()
         try:
             n = 0
@@ -1933,6 +2016,40 @@ class ServeEngine:
         export's payloads are on the wire, the pages are admissible
         capacity again."""
         self._release_pages(req)
+
+    def _export_page_bytes(self, pid: int) -> bytes:
+        """ONE pool page as raw host bytes — the KV tier's spill unit
+        (``export_pages``'s packing for a single page; the tier CRC-
+        stamps the result before the page's pool ref is released)."""
+        with self._span("serve/kv_spill", page=pid):
+            with self._pallas_scope():
+                slices = self._page_out_fn(self.cache, np.int32(pid))
+            slices = jax.block_until_ready(slices)
+        return b"".join(np.asarray(s).tobytes() for s in slices)
+
+    def _import_page_bytes(self, pid: int, payload: bytes) -> None:
+        """Import one parked page payload into pool page ``pid`` — the
+        KV tier's fetch unit (``adopt_request``'s unpacking for a
+        single page).  A size mismatch is a corrupt record, typed so
+        the tier's recompute fallback catches it."""
+        from .kv_tier import KVTierCorruptError
+        leaves, off = [], 0
+        for ref in [self.cache[k] for k in self._page_leaves()]:
+            nb = int(ref.nbytes) // int(ref.shape[1])
+            shape = ref.shape[:1] + (1,) + ref.shape[2:]
+            leaves.append(np.frombuffer(
+                payload, dtype=np.dtype(ref.dtype),
+                count=nb // ref.dtype.itemsize,
+                offset=off).reshape(shape))
+            off += nb
+        if off != len(payload):
+            raise KVTierCorruptError(
+                f"parked page payload is {len(payload)} bytes; this "
+                f"pool's page is {off}")
+        with self._span("serve/kv_fetch", page=pid):
+            with self._pallas_scope():
+                self.cache = self._page_in_fn(self.cache,
+                                              np.int32(pid), *leaves)
 
     def adopt_request(self, prompt, first_token: int,
                       max_new_tokens: int,
@@ -2103,15 +2220,30 @@ class ServeEngine:
         if self.prefix is not None:
             self.prefix.clear()
 
+    def _drain_kv_spill(self):
+        """Write every host-resident parked page to the disk tier
+        (when one exists) — the spill plane's drain barrier, so parked
+        sessions survive the process."""
+        if self.kv_tier is not None:
+            self.kv_tier.drain()
+
+    def _close_kv_spill(self):
+        if self.kv_tier is not None:
+            self.kv_tier.close_spill()
+
+    def _close_kv_fetch(self):
+        if self.kv_tier is not None:
+            self.kv_tier.close()
+
     def _close_telemetry(self):
         if self.telemetry is not None:
             self._flush()
             self.telemetry.close()
 
     def close(self):
-        """Idempotent: drain order is queue -> telemetry (docs/
-        serving.md); queued never-admitted requests fail with a typed
-        error instead of hanging their waiters."""
+        """Idempotent: drain order is queue -> kv spill -> kv fetch ->
+        telemetry (docs/serving.md); queued never-admitted requests
+        fail with a typed error instead of hanging their waiters."""
         if self._closed:
             return
         self._closed = True

@@ -411,6 +411,28 @@ class PrefixCache:
             if fe.children == 0 and d not in self.partials:
                 yield fe.last_hit, ("full", d, None)
 
+    def drop_leaf(self, kind: str, key: str,
+                  sub: Optional[Tuple[int, ...]]) -> int:
+        """Remove one LEAF entry (a ``_evictable`` candidate) and deref
+        its page; returns the page id.  The one dict-surgery path both
+        eviction and the KV tier's park (inference/kv_tier.py) go
+        through — the tier exports + CRC-stamps the page's bytes BEFORE
+        calling this, so the pool ref is only released once the host
+        copy is durable."""
+        if kind == "partial":
+            pe = self.partials[key].pop(sub)
+            if not self.partials[key]:
+                del self.partials[key]
+            if pe.parent in self.full:
+                self.full[pe.parent].children -= 1
+            self.pool.deref(pe.page)
+            return pe.page
+        fe = self.full.pop(key)
+        if fe.parent in self.full:
+            self.full[fe.parent].children -= 1
+        self.pool.deref(fe.page)
+        return fe.page
+
     def evict(self, need_free: int) -> int:
         """Drop least-recently-hit LEAF entries until the pool's free
         count reaches ``need_free`` (or nothing evictable remains).
@@ -426,18 +448,7 @@ class PrefixCache:
             if cand is None:
                 break
             _, (kind, key, sub) = cand
-            if kind == "partial":
-                pe = self.partials[key].pop(sub)
-                if not self.partials[key]:
-                    del self.partials[key]
-                if pe.parent in self.full:
-                    self.full[pe.parent].children -= 1
-                self.pool.deref(pe.page)
-            else:
-                fe = self.full.pop(key)
-                if fe.parent in self.full:
-                    self.full[fe.parent].children -= 1
-                self.pool.deref(fe.page)
+            self.drop_leaf(kind, key, sub)
             evicted += 1
         return evicted
 

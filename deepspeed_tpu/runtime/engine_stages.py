@@ -16,6 +16,11 @@ telemetry last so it still sees every stage's final spans/counters —
     prefetch -> offload uploads -> disk write-back -> ckpt writer
              -> telemetry flush
 
+The serving engine has its own graph with the same discipline
+(``wire_serve_stage_plane``; the fence's second line) —
+
+    serve queue -> kv spill -> kv fetch -> telemetry flush
+
 Prefetched batches are droppable and uploads never outlive their step
 call; the disk tier's write-back workers are joined before their step
 returns (a mid-step close aborts them and the step poisons); an
@@ -220,3 +225,30 @@ def close_telemetry_stage(engine) -> None:
         tel.close()
     if engine.summary_writer is not None:
         engine.summary_writer.close()
+
+
+# ---------------------------------------------------------------------------
+# the serving engine's stage graph, in ITS drain order
+# ---------------------------------------------------------------------------
+def wire_serve_stage_plane(serve) -> None:
+    """Install the :class:`~..inference.engine.ServeEngine`'s drain-
+    order graph (docs/stages.md; the fence's second line).
+
+    Close order: stop taking requests first (``serve_queue`` fails the
+    queued/pending typed and clears the prefix cache), then stop the KV
+    tier's parking and write its host-resident parked pages to the disk
+    tier (``kv_spill`` — the durability consumer waits out its
+    backlog), then drop the remaining parked records (``kv_fetch`` —
+    host/disk bytes only, no pool refs to return), telemetry last so
+    the final flush still sees every tier counter.  Both kv entries are
+    no-ops when the tier is off (``serving.kv_tier.idle_park_ticks=0``).
+    """
+    serve._graph = StageGraph()
+    serve._graph.register("serve_queue", close=serve._close_queue,
+                          drain=lambda: None)
+    serve._graph.register("kv_spill", close=serve._close_kv_spill,
+                          drain=serve._drain_kv_spill)
+    serve._graph.register("kv_fetch", close=serve._close_kv_fetch,
+                          drain=lambda: None)
+    serve._graph.register("telemetry", close=serve._close_telemetry,
+                          drain=serve._flush)

@@ -75,10 +75,13 @@ Stage names and points currently wired: ``prefetch:place``,
 (leaf/shard_index/manifest/meta/rename/latest/read) that live inside
 ``runtime/checkpointing.py``, the serving engine's
 ``serve:admit`` / ``serve:step`` (deepspeed_tpu/inference/engine.py,
-docs/serving.md), and the multi-tenant adapter pool's
+docs/serving.md), the multi-tenant adapter pool's
 ``adapter_fetch:fetch`` — one cold adapter's host->HBM upload
 (deepspeed_tpu/inference/adapters.py, docs/serving.md "multi-tenant
-serving").
+serving"), and the KV tier's ``kv_spill:pageout`` /
+``kv_spill:write`` / ``kv_fetch:read`` / ``kv_fetch:pagein`` — park
+and resume of idle sessions' KV pages (deepspeed_tpu/inference/
+kv_tier.py, docs/serving.md "KV tiering").
 """
 from __future__ import annotations
 

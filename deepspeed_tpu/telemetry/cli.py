@@ -111,6 +111,13 @@ def summarize(path: str, out=None) -> dict:
     sv_adapter_hits: Optional[float] = None
     sv_adapter_faults: Optional[float] = None
     sv_adapter_evictions: Optional[float] = None
+    # KV tier plane (docs/serving.md "KV tiering"): parked sessions is
+    # a gauge (last flush = the run's answer), spill/fetch bytes are
+    # cumulative, resume p99 is the last flush's window percentile
+    sv_kv_parked: Optional[float] = None
+    sv_kv_spill_bytes: Optional[float] = None
+    sv_kv_fetch_bytes: Optional[float] = None
+    sv_kv_resume_p99: Optional[float] = None
     # goodput plane (docs/serving.md "workload plane"): the SLOs and
     # the live tracker's verdict arrive as sync scalars; the
     # per-request phases below recompute the same verdict offline
@@ -272,6 +279,19 @@ def summarize(path: str, out=None) -> dict:
                 ae = scalars.get("serve_adapter_evictions_total")
                 if ae is not None:
                     sv_adapter_evictions = float(ae)
+                # KV tier (docs/serving.md "KV tiering")
+                kp = scalars.get("serve_kv_parked_sessions")
+                if kp is not None:
+                    sv_kv_parked = float(kp)
+                ks = scalars.get("serve_kv_spill_bytes_total")
+                if ks is not None:
+                    sv_kv_spill_bytes = float(ks)
+                kf = scalars.get("serve_kv_fetch_bytes_total")
+                if kf is not None:
+                    sv_kv_fetch_bytes = float(kf)
+                kr = scalars.get("serve_kv_resume_p99_s")
+                if kr is not None:
+                    sv_kv_resume_p99 = float(kr)
                 # goodput scalars (telemetry/goodput.py flush): all
                 # cumulative — the LAST flush is the run's answer
                 gp = scalars.get("serve_goodput")
@@ -442,6 +462,10 @@ def summarize(path: str, out=None) -> dict:
         "serve_adapter_hits_total": sv_adapter_hits,
         "serve_adapter_faults_total": sv_adapter_faults,
         "serve_adapter_evictions_total": sv_adapter_evictions,
+        "serve_kv_parked_sessions": sv_kv_parked,
+        "serve_kv_spill_bytes_total": sv_kv_spill_bytes,
+        "serve_kv_fetch_bytes_total": sv_kv_fetch_bytes,
+        "serve_kv_resume_p99_s": sv_kv_resume_p99,
         "liveness_hosts": len(beat_ages) or None,
         "liveness_max_age_s": (max(beat_ages.values())
                                if beat_ages else None),
@@ -594,6 +618,19 @@ def summarize(path: str, out=None) -> dict:
         print(f"  adapters           {int(sv_adapters_resident)} "
               f"resident{bytes_txt}"
               f"{'  ' + ledger if ledger else ''}", file=out)
+    if sv_kv_parked is not None:
+        # KV tier: idle sessions parked off HBM + the spill/fetch byte
+        # ledger; resume p99 is the fetch-latency tail a parked
+        # session's return pays (docs/serving.md "KV tiering")
+        flow_txt = ""
+        if sv_kv_spill_bytes is not None \
+                or sv_kv_fetch_bytes is not None:
+            flow_txt = (f"  spilled {_fmt_bytes(sv_kv_spill_bytes)}"
+                        f"  fetched {_fmt_bytes(sv_kv_fetch_bytes)}")
+        res_txt = (f"  resume p99 {_fmt_s(sv_kv_resume_p99)}"
+                   if sv_kv_resume_p99 is not None else "")
+        print(f"  kv tier            {int(sv_kv_parked)} session(s) "
+              f"parked{flow_txt}{res_txt}", file=out)
     if beat_ages:
         # liveness (docs/elastic.md): supervisor-visible staleness made
         # operator-visible — last beat age per host at the final sync

@@ -66,6 +66,7 @@ if [ "$1" = "--serve" ]; then
   run fleet_disagg python -m tools.loadgen fleet_disagg
   run loadgen_goodput python -m tools.loadgen goodput
   run serve_lora python -m tools.loadgen lora
+  run kv_tier python -m tools.loadgen kv_tier
   exit 0
 fi
 # --loadgen: just the workload plane's goodput/chaos headline (pure
@@ -193,6 +194,11 @@ run loadgen_goodput python -m tools.loadgen goodput
 # fault TTFT tail under eviction pressure (pure CPU capacity +
 # scheduling claims — docs/serving.md "multi-tenant serving")
 run serve_lora python -m tools.loadgen lora
+# KV-tiering A/B: conversation sessions resumed from the host/disk
+# tier vs HBM-only at the SAME fixed page budget — turn-2 prefix
+# hits survive parking bitwise, zero corrupt resumes (pure CPU
+# capacity claim — docs/serving.md "KV tiering")
+run kv_tier python -m tools.loadgen kv_tier
 run bert python bench_bert.py
 run sparse python bench_sparse.py
 run flash python bench_flash.py

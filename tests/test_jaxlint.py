@@ -319,6 +319,21 @@ def test_serving_subsystem_is_clean_with_empty_baseline():
     assert not [k for k in baseline if inference_prefix in k]
 
 
+def test_kv_tier_is_clean_with_empty_baseline():
+    """The KV tiering plane (inference/kv_tier.py) is JL001-JL007
+    clean WITHOUT any baseline entries — its bitwise-resume contract
+    (docs/serving.md "KV tiering") depends on the page export/import
+    seams staying on the stage runtime's thread plane (JL007) and on
+    the serving subsystem's JL005/JL006 discipline, so no finding
+    there may ever be baselined."""
+    findings = lint_paths([os.path.join(REPO, "deepspeed_tpu",
+                                        "inference", "kv_tier.py")])
+    assert not findings, "\n".join(f.render() for f in findings)
+    baseline = load_baseline()
+    prefix = os.path.join("deepspeed_tpu", "inference", "kv_tier.py")
+    assert not [k for k in baseline if prefix in k]
+
+
 def test_adapter_plane_is_clean_with_empty_baseline():
     """The multi-tenant adapter plane (inference/adapters.py) is
     JL001-JL007 clean WITHOUT any baseline entries — its zero-recompile

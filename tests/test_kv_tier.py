@@ -1,0 +1,507 @@
+"""KV tiering (docs/serving.md "KV tiering"): park idle sessions'
+prefix-cache pages on host RAM and disk, stream them back on resume,
+and survive every failure on the way down:
+
+* the acceptance bar — resume streams BITWISE equal to a never-spilled
+  engine across {host, disk} x {fp16, int8} KV x {plain, speculative},
+* the torture matrix — an injected fault at EVERY ``kv_spill``/
+  ``kv_fetch`` point, one-shot (absorbed by the retry budget) and
+  sticky (ONE degradation warning, engine keeps serving, zero lost
+  requests),
+* the corruption matrix — CRC flip / truncation / deletion of a parked
+  disk page and a poisoned host copy all land the typed
+  :class:`KVTierCorruptError` path and fall back to recompute-from-
+  prompt, never a poisoned stream,
+* pool hygiene — ``pool.refs == {}`` after close in every scenario,
+* the disk-store dialect (PR 15's magic/header/CRC format, tmp+rename),
+  the close-time drain barrier, config validation, telemetry rows.
+"""
+import logging
+import os
+
+import numpy as np
+import jax
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfigError
+from deepspeed_tpu.inference import ServeEngine
+from deepspeed_tpu.inference.kv_tier import (KVTierCorruptError,
+                                             KVTierDiskStore)
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.runtime.resilience import CheckpointCorruptError
+from deepspeed_tpu.runtime.stages import reset_fault_injection
+from deepspeed_tpu.utils.logging import logger as ds_logger
+
+TINY = GPT2Config(vocab_size=128, n_positions=64, d_model=32, n_layer=2,
+                  n_head=4, remat=None, attn_impl="dense")
+DRAFT_BLOCK = {"d_model": 32, "n_layer": 2, "n_head": 4}
+
+_CHAOS_ENVS = ("DS_STAGE_FAULT", "DS_STAGE_DELAY_S")
+
+#: idle_park_ticks used by every engine-level test; the idle-step loop
+#: runs IDLE + 3 ticks (one tick snapshots last_hit, IDLE more cross
+#: the threshold, the rest are slack)
+IDLE = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    for env in _CHAOS_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    reset_fault_injection()
+    yield
+    reset_fault_injection()
+
+
+@pytest.fixture
+def ds_caplog(caplog, monkeypatch):
+    """The project logger does not propagate; flip it so caplog sees
+    the degradation warning (same idiom as tests/test_stages.py)."""
+    monkeypatch.setattr(ds_logger, "propagate", True)
+    with caplog.at_level(logging.WARNING, logger="DeepSpeedTPU"):
+        yield caplog
+
+
+def _tokens(n, vocab=128, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, vocab, (n,)).astype(np.int32)
+
+
+def _p1():
+    # 17 tokens: two full pages (page_len=8) + a 1-token partial tail
+    return list(_tokens(17, seed=11))
+
+
+def _p2():
+    # turn 2 of the same conversation: turn 1's prompt + new tokens
+    return _p1() + list(_tokens(8, seed=12))
+
+
+_model_cache = {}
+
+
+def _model_params():
+    if not _model_cache:
+        model = GPT2Model(TINY)
+        _model_cache["mp"] = (model, model.init(jax.random.PRNGKey(0)))
+    return _model_cache["mp"]
+
+
+def _serve_cfg(slots=4, max_seq=64, prefill=32, telemetry_path=None,
+               **serving_extra):
+    cfg = {"serving": {"slots": slots, "max_seq_len": max_seq,
+                       "prefill_len": prefill, "page_len": 8,
+                       "pages": 16, **serving_extra}}
+    if telemetry_path is not None:
+        cfg["telemetry"] = {"enabled": True,
+                            "output_path": str(telemetry_path)}
+    return cfg
+
+
+def _tier(disk_dir=None, ticks=IDLE, budget=256, **kw):
+    kv = {"idle_park_ticks": ticks, "host_budget_pages": budget}
+    if disk_dir is not None:
+        kv["disk_dir"] = str(disk_dir)
+    kv.update(kw)
+    return {"kv_tier": kv}
+
+
+def _mode_serving(mode):
+    s = {}
+    if "int8" in mode:
+        s["quantization"] = {"kv": "int8"}
+    if "spec" in mode:
+        s["speculate_k"] = 2
+        s["draft"] = dict(DRAFT_BLOCK)
+    return s
+
+
+def _two_turns(serving_extra, mode="plain", idle=0, between=None,
+               collect=None, telemetry_path=None):
+    """The canonical session: turn 1, a think-time gap of idle engine
+    ticks (what parks the session), an optional mid-gap mutation hook,
+    then turn 2 extending the same prompt.  Returns the two token
+    streams, turn 2's shared prefix length, and the collect() snapshot;
+    asserts zero request errors and a leak-free pool."""
+    model, params = _model_params()
+    eng = ServeEngine(
+        model,
+        _serve_cfg(telemetry_path=telemetry_path,
+                   **_mode_serving(mode), **serving_extra),
+        params=params,
+        draft_params=params if "spec" in mode else None)
+    r1 = eng.submit(_p1(), max_new_tokens=4)
+    eng.run_until_idle()
+    for _ in range(idle):
+        eng.step()
+    if between is not None:
+        between(eng)
+    r2 = eng.submit(_p2(), max_new_tokens=4)
+    eng.run_until_idle()
+    assert r1.error is None and r2.error is None
+    stats = collect(eng) if collect is not None else None
+    streams = (list(r1.tokens), list(r2.tokens))
+    shared = r2.shared_len
+    eng.close()
+    assert eng.pool.refs == {}
+    return streams, shared, stats
+
+
+_base_cache = {}
+
+
+def _baseline(mode):
+    """The never-spilled reference streams for one mode (the tier-off
+    engine still gets a live prefix-cache hit on turn 2)."""
+    if mode not in _base_cache:
+        _base_cache[mode] = _two_turns({}, mode=mode)[0]
+    return _base_cache[mode]
+
+
+def _tier_stats(eng):
+    t = eng.kv_tier
+    return {"parked": t.parked_pages_total, "spill": t.spill_bytes,
+            "fetched": t.fetch_bytes, "resumed": t.resumed_sessions_total,
+            "corrupt": t.corrupt_total,
+            "spill_deg": t.spill_stage.degraded,
+            "fetch_deg": t.fetch_stage.degraded,
+            "fails": t.spill_stage.failures + t.fetch_stage.failures}
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance bar: resume is bitwise a never-spilled engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["plain", "int8", "spec", "int8_spec"])
+@pytest.mark.parametrize("arm", ["host", "disk"])
+def test_park_resume_stream_bitwise_vs_never_spilled(arm, mode, tmp_path):
+    """{host, disk} x {fp16, int8} KV x {plain, speculative}: the
+    session parks during think time (host-resident, or written back to
+    the disk tier under a zero host budget), turn 2 resumes it, and
+    both turns' streams are bitwise the never-spilled engine's."""
+    extra = _tier(tmp_path if arm == "disk" else None,
+                  budget=0 if arm == "disk" else 256)
+    # parking cascades root-ward one leaf per idle window (a parent
+    # becomes a leaf only once its child parks), so give the gap a few
+    # windows — enough for the whole 2-3 page chain on every mode
+    streams, shared, stats = _two_turns(
+        extra, mode=mode, idle=4 * (IDLE + 3), collect=_tier_stats)
+    assert streams == _baseline(mode)
+    assert stats["parked"] >= 2 and stats["spill"] > 0
+    assert stats["fetched"] > 0 and stats["resumed"] >= 1
+    assert stats["corrupt"] == 0
+    assert shared >= 16       # both full pages came back from the tier
+
+
+def test_parked_pages_leave_the_pool_during_the_gap(tmp_path):
+    """Parking is the point: mid-gap, the session's prefix-cache pages
+    are OUT of the pool (free for new traffic) and the tier holds the
+    only copy; resume brings them back."""
+    model, params = _model_params()
+    eng = ServeEngine(model, _serve_cfg(**_tier(tmp_path, budget=1)),
+                      params=params)
+    r1 = eng.submit(_p1(), max_new_tokens=4)
+    eng.run_until_idle()
+    held_mid_gap = None
+    for _ in range(IDLE + 3):
+        eng.step()
+    held_mid_gap = (eng.pool.used_count, eng.kv_tier.parked_pages)
+    # over the 1-page host budget, the overflow lives in the disk tier
+    on_disk = [f for f in os.listdir(tmp_path) if f.endswith(".page")]
+    r2 = eng.submit(_p2(), max_new_tokens=4)
+    eng.run_until_idle()
+    assert r1.error is None and r2.error is None
+    assert held_mid_gap[0] == 0 and held_mid_gap[1] >= 2
+    assert len(on_disk) >= 1
+    assert eng.kv_tier.parked_sessions == 0   # consumed by the resume
+    eng.close()
+    assert eng.pool.refs == {}
+
+
+# ---------------------------------------------------------------------------
+# torture matrix: a fault at EVERY spill/fetch point
+# ---------------------------------------------------------------------------
+
+POINTS = [("kv_spill", "pageout"), ("kv_spill", "write"),
+          ("kv_fetch", "read"), ("kv_fetch", "pagein")]
+
+
+@pytest.mark.parametrize("stage,point", POINTS)
+def test_one_shot_fault_is_absorbed_by_the_retry_budget(
+        stage, point, tmp_path, monkeypatch):
+    """A single injected fault at each point is retried inside the
+    stage budget: nothing degrades, the session still parks to disk and
+    resumes, streams stay bitwise."""
+    monkeypatch.setenv("DS_STAGE_FAULT", f"{stage}:{point}:1")
+    reset_fault_injection()
+    streams, shared, stats = _two_turns(
+        _tier(tmp_path, budget=0), idle=IDLE + 3, collect=_tier_stats)
+    assert streams == _baseline("plain")
+    assert stats["fails"] == 1
+    assert not stats["spill_deg"] and not stats["fetch_deg"]
+    assert stats["fetched"] > 0 and stats["corrupt"] == 0
+    assert shared >= 16
+
+
+@pytest.mark.parametrize("stage,point", POINTS)
+def test_sticky_fault_degrades_once_and_keeps_serving(
+        stage, point, tmp_path, monkeypatch, ds_caplog):
+    """A sticky fault at each point exhausts the budget: the stage
+    degrades with ONE loud warning (spill -> sessions stay
+    HBM-resident, fetch -> recompute-from-prompt), every request of
+    every turn still completes, and the streams are bitwise the
+    never-spilled engine's — zero lost requests."""
+    monkeypatch.setenv("DS_STAGE_FAULT", f"{stage}:{point}:1+")
+    reset_fault_injection()
+    model, params = _model_params()
+    eng = ServeEngine(model, _serve_cfg(**_tier(tmp_path, budget=0)),
+                      params=params)
+    r1 = eng.submit(_p1(), max_new_tokens=4)
+    eng.run_until_idle()
+    for _ in range(IDLE + 3):
+        eng.step()
+    r2 = eng.submit(_p2(), max_new_tokens=4)
+    eng.run_until_idle()
+    # zero lost requests: a brand-new session still serves afterwards
+    r3 = eng.submit(list(_tokens(9, seed=44)), max_new_tokens=3)
+    eng.run_until_idle()
+    tier = eng.kv_tier
+    degraded = tier.spill_stage.degraded or tier.fetch_stage.degraded
+    corrupt = tier.corrupt_total
+    eng.close()
+    assert [r.error for r in (r1, r2, r3)] == [None, None, None]
+    assert (list(r1.tokens), list(r2.tokens)) == _baseline("plain")
+    assert degraded and corrupt == 0
+    warns = [r for r in ds_caplog.records
+             if "failure budget" in r.getMessage()]
+    assert len(warns) == 1, "degradation must warn exactly ONCE"
+    assert eng.pool.refs == {}
+
+
+def test_degraded_spill_goes_dormant(tmp_path, monkeypatch):
+    """After kv_spill degrades, parking stops for the rest of the run:
+    later idle sessions stay HBM-resident (the prefix cache keeps
+    their pages) instead of half-parking through a failing tier."""
+    monkeypatch.setenv("DS_STAGE_FAULT", "kv_spill:pageout:1+")
+    reset_fault_injection()
+    model, params = _model_params()
+    eng = ServeEngine(model, _serve_cfg(**_tier(tmp_path, budget=0)),
+                      params=params)
+    r1 = eng.submit(_p1(), max_new_tokens=4)
+    eng.run_until_idle()
+    for _ in range(IDLE + 3):
+        eng.step()
+    assert eng.kv_tier.spill_stage.degraded
+    parked_at_degrade = eng.kv_tier.parked_pages_total
+    # a second session goes idle — with the tier dormant it must stay
+    # in the prefix cache, not the tier
+    r2 = eng.submit(list(_tokens(17, seed=55)), max_new_tokens=4)
+    eng.run_until_idle()
+    for _ in range(IDLE + 3):
+        eng.step()
+    assert eng.kv_tier.parked_pages_total == parked_at_degrade
+    assert eng.prefix.entries > 0
+    assert r1.error is None and r2.error is None
+    eng.close()
+    assert eng.pool.refs == {}
+
+
+# ---------------------------------------------------------------------------
+# corruption matrix: typed error + recompute fallback, never a poison
+# ---------------------------------------------------------------------------
+
+
+def _flip(path):
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _truncate(path):
+    with open(path, "r+b") as f:
+        f.truncate(10)
+
+
+@pytest.mark.parametrize("damage", [_flip, _truncate, os.unlink],
+                         ids=["crc_flip", "truncate", "unlink"])
+def test_disk_damage_falls_back_to_recompute(damage, tmp_path):
+    """Every parked disk page damaged mid-gap (CRC flip, truncation,
+    deletion): resume hits the typed ``KVTierCorruptError`` BEFORE any
+    byte re-enters the pool, drops the record, and recomputes from the
+    prompt — turn 2 is still bitwise correct, nothing is lost."""
+    def corrupt(eng):
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".page")]
+        assert files, "nothing parked to disk — the test lost its prey"
+        for fn in files:
+            damage(os.path.join(str(tmp_path), fn))
+
+    streams, shared, stats = _two_turns(
+        _tier(tmp_path, budget=0), idle=IDLE + 3, between=corrupt,
+        collect=_tier_stats)
+    assert streams == _baseline("plain")
+    assert stats["corrupt"] >= 1
+    assert stats["fetched"] == 0      # no damaged byte reached the pool
+    assert not stats["fetch_deg"]     # typed, not transient: no budget
+
+
+def test_poisoned_host_copy_reverifies_at_pagein(tmp_path):
+    """The host tier re-verifies too: a corrupted host-resident payload
+    fails its CRC stamp at page-in and resume recomputes — the stamp
+    taken at park time gates EVERY re-entry, not just the disk path."""
+    def poison(eng):
+        recs = list(eng.kv_tier._full.values())
+        assert recs
+        for rec in recs:
+            rec.payload = bytes(len(rec.payload))
+
+    streams, _, stats = _two_turns(
+        _tier(None, budget=256), idle=IDLE + 3, between=poison,
+        collect=_tier_stats)
+    assert streams == _baseline("plain")
+    assert stats["corrupt"] >= 1 and stats["fetched"] == 0
+
+
+def test_corrupt_error_is_typed_not_transient():
+    """``KVTierCorruptError`` is the checkpoint family's corrupt error
+    and NOT an ``OSError`` — ``Stage.call`` propagates it on the first
+    hit instead of burning the retry budget on a deterministic CRC
+    mismatch."""
+    assert issubclass(KVTierCorruptError, CheckpointCorruptError)
+    assert not issubclass(KVTierCorruptError, OSError)
+
+
+# ---------------------------------------------------------------------------
+# the disk-store dialect (PR 15's leaf-state format, verbatim)
+# ---------------------------------------------------------------------------
+
+
+def test_disk_store_roundtrip(tmp_path):
+    st = KVTierDiskStore(str(tmp_path), fsync=False)
+    payload = bytes(np.random.default_rng(3).integers(
+        0, 256, 4096).astype(np.uint8))
+    assert st.write("abc", payload) == 4096
+    assert st.read("abc") == payload
+    assert os.path.basename(st.path("abc")) == "kv_abc.page"
+    # tmp+rename: no .tmp survivors under the real names
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    st.remove("abc")
+    with pytest.raises(KVTierCorruptError, match="missing"):
+        st.read("abc")
+    st.remove("abc")                     # best-effort: no raise
+
+
+@pytest.mark.parametrize("mutate,msg", [
+    (lambda p: open(p, "r+b").write(b"XXXXXXXX"), "bad magic"),
+    (lambda p: _truncate(p), "truncated in its header"),
+    (lambda p: _flip(p), "CRC"),
+], ids=["magic", "header", "crc"])
+def test_disk_store_detects_corruption(tmp_path, mutate, msg):
+    st = KVTierDiskStore(str(tmp_path), fsync=False)
+    st.write("x", b"\x01\x02\x03\x04" * 64)
+    mutate(st.path("x"))
+    with pytest.raises(KVTierCorruptError, match=msg):
+        st.read("x")
+
+
+def test_disk_store_shares_the_checkpoint_magic(tmp_path):
+    """One on-disk dialect: a parked page file opens with the SAME
+    magic as PR 15's leaf-state files."""
+    from deepspeed_tpu.inference.kv_tier import _MAGIC
+    from deepspeed_tpu.runtime.disk_offload import _MAGIC as CKPT_MAGIC
+    assert _MAGIC == CKPT_MAGIC
+
+
+# ---------------------------------------------------------------------------
+# close plane: drain barrier, idempotence, defaults
+# ---------------------------------------------------------------------------
+
+
+def test_drain_writes_every_host_copy_to_disk(tmp_path):
+    """The ``kv_spill`` graph drain: every host-resident parked page is
+    written back before close, and the ``kv_fetch`` close then drops
+    the records and their files — nothing leaks on either tier."""
+    model, params = _model_params()
+    eng = ServeEngine(model, _serve_cfg(**_tier(tmp_path, budget=256)),
+                      params=params)
+    eng.submit(_p1(), max_new_tokens=4)
+    eng.run_until_idle()
+    for _ in range(IDLE + 3):
+        eng.step()
+    tier = eng.kv_tier
+    assert tier.parked_pages >= 2 and tier._host_pages > 0
+    n = tier.drain()
+    assert n >= 2 and tier._host_pages == 0
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".page")]
+    assert len(files) == tier.parked_pages
+    eng.close()
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".page")]
+    assert eng.pool.refs == {}
+
+
+def test_close_is_idempotent_with_parked_sessions(tmp_path):
+    model, params = _model_params()
+    eng = ServeEngine(model, _serve_cfg(**_tier(tmp_path, budget=1)),
+                      params=params)
+    eng.submit(_p1(), max_new_tokens=4)
+    eng.run_until_idle()
+    for _ in range(IDLE + 3):
+        eng.step()
+    assert eng.kv_tier.parked_pages >= 2
+    eng.close()
+    eng.close()
+    assert eng.kv_tier.parked_pages == 0
+    assert eng.pool.refs == {}
+
+
+def test_tier_off_by_default_builds_no_tier():
+    """idle_park_ticks=0 (the default) means NO tier object — the
+    paged engine is bitwise the pre-tier engine."""
+    model, params = _model_params()
+    eng = ServeEngine(model, _serve_cfg(), params=params)
+    assert eng.kv_tier is None
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# config validation + telemetry
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv,msg", [
+    ("nope", "must be a dict"),
+    ({"bogus": 1}, "unknown key"),
+    ({"idle_park_ticks": -1}, "int >= 0"),
+    ({"idle_park_ticks": True}, "int >= 0"),
+    ({"host_budget_pages": -2}, "int >= 0"),
+    ({"disk_dir": 7}, "string"),
+    ({"fsync": "yes"}, "bool"),
+], ids=["dict", "unknown", "neg_ticks", "bool_ticks", "neg_budget",
+        "dir_type", "fsync_type"])
+def test_kv_tier_config_validation(kv, msg):
+    with pytest.raises(DeepSpeedConfigError, match=msg):
+        ServeEngine(GPT2Model(TINY), _serve_cfg(kv_tier=kv))
+
+
+def test_kv_tier_requires_the_paged_plane():
+    cfg = {"serving": {"slots": 2, "max_seq_len": 32, "prefill_len": 16,
+                       "kv_tier": {"idle_park_ticks": 2}}}
+    with pytest.raises(DeepSpeedConfigError, match="page_len"):
+        ServeEngine(GPT2Model(TINY), cfg)
+
+
+def test_kv_tier_telemetry_flows_to_summarize(tmp_path, capsys):
+    from deepspeed_tpu.telemetry.cli import summarize
+    tel = tmp_path / "tel"
+    disk = tmp_path / "disk"
+    _, _, stats = _two_turns(_tier(disk, budget=0), idle=IDLE + 3,
+                             telemetry_path=tel, collect=_tier_stats)
+    rep = summarize(os.path.join(str(tel), "events.jsonl"))
+    assert rep["serve_kv_spill_bytes_total"] == stats["spill"]
+    assert rep["serve_kv_fetch_bytes_total"] == stats["fetched"]
+    assert rep["serve_kv_parked_sessions"] is not None
+    assert rep["serve_kv_resume_p99_s"] is not None
+    out = capsys.readouterr().out
+    assert "kv tier" in out

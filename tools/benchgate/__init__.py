@@ -90,6 +90,10 @@ METRIC_DIRECTIONS = {
     # headline — HIGHER is better (docs/serving.md "multi-tenant
     # serving")
     "serve_lora_tenants_per_byte": False,
+    # KV tiering: sessions resumable from the parked tier at a fixed
+    # HBM page budget, relative to the HBM-only engine — more parked
+    # sessions per HBM byte is the tier's whole point
+    "kv_tier_sessions_per_hbm_byte": False,
 }
 
 

@@ -80,6 +80,7 @@ def replay_engine(model, params, serving: dict,
                   collect: Optional[Callable] = None,
                   draft_params=None,
                   max_ticks: int = 100_000,
+                  idle_tick: bool = False,
                   tag: str = "leg") -> EngineRun:
     """Replay a workload schedule against one ``ServeEngine``.
 
@@ -93,6 +94,12 @@ def replay_engine(model, params, serving: dict,
     ``collect`` called with the still-open engine after the drain —
                 the scenario's seam for cache-byte asserts, spec
                 counters, prefix stats.
+    ``idle_tick`` keep stepping the (empty) engine while waiting for
+                the next arrival instead of sleeping through the gap —
+                session think-time then advances the engine's tick
+                clock, which is what the KV tier's ``idle_park_ticks``
+                idleness measure counts (docs/serving.md "KV
+                tiering").
     """
     from deepspeed_tpu.inference import ServeEngine
     from deepspeed_tpu.telemetry.cli import (_read_jsonl_tolerant,
@@ -140,6 +147,13 @@ def replay_engine(model, params, serving: dict,
                     nxt += 1
                 if not eng.scheduler.active and not eng._pending \
                         and eng.queue.qsize() == 0:
+                    if idle_tick:
+                        # idle ticks advance the engine clock (the KV
+                        # tier's idleness measure) instead of freezing
+                        # it through the think-time gap
+                        eng.step()
+                        ticks += 1
+                        continue
                     # idle but arrivals pending: wait for the next one
                     time.sleep(min(0.002,
                                    max(arrivals[nxt] - now, 0.0)))

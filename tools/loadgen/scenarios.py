@@ -1078,6 +1078,137 @@ def run_goodput(n_requests=48, prompt_len=6, gen_tokens=8, slots=4,
     return rec
 
 
+
+
+# ---------------------------------------------------------------------------
+# kv_tier: park idle sessions on host/disk and resume them (docs/
+# serving.md "KV tiering")
+# ---------------------------------------------------------------------------
+
+
+def run_kv_tier(n_sessions=8, prompt_len=17, cont_len=8, gen_tokens=4,
+                page_len=8, pages=12, slots=4, idle_park_ticks=3,
+                host_budget_pages=2, think_s=0.4, out_dir="."):
+    """The KV-tiering headline A/B (BENCH_kv_tier.json): ``n_sessions``
+    two-turn conversations over the SAME small page pool — a wave of
+    first turns, ``idle_gap_s`` of think-time (the ``Workload`` session
+    machinery), then a wave of continuations whose prompts extend turn
+    one.  The tiered arm parks idle prefix pages to host RAM and disk
+    (both tiers exercised: ``host_budget_pages`` < the parked set) and
+    resumes every session from the tier; the HBM-only arm must evict
+    cached prefixes under the same pool pressure and recompute.  The
+    pinned headline is the ratio of sessions resumed with their full
+    prefix at the SAME fixed HBM page budget — sessions per HBM byte.
+
+    Riders: (1) bitwise parity — the tiered arm's token streams equal
+    the HBM-only arm's (park/resume or recompute, never a diverged
+    stream); (2) the tier actually moved bytes through BOTH tiers
+    (spill and fetch counters, disk hits); (3) zero lost requests."""
+    import dataclasses as _dc
+
+    model, params = _init_model()
+    S = n_sessions
+    wl = Workload(2 * S,
+                  arrival=ArrivalSpec("uniform", period=0.05),
+                  prompt_len=LengthSpec(value=prompt_len),
+                  gen_tokens=LengthSpec(value=gen_tokens),
+                  session_len=S, idle_gap_s=think_s)
+    items = wl.build(seed=0)
+    # rewrite payloads into per-session two-turn conversations: item i
+    # is conversation i's first turn, item S+i extends it by cont_len
+    # tokens — identical across arms by construction
+    convs = []
+    for s in range(S):
+        rng = np.random.default_rng([11, s])
+        base = [int(t) for t in rng.integers(1, 256, (prompt_len,))]
+        cont = [int(t) for t in rng.integers(1, 256, (cont_len,))]
+        convs.append((tuple(base), tuple(base + cont)))
+    items = [_dc.replace(it,
+                         prompt=convs[i % S][0 if i < S else 1])
+             for i, it in enumerate(items)]
+    assert items[S].at_s - items[S - 1].at_s >= think_s, \
+        "session gap did not land between the turn waves"
+    warm_rng = np.random.default_rng([11, 999])
+    warm = [int(t) for t in warm_rng.integers(1, 256, (6,))]
+
+    serving = {"slots": slots, "max_seq_len": 64,
+               "prefill_len": prompt_len + cont_len + 7,
+               "page_len": page_len, "pages": pages,
+               "queue_capacity": 64}
+    full_prefix = (prompt_len // page_len) * page_len
+
+    def _tier_stats(eng):
+        t = eng.kv_tier
+        if t is None:
+            return {"spill_bytes": 0, "fetch_bytes": 0,
+                    "parked_pages_total": 0, "resumed_pages": 0,
+                    "corrupt": 0, "hbm_kv_bytes": eng.kv_bytes}
+        return {"spill_bytes": t.spill_bytes,
+                "fetch_bytes": t.fetch_bytes,
+                "parked_pages_total": t.parked_pages_total,
+                "resumed_pages": t.resumed_pages_total,
+                "corrupt": t.corrupt_total,
+                "resume_p99_s": t.resume_p99_s(),
+                "hbm_kv_bytes": eng.kv_bytes}
+
+    import tempfile
+    disk_dir = tempfile.mkdtemp(prefix="loadgen_kvtier_")
+    tiered = replay_engine(
+        model, params,
+        {**serving, "kv_tier": {"idle_park_ticks": idle_park_ticks,
+                                "host_budget_pages": host_budget_pages,
+                                "disk_dir": disk_dir}},
+        items, warmup=(warm, 2), idle_tick=True,
+        collect=_tier_stats, tag="kv_tiered")
+    base = replay_engine(
+        model, params, serving, items, warmup=(warm, 2),
+        idle_tick=True, collect=_tier_stats, tag="kv_base")
+
+    # bitwise parity: tiered resume (or its recompute fallback) must
+    # never diverge a stream
+    for rt, rb in zip(tiered.requests, base.requests):
+        assert rt.tokens == rb.tokens, \
+            "tiered arm diverged from the HBM-only arm"
+
+    def _resumed(run):
+        return sum(1 for r in run.requests[S:]
+                   if r.shared_len >= full_prefix)
+
+    resumed_tiered = _resumed(tiered)
+    resumed_base = _resumed(base)
+    ts = tiered.stats
+    assert ts["spill_bytes"] > 0 and ts["fetch_bytes"] > 0, ts
+    assert ts["corrupt"] == 0, ts
+    assert resumed_tiered > resumed_base, \
+        (resumed_tiered, resumed_base)
+    hbm_bytes = ts["hbm_kv_bytes"]
+    value = ((resumed_tiered / hbm_bytes)
+             / max(resumed_base / hbm_bytes, 1.0 / hbm_bytes))
+
+    rec = {
+        "metric": "kv_tier_sessions_per_hbm_byte",
+        "value": value,
+        "n_sessions": S,
+        "page_len": page_len,
+        "pages": pages,
+        "idle_park_ticks": idle_park_ticks,
+        "host_budget_pages": host_budget_pages,
+        "think_s": think_s,
+        "hbm_kv_bytes": hbm_bytes,
+        "sessions_resumed": {"tiered": resumed_tiered,
+                             "hbm_only": resumed_base},
+        "sessions_per_hbm_byte": {
+            "tiered": resumed_tiered / hbm_bytes,
+            "hbm_only": resumed_base / hbm_bytes,
+        },
+        "tiered": {"tokens": tiered.tokens, "wall_s": tiered.wall_s,
+                   "ticks": tiered.ticks, **ts},
+        "hbm_only": {"tokens": base.tokens, "wall_s": base.wall_s,
+                     "ticks": base.ticks},
+    }
+    _write_bench(out_dir, "BENCH_kv_tier.json", rec)
+    return rec
+
 #: scenario registry — ``python -m tools.loadgen <name>``
 SCENARIOS = {
     "serve": run_ab,
@@ -1088,4 +1219,5 @@ SCENARIOS = {
     "fleet_disagg": run_fleet_disagg,
     "goodput": run_goodput,
     "lora": run_lora,
+    "kv_tier": run_kv_tier,
 }
